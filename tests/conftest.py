@@ -1,0 +1,18 @@
+"""Test harness config: force an 8-device virtual CPU mesh so multi-chip
+sharding paths compile and execute without TPU hardware (the driver's
+dryrun_multichip does the same)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_staging(tmp_path):
+    return str(tmp_path / "staging")

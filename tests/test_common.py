@@ -1,0 +1,172 @@
+"""Tests for counters, dispatcher, state machine, config."""
+import enum
+
+import pytest
+
+from tez_tpu.common import config as C
+from tez_tpu.common.counters import (CounterLimitExceeded, DAGCounter, Limits,
+                                     TaskCounter, TezCounters)
+from tez_tpu.common.dispatcher import DrainDispatcher, Dispatcher, Event
+from tez_tpu.common.ids import DAGId, new_app_id
+from tez_tpu.common.statemachine import (InvalidStateTransition,
+                                         StateMachineFactory)
+
+
+class Color(enum.Enum):
+    PING = 1
+    PONG = 2
+
+
+class Ev(Event):
+    def __init__(self, t):
+        super().__init__(t)
+
+
+def test_dispatcher_routes_by_enum_class():
+    d = DrainDispatcher()
+    got = []
+    d.register(Color, lambda e: got.append(e.event_type))
+    d.dispatch(Ev(Color.PING))
+    d.dispatch(Ev(Color.PONG))
+    assert d.drain() == 2
+    assert got == [Color.PING, Color.PONG]
+
+
+def test_dispatcher_handler_enqueues_more():
+    d = DrainDispatcher()
+    got = []
+
+    def handler(e):
+        got.append(e.event_type)
+        if e.event_type is Color.PING:
+            d.dispatch(Ev(Color.PONG))
+
+    d.register(Color, handler)
+    d.dispatch(Ev(Color.PING))
+    d.drain()
+    assert got == [Color.PING, Color.PONG]
+
+
+def test_threaded_dispatcher_drains():
+    d = Dispatcher()
+    got = []
+    d.register(Color, lambda e: got.append(1))
+    d.start()
+    for _ in range(100):
+        d.dispatch(Ev(Color.PING))
+    assert d.await_drained(5)
+    d.stop()
+    assert len(got) == 100
+
+
+def test_multi_handler_fanout():
+    d = DrainDispatcher()
+    a, b = [], []
+    d.register(Color, lambda e: a.append(1))
+    d.register(Color, lambda e: b.append(1))
+    d.dispatch(Ev(Color.PING))
+    d.drain()
+    assert a == [1] and b == [1]
+
+
+class TState(enum.Enum):
+    NEW = 1
+    RUNNING = 2
+    DONE = 3
+    FAILED = 4
+
+
+class TEvent(enum.Enum):
+    START = 1
+    FINISH = 2
+    CRASH = 3
+
+
+def test_state_machine_transitions():
+    f = StateMachineFactory(TState.NEW)
+    f.add(TState.NEW, TState.RUNNING, TEvent.START)
+    f.add_multi(TState.RUNNING, (TState.DONE, TState.FAILED), TEvent.FINISH,
+                lambda entity, ev: TState.DONE if ev.ok else TState.FAILED)
+
+    class E:
+        pass
+
+    class FinishEv:
+        event_type = TEvent.FINISH
+
+        def __init__(self, ok):
+            self.ok = ok
+
+    class StartEv:
+        event_type = TEvent.START
+
+    sm = f.make(E())
+    assert sm.state is TState.NEW
+    sm.handle(StartEv())
+    assert sm.state is TState.RUNNING
+    sm.handle(FinishEv(ok=False))
+    assert sm.state is TState.FAILED
+    with pytest.raises(InvalidStateTransition):
+        sm.handle(StartEv())
+
+
+def test_state_change_callback():
+    f = StateMachineFactory(TState.NEW)
+    f.add(TState.NEW, TState.RUNNING, TEvent.START)
+    changes = []
+
+    class StartEv:
+        event_type = TEvent.START
+
+    sm = f.make(object(), on_state_change=lambda e, o, n: changes.append((o, n)))
+    sm.handle(StartEv())
+    assert changes == [(TState.NEW, TState.RUNNING)]
+
+
+def test_counters_aggregate():
+    t1, t2, v = TezCounters(), TezCounters(), TezCounters()
+    t1.increment(TaskCounter.OUTPUT_RECORDS, 10)
+    t2.increment(TaskCounter.OUTPUT_RECORDS, 5)
+    t2.increment(DAGCounter.NUM_SUCCEEDED_TASKS)
+    v.aggregate(t1)
+    v.aggregate(t2)
+    assert v.find_counter(TaskCounter.OUTPUT_RECORDS).value == 15
+    assert v.find_counter(DAGCounter.NUM_SUCCEEDED_TASKS).value == 1
+    d = v.to_dict()
+    assert d["TaskCounter"]["OUTPUT_RECORDS"] == 15
+    assert TezCounters.from_dict(d).find_counter(
+        TaskCounter.OUTPUT_RECORDS).value == 15
+
+
+def test_counter_group_limit():
+    c = TezCounters()
+    g = c.group("g")
+    for i in range(Limits.MAX_COUNTERS):
+        g.find_counter(f"c{i}")
+    with pytest.raises(CounterLimitExceeded):
+        g.find_counter("one-too-many")
+
+
+def test_config_keys_and_scopes():
+    conf = C.TezConfiguration()
+    assert conf.get(C.IO_SORT_MB) == 256
+    conf.set(C.IO_SORT_MB, 64)
+    assert conf.get(C.IO_SORT_MB) == 64
+    assert C.IO_SORT_MB.scope is C.Scope.VERTEX
+    sub = C.runtime_conf_subset(
+        {"tez.runtime.io.sort.mb": 1, "tez.am.foo": 2})
+    assert sub == {"tez.runtime.io.sort.mb": 1}
+    merged = conf.merged({"x": 1})
+    assert merged["x"] == 1 and merged.get(C.IO_SORT_MB) == 64
+
+
+def test_ids_format():
+    app = new_app_id(123)
+    d = DAGId(app, 1)
+    v = d.vertex(2)
+    t = v.task(3)
+    a = t.attempt(0)
+    assert str(v).startswith("vertex_")
+    assert str(a).startswith("attempt_")
+    assert a.dag_id is d
+    assert sorted([t.attempt(1), a]) == [a, t.attempt(1)]

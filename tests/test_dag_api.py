@@ -1,0 +1,134 @@
+"""DAG DSL tests mirroring tez-api's TestDAGVerify / TestDAGPlan."""
+import pickle
+
+import pytest
+
+from tez_tpu.common.payload import (InputDescriptor, OutputDescriptor,
+                                    ProcessorDescriptor, UserPayload)
+from tez_tpu.dag.dag import DAG, Edge, TezUncheckedException, Vertex
+from tez_tpu.dag.edge_property import (DataMovementType, DataSourceType,
+                                       EdgeProperty, SchedulingType)
+from tez_tpu.dag.plan import DAGPlan
+
+
+def proc(name="p"):
+    return ProcessorDescriptor.create("tez_tpu.library.processors:SimpleProcessor")
+
+
+def sg_edge(a, b):
+    return Edge.create(a, b, EdgeProperty.create(
+        DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create("x:O"), InputDescriptor.create("x:I")))
+
+
+def test_linear_dag_verifies():
+    a, b, c = Vertex.create("a", proc(), 2), Vertex.create("b", proc(), 2), \
+        Vertex.create("c", proc(), 1)
+    dag = DAG.create("d").add_vertex(a).add_vertex(b).add_vertex(c)
+    dag.add_edge(sg_edge(a, b)).add_edge(sg_edge(b, c))
+    assert dag.verify() == ["a", "b", "c"]
+
+
+def test_cycle_rejected():
+    a, b = Vertex.create("a", proc(), 1), Vertex.create("b", proc(), 1)
+    dag = DAG.create("d").add_vertex(a).add_vertex(b)
+    dag.add_edge(sg_edge(a, b)).add_edge(sg_edge(b, a))
+    with pytest.raises(TezUncheckedException, match="cycle"):
+        dag.verify()
+
+
+def test_self_edge_rejected():
+    a = Vertex.create("a", proc(), 1)
+    dag = DAG.create("d").add_vertex(a)
+    dag.add_edge(sg_edge(a, a))
+    with pytest.raises(TezUncheckedException, match="self-edge"):
+        dag.verify()
+
+
+def test_duplicate_vertex_rejected():
+    dag = DAG.create("d").add_vertex(Vertex.create("a", proc(), 1))
+    with pytest.raises(TezUncheckedException, match="duplicate"):
+        dag.add_vertex(Vertex.create("a", proc(), 1))
+
+
+def test_edge_with_foreign_vertex_rejected():
+    a = Vertex.create("a", proc(), 1)
+    b = Vertex.create("b", proc(), 1)
+    dag = DAG.create("d").add_vertex(a)
+    with pytest.raises(TezUncheckedException, match="not part of DAG"):
+        dag.add_edge(sg_edge(a, b))
+
+
+def test_disconnected_rejected():
+    a, b, c, d = (Vertex.create(n, proc(), 1) for n in "abcd")
+    dag = DAG.create("d")
+    for v in (a, b, c, d):
+        dag.add_vertex(v)
+    dag.add_edge(sg_edge(a, b)).add_edge(sg_edge(c, d))
+    with pytest.raises(TezUncheckedException, match="disconnected"):
+        dag.verify()
+
+
+def test_one_to_one_parallelism_mismatch_rejected():
+    a, b = Vertex.create("a", proc(), 2), Vertex.create("b", proc(), 3)
+    e = Edge.create(a, b, EdgeProperty.create(
+        DataMovementType.ONE_TO_ONE, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create("x:O"), InputDescriptor.create("x:I")))
+    dag = DAG.create("d").add_vertex(a).add_vertex(b).add_edge(e)
+    with pytest.raises(TezUncheckedException, match="ONE_TO_ONE"):
+        dag.verify()
+
+
+def test_bad_parallelism_rejected():
+    with pytest.raises(TezUncheckedException):
+        Vertex.create("a", proc(), 0)
+    with pytest.raises(TezUncheckedException):
+        Vertex.create("a", proc(), -2)
+
+
+def test_plan_roundtrip():
+    a, b = Vertex.create("a", proc(), 2), Vertex.create("b", proc(), 4)
+    a.set_conf("tez.runtime.io.sort.mb", 64)
+    dag = DAG.create("d").add_vertex(a).add_vertex(b).add_edge(sg_edge(a, b))
+    plan = dag.create_dag_plan({"k": "v"})
+    plan2 = DAGPlan.deserialize(plan.serialize())
+    assert plan2.name == "d"
+    assert [v.name for v in plan2.vertices] == ["a", "b"]
+    assert plan2.vertex("a").out_edge_ids == ("a->b",)
+    assert plan2.vertex("b").in_edge_ids == ("a->b",)
+    assert plan2.vertex("a").conf["tez.runtime.io.sort.mb"] == 64
+    assert plan2.dag_conf["k"] == "v"
+    assert plan2.edge("a->b").edge_property.data_movement_type is \
+        DataMovementType.SCATTER_GATHER
+
+
+def test_vertex_group_plan():
+    a, b, c = (Vertex.create(n, proc(), 2) for n in "abc")
+    dag = DAG.create("d")
+    for v in (a, b, c):
+        dag.add_vertex(v)
+    g = dag.create_vertex_group("g", [a, b])
+    from tez_tpu.dag.dag import GroupInputEdge
+    from tez_tpu.common.payload import EntityDescriptor
+    ep = EdgeProperty.create(
+        DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create("x:O"), InputDescriptor.create("x:I"))
+    dag.add_group_edge(GroupInputEdge.create(
+        g, c, ep, EntityDescriptor.create("x:Merged")))
+    plan = dag.create_dag_plan()
+    assert len(plan.group_edges) == 1
+    # group edge expands to one member edge each
+    member_edges = [e for e in plan.edges if "#group:" in e.id]
+    assert {e.input_vertex for e in member_edges} == {"a", "b"}
+    assert plan.vertex("c").in_edge_ids == tuple(e.id for e in member_edges)
+
+
+def test_user_payload_roundtrip():
+    p = UserPayload.of({"a": 1})
+    assert p.load() == {"a": 1}
+    assert UserPayload.of(b"raw").load() == b"raw"
+    assert UserPayload.of(None).load() is None
+    assert pickle.loads(pickle.dumps(p)).load() == {"a": 1}

@@ -1,0 +1,115 @@
+"""InputInitializer and OutputCommitter SPIs.
+
+Reference parity: tez-api/.../runtime/api/InputInitializer.java:36 (AM-side
+root-input planning: compute splits -> InputDataInformationEvents + suggested
+parallelism) and OutputCommitter.java (per-output commit/abort, optionally
+deferred to DAG success).
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, List, Optional, Sequence
+
+from tez_tpu.api.events import (InputDataInformationEvent,
+                                InputInitializerEvent)
+from tez_tpu.common.payload import UserPayload
+
+
+class InputInitializerContext(abc.ABC):
+    @property
+    @abc.abstractmethod
+    def input_name(self) -> str: ...
+
+    @property
+    @abc.abstractmethod
+    def vertex_name(self) -> str: ...
+
+    @property
+    @abc.abstractmethod
+    def dag_name(self) -> str: ...
+
+    @property
+    @abc.abstractmethod
+    def user_payload(self) -> UserPayload: ...
+
+    @property
+    @abc.abstractmethod
+    def num_tasks(self) -> int:
+        """Vertex parallelism as declared (-1 = initializer decides)."""
+
+    @abc.abstractmethod
+    def get_total_available_resource(self) -> int: ...
+
+    @abc.abstractmethod
+    def get_vertex_num_tasks(self, vertex_name: str) -> int: ...
+
+    @abc.abstractmethod
+    def register_for_vertex_state_updates(self, vertex_name: str,
+                                          states: Sequence[str]) -> None: ...
+
+
+@dataclasses.dataclass
+class InputConfigureVertexTasksEvent:
+    """Initializer asks the framework to set parallelism
+    (reference: events/InputConfigureVertexTasksEvent.java)."""
+    num_tasks: int
+    location_hints: Any = None
+
+
+class InputInitializer(abc.ABC):
+    """Reference: InputInitializer.java:36."""
+
+    def __init__(self, context: InputInitializerContext):
+        self.context = context
+
+    @abc.abstractmethod
+    def initialize(self) -> List[Any]:
+        """Returns a list of events: InputDataInformationEvent per split and
+        optionally one InputConfigureVertexTasksEvent."""
+
+    def handle_input_initializer_event(
+            self, events: List[InputInitializerEvent]) -> None:
+        pass
+
+    def on_vertex_state_updated(self, update: Any) -> None:
+        pass
+
+
+class OutputCommitterContext(abc.ABC):
+    @property
+    @abc.abstractmethod
+    def output_name(self) -> str: ...
+
+    @property
+    @abc.abstractmethod
+    def vertex_name(self) -> str: ...
+
+    @property
+    @abc.abstractmethod
+    def user_payload(self) -> UserPayload: ...
+
+
+class OutputCommitter(abc.ABC):
+    """Reference: OutputCommitter.java."""
+
+    def __init__(self, context: OutputCommitterContext):
+        self.context = context
+
+    @abc.abstractmethod
+    def initialize(self) -> None: ...
+
+    @abc.abstractmethod
+    def setup_output(self) -> None: ...
+
+    @abc.abstractmethod
+    def commit_output(self) -> None: ...
+
+    @abc.abstractmethod
+    def abort_output(self, final_state: str) -> None: ...
+
+    def is_task_recovery_supported(self) -> bool:
+        return False
+
+    def recover_task(self, task_index: int, previous_dag_attempt: int) -> None:
+        pass

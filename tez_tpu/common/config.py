@@ -1,0 +1,191 @@
+"""Typed configuration with scope annotations.
+
+Reference parity: tez-api/.../TezConfiguration.java (238 keys,
+@ConfigurationScope annotations) and TezRuntimeConfiguration.java (70 runtime
+keys filtered into per-IO payloads via the edge config builders).  The design
+rule kept from the reference: *runtime config travels inside the edge payload,
+not global files* (SURVEY.md §5.6).
+
+TPU-first deltas: memory keys budget HBM instead of JVM heap; sorter/shuffle
+keys configure device kernels (span bytes = HBM block size, io factor = k-way
+merge width on device).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Iterator, Mapping
+
+
+_UNSET = object()
+
+
+class Scope(enum.Enum):
+    """Reference: ConfigurationScope.java — where a key may be overridden."""
+    AM = "am"
+    DAG = "dag"
+    VERTEX = "vertex"
+    CLIENT = "client"
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfKey:
+    name: str
+    default: Any
+    scope: Scope
+    doc: str = ""
+
+    def __call__(self, conf: "TezConfiguration") -> Any:
+        return conf.get(self)
+
+
+_REGISTRY: dict[str, ConfKey] = {}
+
+
+def _key(name: str, default: Any, scope: Scope, doc: str = "") -> ConfKey:
+    k = ConfKey(name, default, scope, doc)
+    _REGISTRY[name] = k
+    return k
+
+
+class TezConfiguration(dict):
+    """String-keyed config map with typed accessors.
+
+    Mirrors Hadoop `Configuration` usage in the reference but is a plain dict
+    so it pickles into payloads cheaply.
+    """
+
+    def get_key(self, key: "ConfKey | str", default: Any = _UNSET) -> Any:
+        return self.get(key, default)
+
+    def get(self, key: Any, default: Any = _UNSET) -> Any:  # type: ignore[override]
+        """Precedence: stored value > caller-supplied default > registered
+        ConfKey default > None."""
+        if isinstance(key, ConfKey):
+            name, reg_default = key.name, key.default
+        else:
+            name = key
+            reg = _REGISTRY.get(key)
+            reg_default = reg.default if reg is not None else None
+        if name in self:
+            return self[name]
+        return reg_default if default is _UNSET else default
+
+    def set(self, key: "ConfKey | str", value: Any) -> "TezConfiguration":
+        self[key.name if isinstance(key, ConfKey) else key] = value
+        return self
+
+    def merged(self, other: Mapping | None) -> "TezConfiguration":
+        out = TezConfiguration(self)
+        if other:
+            out.update(other)
+        return out
+
+    def subset(self, prefix: str) -> "TezConfiguration":
+        return TezConfiguration(
+            {k: v for k, v in self.items() if k.startswith(prefix)})
+
+    @staticmethod
+    def registry() -> Iterator[ConfKey]:
+        return iter(_REGISTRY.values())
+
+
+# --------------------------------------------------------------------------
+# AM / framework keys (TezConfiguration.java analog)
+# --------------------------------------------------------------------------
+LOCAL_MODE = _key("tez.local.mode", True, Scope.CLIENT,
+                  "Run orchestrator in-process (reference: TezConfiguration.TEZ_LOCAL_MODE)")
+SESSION_MODE = _key("tez.session.mode", False, Scope.CLIENT,
+                    "Keep AM alive across DAGs")
+STAGING_DIR = _key("tez.staging-dir", "/tmp/tez-tpu-staging", Scope.CLIENT)
+AM_MAX_APP_ATTEMPTS = _key("tez.am.max.app.attempts", 2, Scope.AM)
+TASK_MAX_FAILED_ATTEMPTS = _key("tez.am.task.max.failed.attempts", 4, Scope.VERTEX,
+                                "Reference: TezConfiguration.TEZ_AM_TASK_MAX_FAILED_ATTEMPTS")
+MAX_ALLOWED_OUTPUT_FAILURES = _key("tez.am.max.allowed.output.failures", 10, Scope.VERTEX)
+MAX_ALLOWED_OUTPUT_FAILURES_FRACTION = _key(
+    "tez.am.max.allowed.output.failures.fraction", 0.1, Scope.VERTEX)
+NODE_BLACKLISTING_ENABLED = _key("tez.am.node-blacklisting.enabled", True, Scope.AM)
+NODE_BLACKLISTING_FAILURE_THRESHOLD = _key(
+    "tez.am.node-blacklisting.ignore-threshold-node-percent", 33, Scope.AM)
+AM_CONTAINER_REUSE_ENABLED = _key("tez.am.container.reuse.enabled", True, Scope.AM)
+AM_SESSION_MIN_HELD_CONTAINERS = _key("tez.am.session.min.held-containers", 0, Scope.AM)
+AM_CONTAINER_IDLE_RELEASE_TIMEOUT_MIN = _key(
+    "tez.am.container.idle.release-timeout-min.millis", 5000, Scope.AM)
+TASK_HEARTBEAT_TIMEOUT_MS = _key("tez.task.heartbeat.timeout-ms", 300_000, Scope.VERTEX)
+CONTAINER_HEARTBEAT_TIMEOUT_MS = _key("tez.container.heartbeat.timeout-ms", 300_000, Scope.AM)
+TASK_PROGRESS_STUCK_INTERVAL_MS = _key("tez.task.progress.stuck.interval-ms", -1, Scope.VERTEX)
+SPECULATION_ENABLED = _key("tez.am.speculation.enabled", False, Scope.VERTEX)
+SPECULATION_SLOWTASK_THRESHOLD = _key(
+    "tez.am.legacy.speculative.slowtask.threshold", 1.0, Scope.VERTEX)
+SPECULATION_ESTIMATOR = _key("tez.am.legacy.speculative.estimator.class",
+                             "simple_exponential", Scope.VERTEX)
+DAG_RECOVERY_ENABLED = _key("tez.dag.recovery.enabled", True, Scope.AM)
+DAG_RECOVERY_FLUSH_INTERVAL_SECS = _key("tez.dag.recovery.flush.interval.secs", 30, Scope.AM)
+HISTORY_LOGGING_SERVICE_CLASS = _key(
+    "tez.history.logging.service.class",
+    "tez_tpu.am.history:InMemoryHistoryLoggingService", Scope.AM)
+HISTORY_LOG_DIR = _key("tez.history.logging.log-dir", "", Scope.AM)
+AM_NUM_CONTAINERS = _key("tez.am.local.num-containers", 0, Scope.AM,
+                         "Local-mode executor slots; 0 = cpu count")
+GENERATE_DEBUG_ARTIFACTS = _key("tez.generate.debug.artifacts", False, Scope.DAG)
+AM_COMMIT_ALL_OUTPUTS_ON_SUCCESS = _key(
+    "tez.am.commit-all-outputs-on-dag-success", True, Scope.DAG,
+    "Reference: commit at DAG success vs per-vertex commit (DAGImpl commit modes)")
+AM_PREEMPTION_PERCENTAGE = _key("tez.am.preemption.percentage", 10, Scope.AM)
+AM_CLIENT_HEARTBEAT_TIMEOUT_SECS = _key("tez.am.client.heartbeat.timeout.secs", -1, Scope.AM)
+DAG_SCHEDULER_CLASS = _key("tez.am.dag.scheduler.class",
+                           "tez_tpu.am.dag_scheduler:DAGSchedulerNaturalOrder", Scope.AM)
+THREAD_DUMP_INTERVAL_MS = _key("tez.thread.dump.interval.ms", 0, Scope.VERTEX)
+
+# --------------------------------------------------------------------------
+# Runtime (per-edge / per-IO) keys (TezRuntimeConfiguration.java analog)
+# --------------------------------------------------------------------------
+RUNTIME_PREFIX = "tez.runtime."
+
+IO_SORT_MB = _key("tez.runtime.io.sort.mb", 256, Scope.VERTEX,
+                  "Device sort span budget (HBM MiB); reference: buffer for PipelinedSorter")
+IO_SORT_FACTOR = _key("tez.runtime.io.sort.factor", 64, Scope.VERTEX,
+                      "k-way merge width; reference: TezRuntimeConfiguration io.sort.factor")
+SORTER_CLASS = _key("tez.runtime.sorter.class", "device", Scope.VERTEX,
+                    "'device' (TPU radix/segmented sort) or 'host' (numpy fallback)")
+COMBINER_CLASS = _key("tez.runtime.combiner.class", "", Scope.VERTEX)
+PARTITIONER_CLASS = _key("tez.runtime.partitioner.class",
+                         "tez_tpu.library.partitioners:HashPartitioner", Scope.VERTEX)
+PIPELINED_SHUFFLE_ENABLED = _key("tez.runtime.pipelined-shuffle.enabled", False, Scope.VERTEX,
+                                 "Emit per-spill DMEs; disables final merge "
+                                 "(reference: PipelinedSorter.java:113)")
+ENABLE_FINAL_MERGE = _key("tez.runtime.enable.final-merge.in.output", True, Scope.VERTEX)
+SHUFFLE_PARALLEL_COPIES = _key("tez.runtime.shuffle.parallel.copies", 8, Scope.VERTEX)
+SHUFFLE_BUFFER_FRACTION = _key("tez.runtime.shuffle.fetch.buffer.percent", 0.9, Scope.VERTEX)
+SHUFFLE_MEMORY_LIMIT_PERCENT = _key("tez.runtime.shuffle.memory.limit.percent", 0.25, Scope.VERTEX)
+SHUFFLE_MERGE_PERCENT = _key("tez.runtime.shuffle.merge.percent", 0.9, Scope.VERTEX)
+SHUFFLE_FAILED_CHECK_SINCE_LAST_COMPLETION = _key(
+    "tez.runtime.shuffle.failed.check.since-last.completion", True, Scope.VERTEX)
+SHUFFLE_FETCH_MAX_TASK_OUTPUT_AT_ONCE = _key(
+    "tez.runtime.shuffle.fetch.max.task.output.at.once", 20, Scope.VERTEX)
+SHUFFLE_NOTIFY_READERROR = _key("tez.runtime.shuffle.notify.readerror", True, Scope.VERTEX)
+SHUFFLE_CONNECT_TIMEOUT_MS = _key("tez.runtime.shuffle.connect.timeout", 12_000, Scope.VERTEX)
+SHUFFLE_READ_TIMEOUT_MS = _key("tez.runtime.shuffle.read.timeout", 30_000, Scope.VERTEX)
+COMPRESS = _key("tez.runtime.compress", False, Scope.VERTEX)
+COMPRESS_CODEC = _key("tez.runtime.compress.codec", "zlib", Scope.VERTEX)
+KEY_CLASS = _key("tez.runtime.key.class", "bytes", Scope.VERTEX)
+VALUE_CLASS = _key("tez.runtime.value.class", "bytes", Scope.VERTEX)
+KEY_COMPARATOR_CLASS = _key("tez.runtime.key.comparator.class", "", Scope.VERTEX)
+UNORDERED_OUTPUT_BUFFER_SIZE_MB = _key(
+    "tez.runtime.unordered.output.buffer.size-mb", 100, Scope.VERTEX)
+REPORT_PARTITION_STATS = _key("tez.runtime.report.partition.stats", True, Scope.VERTEX,
+                              "Ship per-partition output sizes in VertexManagerEvents "
+                              "(feeds auto-parallelism)")
+KEY_WIDTH_BYTES = _key("tez.runtime.tpu.key.width.bytes", 16, Scope.VERTEX,
+                       "Fixed normalized key width for device radix sort (TPU-specific)")
+DEVICE_BATCH_RECORDS = _key("tez.runtime.tpu.batch.records", 1 << 20, Scope.VERTEX,
+                            "Records per device sort batch (static shape bucket)")
+HOST_SPILL_DIR = _key("tez.runtime.tpu.host.spill.dir", "", Scope.VERTEX,
+                      "Where device buffers spill when HBM budget is exceeded; "
+                      "'' = <staging>/spill")
+
+
+def runtime_conf_subset(conf: Mapping) -> "TezConfiguration":
+    """Filter the runtime keys into an edge payload (reference: edge config
+    builders serialize only TezRuntimeConfiguration keys into UserPayload)."""
+    return TezConfiguration(conf).subset(RUNTIME_PREFIX)

@@ -1,0 +1,163 @@
+"""Local-mode orchestrator integration tests.
+
+Mirrors the reference's TestLocalMode / TestFaultTolerance style: whole DAGs
+through TezClient with fault-injectable components (SURVEY.md §4).
+"""
+import pytest
+
+from tez_tpu.client.dag_client import DAGStatusState
+from tez_tpu.client.tez_client import TezClient
+from tez_tpu.common.payload import (InputDescriptor, OutputDescriptor,
+                                    ProcessorDescriptor)
+from tez_tpu.dag.dag import DAG, Edge, Vertex
+from tez_tpu.dag.edge_property import (DataMovementType, DataSourceType,
+                                       EdgeProperty, SchedulingType)
+
+
+def sleep_vertex(name, parallelism, sleep_ms=1, payload=None):
+    p = dict(payload or {})
+    p.setdefault("sleep_ms", sleep_ms)
+    return Vertex.create(name, ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor", payload=p), parallelism)
+
+
+def make_test_vertex(name, parallelism, payload=None):
+    return Vertex.create(name, ProcessorDescriptor.create(
+        "tez_tpu.library.test_components:TestProcessor", payload=payload or {}),
+        parallelism)
+
+
+def tedge(a, b, movement=DataMovementType.SCATTER_GATHER):
+    return Edge.create(a, b, EdgeProperty.create(
+        movement, DataSourceType.PERSISTED, SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create("tez_tpu.library.test_components:TestOutput"),
+        InputDescriptor.create("tez_tpu.library.test_components:TestInput")))
+
+
+@pytest.fixture()
+def client(tmp_staging):
+    c = TezClient.create("test", {"tez.staging-dir": tmp_staging,
+                                  "tez.am.local.num-containers": 4}).start()
+    yield c
+    c.stop()
+
+
+def test_single_vertex_dag(client):
+    dag = DAG.create("single").add_vertex(sleep_vertex("v", 3))
+    status = client.submit_dag(dag).wait_for_completion(timeout=30)
+    assert status.state is DAGStatusState.SUCCEEDED
+    assert status.vertex_status["v"].progress.succeeded_task_count == 3
+
+
+def test_two_vertex_scatter_gather(client):
+    a, b = make_test_vertex("a", 3), make_test_vertex("b", 2)
+    dag = DAG.create("sg").add_vertex(a).add_vertex(b).add_edge(tedge(a, b))
+    status = client.submit_dag(dag).wait_for_completion(timeout=30)
+    assert status.state is DAGStatusState.SUCCEEDED
+    assert status.vertex_status["a"].progress.succeeded_task_count == 3
+    assert status.vertex_status["b"].progress.succeeded_task_count == 2
+
+
+def test_diamond_dag(client):
+    a, b, c, d = (make_test_vertex(n, 2) for n in "abcd")
+    dag = DAG.create("diamond")
+    for v in (a, b, c, d):
+        dag.add_vertex(v)
+    dag.add_edge(tedge(a, b)).add_edge(tedge(a, c))
+    dag.add_edge(tedge(b, d, DataMovementType.BROADCAST))
+    dag.add_edge(tedge(c, d))
+    status = client.submit_dag(dag).wait_for_completion(timeout=30)
+    assert status.state is DAGStatusState.SUCCEEDED
+
+
+def test_one_to_one_edge(client):
+    a, b = make_test_vertex("a", 3), make_test_vertex("b", 3)
+    dag = DAG.create("o2o").add_vertex(a).add_vertex(b).add_edge(
+        tedge(a, b, DataMovementType.ONE_TO_ONE))
+    status = client.submit_dag(dag).wait_for_completion(timeout=30)
+    assert status.state is DAGStatusState.SUCCEEDED
+
+
+def test_failing_task_retries_then_succeeds(client):
+    # fails attempts 0 and 1, succeeds from attempt 2
+    v = make_test_vertex("v", 2, payload={
+        "do_fail": True, "failing_task_indices": [1],
+        "failing_upto_attempt": 1})
+    dag = DAG.create("retry").add_vertex(v)
+    status = client.submit_dag(dag).wait_for_completion(timeout=30)
+    assert status.state is DAGStatusState.SUCCEEDED
+
+
+def test_task_fails_all_attempts_fails_dag(client):
+    v = make_test_vertex("v", 2, payload={
+        "do_fail": True, "failing_task_indices": [0]})
+    dag = DAG.create("perma-fail").add_vertex(v)
+    status = client.submit_dag(dag).wait_for_completion(timeout=30)
+    assert status.state is DAGStatusState.FAILED
+    assert any("failed" in d for d in status.diagnostics)
+
+
+def test_downstream_vertex_failure_fails_dag(client):
+    a = make_test_vertex("a", 2)
+    b = make_test_vertex("b", 2, payload={"do_fail": True,
+                                     "failing_task_indices": [-1]})
+    dag = DAG.create("down-fail").add_vertex(a).add_vertex(b).add_edge(
+        tedge(a, b))
+    status = client.submit_dag(dag).wait_for_completion(timeout=30)
+    assert status.state is DAGStatusState.FAILED
+
+
+def test_fatal_failure_no_retry(client):
+    v = make_test_vertex("v", 1, payload={
+        "do_fail": True, "failing_task_indices": [-1], "fatal": True})
+    dag = DAG.create("fatal").add_vertex(v)
+    status = client.submit_dag(dag).wait_for_completion(timeout=30)
+    assert status.state is DAGStatusState.FAILED
+
+
+def test_kill_dag(client):
+    v = sleep_vertex("v", 2, sleep_ms=10_000)
+    dag = DAG.create("kill").add_vertex(v)
+    dc = client.submit_dag(dag)
+    import time
+    time.sleep(0.3)
+    dc.try_kill_dag()
+    status = dc.wait_for_completion(timeout=30)
+    assert status.state is DAGStatusState.KILLED
+
+
+def test_session_runs_multiple_dags(client):
+    for i in range(3):
+        dag = DAG.create(f"d{i}").add_vertex(sleep_vertex("v", 2))
+        status = client.submit_dag(dag).wait_for_completion(timeout=30)
+        assert status.state is DAGStatusState.SUCCEEDED
+
+
+def test_three_stage_mrr_shape(client):
+    """map -> reduce -> reduce chained scatter-gathers (MRR, SURVEY §6)."""
+    a, b, c = make_test_vertex("m", 4), make_test_vertex("r1", 3), make_test_vertex("r2", 2)
+    dag = DAG.create("mrr").add_vertex(a).add_vertex(b).add_vertex(c)
+    dag.add_edge(tedge(a, b)).add_edge(tedge(b, c))
+    status = client.submit_dag(dag).wait_for_completion(timeout=30)
+    assert status.state is DAGStatusState.SUCCEEDED
+
+
+def test_counters_aggregate_to_dag(client):
+    dag = DAG.create("counters").add_vertex(sleep_vertex("v", 2))
+    status = client.submit_dag(dag).wait_for_completion(timeout=30)
+    assert status.counters is not None
+    d = status.counters.to_dict()
+    assert d.get("TaskCounter", {}).get("WALL_CLOCK_MILLISECONDS", 0) >= 0
+
+
+def test_history_events_emitted(client, tmp_staging):
+    dag = DAG.create("hist").add_vertex(sleep_vertex("v", 1))
+    client.submit_dag(dag).wait_for_completion(timeout=30)
+    svc = client.framework_client.am.logging_service
+    from tez_tpu.am.history import HistoryEventType
+    types = {e.event_type for e in svc.events}
+    for t in (HistoryEventType.DAG_SUBMITTED, HistoryEventType.DAG_STARTED,
+              HistoryEventType.VERTEX_STARTED, HistoryEventType.TASK_STARTED,
+              HistoryEventType.TASK_ATTEMPT_STARTED,
+              HistoryEventType.DAG_FINISHED):
+        assert t in types, f"missing {t}"

@@ -1,0 +1,233 @@
+"""DAGAppMaster: composite service wiring every orchestrator subsystem.
+
+Reference parity: tez-dag/.../app/DAGAppMaster.java:226 (serviceInit:423
+registers dispatchers/scheduler/launcher/history; session mode runs multiple
+DAGs; shutdown/error funnel) + LocalDAGAppMaster.  Here the AM always runs
+in-process ("local mode"); a multi-host deployment wraps this object with
+gRPC endpoints for the client and umbilical protocols.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import concurrent.futures
+from typing import Any, Dict, List, Optional, Sequence
+
+from tez_tpu.am.dag_impl import DAGImpl, DAGState, TERMINAL_DAG_STATES
+from tez_tpu.am.events import (DAGEvent, DAGEventType, SchedulerEvent,
+                               SchedulerEventType, TaskAttemptEvent,
+                               TaskAttemptEventType, TaskEvent, TaskEventType,
+                               VertexEvent, VertexEventType)
+from tez_tpu.am.history import (HistoryEvent, HistoryEventHandler,
+                                HistoryEventType)
+from tez_tpu.am.launcher import RunnerPool
+from tez_tpu.am.task_comm import TaskCommunicatorManager
+from tez_tpu.am.task_scheduler import (LocalTaskSchedulerService,
+                                       TaskSchedulerManager)
+from tez_tpu.common import config as C
+from tez_tpu.common.counters import TezCounters
+from tez_tpu.common.dispatcher import Dispatcher
+from tez_tpu.common.ids import DAGId, TaskAttemptId
+from tez_tpu.dag.plan import DAGPlan
+
+log = logging.getLogger(__name__)
+
+
+class DAGAppMaster:
+    """The single-controller orchestrator."""
+
+    def __init__(self, app_id: str, conf: C.TezConfiguration,
+                 recovery_data: Any = None):
+        self.app_id = app_id
+        self.conf = conf
+        self.node_id = "local-0"
+        self.work_dir = os.path.join(
+            conf.get(C.STAGING_DIR), app_id, "work")
+        os.makedirs(self.work_dir, exist_ok=True)
+        self.dispatcher = Dispatcher(f"am-{app_id}")
+        self.dag_counters = TezCounters()
+        num_slots = conf.get(C.AM_NUM_CONTAINERS) or max(2, os.cpu_count() or 2)
+        self.task_scheduler = LocalTaskSchedulerService(self, num_slots)
+        self.scheduler_manager = TaskSchedulerManager(self, self.task_scheduler)
+        self.runner_pool = RunnerPool(self, num_slots)
+        self.task_comm = TaskCommunicatorManager(self)
+        logging_service = HistoryEventHandler.create_logging_service(conf)
+        from tez_tpu.am.recovery import RecoveryService
+        recovery_enabled = conf.get(C.DAG_RECOVERY_ENABLED)
+        self.recovery_service = RecoveryService(self) if recovery_enabled else None
+        self.history_handler = HistoryEventHandler(
+            logging_service, self.recovery_service)
+        self.logging_service = logging_service
+        self.executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix=f"am-exec-{app_id}")
+        self.current_dag: Optional[DAGImpl] = None
+        self.completed_dags: Dict[str, DAGState] = {}
+        self._dag_seq = 0
+        self._dag_done = threading.Condition()
+        self._recovery_data = recovery_data
+        self._register_handlers()
+        self._started = False
+
+    # -- service lifecycle ---------------------------------------------------
+    def start(self) -> None:
+        if self._started:
+            return
+        self.logging_service.start()
+        if self.recovery_service is not None:
+            self.recovery_service.start()
+        self.dispatcher.on_error = self._on_dispatcher_error
+        self.dispatcher.start()
+        self._started = True
+        self.history(HistoryEvent(HistoryEventType.AM_STARTED,
+                                  data={"app_id": self.app_id}))
+
+    def stop(self) -> None:
+        self.task_scheduler.shutdown()
+        self.runner_pool.shutdown()
+        self.dispatcher.stop()
+        self.executor.shutdown(wait=False)
+        if self.recovery_service is not None:
+            self.recovery_service.stop()
+        self.logging_service.stop()
+        self._started = False
+
+    def _register_handlers(self) -> None:
+        from tez_tpu.am.events import (DAGEventType, LauncherEventType,
+                                       SchedulerEventType, SpeculatorEventType,
+                                       TaskAttemptEventType, TaskEventType,
+                                       VertexEventType)
+        d = self.dispatcher
+        d.register(DAGEventType, self._handle_dag_event)
+        d.register(VertexEventType, self._handle_vertex_event)
+        d.register(TaskEventType, self._handle_task_event)
+        d.register(TaskAttemptEventType, self._handle_attempt_event)
+        d.register(SchedulerEventType, self.scheduler_manager.handle)
+
+    # -- event handlers (dispatcher thread) ----------------------------------
+    def _handle_dag_event(self, event: DAGEvent) -> None:
+        dag = self.current_dag
+        if dag is not None and dag.dag_id == event.dag_id:
+            dag.handle(event)
+
+    def _handle_vertex_event(self, event: VertexEvent) -> None:
+        dag = self.current_dag
+        if dag is None or dag.dag_id != event.vertex_id.dag_id:
+            return
+        v = dag.vertex_by_id(event.vertex_id)
+        if v is not None:
+            v.handle(event)
+
+    def _handle_task_event(self, event: TaskEvent) -> None:
+        dag = self.current_dag
+        if dag is None or dag.dag_id != event.task_id.dag_id:
+            return
+        v = dag.vertex_by_id(event.task_id.vertex_id)
+        if v is None:
+            return
+        t = v.tasks.get(event.task_id.id)
+        if t is not None:
+            t.handle(event)
+
+    def _handle_attempt_event(self, event: TaskAttemptEvent) -> None:
+        dag = self.current_dag
+        if dag is None or dag.dag_id != event.attempt_id.dag_id:
+            return
+        v = dag.vertex_by_id(event.attempt_id.vertex_id)
+        if v is None:
+            return
+        t = v.tasks.get(event.attempt_id.task_id.id)
+        att = t.attempt(event.attempt_id) if t is not None else None
+        if att is not None:
+            att.handle(event)
+
+    def _on_dispatcher_error(self, exc: BaseException, event: Any) -> None:
+        """AM error funnel (reference: DAGAppMaster error handling —
+        unhandled dispatcher error fails the DAG, not the process)."""
+        dag = self.current_dag
+        if dag is not None and dag.state not in TERMINAL_DAG_STATES:
+            self.dispatch(DAGEvent(DAGEventType.INTERNAL_ERROR, dag.dag_id,
+                                   diagnostics=repr(exc)))
+
+    # -- AMContext surface used by components --------------------------------
+    def dispatch(self, event: Any) -> None:
+        self.dispatcher.dispatch(event)
+
+    def history(self, event: HistoryEvent) -> None:
+        self.history_handler.handle(event)
+
+    def history_vertex_configured(self, vertex: Any) -> None:
+        self.history(HistoryEvent(
+            HistoryEventType.VERTEX_CONFIGURE_DONE,
+            dag_id=str(vertex.vertex_id.dag_id),
+            vertex_id=str(vertex.vertex_id),
+            data={"vertex_name": vertex.name,
+                  "num_tasks": vertex.num_tasks}))
+
+    def submit_to_executor(self, fn: Any) -> None:
+        self.executor.submit(fn)
+
+    def total_slots(self) -> int:
+        return self.task_scheduler.total_slots()
+
+    def ensure_runners(self, backlog: int) -> None:
+        self.runner_pool.ensure_runners(backlog)
+
+    def kill_attempt_in_runner(self, attempt_id: TaskAttemptId) -> None:
+        self.task_comm.kill_attempt(attempt_id)
+
+    def deliver_processor_events(self, vertex: Any, events: Sequence[Any],
+                                 task_indices: Sequence[int]) -> None:
+        for idx in task_indices:
+            task = vertex.tasks.get(idx)
+            if task is None:
+                continue
+            for att in task.attempts.values():
+                self.task_comm.deliver_custom_events(
+                    att.attempt_id, list(events))
+
+    def on_dag_finished(self, dag: DAGImpl, final: DAGState) -> None:
+        with self._dag_done:
+            self.completed_dags[str(dag.dag_id)] = final
+            self._dag_done.notify_all()
+
+    # -- DAG submission (client-facing) --------------------------------------
+    def submit_dag(self, plan: DAGPlan) -> DAGId:
+        assert self._started, "AM not started"
+        with self._dag_done:
+            if self.current_dag is not None and \
+                    self.current_dag.state not in TERMINAL_DAG_STATES:
+                raise RuntimeError("a DAG is already running")
+        self._dag_seq += 1
+        dag_id = DAGId(self.app_id, self._dag_seq)
+        self.history(HistoryEvent(
+            HistoryEventType.DAG_SUBMITTED, dag_id=str(dag_id),
+            data={"dag_name": plan.name,
+                  "plan": plan.serialize().hex()}))
+        dag = DAGImpl(dag_id, plan, self)
+        self.current_dag = dag
+        self.dispatch(DAGEvent(DAGEventType.DAG_INIT, dag_id))
+        self.dispatch(DAGEvent(DAGEventType.DAG_START, dag_id))
+        return dag_id
+
+    def wait_for_dag(self, dag_id: DAGId,
+                     timeout: Optional[float] = None) -> DAGState:
+        with self._dag_done:
+            ok = self._dag_done.wait_for(
+                lambda: str(dag_id) in self.completed_dags, timeout)
+            if not ok:
+                raise TimeoutError(f"DAG {dag_id} still running")
+            return self.completed_dags[str(dag_id)]
+
+    def kill_dag(self, dag_id: DAGId, reason: str = "killed by client") -> None:
+        self.dispatch(DAGEvent(DAGEventType.DAG_KILL, dag_id,
+                               diagnostics=reason))
+
+    def dag_status(self, dag_id: DAGId) -> Dict[str, Any]:
+        dag = self.current_dag
+        if dag is None or dag.dag_id != dag_id:
+            state = self.completed_dags.get(str(dag_id))
+            return {"name": "?", "state": state.name if state else "UNKNOWN",
+                    "progress": 1.0 if state else 0.0, "vertices": {},
+                    "diagnostics": []}
+        return dag.status_dict()

@@ -1,0 +1,39 @@
+"""DAG-level priority assignment.
+
+Reference parity: tez-dag/.../dag/impl/DAGSchedulerNaturalOrder.java:75 —
+priority = topological depth (deeper vertices run at lower priority so
+upstream work drains first); the "controlled" variant gates scheduling on
+vertex readiness, which our vertex managers already do.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict
+
+if TYPE_CHECKING:
+    from tez_tpu.am.dag_impl import DAGImpl
+
+
+def assign_natural_order_priorities(dag: "DAGImpl") -> None:
+    """Longest-path-from-root depth, priority = (depth+1)*3 with the +/-1
+    band reserved for retries/speculation (reference multiplies by 3 to give
+    each vertex a priority band)."""
+    order = []
+    indeg = {v.name: 0 for v in dag.plan.vertices}
+    adj: Dict[str, list] = {v.name: [] for v in dag.plan.vertices}
+    for e in dag.plan.edges:
+        adj[e.input_vertex].append(e.output_vertex)
+        indeg[e.output_vertex] += 1
+    ready = [n for n, d in indeg.items() if d == 0]
+    depth = {n: 0 for n in ready}
+    while ready:
+        n = ready.pop()
+        order.append(n)
+        for m in adj[n]:
+            depth[m] = max(depth.get(m, 0), depth[n] + 1)
+            indeg[m] -= 1
+            if indeg[m] == 0:
+                ready.append(m)
+
+    for name, v in dag.vertices.items():
+        v.distance_from_root = depth.get(name, 0)
+        v.priority = (depth.get(name, 0) + 1) * 3

@@ -1,0 +1,180 @@
+"""History subsystem: every lifecycle transition emits a typed HistoryEvent.
+
+Reference parity: tez-dag/.../dag/history/ (~25 event classes,
+HistoryEvents.proto), HistoryEventHandler.java:46 fanning out to the recovery
+journal and a pluggable HistoryLoggingService; SimpleHistoryLoggingService and
+ProtoHistoryLoggingService analogs (here: in-memory and JSONL-file loggers —
+the JSONL journal doubles as the analyzer/trace input, SURVEY.md §5.1).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from tez_tpu.common.payload import resolve_class
+
+
+class HistoryEventType(enum.Enum):
+    APP_LAUNCHED = enum.auto()
+    AM_LAUNCHED = enum.auto()
+    AM_STARTED = enum.auto()
+    DAG_SUBMITTED = enum.auto()
+    DAG_INITIALIZED = enum.auto()
+    DAG_STARTED = enum.auto()
+    DAG_COMMIT_STARTED = enum.auto()
+    DAG_FINISHED = enum.auto()
+    DAG_KILL_REQUEST = enum.auto()
+    VERTEX_INITIALIZED = enum.auto()
+    VERTEX_STARTED = enum.auto()
+    VERTEX_CONFIGURE_DONE = enum.auto()
+    VERTEX_COMMIT_STARTED = enum.auto()
+    VERTEX_GROUP_COMMIT_STARTED = enum.auto()
+    VERTEX_GROUP_COMMIT_FINISHED = enum.auto()
+    VERTEX_FINISHED = enum.auto()
+    TASK_STARTED = enum.auto()
+    TASK_FINISHED = enum.auto()
+    TASK_ATTEMPT_STARTED = enum.auto()
+    TASK_ATTEMPT_FINISHED = enum.auto()
+    CONTAINER_LAUNCHED = enum.auto()
+    CONTAINER_STOPPED = enum.auto()
+
+
+#: Events whose loss recovery cannot tolerate — flushed synchronously.
+#: Reference: SummaryEvent handling in RecoveryService (hflush :246-250).
+SUMMARY_EVENT_TYPES = frozenset({
+    HistoryEventType.DAG_SUBMITTED,
+    HistoryEventType.DAG_STARTED,
+    HistoryEventType.DAG_COMMIT_STARTED,
+    HistoryEventType.VERTEX_COMMIT_STARTED,
+    HistoryEventType.VERTEX_GROUP_COMMIT_STARTED,
+    HistoryEventType.VERTEX_GROUP_COMMIT_FINISHED,
+    HistoryEventType.DAG_FINISHED,
+    HistoryEventType.DAG_KILL_REQUEST,
+})
+
+
+@dataclasses.dataclass
+class HistoryEvent:
+    event_type: HistoryEventType
+    # entity ids as strings for serializability; None where not applicable
+    dag_id: Optional[str] = None
+    vertex_id: Optional[str] = None
+    task_id: Optional[str] = None
+    attempt_id: Optional[str] = None
+    container_id: Optional[str] = None
+    timestamp: float = 0.0
+    data: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if not self.timestamp:
+            self.timestamp = time.time()
+
+    @property
+    def is_summary(self) -> bool:
+        return self.event_type in SUMMARY_EVENT_TYPES
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["event_type"] = self.event_type.name
+        return json.dumps(d, default=str)
+
+    @staticmethod
+    def from_json(line: str) -> "HistoryEvent":
+        d = json.loads(line)
+        d["event_type"] = HistoryEventType[d["event_type"]]
+        return HistoryEvent(**d)
+
+
+class HistoryLoggingService:
+    """SPI (reference: HistoryLoggingService.java)."""
+
+    def start(self) -> None: ...
+
+    def stop(self) -> None: ...
+
+    def handle(self, event: HistoryEvent) -> None:
+        raise NotImplementedError
+
+
+class InMemoryHistoryLoggingService(HistoryLoggingService):
+    def __init__(self, conf: Any = None):
+        self.events: List[HistoryEvent] = []
+        self._lock = threading.Lock()
+
+    def handle(self, event: HistoryEvent) -> None:
+        with self._lock:
+            self.events.append(event)
+
+    def of_type(self, t: HistoryEventType) -> List[HistoryEvent]:
+        with self._lock:
+            return [e for e in self.events if e.event_type is t]
+
+
+class JsonlHistoryLoggingService(HistoryLoggingService):
+    """Date/app-partitioned JSONL files — the ProtoHistoryLoggingService
+    analog; also what the history parser/analyzers read."""
+
+    def __init__(self, conf: Any = None, log_dir: str = ""):
+        if not log_dir and conf is not None:
+            log_dir = conf.get("tez.history.logging.log-dir") or ""
+        self.log_dir = log_dir or "/tmp/tez-tpu-history"
+        self._fh = None
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._fh = open(os.path.join(
+            self.log_dir, f"history_{int(time.time())}_{os.getpid()}.jsonl"), "a")
+
+    def handle(self, event: HistoryEvent) -> None:
+        with self._lock:
+            if self._fh is None:
+                self.start()
+            self._fh.write(event.to_json() + "\n")
+            if event.is_summary:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._fh:
+                self._fh.flush()
+                self._fh.close()
+                self._fh = None
+
+
+class DevNullHistoryLoggingService(HistoryLoggingService):
+    def __init__(self, conf: Any = None):
+        pass
+
+    def handle(self, event: HistoryEvent) -> None:
+        pass
+
+
+class HistoryEventHandler:
+    """Fans history events out to the logging service and recovery journal.
+
+    Reference: HistoryEventHandler.java:46.
+    """
+
+    def __init__(self, logging_service: HistoryLoggingService,
+                 recovery_service: "Any | None" = None):
+        self.logging_service = logging_service
+        self.recovery_service = recovery_service
+
+    def handle(self, event: HistoryEvent) -> None:
+        if self.recovery_service is not None:
+            self.recovery_service.handle(event)
+        self.logging_service.handle(event)
+
+    @staticmethod
+    def create_logging_service(conf: Any) -> HistoryLoggingService:
+        cls_name = conf.get("tez.history.logging.service.class") if conf else None
+        if not cls_name:
+            return InMemoryHistoryLoggingService()
+        return resolve_class(cls_name)(conf)

@@ -1,0 +1,90 @@
+"""Runner pool: the container launcher + TezChild loop, in-process.
+
+Reference parity: tez-dag ContainerLauncherManager.java:62 +
+LocalContainerLauncher.java:87 (tasks as threads, uber-style) + the TezChild
+run loop (tez-runtime-internals TezChild.java:214).  A "container" here is a
+worker thread with an object registry (so kernel caches survive across tasks
+— the TPU analog of JVM container reuse); on a real pod each would be a
+runner process on a TPU host.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from tez_tpu.am.history import HistoryEvent, HistoryEventType
+from tez_tpu.api.runtime import ObjectRegistry
+from tez_tpu.common.counters import DAGCounter
+from tez_tpu.common.ids import ContainerId
+
+log = logging.getLogger(__name__)
+
+
+class RunnerPool:
+    def __init__(self, ctx: Any, max_runners: int,
+                 idle_timeout: float = 5.0):
+        self.ctx = ctx
+        self.max_runners = max_runners
+        self.idle_timeout = idle_timeout
+        self._runners: Dict[ContainerId, threading.Thread] = {}
+        self._seq = itertools.count()
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    def ensure_runners(self, backlog: int) -> None:
+        """Spin up runner threads while there is queued work and capacity."""
+        with self._lock:
+            if self._stopped:
+                return
+            want = min(self.max_runners, len(self._runners) + max(0, backlog))
+            while len(self._runners) < want:
+                cid = ContainerId(self.ctx.app_id, next(self._seq))
+                t = threading.Thread(target=self._runner_loop, args=(cid,),
+                                     name=str(cid), daemon=True)
+                self._runners[cid] = t
+                t.start()
+
+    def _runner_loop(self, container_id: ContainerId) -> None:
+        """The TezChild loop: pull task, run, repeat until idle."""
+        from tez_tpu.runtime.task_runner import TaskRunner
+        self.ctx.history(HistoryEvent(
+            HistoryEventType.CONTAINER_LAUNCHED,
+            container_id=str(container_id)))
+        registry = ObjectRegistry()
+        tasks_run = 0
+        try:
+            while not self._stopped:
+                spec = self.ctx.task_comm.get_task(container_id,
+                                                   timeout=self.idle_timeout)
+                if spec is None:
+                    break
+                if tasks_run > 0:
+                    self.ctx.dag_counters.increment(
+                        DAGCounter.TOTAL_CONTAINER_REUSE_COUNT)
+                tasks_run += 1
+                runner = TaskRunner(spec, self.ctx.task_comm, registry,
+                                    work_dir=self.ctx.work_dir,
+                                    node_id=self.ctx.node_id)
+                runner.run()
+                registry.clear_scope(ObjectRegistry.VERTEX)
+        finally:
+            with self._lock:
+                self._runners.pop(container_id, None)
+            self.ctx.history(HistoryEvent(
+                HistoryEventType.CONTAINER_STOPPED,
+                container_id=str(container_id),
+                data={"tasks_run": tasks_run}))
+
+    def live_count(self) -> int:
+        with self._lock:
+            return len(self._runners)
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._stopped = True
+        if wait:
+            deadline = time.time() + 10
+            for t in list(self._runners.values()):
+                t.join(timeout=max(0.1, deadline - time.time()))

@@ -1,0 +1,131 @@
+"""Event-sourced recovery journal.
+
+Reference parity: tez-dag/.../history/recovery/RecoveryService.java:53 (per-DAG
+journal + synchronously-flushed summary events) and app/RecoveryParser.java:89
+(replay: completed work short-circuited, in-flight work re-run, commit-in-
+flight without completion => DAG failed).
+
+Journal layout: <staging>/<app_id>/recovery/<attempt>/journal.jsonl.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from typing import Any, Dict, List, Optional, Set
+
+from tez_tpu.am.history import HistoryEvent, HistoryEventType
+from tez_tpu.common import config as C
+from tez_tpu.dag.plan import DAGPlan
+
+log = logging.getLogger(__name__)
+
+
+class RecoveryService:
+    """Append-only journal of history events (summary events fsync'd)."""
+
+    def __init__(self, ctx: Any, attempt: int = 1):
+        self.ctx = ctx
+        self.attempt = attempt
+        staging = ctx.conf.get(C.STAGING_DIR)
+        self.dir = os.path.join(staging, ctx.app_id, "recovery", str(attempt))
+        self._fh = None
+
+    def start(self) -> None:
+        os.makedirs(self.dir, exist_ok=True)
+        self._fh = open(os.path.join(self.dir, "journal.jsonl"), "a")
+
+    def handle(self, event: HistoryEvent) -> None:
+        if self._fh is None:
+            return
+        self._fh.write(event.to_json() + "\n")
+        if event.is_summary:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+    def stop(self) -> None:
+        if self._fh is not None:
+            self._fh.flush()
+            self._fh.close()
+            self._fh = None
+
+
+@dataclasses.dataclass
+class DAGRecoveryData:
+    """What the parser reconstructs for the new AM attempt."""
+    dag_id: str
+    plan: Optional[DAGPlan]
+    dag_state: Optional[str]                  # terminal state if finished
+    commit_in_flight: bool
+    completed_vertices: Dict[str, Dict[str, Any]]   # vertex name -> finish data
+    succeeded_tasks: Set[str]                 # task id strings
+    events: List[HistoryEvent]
+
+
+class RecoveryParser:
+    """Reference: RecoveryParser.parseRecoveryData:658."""
+
+    def __init__(self, staging_dir: str, app_id: str):
+        self.base = os.path.join(staging_dir, app_id, "recovery")
+
+    def journal_files(self) -> List[str]:
+        if not os.path.isdir(self.base):
+            return []
+        out = []
+        for attempt in sorted(os.listdir(self.base), key=lambda s: int(s)):
+            p = os.path.join(self.base, attempt, "journal.jsonl")
+            if os.path.exists(p):
+                out.append(p)
+        return out
+
+    def parse(self) -> Optional[DAGRecoveryData]:
+        """Returns recovery data for the last in-progress DAG, or None when
+        there is nothing to recover (no DAG, or last DAG finished)."""
+        events: List[HistoryEvent] = []
+        for path in self.journal_files():
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        events.append(HistoryEvent.from_json(line))
+                    except Exception:  # noqa: BLE001 — torn tail write
+                        log.warning("skipping corrupt journal line")
+        if not events:
+            return None
+        # find last submitted DAG
+        last_dag_id: Optional[str] = None
+        plan: Optional[DAGPlan] = None
+        for ev in events:
+            if ev.event_type is HistoryEventType.DAG_SUBMITTED:
+                last_dag_id = ev.dag_id
+                raw = ev.data.get("plan")
+                plan = DAGPlan.deserialize(bytes.fromhex(raw)) if raw else None
+        if last_dag_id is None:
+            return None
+        dag_events = [e for e in events if e.dag_id == last_dag_id]
+        dag_state = None
+        commit_started = False
+        completed_vertices: Dict[str, Dict[str, Any]] = {}
+        succeeded_tasks: Set[str] = set()
+        for ev in dag_events:
+            t = ev.event_type
+            if t is HistoryEventType.DAG_FINISHED:
+                dag_state = ev.data.get("state")
+            elif t in (HistoryEventType.DAG_COMMIT_STARTED,
+                       HistoryEventType.VERTEX_COMMIT_STARTED,
+                       HistoryEventType.VERTEX_GROUP_COMMIT_STARTED):
+                commit_started = True
+            elif t is HistoryEventType.VERTEX_FINISHED and \
+                    ev.data.get("state") == "SUCCEEDED":
+                completed_vertices[ev.data.get("vertex_name")] = ev.data
+            elif t is HistoryEventType.TASK_FINISHED and \
+                    ev.data.get("state") == "SUCCEEDED":
+                succeeded_tasks.add(ev.task_id)
+        return DAGRecoveryData(
+            dag_id=last_dag_id, plan=plan, dag_state=dag_state,
+            commit_in_flight=commit_started and dag_state is None,
+            completed_vertices=completed_vertices,
+            succeeded_tasks=succeeded_tasks, events=dag_events)

@@ -1,0 +1,176 @@
+"""The umbilical: runner <-> AM control protocol.
+
+Reference parity: tez-runtime-internals/.../common/TezTaskUmbilicalProtocol.java:42
+(getTask / heartbeat / canCommit) + tez-dag TaskCommunicatorManager.java:220
+(heartbeat event routing) and TezTaskCommunicatorImpl (getTask :311).
+
+In local mode this is a plain in-process object; a multi-host deployment puts
+a gRPC server in front of the same interface (the TaskCommunicator service
+plugin seam).  Heartbeats batch task events up and pull routed input events
+down, exactly like TezHeartbeatRequest/Response.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from tez_tpu.api.events import TezAPIEvent, TezEvent
+from tez_tpu.am.events import (TaskAttemptEvent, TaskAttemptEventType,
+                               VertexEvent, VertexEventType)
+from tez_tpu.common.counters import TezCounters
+from tez_tpu.common.ids import ContainerId, TaskAttemptId
+from tez_tpu.runtime.task_spec import TaskSpec
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class HeartbeatRequest:
+    attempt_id: TaskAttemptId
+    events: List[TezEvent]
+    counters: Optional[TezCounters] = None
+    progress: float = 0.0
+
+
+@dataclasses.dataclass
+class HeartbeatResponse:
+    events: List[TezAPIEvent]
+    should_die: bool = False
+
+
+class _AttemptSession:
+    __slots__ = ("edge_seqs", "killed", "last_heartbeat", "custom_events",
+                 "custom_seq")
+
+    def __init__(self) -> None:
+        self.edge_seqs: Dict[str, int] = {}
+        self.killed = False
+        self.last_heartbeat = time.time()
+        self.custom_events: List[TezAPIEvent] = []
+
+
+class TaskCommunicatorManager:
+    """AM side of the umbilical."""
+
+    def __init__(self, ctx: Any):
+        self.ctx = ctx
+        self._sessions: Dict[TaskAttemptId, _AttemptSession] = {}
+        self._lock = threading.Lock()
+
+    # -- runner-facing API (called from runner threads) ----------------------
+    def get_task(self, container_id: ContainerId,
+                 timeout: float = 1.0) -> Optional[TaskSpec]:
+        spec = self.ctx.task_scheduler.get_task(container_id, timeout)
+        if spec is None:
+            return None
+        with self._lock:
+            self._sessions[spec.attempt_id] = _AttemptSession()
+        self.ctx.dispatch(TaskAttemptEvent(
+            TaskAttemptEventType.TA_STARTED_REMOTELY, spec.attempt_id,
+            container_id=container_id, node_id=self.ctx.node_id))
+        return spec
+
+    def heartbeat(self, request: HeartbeatRequest) -> HeartbeatResponse:
+        session = self._session(request.attempt_id)
+        session.last_heartbeat = time.time()
+        if request.events:
+            self._route_events(request.attempt_id, request.events)
+        if request.counters is not None or request.progress:
+            self.ctx.dispatch(TaskAttemptEvent(
+                TaskAttemptEventType.TA_STATUS_UPDATE, request.attempt_id,
+                counters=request.counters, progress=request.progress))
+        events = self._pull_events(request.attempt_id, session)
+        return HeartbeatResponse(events=events, should_die=session.killed)
+
+    def can_commit(self, attempt_id: TaskAttemptId) -> bool:
+        vertex = self.ctx.current_dag.vertex_by_id(attempt_id.vertex_id)
+        if vertex is None:
+            return False
+        task = vertex.tasks.get(attempt_id.task_id.id)
+        if task is None:
+            return False
+        with self._lock:  # serialize commit arbitration
+            return task.can_commit(attempt_id)
+
+    def task_done(self, attempt_id: TaskAttemptId, events: List[TezEvent],
+                  counters: Optional[TezCounters]) -> None:
+        if events:
+            self._route_events(attempt_id, events)
+        self.ctx.dispatch(TaskAttemptEvent(
+            TaskAttemptEventType.TA_DONE, attempt_id, counters=counters))
+        self._drop_session(attempt_id)
+
+    def task_failed(self, attempt_id: TaskAttemptId, diagnostics: str,
+                    fatal: bool = False,
+                    counters: Optional[TezCounters] = None) -> None:
+        self.ctx.dispatch(TaskAttemptEvent(
+            TaskAttemptEventType.TA_FAILED, attempt_id,
+            diagnostics=diagnostics, fatal=fatal, counters=counters))
+        self._drop_session(attempt_id)
+
+    def task_killed(self, attempt_id: TaskAttemptId, diagnostics: str) -> None:
+        self.ctx.dispatch(TaskAttemptEvent(
+            TaskAttemptEventType.TA_KILL_REQUEST, attempt_id,
+            diagnostics=diagnostics))
+        self._drop_session(attempt_id)
+
+    def should_die(self, attempt_id: TaskAttemptId) -> bool:
+        with self._lock:
+            s = self._sessions.get(attempt_id)
+        return s.killed if s is not None else True
+
+    # -- AM-facing -----------------------------------------------------------
+    def kill_attempt(self, attempt_id: TaskAttemptId) -> None:
+        with self._lock:
+            s = self._sessions.get(attempt_id)
+            if s is not None:
+                s.killed = True
+
+    def deliver_custom_events(self, attempt_id: TaskAttemptId,
+                              events: Sequence[TezAPIEvent]) -> None:
+        with self._lock:
+            s = self._sessions.get(attempt_id)
+            if s is not None:
+                s.custom_events.extend(events)
+
+    def sessions_snapshot(self) -> Dict[TaskAttemptId, float]:
+        with self._lock:
+            return {a: s.last_heartbeat for a, s in self._sessions.items()}
+
+    # -- internals -----------------------------------------------------------
+    def _session(self, attempt_id: TaskAttemptId) -> _AttemptSession:
+        with self._lock:
+            s = self._sessions.get(attempt_id)
+            if s is None:
+                s = self._sessions[attempt_id] = _AttemptSession()
+            return s
+
+    def _drop_session(self, attempt_id: TaskAttemptId) -> None:
+        # Session survives until the attempt's terminal event is processed;
+        # dropping immediately is fine because routed events were flushed.
+        with self._lock:
+            self._sessions.pop(attempt_id, None)
+
+    def _route_events(self, attempt_id: TaskAttemptId,
+                      events: List[TezEvent]) -> None:
+        vertex_id = attempt_id.vertex_id
+        for tez_event in events:
+            self.ctx.dispatch(VertexEvent(
+                VertexEventType.V_ROUTE_EVENT, vertex_id,
+                tez_event=tez_event))
+
+    def _pull_events(self, attempt_id: TaskAttemptId,
+                     session: _AttemptSession) -> List[TezAPIEvent]:
+        vertex = self.ctx.current_dag.vertex_by_id(attempt_id.vertex_id) \
+            if self.ctx.current_dag else None
+        if vertex is None:
+            return []
+        out = vertex.get_task_events(attempt_id.task_id.id, session.edge_seqs)
+        with self._lock:
+            if session.custom_events:
+                out.extend(("__custom__", ev) for ev in session.custom_events)
+                session.custom_events = []
+        return out

@@ -1,0 +1,120 @@
+"""Task scheduling onto the runner pool.
+
+Reference parity: tez-dag/.../app/rm/ — TaskSchedulerManager.java:99
+multiplexing pluggable TaskSchedulers; here the stock scheduler is the
+LocalTaskSchedulerService analog: a priority queue of launch requests that
+runner "containers" pull from (the pull IS the allocation — mirrors
+TezChild.getTask).  Container reuse falls out naturally: a runner keeps
+pulling until the idle timeout.
+
+The TaskScheduler SPI seam (schedule/deallocate/total_slots) is what a
+TPU-pod or GKE scheduler plugin would implement instead
+(reference: tez-api serviceplugins TaskScheduler).
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Set, TYPE_CHECKING
+
+from tez_tpu.am.events import (SchedulerEvent, SchedulerEventType,
+                               TaskAttemptEvent, TaskAttemptEventType)
+from tez_tpu.common.ids import ContainerId, TaskAttemptId
+from tez_tpu.runtime.task_spec import TaskSpec
+
+log = logging.getLogger(__name__)
+
+
+class TaskSchedulerService:
+    """SPI: how execution slots are acquired (reference:
+    serviceplugins/api/TaskScheduler)."""
+
+    def schedule(self, attempt_id: TaskAttemptId, task_spec: TaskSpec,
+                 priority: int) -> None:
+        raise NotImplementedError
+
+    def deallocate(self, attempt_id: TaskAttemptId) -> None:
+        raise NotImplementedError
+
+    def total_slots(self) -> int:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class LocalTaskSchedulerService(TaskSchedulerService):
+    """Priority queue + pull model (reference: LocalTaskSchedulerService.java:54
+    merged with the container-side getTask loop)."""
+
+    def __init__(self, ctx: Any, num_slots: int):
+        self.ctx = ctx
+        self.num_slots = num_slots
+        self._lock = threading.Lock()
+        self._available = threading.Condition(self._lock)
+        self._heap: List[Any] = []
+        self._seq = itertools.count()
+        self._queued: Set[TaskAttemptId] = set()
+        self._running: Dict[TaskAttemptId, ContainerId] = {}
+        self._shutdown = False
+
+    def schedule(self, attempt_id: TaskAttemptId, task_spec: TaskSpec,
+                 priority: int) -> None:
+        with self._lock:
+            heapq.heappush(self._heap,
+                           (priority, next(self._seq), attempt_id, task_spec))
+            self._queued.add(attempt_id)
+            self._available.notify()
+        self.ctx.ensure_runners(self.backlog())
+
+    def deallocate(self, attempt_id: TaskAttemptId) -> None:
+        with self._lock:
+            self._queued.discard(attempt_id)
+            self._running.pop(attempt_id, None)
+
+    def backlog(self) -> int:
+        with self._lock:
+            return len(self._queued)
+
+    def total_slots(self) -> int:
+        return self.num_slots
+
+    def get_task(self, container_id: ContainerId,
+                 timeout: float) -> Optional[TaskSpec]:
+        """Runner pull (the allocation point).  Returns None on idle timeout
+        or shutdown."""
+        with self._lock:
+            while True:
+                while self._heap:
+                    prio, seq, attempt_id, spec = heapq.heappop(self._heap)
+                    if attempt_id not in self._queued:
+                        continue  # cancelled while queued
+                    self._queued.discard(attempt_id)
+                    self._running[attempt_id] = container_id
+                    return spec
+                if self._shutdown:
+                    return None
+                if not self._available.wait(timeout):
+                    return None
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._available.notify_all()
+
+
+class TaskSchedulerManager:
+    """Dispatcher-facing façade (reference: TaskSchedulerManager.java:99)."""
+
+    def __init__(self, ctx: Any, scheduler: TaskSchedulerService):
+        self.ctx = ctx
+        self.scheduler = scheduler
+
+    def handle(self, event: SchedulerEvent) -> None:
+        if event.event_type is SchedulerEventType.S_TA_LAUNCH_REQUEST:
+            self.scheduler.schedule(event.attempt_id, event.task_spec,
+                                    event.priority)
+        elif event.event_type is SchedulerEventType.S_TA_ENDED:
+            self.scheduler.deallocate(event.attempt_id)

@@ -1,0 +1,601 @@
+"""Vertex state machine: task creation, root-input init, vertex-manager
+hosting, edge wiring, event routing, completion bookkeeping.
+
+Reference parity: tez-dag/.../dag/impl/VertexImpl.java:218 (the reference's
+single biggest class) — here split between this file and
+vertex_manager_host.py.  Collapsed states: the reference's
+NEW/INITIALIZING/INITED/RUNNING/COMMITTING/TERMINATING/... map onto
+NEW -> INITIALIZING -> INITED -> RUNNING -> terminal, with commit handled at
+the DAG level (default commit-on-DAG-success mode).
+"""
+from __future__ import annotations
+
+import enum
+import logging
+import time
+from typing import Any, Dict, List, Optional, Sequence, Set, TYPE_CHECKING
+
+from tez_tpu.api.events import (CustomProcessorEvent,
+                                CompositeDataMovementEvent, DataMovementEvent,
+                                InputDataInformationEvent, InputFailedEvent,
+                                InputInitializerEvent, InputReadErrorEvent,
+                                TezAPIEvent, TezEvent, VertexManagerEvent)
+from tez_tpu.am.edge import EdgeImpl
+from tez_tpu.am.events import (TaskAttemptEvent, TaskAttemptEventType,
+                               TaskEvent, TaskEventType, VertexEvent,
+                               VertexEventType, DAGEvent, DAGEventType)
+from tez_tpu.am.history import HistoryEvent, HistoryEventType
+from tez_tpu.am.task_impl import (TaskAttemptState, TaskImpl, TaskState,
+                                  TERMINAL_TASK_STATES)
+from tez_tpu.common.counters import TezCounters
+from tez_tpu.common.ids import TaskAttemptId, VertexId
+from tez_tpu.common.statemachine import StateMachineFactory
+from tez_tpu.dag.edge_property import DataMovementType, SchedulingType
+from tez_tpu.dag.plan import VertexPlan
+from tez_tpu.runtime.task_spec import (GroupInputSpec, InputSpec, OutputSpec,
+                                       TaskSpec)
+
+if TYPE_CHECKING:
+    from tez_tpu.am.dag_impl import DAGImpl
+
+log = logging.getLogger(__name__)
+
+
+class VertexState(enum.Enum):
+    NEW = enum.auto()
+    INITIALIZING = enum.auto()
+    INITED = enum.auto()
+    RUNNING = enum.auto()
+    SUCCEEDED = enum.auto()
+    FAILED = enum.auto()
+    KILLED = enum.auto()
+    ERROR = enum.auto()
+
+
+TERMINAL_VERTEX_STATES = frozenset(
+    {VertexState.SUCCEEDED, VertexState.FAILED, VertexState.KILLED,
+     VertexState.ERROR})
+
+
+class VertexImpl:
+    _factory: StateMachineFactory = None
+
+    def __init__(self, vertex_id: VertexId, plan: VertexPlan, dag: "DAGImpl"):
+        self.vertex_id = vertex_id
+        self.plan = plan
+        self.name = plan.name
+        self.dag = dag
+        self.ctx = dag.ctx
+        self.conf = dag.conf.merged(plan.conf)
+        self.num_tasks = plan.parallelism
+        self.tasks: Dict[int, TaskImpl] = {}
+        self.in_edges: Dict[str, EdgeImpl] = {}    # keyed by source vertex name
+        self.out_edges: Dict[str, EdgeImpl] = {}   # keyed by dest vertex name
+        self.group_input_specs: List[GroupInputSpec] = []
+        self.priority = 0                          # set by DAG scheduler
+        self.distance_from_root = 0
+        self.counters = TezCounters()
+        self.diagnostics: List[str] = []
+        self.vertex_manager: Any = None            # VertexManagerHost
+        self.completed_tasks = 0
+        self.succeeded_tasks = 0
+        self.failed_tasks = 0
+        self.killed_tasks = 0
+        self.scheduled_task_indices: Set[int] = set()
+        self.init_time = 0.0
+        self.start_time = 0.0
+        self.finish_time = 0.0
+        # root input machinery
+        self.root_input_events: Dict[str, List[InputDataInformationEvent]] = {}
+        self.pending_initializers: Set[str] = set()
+        self.initializers: Dict[str, Any] = {}
+        self.vm_tasks_scheduled = False
+        self.start_requested = False
+        self.started_sources: Set[str] = set()
+        self.completed_source_attempts: Set[TaskAttemptId] = set()
+        self.sm = self._factory.make(self)
+
+    # ------------------------------------------------------------------ util
+    @property
+    def state(self) -> VertexState:
+        return self.sm.state
+
+    def handle(self, event: VertexEvent) -> None:
+        if self.state in TERMINAL_VERTEX_STATES:
+            # A SUCCEEDED vertex still routes late events and can be pulled
+            # back to RUNNING by output loss (reference: VertexImpl handles
+            # V_TASK_RESCHEDULED from SUCCEEDED via VertexRerun).
+            if self.state is VertexState.SUCCEEDED:
+                if event.event_type is VertexEventType.V_ROUTE_EVENT:
+                    self._on_route_event(event)
+                elif event.event_type is VertexEventType.V_TASK_RESCHEDULED:
+                    self.sm.force_state(self._on_task_rescheduled(event))
+                elif event.event_type is VertexEventType.V_TASK_COMPLETED:
+                    self.sm.force_state(self._on_task_completed(event))
+            return
+        if not self.sm.can_handle(event.event_type):
+            log.debug("vertex %s: ignoring %s in %s", self.name,
+                      event.event_type, self.state)
+            return
+        self.sm.handle(event)
+
+    def task(self, index: int) -> TaskImpl:
+        return self.tasks[index]
+
+    def attempt(self, attempt_id: TaskAttemptId) -> Any:
+        t = self.tasks.get(attempt_id.task_id.id)
+        return t.attempt(attempt_id) if t else None
+
+    def downstream_consumer_count(self, src_task: int) -> int:
+        return sum(e.edge_manager.get_num_destination_consumer_tasks(src_task)
+                   for e in self.out_edges.values())
+
+    def progress(self) -> float:
+        if not self.tasks:
+            return 1.0 if self.state is VertexState.SUCCEEDED else 0.0
+        return self.succeeded_tasks / len(self.tasks)
+
+    # ------------------------------------------------------- initialization
+    def _on_init(self, event: VertexEvent) -> VertexState:
+        self.init_time = time.time()
+        for spec in self.plan.root_inputs:
+            if spec.initializer_descriptor is not None:
+                self.pending_initializers.add(spec.name)
+            elif spec.events:
+                self.root_input_events[spec.name] = list(spec.events)
+                if spec.parallelism >= 0 and self.num_tasks < 0:
+                    self.num_tasks = spec.parallelism
+        if self.pending_initializers:
+            self._run_initializers()
+            return VertexState.INITIALIZING
+        return self._try_finish_init()
+
+    def _run_initializers(self) -> None:
+        """Reference: RootInputInitializerManager.java:82 — run initializers
+        on an executor, feed events back through the dispatcher."""
+        from tez_tpu.am.initializer_host import run_initializer
+        for spec in self.plan.root_inputs:
+            if spec.initializer_descriptor is None:
+                continue
+            run_initializer(self, spec)
+
+    def _on_root_input_initialized(self, event: VertexEvent) -> VertexState:
+        name = event.input_name
+        events: List[Any] = event.events or []
+        data_events: List[InputDataInformationEvent] = []
+        for ev in events:
+            from tez_tpu.api.initializer import InputConfigureVertexTasksEvent
+            if isinstance(ev, InputConfigureVertexTasksEvent):
+                if self.num_tasks < 0:
+                    self.num_tasks = ev.num_tasks
+            else:
+                data_events.append(ev)
+        # assign target indices round-robin by source index (reference:
+        # RootInputVertexManager assigns event i -> task i)
+        for i, ev in enumerate(data_events):
+            if ev.target_index < 0:
+                ev.target_index = i % max(1, self.num_tasks if self.num_tasks > 0
+                                          else len(data_events))
+        self.root_input_events[name] = data_events
+        if self.num_tasks < 0 and len(data_events) > 0:
+            self.num_tasks = len(data_events)
+        self.pending_initializers.discard(name)
+        if self.vertex_manager is not None:
+            self.vertex_manager.on_root_vertex_initialized(
+                name, self.plan.root_inputs, data_events)
+        if self.pending_initializers:
+            return VertexState.INITIALIZING
+        return self._try_finish_init()
+
+    def _on_root_input_failed(self, event: VertexEvent) -> VertexState:
+        self.diagnostics.append(
+            f"root input {getattr(event, 'input_name', '?')} failed: "
+            f"{getattr(event, 'diagnostics', '')}")
+        self._abort("FAILED")
+        return VertexState.FAILED
+
+    def _try_finish_init(self) -> VertexState:
+        # ONE_TO_ONE edges inherit source parallelism when unset.
+        if self.num_tasks < 0:
+            for e in self.in_edges.values():
+                if (e.edge_property.data_movement_type is DataMovementType.ONE_TO_ONE
+                        and e.source_vertex.num_tasks >= 0):
+                    self.num_tasks = e.source_vertex.num_tasks
+                    break
+        if self.num_tasks < 0:
+            self.diagnostics.append("parallelism never determined")
+            self._abort("FAILED")
+            return VertexState.FAILED
+        self._create_tasks()
+        self._create_committers()
+        self._create_vertex_manager()
+        self.ctx.history(HistoryEvent(
+            HistoryEventType.VERTEX_INITIALIZED,
+            dag_id=str(self.vertex_id.dag_id), vertex_id=str(self.vertex_id),
+            data={"vertex_name": self.name, "num_tasks": self.num_tasks}))
+        self.dag.on_vertex_inited(self)
+        if self.start_requested:
+            return self._do_start()
+        return VertexState.INITED
+
+    def _create_tasks(self) -> None:
+        for i in range(self.num_tasks):
+            tid = self.vertex_id.task(i)
+            self.tasks[i] = TaskImpl(tid, self)
+
+    def _create_committers(self) -> None:
+        """Instantiate + setup leaf-output committers in the AM (reference:
+        VertexImpl OutputCommitter handling; commit itself runs at DAG
+        success in the default commit mode)."""
+        self.committers: Dict[str, Any] = {}
+        from tez_tpu.api.initializer import OutputCommitterContext
+
+        class _Ctx(OutputCommitterContext):
+            def __init__(self, output_name: str, vertex_name: str, payload: Any):
+                self._o, self._v, self._p = output_name, vertex_name, payload
+
+            @property
+            def output_name(self) -> str:
+                return self._o
+
+            @property
+            def vertex_name(self) -> str:
+                return self._v
+
+            @property
+            def user_payload(self) -> Any:
+                return self._p
+
+        for sink in self.plan.leaf_outputs:
+            if sink.committer_descriptor is None:
+                continue
+            ctx = _Ctx(sink.name, self.name,
+                       sink.committer_descriptor.payload)
+            committer = sink.committer_descriptor.instantiate(ctx)
+            committer.initialize()
+            committer.setup_output()
+            self.committers[sink.name] = committer
+
+    def _recreate_tasks(self, new_parallelism: int) -> None:
+        """Auto-parallelism reconfiguration before any task scheduled."""
+        assert not self.scheduled_task_indices, \
+            "cannot reconfigure after tasks scheduled"
+        self.num_tasks = new_parallelism
+        self.tasks.clear()
+        self._create_tasks()
+
+    def _create_vertex_manager(self) -> None:
+        from tez_tpu.am.vertex_manager_host import (VertexManagerHost,
+                                                    pick_default_manager)
+        desc = self.plan.vertex_manager
+        if desc is None:
+            desc = pick_default_manager(self)
+        self.vertex_manager = VertexManagerHost(self, desc)
+        self.vertex_manager.initialize()
+
+    # ------------------------------------------------------------- start
+    def _on_start(self, event: VertexEvent) -> VertexState:
+        if self.state is VertexState.NEW or self.pending_initializers:
+            self.start_requested = True
+            return self.state
+        return self._do_start()
+
+    def _do_start(self) -> VertexState:
+        self.start_time = time.time()
+        self.ctx.history(HistoryEvent(
+            HistoryEventType.VERTEX_STARTED,
+            dag_id=str(self.vertex_id.dag_id), vertex_id=str(self.vertex_id),
+            data={"vertex_name": self.name}))
+        # tell downstream vertices their source started (slow-start triggers)
+        for e in self.out_edges.values():
+            self.ctx.dispatch(VertexEvent(
+                VertexEventType.V_SOURCE_VERTEX_STARTED,
+                e.destination_vertex.vertex_id, source_vertex_name=self.name))
+        self.vertex_manager.on_vertex_started(
+            sorted(self.completed_source_attempts))
+        if self.num_tasks == 0:
+            return self._check_complete() or VertexState.RUNNING
+        return VertexState.RUNNING
+
+    def _on_source_vertex_started(self, event: VertexEvent) -> None:
+        self.started_sources.add(event.source_vertex_name)
+
+    # ---------------------------------------------------------- scheduling
+    def schedule_tasks(self, task_indices: Sequence[int]) -> None:
+        """Called by the vertex manager host (reference:
+        VertexImpl.scheduleTasks:1775)."""
+        self.vm_tasks_scheduled = True
+        for i in task_indices:
+            if i in self.scheduled_task_indices:
+                continue
+            self.scheduled_task_indices.add(i)
+            self.ctx.dispatch(TaskEvent(TaskEventType.T_SCHEDULE,
+                                        self.vertex_id.task(i)))
+
+    # ------------------------------------------------- completion tracking
+    def _on_task_completed(self, event: VertexEvent) -> VertexState:
+        final_state: TaskState = event.final_state
+        self.completed_tasks += 1
+        if final_state is TaskState.SUCCEEDED:
+            self.succeeded_tasks += 1
+            task = self.tasks[event.task_id.id]
+            att = task.successful_attempt_impl()
+            if att is not None:
+                self._notify_source_completion(att.attempt_id)
+        elif final_state is TaskState.FAILED:
+            self.failed_tasks += 1
+            self.diagnostics.append(
+                f"task {event.task_id} failed: {getattr(event, 'diagnostics', '')}")
+            self._abort("FAILED", terminate_tasks=True)
+            return VertexState.FAILED
+        else:
+            self.killed_tasks += 1
+        res = self._check_complete()
+        return res or VertexState.RUNNING
+
+    def _notify_source_completion(self, attempt_id: TaskAttemptId) -> None:
+        """Tell downstream vertex managers a source task finished."""
+        for e in self.out_edges.values():
+            self.ctx.dispatch(VertexEvent(
+                VertexEventType.V_SOURCE_TASK_ATTEMPT_COMPLETED,
+                e.destination_vertex.vertex_id, attempt_id=attempt_id,
+                source_vertex_name=self.name))
+
+    def _on_source_task_attempt_completed(self, event: VertexEvent) -> None:
+        if event.attempt_id in self.completed_source_attempts:
+            return
+        self.completed_source_attempts.add(event.attempt_id)
+        if self.vertex_manager is not None:
+            self.vertex_manager.on_source_task_completed(event.attempt_id)
+
+    def _on_task_rescheduled(self, event: VertexEvent) -> VertexState:
+        """A SUCCEEDED task is re-running (output loss)."""
+        self.completed_tasks -= 1
+        self.succeeded_tasks -= 1
+        if self.state is VertexState.SUCCEEDED:
+            self.dag.on_vertex_rerunning(self)
+        return VertexState.RUNNING
+
+    def _check_complete(self) -> Optional[VertexState]:
+        if self.completed_tasks >= len(self.tasks) and \
+                self.succeeded_tasks == len(self.tasks):
+            self.finish_time = time.time()
+            self.counters = TezCounters()  # fresh roll-up (vertex may rerun)
+            for t in self.tasks.values():
+                att = t.successful_attempt_impl()
+                if att is not None:
+                    self.counters.aggregate(att.counters)
+            self.ctx.history(HistoryEvent(
+                HistoryEventType.VERTEX_FINISHED,
+                dag_id=str(self.vertex_id.dag_id),
+                vertex_id=str(self.vertex_id),
+                data={"vertex_name": self.name, "state": "SUCCEEDED",
+                      "num_tasks": self.num_tasks,
+                      "time_taken": self.finish_time - (self.start_time or
+                                                        self.finish_time),
+                      "counters": self.counters.to_dict()}))
+            self.dag.on_vertex_completed(self, VertexState.SUCCEEDED)
+            return VertexState.SUCCEEDED
+        if self.completed_tasks >= len(self.tasks) and self.killed_tasks > 0:
+            self._abort("KILLED")
+            return VertexState.KILLED
+        return None
+
+    def _abort(self, final: str, terminate_tasks: bool = False) -> None:
+        self.finish_time = time.time()
+        if terminate_tasks:
+            for t in self.tasks.values():
+                if t.state not in TERMINAL_TASK_STATES:
+                    self.ctx.dispatch(TaskEvent(TaskEventType.T_TERMINATE,
+                                                t.task_id))
+        self.ctx.history(HistoryEvent(
+            HistoryEventType.VERTEX_FINISHED,
+            dag_id=str(self.vertex_id.dag_id), vertex_id=str(self.vertex_id),
+            data={"vertex_name": self.name, "state": final,
+                  "diagnostics": "; ".join(self.diagnostics)}))
+        self.dag.on_vertex_completed(
+            self, VertexState[final] if final in VertexState.__members__
+            else VertexState.FAILED)
+
+    def _on_terminate(self, event: VertexEvent) -> VertexState:
+        diag = getattr(event, "diagnostics", "vertex terminated")
+        self.diagnostics.append(diag)
+        live = [t for t in self.tasks.values()
+                if t.state not in TERMINAL_TASK_STATES]
+        if not live:
+            self._abort("KILLED")
+            return VertexState.KILLED
+        for t in live:
+            self.ctx.dispatch(TaskEvent(TaskEventType.T_TERMINATE, t.task_id,
+                                        diagnostics=diag))
+        return VertexState.RUNNING if self.state is VertexState.RUNNING \
+            else VertexState.KILLED
+
+    def _on_manager_error(self, event: VertexEvent) -> VertexState:
+        self.diagnostics.append(
+            f"vertex manager error: {getattr(event, 'diagnostics', '')}")
+        self._abort("FAILED", terminate_tasks=True)
+        return VertexState.FAILED
+
+    # ------------------------------------------------------- event routing
+    def _on_route_event(self, event: VertexEvent) -> None:
+        """Route one task-generated TezEvent (reference: VertexImpl event
+        routing + Edge.sendTezEventToDestinationTasks)."""
+        tez_event: TezEvent = event.tez_event
+        ev = tez_event.event
+        src = tez_event.source_info
+        attempt_id: Optional[TaskAttemptId] = src.task_attempt_id if src else None
+        src_task = attempt_id.task_id.id if attempt_id else -1
+        version = attempt_id.id if attempt_id else 0
+
+        if isinstance(ev, (DataMovementEvent, CompositeDataMovementEvent)):
+            edge = self.out_edges.get(src.edge_vertex_name) if src else None
+            if edge is None:
+                log.warning("vertex %s: DME for unknown edge %s", self.name,
+                            src.edge_vertex_name if src else None)
+                return
+            edge.add_source_event(src_task, version, ev)
+            self.dag.notify_new_edge_events(edge)
+        elif isinstance(ev, InputFailedEvent):
+            edge = self.out_edges.get(src.edge_vertex_name) if src else None
+            if edge is not None:
+                edge.add_source_event(src_task, version, ev)
+        elif isinstance(ev, VertexManagerEvent):
+            target = self.dag.vertex_by_name(ev.target_vertex_name)
+            if target is not None and target.vertex_manager is not None:
+                ev.producer_attempt = attempt_id
+                target.vertex_manager.on_vertex_manager_event(ev)
+        elif isinstance(ev, InputReadErrorEvent):
+            self._handle_input_read_error(ev, src, src_task)
+        elif isinstance(ev, InputInitializerEvent):
+            target = self.dag.vertex_by_name(ev.target_vertex_name)
+            if target is not None:
+                from tez_tpu.am.initializer_host import deliver_initializer_event
+                deliver_initializer_event(target, ev)
+        elif isinstance(ev, CustomProcessorEvent):
+            pass  # delivered directly to processors via task pull
+        else:
+            log.warning("vertex %s: unroutable event %r", self.name, ev)
+
+    def _handle_input_read_error(self, ev: InputReadErrorEvent,
+                                 src: Any, consumer_task: int) -> None:
+        """Fetch failure: blame the producer attempt (§3.5)."""
+        edge = self.in_edges.get(src.edge_vertex_name) if src else None
+        if edge is None:
+            return
+        src_task_idx = edge.route_input_error_to_source(consumer_task, ev.index)
+        producer_vertex: VertexImpl = edge.source_vertex
+        task = producer_vertex.tasks.get(src_task_idx)
+        if task is None:
+            return
+        target_attempt = task.task_id.attempt(ev.version)
+        self.ctx.dispatch(TaskAttemptEvent(
+            TaskAttemptEventType.TA_OUTPUT_FAILED, target_attempt,
+            consumer_task_index=consumer_task,
+            is_local_fetch=ev.is_local_fetch,
+            is_disk_error_at_source=ev.is_disk_error_at_source,
+            diagnostics=ev.diagnostics))
+
+    # ------------------------------------------------ consumer event pull
+    def get_task_events(self, task_index: int,
+                        seqs: Dict[str, int]) -> List[tuple]:
+        """Pull routed events for one of this vertex's tasks as
+        (input_name, event) pairs.  ``seqs`` maps in-edge id -> consumed
+        high-water mark, updated in place."""
+        out: List[tuple] = []
+        for edge in self.in_edges.values():
+            seq = seqs.get(edge.id, 0)
+            events, new_seq = edge.get_events_for_task(task_index, seq)
+            seqs[edge.id] = new_seq
+            out.extend((edge.source_vertex.name, e) for e in events)
+        # root input events, delivered once
+        key = "__root__"
+        if not seqs.get(key):
+            for name, events in self.root_input_events.items():
+                for ev in events:
+                    if ev.target_index == task_index:
+                        out.append((name, ev))
+            seqs[key] = 1
+        return out
+
+    # ---------------------------------------------------------- task specs
+    def build_task_spec(self, attempt_id: TaskAttemptId) -> TaskSpec:
+        task_idx = attempt_id.task_id.id
+        inputs: List[InputSpec] = []
+        for e in self.in_edges.values():
+            inputs.append(InputSpec(
+                source_vertex_name=e.source_vertex.name,
+                input_descriptor=e.edge_property.edge_destination,
+                physical_input_count=e.num_dest_physical_inputs(task_idx),
+                is_root_input=False))
+        for spec in self.plan.root_inputs:
+            inputs.append(InputSpec(
+                source_vertex_name=spec.name,
+                input_descriptor=spec.input_descriptor,
+                physical_input_count=1, is_root_input=True))
+        outputs: List[OutputSpec] = []
+        for e in self.out_edges.values():
+            outputs.append(OutputSpec(
+                destination_vertex_name=e.destination_vertex.name,
+                output_descriptor=e.edge_property.edge_source,
+                physical_output_count=e.num_source_physical_outputs(task_idx),
+                is_leaf_output=False))
+        for sink in self.plan.leaf_outputs:
+            outputs.append(OutputSpec(
+                destination_vertex_name=sink.name,
+                output_descriptor=sink.output_descriptor,
+                physical_output_count=1, is_leaf_output=True))
+        return TaskSpec(
+            attempt_id=attempt_id,
+            dag_name=self.dag.name,
+            vertex_name=self.name,
+            vertex_parallelism=self.num_tasks,
+            processor_descriptor=self.plan.processor,
+            inputs=tuple(inputs),
+            outputs=tuple(outputs),
+            group_inputs=tuple(self.group_input_specs),
+            conf=dict(self.conf),
+        )
+
+    def status_dict(self) -> Dict[str, Any]:
+        running = sum(1 for t in self.tasks.values()
+                      if t.state is TaskState.RUNNING)
+        return {
+            "name": self.name, "state": self.state.name,
+            "total_tasks": len(self.tasks), "succeeded": self.succeeded_tasks,
+            "running": running, "failed": self.failed_tasks,
+            "killed": self.killed_tasks,
+            "progress": self.progress(),
+            "diagnostics": list(self.diagnostics),
+        }
+
+
+def _build_vertex_factory() -> StateMachineFactory:
+    S, E = VertexState, VertexEventType
+    f = StateMachineFactory(S.NEW)
+    f.add_multi(S.NEW, (S.INITIALIZING, S.INITED, S.FAILED, S.RUNNING),
+                E.V_INIT, VertexImpl._on_init)
+    f.add_multi(S.NEW, (S.NEW,), E.V_START, VertexImpl._on_start)
+    f.add(S.NEW, S.NEW, E.V_SOURCE_VERTEX_STARTED,
+          VertexImpl._on_source_vertex_started)
+    f.add(S.NEW, S.KILLED, E.V_TERMINATE, VertexImpl._on_terminate)
+
+    f.add_multi(S.INITIALIZING, (S.INITIALIZING, S.INITED, S.FAILED, S.RUNNING),
+                E.V_ROOT_INPUT_INITIALIZED, VertexImpl._on_root_input_initialized)
+    f.add_multi(S.INITIALIZING, (S.FAILED,), E.V_ROOT_INPUT_FAILED,
+                VertexImpl._on_root_input_failed)
+    f.add_multi(S.INITIALIZING, (S.INITIALIZING,), E.V_START,
+                VertexImpl._on_start)
+    f.add(S.INITIALIZING, S.INITIALIZING, E.V_SOURCE_VERTEX_STARTED,
+          VertexImpl._on_source_vertex_started)
+    f.add(S.INITIALIZING, S.KILLED, E.V_TERMINATE, VertexImpl._on_terminate)
+
+    f.add_multi(S.INITED, (S.RUNNING,), E.V_START, VertexImpl._on_start)
+    f.add(S.INITED, S.INITED, E.V_SOURCE_VERTEX_STARTED,
+          VertexImpl._on_source_vertex_started)
+    f.add(S.INITED, S.INITED, E.V_SOURCE_TASK_ATTEMPT_COMPLETED,
+          VertexImpl._on_source_task_attempt_completed)
+    f.add(S.INITED, S.INITED, E.V_ROUTE_EVENT, VertexImpl._on_route_event)
+    f.add(S.INITED, S.KILLED, E.V_TERMINATE, VertexImpl._on_terminate)
+    f.add_multi(S.INITED, (S.FAILED,), E.V_MANAGER_USER_CODE_ERROR,
+                VertexImpl._on_manager_error)
+
+    f.add_multi(S.RUNNING, (S.RUNNING, S.SUCCEEDED, S.FAILED, S.KILLED),
+                E.V_TASK_COMPLETED, VertexImpl._on_task_completed)
+    f.add_multi(S.RUNNING, (S.RUNNING,), E.V_TASK_RESCHEDULED,
+                VertexImpl._on_task_rescheduled)
+    f.add(S.RUNNING, S.RUNNING, E.V_ROUTE_EVENT, VertexImpl._on_route_event)
+    f.add(S.RUNNING, S.RUNNING, E.V_SOURCE_TASK_ATTEMPT_COMPLETED,
+          VertexImpl._on_source_task_attempt_completed)
+    f.add(S.RUNNING, S.RUNNING, E.V_SOURCE_VERTEX_STARTED,
+          VertexImpl._on_source_vertex_started)
+    f.add_multi(S.RUNNING, (S.RUNNING, S.KILLED), E.V_TERMINATE,
+                VertexImpl._on_terminate)
+    f.add_multi(S.RUNNING, (S.FAILED,), E.V_MANAGER_USER_CODE_ERROR,
+                VertexImpl._on_manager_error)
+    # SUCCEEDED vertices can still route events (late consumers) and see
+    # task reschedules — handled via handle() terminal-state guard override:
+    return f
+
+
+VertexImpl._factory = _build_vertex_factory()

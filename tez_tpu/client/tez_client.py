@@ -1,0 +1,97 @@
+"""TezClient: session & non-session DAG submission.
+
+Reference parity: tez-api/.../client/TezClient.java:228 (builder, start:384,
+submitDAG:613, stop:727, preWarm:897) + FrameworkClient SPI (YARN vs
+LocalClient).  Here the stock framework client is local/in-process (the
+reference's LocalClient path); a cluster deployment would swap a gRPC
+FrameworkClient behind the same surface.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from tez_tpu.am.app_master import DAGAppMaster
+from tez_tpu.client.dag_client import DAGClient
+from tez_tpu.common import config as C
+from tez_tpu.common.ids import new_app_id
+from tez_tpu.dag.dag import DAG
+
+log = logging.getLogger(__name__)
+
+
+class FrameworkClient:
+    """SPI: how to reach/launch an AM (reference: FrameworkClient.java:58)."""
+
+    def start(self) -> None: ...
+
+    def stop(self) -> None: ...
+
+    def submit_dag(self, plan: Any) -> Any:
+        raise NotImplementedError
+
+
+class LocalFrameworkClient(FrameworkClient):
+    """In-process AM (reference: LocalClient.java:80)."""
+
+    def __init__(self, conf: C.TezConfiguration):
+        self.conf = conf
+        self.app_id = new_app_id()
+        self.am: Optional[DAGAppMaster] = None
+
+    def start(self) -> None:
+        self.am = DAGAppMaster(self.app_id, self.conf)
+        self.am.start()
+
+    def stop(self) -> None:
+        if self.am is not None:
+            self.am.stop()
+            self.am = None
+
+    def submit_dag(self, plan: Any) -> Any:
+        return self.am.submit_dag(plan)
+
+
+class TezClient:
+    def __init__(self, name: str, conf: Optional[Dict[str, Any]] = None,
+                 session: bool = False):
+        self.name = name
+        self.conf = C.TezConfiguration(conf or {})
+        self.session_mode = session or self.conf.get(C.SESSION_MODE)
+        self.framework_client: Optional[FrameworkClient] = None
+        self._started = False
+
+    @staticmethod
+    def create(name: str, conf: Optional[Dict[str, Any]] = None,
+               session: bool = False) -> "TezClient":
+        return TezClient(name, conf, session)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "TezClient":
+        assert not self._started
+        self.framework_client = LocalFrameworkClient(self.conf)
+        self.framework_client.start()
+        self._started = True
+        return self
+
+    def submit_dag(self, dag: DAG) -> DAGClient:
+        assert self._started, "client not started"
+        plan = dag.create_dag_plan(dict(self.conf))
+        dag_id = self.framework_client.submit_dag(plan)
+        return DAGClient(self.framework_client.am, dag_id)
+
+    def pre_warm(self) -> None:
+        """Spin runners up before the first DAG (reference: preWarm:897)."""
+        am = self.framework_client.am
+        am.ensure_runners(am.total_slots())
+
+    def stop(self) -> None:
+        if self._started:
+            self.framework_client.stop()
+            self._started = False
+
+    def __enter__(self) -> "TezClient":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()

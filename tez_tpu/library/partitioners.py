@@ -1,0 +1,47 @@
+"""Partitioner SPI + stock partitioners.
+
+Reference parity: tez-runtime-library/.../library/partitioner/
+{HashPartitioner,RoundRobinPartitioner}.java.  Device-side batch
+partitioning for the TPU data plane lives in tez_tpu.ops.partition; these
+host-side partitioners remain for scalar/record-at-a-time paths and parity.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+
+def _stable_hash(key: Any) -> int:
+    """Deterministic across processes (Python's hash() is salted)."""
+    if isinstance(key, (bytes, bytearray)):
+        data = bytes(key)
+    elif isinstance(key, str):
+        data = key.encode()
+    elif isinstance(key, int):
+        data = key.to_bytes(8, "little", signed=True)
+    else:
+        data = repr(key).encode()
+    # FNV-1a 32-bit — matches ops/partition.py device kernel
+    h = 2166136261
+    for b in data:
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+class Partitioner:
+    def get_partition(self, key: Any, value: Any, num_partitions: int) -> int:
+        raise NotImplementedError
+
+
+class HashPartitioner(Partitioner):
+    def get_partition(self, key: Any, value: Any, num_partitions: int) -> int:
+        return _stable_hash(key) % num_partitions
+
+
+class RoundRobinPartitioner(Partitioner):
+    def __init__(self) -> None:
+        self._next = 0
+
+    def get_partition(self, key: Any, value: Any, num_partitions: int) -> int:
+        p = self._next % num_partitions
+        self._next += 1
+        return p

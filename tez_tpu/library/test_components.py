@@ -1,0 +1,118 @@
+"""Fault-injectable Input/Processor/Output test doubles.
+
+Reference parity: tez-tests/.../test/{TestInput,TestProcessor,TestOutput}.java
+(config-driven failures by task/attempt index, SURVEY.md §4 item 4).  Payload
+dict keys:
+  do_fail: bool                 fail in run/read
+  failing_task_indices: [int]   which tasks fail ([-1] = all)
+  failing_upto_attempt: int     fail attempts <= this number (then succeed)
+  fatal: bool                   report a FATAL failure
+  sleep_ms: int                 delay before acting
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Sequence
+
+from tez_tpu.api.events import TezAPIEvent
+from tez_tpu.api.runtime import (KeyValueReader, LogicalIOProcessor,
+                                 LogicalInput, LogicalOutput, Reader, Writer)
+
+
+class _FailPolicy:
+    def __init__(self, context: Any):
+        payload = context.user_payload.load() or {}
+        self.payload = payload if isinstance(payload, dict) else {}
+        self.context = context
+
+    def should_fail(self) -> bool:
+        if not self.payload.get("do_fail"):
+            return False
+        tasks = self.payload.get("failing_task_indices", [-1])
+        if -1 not in tasks and self.context.task_index not in tasks:
+            return False
+        upto = self.payload.get("failing_upto_attempt", 10**9)
+        return self.context.task_attempt_number <= upto
+
+    @property
+    def fatal(self) -> bool:
+        return bool(self.payload.get("fatal"))
+
+    def sleep(self) -> None:
+        ms = self.payload.get("sleep_ms", 0)
+        if ms:
+            time.sleep(ms / 1000.0)
+
+
+class _EmptyReader(KeyValueReader):
+    def __iter__(self):
+        return iter(())
+
+
+class TestInput(LogicalInput):
+    def initialize(self) -> List[TezAPIEvent]:
+        self._policy = _FailPolicy(self.context)
+        return []
+
+    def get_reader(self) -> Reader:
+        self._policy.sleep()
+        if self._policy.should_fail():
+            if self._policy.fatal:
+                self.context.fatal_error(None, "TestInput fatal failure")
+            raise RuntimeError(
+                f"TestInput failing task={self.context.task_index} "
+                f"attempt={self.context.task_attempt_number}")
+        return _EmptyReader()
+
+    def handle_events(self, events: Sequence[TezAPIEvent]) -> None:
+        pass
+
+    def close(self) -> List[TezAPIEvent]:
+        return []
+
+
+class _NullWriter(Writer):
+    def write(self, key: Any, value: Any) -> None:
+        pass
+
+
+class TestOutput(LogicalOutput):
+    def initialize(self) -> List[TezAPIEvent]:
+        self._policy = _FailPolicy(self.context)
+        return []
+
+    def get_writer(self) -> Writer:
+        return _NullWriter()
+
+    def handle_events(self, events: Sequence[TezAPIEvent]) -> None:
+        pass
+
+    def close(self) -> List[TezAPIEvent]:
+        if self._policy.should_fail():
+            raise RuntimeError("TestOutput failing at close")
+        return []
+
+
+class TestProcessor(LogicalIOProcessor):
+    def initialize(self) -> None:
+        self._policy = _FailPolicy(self.context)
+
+    def run(self, inputs: Dict[str, LogicalInput],
+            outputs: Dict[str, LogicalOutput]) -> None:
+        self._policy.sleep()
+        # drive inputs so TestInput failures fire
+        for inp in inputs.values():
+            reader = inp.get_reader()
+            if isinstance(reader, KeyValueReader):
+                for _ in reader:
+                    pass
+        if self._policy.should_fail():
+            if self._policy.fatal:
+                self.context.fatal_error(None, "TestProcessor fatal failure")
+                raise RuntimeError("fatal TestProcessor failure")
+            raise RuntimeError(
+                f"TestProcessor failing task={self.context.task_index} "
+                f"attempt={self.context.task_attempt_number}")
+
+    def close(self) -> None:
+        pass

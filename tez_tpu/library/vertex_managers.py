@@ -1,0 +1,220 @@
+"""Stock vertex managers.
+
+Reference parity:
+- ImmediateStartVertexManager (tez-runtime-library/.../vertexmanager/)
+- RootInputVertexManager (tez-dag/.../dag/impl/RootInputVertexManager.java:
+  slow-start for root-input vertices; simplified to immediate here)
+- InputReadyVertexManager (ONE_TO_ONE/location affinity scheduling)
+- ShuffleVertexManager{Base} (slow-start by source completion fraction +
+  auto-parallelism, ShuffleVertexManagerBase.java:271,320; auto-parallelism
+  shrink in tez_tpu.library.shuffle_vm_payloads)
+"""
+from __future__ import annotations
+
+import logging
+import math
+from typing import Any, Dict, List, Optional, Sequence, Set
+
+from tez_tpu.api.events import VertexManagerEvent
+from tez_tpu.api.vertex_manager import (ScheduleTaskRequest,
+                                        TaskAttemptIdentifier,
+                                        VertexManagerPlugin,
+                                        VertexManagerPluginContext,
+                                        VertexStateUpdate)
+from tez_tpu.dag.edge_property import DataMovementType
+
+log = logging.getLogger(__name__)
+
+
+class ImmediateStartVertexManager(VertexManagerPlugin):
+    """Schedule every task as soon as the vertex starts."""
+
+    def initialize(self) -> None:
+        pass
+
+    def on_vertex_started(self, completions: Sequence[TaskAttemptIdentifier]) -> None:
+        n = self.context.get_vertex_num_tasks(self.context.vertex_name)
+        self.context.schedule_tasks(
+            [ScheduleTaskRequest(i) for i in range(n)])
+
+    def on_source_task_completed(self, attempt: TaskAttemptIdentifier) -> None:
+        pass
+
+    def on_vertex_manager_event_received(self, event: VertexManagerEvent) -> None:
+        pass
+
+    def on_root_vertex_initialized(self, input_name: str, descriptor: Any,
+                                   events: List[Any]) -> None:
+        pass
+
+
+class RootInputVertexManager(ImmediateStartVertexManager):
+    """Vertices fed by root inputs; parallelism is already settled by the
+    initializer when the vertex starts, so scheduling is immediate.  The
+    reference adds optional slow-start against *source vertices* which root
+    vertices don't have."""
+
+
+class InputReadyVertexManager(VertexManagerPlugin):
+    """Schedule task i when every ONE_TO_ONE source task i has completed and
+    broadcast sources are fully done (reference: InputReadyVertexManager —
+    also provides affinity; locality is a no-op in-process)."""
+
+    def initialize(self) -> None:
+        self._scheduled: Set[int] = set()
+        self._one_to_one_done: Dict[str, Set[int]] = {}
+        self._broadcast_done: Dict[str, Set[int]] = {}
+        self._started = False
+
+    def _edge_sources(self) -> Dict[str, Any]:
+        return self.context.get_input_vertex_edge_properties()
+
+    def on_vertex_started(self, completions: Sequence[TaskAttemptIdentifier]) -> None:
+        self._started = True
+        for c in completions:
+            self._record(c)
+        self._maybe_schedule()
+
+    def on_source_task_completed(self, attempt: TaskAttemptIdentifier) -> None:
+        self._record(attempt)
+        if self._started:
+            self._maybe_schedule()
+
+    def _record(self, attempt: TaskAttemptIdentifier) -> None:
+        props = self._edge_sources()
+        prop = props.get(attempt.vertex_name)
+        if prop is None:
+            return
+        if prop.data_movement_type is DataMovementType.ONE_TO_ONE:
+            self._one_to_one_done.setdefault(
+                attempt.vertex_name, set()).add(attempt.task_index)
+        else:
+            self._broadcast_done.setdefault(
+                attempt.vertex_name, set()).add(attempt.task_index)
+
+    def _maybe_schedule(self) -> None:
+        props = self._edge_sources()
+        num = self.context.get_vertex_num_tasks(self.context.vertex_name)
+        def _source_done(name: str) -> bool:
+            target = self.context.get_vertex_num_tasks(name)
+            # parallelism undetermined (-1) means the source hasn't even
+            # initialized — definitely not ready
+            return target >= 0 and \
+                len(self._broadcast_done.get(name, ())) >= target
+
+        bcast_ready = all(
+            _source_done(name) for name, p in props.items()
+            if p.data_movement_type is not DataMovementType.ONE_TO_ONE)
+        if not bcast_ready:
+            return
+        o2o = [name for name, p in props.items()
+               if p.data_movement_type is DataMovementType.ONE_TO_ONE]
+        ready = []
+        for i in range(num):
+            if i in self._scheduled:
+                continue
+            if all(i in self._one_to_one_done.get(name, ()) for name in o2o):
+                ready.append(i)
+        if ready:
+            self._scheduled.update(ready)
+            self.context.schedule_tasks(
+                [ScheduleTaskRequest(i) for i in ready])
+
+    def on_vertex_manager_event_received(self, event: VertexManagerEvent) -> None:
+        pass
+
+    def on_root_vertex_initialized(self, input_name: str, descriptor: Any,
+                                   events: List[Any]) -> None:
+        pass
+
+
+class ShuffleVertexManager(VertexManagerPlugin):
+    """Slow-start + (phase 5) auto-parallelism for scatter-gather consumers.
+
+    Reference: ShuffleVertexManagerBase.java:271 — tasks are released as the
+    fraction of completed source tasks crosses [min, max]; between the two
+    fractions tasks are released proportionally.  Config via payload dict:
+    {"min_fraction": 0.25, "max_fraction": 0.75, "auto_parallel": False,
+     "desired_task_input_size": 100MiB, "min_task_parallelism": 1}.
+    """
+
+    DEFAULT_MIN_FRACTION = 0.25
+    DEFAULT_MAX_FRACTION = 0.75
+
+    def initialize(self) -> None:
+        payload = self.context.user_payload.load() or {}
+        if not isinstance(payload, dict):
+            payload = {}
+        self.min_fraction = payload.get("min_fraction", self.DEFAULT_MIN_FRACTION)
+        self.max_fraction = payload.get("max_fraction", self.DEFAULT_MAX_FRACTION)
+        self.auto_parallel = payload.get("auto_parallel", False)
+        self.desired_task_input_size = payload.get(
+            "desired_task_input_size", 100 * 1024 * 1024)
+        self.min_task_parallelism = payload.get("min_task_parallelism", 1)
+        self._started = False
+        self._scheduled: Set[int] = set()
+        self._completed_sources: Set[tuple] = set()
+        self._pending_completions: List[TaskAttemptIdentifier] = []
+        self._output_stats: Dict[tuple, int] = {}   # (vertex, task) -> bytes
+        self._parallelism_determined = not self.auto_parallel
+
+    # -- source bookkeeping --------------------------------------------------
+    def _total_source_tasks(self) -> int:
+        total = 0
+        for name, prop in self.context.get_input_vertex_edge_properties().items():
+            if prop.data_movement_type in (DataMovementType.SCATTER_GATHER,
+                                           DataMovementType.CUSTOM):
+                total += max(0, self.context.get_vertex_num_tasks(name))
+        return total
+
+    def on_vertex_started(self, completions: Sequence[TaskAttemptIdentifier]) -> None:
+        self._started = True
+        for c in completions:
+            self._completed_sources.add((c.vertex_name, c.task_index))
+        self._maybe_schedule()
+
+    def on_source_task_completed(self, attempt: TaskAttemptIdentifier) -> None:
+        self._completed_sources.add((attempt.vertex_name, attempt.task_index))
+        if self._started:
+            self._maybe_schedule()
+
+    def on_vertex_manager_event_received(self, event: VertexManagerEvent) -> None:
+        """Collect per-task output sizes for auto-parallelism (phase 5)."""
+        payload = event.user_payload
+        if isinstance(payload, dict) and "output_size" in payload and \
+                event.producer_attempt is not None:
+            att = event.producer_attempt
+            key = (str(att.vertex_id), att.task_id.id) \
+                if hasattr(att, "task_id") else (str(att), 0)
+            self._output_stats[key] = payload["output_size"]
+
+    def on_root_vertex_initialized(self, input_name: str, descriptor: Any,
+                                   events: List[Any]) -> None:
+        pass
+
+    # -- scheduling ----------------------------------------------------------
+    def _maybe_schedule(self) -> None:
+        total_sources = self._total_source_tasks()
+        num_tasks = self.context.get_vertex_num_tasks(self.context.vertex_name)
+        if num_tasks <= 0:
+            return
+        if total_sources == 0:
+            fraction = 1.0
+        else:
+            fraction = len(self._completed_sources) / total_sources
+        if fraction < self.min_fraction:
+            return
+        if self.max_fraction <= self.min_fraction:
+            release = num_tasks
+        elif fraction >= self.max_fraction:
+            release = num_tasks
+        else:
+            release = int(math.ceil(
+                num_tasks * (fraction - self.min_fraction)
+                / (self.max_fraction - self.min_fraction)))
+        ready = [i for i in range(min(release, num_tasks))
+                 if i not in self._scheduled]
+        if ready:
+            self._scheduled.update(ready)
+            self.context.schedule_tasks(
+                [ScheduleTaskRequest(i) for i in ready])

@@ -1,0 +1,148 @@
+"""Runtime context implementations handed to Inputs/Outputs/Processors.
+
+Reference parity: tez-runtime-internals/.../api/impl/{TezInputContextImpl,
+TezOutputContextImpl,TezProcessorContextImpl}.java — counters, payloads,
+event send, memory requests, progress, fatal-error funnel.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence, TYPE_CHECKING
+
+from tez_tpu.api.events import (EventMetaData, TezAPIEvent, TezEvent)
+from tez_tpu.api.runtime import (InputContext, MemoryUpdateCallback,
+                                 ObjectRegistry, OutputContext,
+                                 ProcessorContext)
+from tez_tpu.common.counters import TezCounters
+from tez_tpu.common.payload import UserPayload
+
+if TYPE_CHECKING:
+    from tez_tpu.runtime.task_runner import TaskRunner
+
+
+class TaskKilledError(Exception):
+    """Raised inside user code when the AM killed this attempt."""
+
+
+class _BaseContext:
+    def __init__(self, runner: "TaskRunner", payload: UserPayload,
+                 producer_consumer_type: str, edge_vertex_name: str = ""):
+        self._runner = runner
+        self._payload = payload
+        self._type = producer_consumer_type
+        self._edge_vertex_name = edge_vertex_name
+
+    # -- identity ------------------------------------------------------------
+    @property
+    def task_attempt_id(self):
+        return self._runner.spec.attempt_id
+
+    @property
+    def task_index(self) -> int:
+        return self._runner.spec.task_index
+
+    @property
+    def task_attempt_number(self) -> int:
+        return self._runner.spec.attempt_number
+
+    @property
+    def vertex_name(self) -> str:
+        return self._runner.spec.vertex_name
+
+    @property
+    def dag_name(self) -> str:
+        return self._runner.spec.dag_name
+
+    @property
+    def vertex_parallelism(self) -> int:
+        return self._runner.spec.vertex_parallelism
+
+    @property
+    def counters(self) -> TezCounters:
+        return self._runner.counters
+
+    @property
+    def user_payload(self) -> UserPayload:
+        return self._payload
+
+    @property
+    def conf(self) -> dict:
+        return self._runner.spec.conf
+
+    # -- events --------------------------------------------------------------
+    def _source_meta(self) -> EventMetaData:
+        return EventMetaData(
+            producer_consumer_type=self._type,
+            task_vertex_name=self._runner.spec.vertex_name,
+            edge_vertex_name=self._edge_vertex_name,
+            task_attempt_id=self._runner.spec.attempt_id)
+
+    def send_events(self, events: Sequence[TezAPIEvent]) -> None:
+        meta = self._source_meta()
+        self._runner.enqueue_events(
+            [TezEvent(ev, source_info=meta) for ev in events])
+
+    # -- memory / progress ---------------------------------------------------
+    def request_initial_memory(self, size: int,
+                               callback: "MemoryUpdateCallback | None") -> None:
+        cb = callback.memory_assigned if callback is not None else None
+        self._runner.memory.request_memory(size, cb, requester=repr(self))
+
+    def notify_progress(self) -> None:
+        self._runner.check_killed()
+
+    def set_progress(self, progress: float) -> None:
+        self._runner.progress = max(0.0, min(1.0, progress))
+        self._runner.check_killed()
+
+    def fatal_error(self, exc: Optional[BaseException], message: str) -> None:
+        self._runner.fatal_error(exc, message)
+
+    @property
+    def work_dirs(self) -> List[str]:
+        return [self._runner.work_dir]
+
+    def get_service_provider_metadata(self, service: str) -> Any:
+        return self._runner.service_metadata.get(service)
+
+    @property
+    def object_registry(self) -> ObjectRegistry:
+        return self._runner.registry
+
+
+class TezInputContext(_BaseContext, InputContext):
+    def __init__(self, runner: "TaskRunner", payload: UserPayload,
+                 source_vertex: str, input_index: int):
+        super().__init__(runner, payload, "INPUT", source_vertex)
+        self._input_index = input_index
+
+    @property
+    def source_vertex_name(self) -> str:
+        return self._edge_vertex_name
+
+    @property
+    def input_index(self) -> int:
+        return self._input_index
+
+
+class TezOutputContext(_BaseContext, OutputContext):
+    def __init__(self, runner: "TaskRunner", payload: UserPayload,
+                 dest_vertex: str, output_index: int):
+        super().__init__(runner, payload, "OUTPUT", dest_vertex)
+        self._output_index = output_index
+
+    @property
+    def destination_vertex_name(self) -> str:
+        return self._edge_vertex_name
+
+    @property
+    def output_index(self) -> int:
+        return self._output_index
+
+
+class TezProcessorContext(_BaseContext, ProcessorContext):
+    def __init__(self, runner: "TaskRunner", payload: UserPayload):
+        super().__init__(runner, payload, "PROCESSOR")
+
+    def can_commit(self) -> bool:
+        return self._runner.umbilical.can_commit(self._runner.spec.attempt_id)

@@ -1,0 +1,63 @@
+"""Cross-I/O memory arbitration for one task.
+
+Reference parity: tez-runtime-internals/.../common/resources/
+MemoryDistributor.java:110 + runtime-library WeightedScalingMemoryDistributor:
+components request memory during initialize(), grants are scaled to fit the
+task budget and delivered via callback before start().
+
+TPU-first delta: the budget is an HBM byte budget per task (device memory is
+the scarce resource the sorter/merger spans live in), not a JVM heap.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, List, Optional
+
+log = logging.getLogger(__name__)
+
+#: Default per-task HBM budget used when the spec doesn't say (bytes).
+DEFAULT_TASK_BUDGET = 2 << 30
+
+#: Requests below this are granted in full before scaling (reference:
+#: tez.task.scale.memory.reserve-fraction behavior approximated).
+RESERVE_FRACTION = 0.05
+
+
+@dataclasses.dataclass
+class _Request:
+    requester: str
+    requested: int
+    callback: Optional[Callable[[int], None]]
+    granted: int = 0
+
+
+class MemoryDistributor:
+    def __init__(self, budget_bytes: int = DEFAULT_TASK_BUDGET):
+        self.budget = int(budget_bytes * (1 - RESERVE_FRACTION))
+        self._requests: List[_Request] = []
+        self._allocated = False
+
+    def request_memory(self, size: int, callback: Optional[Callable[[int], None]],
+                       requester: str = "") -> None:
+        assert not self._allocated, "requests closed after allocation"
+        self._requests.append(_Request(requester, int(size), callback))
+
+    def make_initial_allocations(self) -> None:
+        """Scale every request proportionally when oversubscribed
+        (reference: MemoryDistributor.makeInitialAllocations:120)."""
+        total = sum(r.requested for r in self._requests)
+        scale = 1.0 if total <= self.budget or total == 0 else \
+            self.budget / total
+        for r in self._requests:
+            r.granted = int(r.requested * scale)
+            if r.callback is not None:
+                r.callback(r.granted)
+        self._allocated = True
+        if scale < 1.0:
+            log.info("memory oversubscribed: scaled %d requests by %.2f "
+                     "(asked %d, budget %d)", len(self._requests), scale,
+                     total, self.budget)
+
+    def total_granted(self) -> int:
+        return sum(r.granted for r in self._requests)

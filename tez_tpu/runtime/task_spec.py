@@ -1,0 +1,60 @@
+"""Serializable task description shipped AM -> runner.
+
+Reference parity: tez-runtime-internals/.../runtime/api/impl/TaskSpec.java
+(272 LoC): processor descriptor + one InputSpec/OutputSpec per connected edge
+or root-input/leaf-output, plus task conf.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+from tez_tpu.common.ids import TaskAttemptId
+from tez_tpu.common.payload import (InputDescriptor, OutputDescriptor,
+                                    ProcessorDescriptor)
+
+
+@dataclasses.dataclass(frozen=True)
+class InputSpec:
+    """Reference: InputSpec.java — source vertex (or root input) name +
+    descriptor + physical input count."""
+    source_vertex_name: str
+    input_descriptor: InputDescriptor
+    physical_input_count: int
+    is_root_input: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class OutputSpec:
+    destination_vertex_name: str
+    output_descriptor: OutputDescriptor
+    physical_output_count: int
+    is_leaf_output: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupInputSpec:
+    group_name: str
+    group_vertices: Tuple[str, ...]
+    merged_input_descriptor: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskSpec:
+    attempt_id: TaskAttemptId
+    dag_name: str
+    vertex_name: str
+    vertex_parallelism: int
+    processor_descriptor: ProcessorDescriptor
+    inputs: Tuple[InputSpec, ...]
+    outputs: Tuple[OutputSpec, ...]
+    group_inputs: Tuple[GroupInputSpec, ...] = ()
+    conf: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def task_index(self) -> int:
+        return self.attempt_id.task_id.id
+
+    @property
+    def attempt_number(self) -> int:
+        return self.attempt_id.id

@@ -1,0 +1,210 @@
+"""Byte-exactness tests for the device data plane (sort/partition/merge/run
+format) against numpy/pure-Python goldens — the TestIFile/TestPipelinedSorter
+analog (SURVEY.md §4 tier 1 'real byte paths')."""
+import os
+import random
+
+import numpy as np
+import pytest
+
+from tez_tpu.library.partitioners import HashPartitioner
+from tez_tpu.ops import device
+from tez_tpu.ops.keycodec import encode_keys, matrix_to_lanes, pad_to_matrix
+from tez_tpu.ops.runformat import KVBatch, Run
+from tez_tpu.ops.serde import VarLongSerde, get_serde
+from tez_tpu.ops.sorter import (DeviceSorter, merge_sorted_runs,
+                                sum_long_combiner)
+
+
+def random_pairs(n, seed=0, max_key=12, max_val=8):
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        k = bytes(rng.randrange(256) for _ in range(rng.randrange(1, max_key)))
+        v = bytes(rng.randrange(256) for _ in range(rng.randrange(0, max_val)))
+        out.append((k, v))
+    return out
+
+
+def golden_sorted(pairs, num_partitions):
+    hp = HashPartitioner()
+    decorated = [(hp.get_partition(k, v, num_partitions), k, i, v)
+                 for i, (k, v) in enumerate(pairs)]
+    decorated.sort(key=lambda t: (t[0], t[1], t[2]))  # stable by arrival
+    return decorated
+
+
+def test_kvbatch_roundtrip():
+    pairs = random_pairs(100)
+    b = KVBatch.from_pairs(pairs)
+    assert list(b.iter_pairs()) == pairs
+    assert b.num_records == 100
+    perm = np.arange(99, -1, -1)
+    rev = b.take(perm)
+    assert list(rev.iter_pairs()) == pairs[::-1]
+
+
+def test_pad_and_lanes_order_preserving():
+    keys = [b"a", b"ab", b"b", b"", b"a\x00", b"\xff" * 20]
+    b = KVBatch.from_pairs([(k, b"") for k in keys])
+    mat, lengths = pad_to_matrix(b.key_bytes, b.key_offsets, 16)
+    lanes = matrix_to_lanes(mat)
+    order = sorted(range(len(keys)),
+                   key=lambda i: tuple(lanes[i].tolist()) + (i,))
+    golden = sorted(range(len(keys)), key=lambda i: (keys[i][:16], i))
+    assert order == golden
+
+
+def test_device_hash_matches_host_partitioner():
+    pairs = random_pairs(500, seed=1, max_key=40)
+    b = KVBatch.from_pairs(pairs)
+    hp = HashPartitioner()
+    golden = np.array([hp.get_partition(k, None, 7) for k, _ in pairs])
+    klens = b.key_offsets[1:] - b.key_offsets[:-1]
+    w = 1 << max(2, (int(klens.max()) - 1).bit_length())
+    mat, lengths = pad_to_matrix(b.key_bytes, b.key_offsets, w)
+    got = device.hash_partition(mat, lengths, 7)
+    np.testing.assert_array_equal(got, golden)
+
+
+@pytest.mark.parametrize("n,width", [(1000, 16), (1000, 4), (0, 16), (1, 16)])
+def test_device_sorter_byte_exact(n, width):
+    pairs = random_pairs(n, seed=2, max_key=24)  # keys can exceed width=4/16
+    sorter = DeviceSorter(num_partitions=5, key_width=width)
+    for k, v in pairs:
+        sorter.write(k, v)
+    run = sorter.flush()
+    golden = golden_sorted(pairs, 5)
+    got = list(run.batch.iter_pairs())
+    assert got == [(k, v) for _, k, _, v in golden]
+    # partition index correct
+    for p in range(5):
+        part = run.partition(p)
+        expected = [(k, v) for pp, k, _, v in golden if pp == p]
+        assert list(part.iter_pairs()) == expected
+
+
+def test_sorter_multi_span_merge():
+    pairs = random_pairs(3000, seed=3)
+    sorter = DeviceSorter(num_partitions=3, key_width=16,
+                          span_budget_bytes=4096)  # force many spans
+    for k, v in pairs:
+        sorter.write(k, v)
+    run = sorter.flush()
+    assert sorter.num_spills > 1
+    golden = golden_sorted(pairs, 3)
+    assert list(run.batch.iter_pairs()) == [(k, v) for _, k, _, v in golden]
+
+
+def test_sorter_host_spill(tmp_path):
+    pairs = random_pairs(2000, seed=4)
+    sorter = DeviceSorter(num_partitions=2, span_budget_bytes=2048,
+                          spill_dir=str(tmp_path), mem_budget_bytes=4096)
+    for k, v in pairs:
+        sorter.write(k, v)
+    run = sorter.flush()
+    assert any(f.endswith(".run") for f in os.listdir(tmp_path))
+    golden = golden_sorted(pairs, 2)
+    assert list(run.batch.iter_pairs()) == [(k, v) for _, k, _, v in golden]
+
+
+def test_run_save_load_checksum(tmp_path):
+    pairs = random_pairs(50, seed=5)
+    sorter = DeviceSorter(num_partitions=4)
+    for k, v in pairs:
+        sorter.write(k, v)
+    run = sorter.flush()
+    p = str(tmp_path / "x.run")
+    run.save(p)
+    run2 = Run.load(p)
+    assert list(run2.batch.iter_pairs()) == list(run.batch.iter_pairs())
+    np.testing.assert_array_equal(run2.row_index, run.row_index)
+    # corrupt -> checksum failure
+    data = bytearray(open(p, "rb").read())
+    data[-1] ^= 0xFF
+    open(p, "wb").write(bytes(data))
+    with pytest.raises(IOError, match="checksum"):
+        Run.load(p)
+
+
+def test_merge_sorted_runs_equals_single_sort():
+    pairs = random_pairs(900, seed=6)
+    chunks = [pairs[:300], pairs[300:600], pairs[600:]]
+    runs = []
+    for c in chunks:
+        s = DeviceSorter(num_partitions=4)
+        for k, v in c:
+            s.write(k, v)
+        runs.append(s.flush())
+    merged = merge_sorted_runs(runs, 4, 16)
+    # golden: all pairs, arrival order = chunk order (stability contract)
+    golden = golden_sorted(pairs, 4)
+    assert list(merged.batch.iter_pairs()) == \
+        [(k, v) for _, k, _, v in golden]
+
+
+def test_pipelined_spills_emitted():
+    pairs = random_pairs(1000, seed=7)
+    sorter = DeviceSorter(num_partitions=2, span_budget_bytes=4096)
+    spills = []
+    sorter.on_spill = lambda run, sid: spills.append((sid, run))
+    for k, v in pairs:
+        sorter.write(k, v)
+    assert sorter.flush() is None
+    assert len(spills) >= 2
+    total = sum(r.batch.num_records for _, r in spills)
+    assert total == 1000
+
+
+def test_sum_long_combiner():
+    serde = VarLongSerde()
+    words = [b"a", b"b", b"a", b"c", b"a", b"b"]
+    sorter = DeviceSorter(num_partitions=2, combiner=sum_long_combiner)
+    for w in words:
+        sorter.write(w, serde.to_bytes(1))
+    run = sorter.flush()
+    got = {k: serde.from_bytes(v) for k, v in run.batch.iter_pairs()}
+    assert got == {b"a": 3, b"b": 2, b"c": 1}
+
+
+def test_varlong_serde_order_and_values():
+    s = VarLongSerde()
+    vals = [-(2**62), -5, -1, 0, 1, 7, 2**62]
+    encs = [s.to_bytes(v) for v in vals]
+    assert encs == sorted(encs)
+    assert [s.from_bytes(e) for e in encs] == vals
+
+
+def test_empty_partition_flags():
+    sorter = DeviceSorter(num_partitions=8)
+    sorter.write(b"onlykey", b"v")
+    run = sorter.flush()
+    flags = run.empty_partition_flags()
+    assert flags.count(False) == 1 and flags.count(True) == 7
+
+
+def test_split_boundary_no_lost_or_duplicated_lines(tmp_path):
+    """Every line is read by exactly one split, including lines starting
+    exactly at a split boundary (LineRecordReader semantics)."""
+    from tez_tpu.io.text import FileSplit, _LineReader, compute_splits
+
+    class _Ctx:
+        def notify_progress(self):
+            pass
+
+        class counters:
+            @staticmethod
+            def increment(*a):
+                pass
+
+    p = tmp_path / "t.txt"
+    lines = [f"line{i:04d}" for i in range(1000)]
+    p.write_text("\n".join(lines) + "\n")
+    size = p.stat().st_size
+    # brute-force every 2-way split point, including line boundaries
+    for cut in list(range(1, size, 97)) + [9, 10, 11, 18, 19, 20, 21]:
+        splits = [FileSplit(str(p), 0, cut), FileSplit(str(p), cut, size - cut)]
+        got = []
+        for s in splits:
+            got.extend(l.decode() for _, l in _LineReader([s], _Ctx()))
+        assert got == lines, f"cut={cut}"

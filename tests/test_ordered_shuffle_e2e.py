@@ -1,0 +1,94 @@
+"""End-to-end ordered-shuffle tests: OrderedWordCount through the full stack
+(the phase-3 gate from SURVEY.md §7: E2E with correct, deterministically
+ordered reducer input)."""
+import collections
+import os
+import random
+
+import pytest
+
+from tez_tpu.examples import ordered_wordcount
+
+
+WORDS = ["apple", "banana", "cherry", "date", "elderberry", "fig", "grape",
+         "kiwi", "lemon", "mango", "nectarine", "orange", "papaya", "quince"]
+
+
+def write_corpus(path, num_lines=500, seed=0):
+    rng = random.Random(seed)
+    counts = collections.Counter()
+    with open(path, "w") as fh:
+        for _ in range(num_lines):
+            line = [rng.choice(WORDS) for _ in range(rng.randrange(1, 12))]
+            counts.update(line)
+            fh.write(" ".join(line) + "\n")
+    return counts
+
+
+def read_output(out_dir):
+    rows = []
+    for f in sorted(os.listdir(out_dir)):
+        if not f.startswith("part-"):
+            continue
+        with open(os.path.join(out_dir, f), "rb") as fh:
+            for line in fh:
+                word, count = line.rstrip(b"\n").split(b"\t")
+                rows.append((word.decode(), int(count)))
+    return rows
+
+
+@pytest.mark.parametrize("combine,pipelined", [(True, False), (False, False),
+                                               (True, True)])
+def test_ordered_wordcount_e2e(tmp_path, combine, pipelined):
+    corpus = tmp_path / "in.txt"
+    golden = write_corpus(str(corpus), num_lines=300)
+    out_dir = str(tmp_path / "out")
+    state = ordered_wordcount.run(
+        [str(corpus)], out_dir,
+        conf={"tez.staging-dir": str(tmp_path / "stg")},
+        tokenizer_parallelism=3, summation_parallelism=2,
+        sorter_parallelism=1, combine=combine, pipelined=pipelined)
+    assert state == "SUCCEEDED"
+    assert os.path.exists(os.path.join(out_dir, "_SUCCESS"))
+    rows = read_output(out_dir)
+    # counts correct
+    assert {w: c for w, c in rows} == dict(golden)
+    # globally ordered by count ascending (big-endian long key order)
+    counts = [c for _, c in rows]
+    assert counts == sorted(counts)
+
+
+def test_ordered_wordcount_multifile_splits(tmp_path):
+    goldens = collections.Counter()
+    ins = []
+    for i in range(3):
+        p = tmp_path / f"in{i}.txt"
+        goldens.update(write_corpus(str(p), num_lines=100, seed=i))
+        ins.append(str(p))
+    out_dir = str(tmp_path / "out")
+    state = ordered_wordcount.run(
+        ins, out_dir, conf={"tez.staging-dir": str(tmp_path / "stg")},
+        tokenizer_parallelism=4)
+    assert state == "SUCCEEDED"
+    assert {w: c for w, c in read_output(out_dir)} == dict(goldens)
+
+
+def test_determinism_two_runs_byte_identical(tmp_path):
+    """Byte-identical output across runs (the reference north-star's
+    byte-exactness requirement applied to our own framework)."""
+    corpus = tmp_path / "in.txt"
+    write_corpus(str(corpus), num_lines=200, seed=42)
+    outs = []
+    for run_i in range(2):
+        out_dir = str(tmp_path / f"out{run_i}")
+        state = ordered_wordcount.run(
+            [str(corpus)], out_dir,
+            conf={"tez.staging-dir": str(tmp_path / f"stg{run_i}")},
+            tokenizer_parallelism=3, summation_parallelism=3)
+        assert state == "SUCCEEDED"
+        parts = b""
+        for f in sorted(os.listdir(out_dir)):
+            if f.startswith("part-"):
+                parts += open(os.path.join(out_dir, f), "rb").read()
+        outs.append(parts)
+    assert outs[0] == outs[1]

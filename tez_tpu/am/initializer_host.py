@@ -39,6 +39,15 @@ class _InitializerContext(InputInitializerContext):
 
     @property
     def user_payload(self) -> UserPayload:
+        # The initializer's own payload, unconditionally (reference:
+        # InputInitializerContext.getUserPayload); the input descriptor's
+        # payload is exposed separately as input_user_payload.
+        if self._spec.initializer_descriptor is not None:
+            return self._spec.initializer_descriptor.payload
+        return UserPayload()
+
+    @property
+    def input_user_payload(self) -> UserPayload:
         return self._spec.input_descriptor.payload
 
     @property

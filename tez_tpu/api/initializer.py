@@ -31,7 +31,14 @@ class InputInitializerContext(abc.ABC):
 
     @property
     @abc.abstractmethod
-    def user_payload(self) -> UserPayload: ...
+    def user_payload(self) -> UserPayload:
+        """The initializer descriptor's payload."""
+
+    @property
+    def input_user_payload(self) -> UserPayload:
+        """The input descriptor's payload (reference:
+        InputInitializerContext.getInputUserPayload)."""
+        return UserPayload()
 
     @property
     @abc.abstractmethod

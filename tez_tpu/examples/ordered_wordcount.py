@@ -1,0 +1,125 @@
+"""OrderedWordCount: the reference's flagship example and the north-star
+benchmark workload.
+
+Reference parity: tez-examples/.../OrderedWordCount.java:56 (DAG at :124):
+tokenizer --(word,1 sorted+combined)--> summation --(count,word sorted)-->
+sorter, writing words ordered by count.  Both edges are sorted scatter-gather
+running on the TPU DeviceSorter; the count key uses the order-preserving
+big-endian long serde so numeric order == byte order.
+"""
+from __future__ import annotations
+
+import re
+import sys
+from typing import Dict
+
+from tez_tpu.api.runtime import LogicalInput, LogicalOutput
+from tez_tpu.client.tez_client import TezClient
+from tez_tpu.common.payload import (InputDescriptor,
+                                    InputInitializerDescriptor,
+                                    OutputCommitterDescriptor,
+                                    OutputDescriptor, ProcessorDescriptor)
+from tez_tpu.dag.dag import (DAG, DataSinkDescriptor, DataSourceDescriptor,
+                             Edge, Vertex)
+from tez_tpu.library.conf import OrderedPartitionedKVEdgeConfig
+from tez_tpu.library.processors import SimpleProcessor
+
+TOKEN_RE = re.compile(rb"\\s+")
+
+
+class TokenProcessor(SimpleProcessor):
+    """Split lines into words, emit (word, 1)."""
+
+    def run(self, inputs: Dict[str, LogicalInput],
+            outputs: Dict[str, LogicalOutput]) -> None:
+        reader = inputs["input"].get_reader()
+        writer = outputs["summation"].get_writer()
+        for _offset, line in reader:
+            for word in line.split():
+                writer.write(word, 1)
+
+
+class SumProcessor(SimpleProcessor):
+    """Sum counts per word, emit (count, word) toward the sorter."""
+
+    def run(self, inputs: Dict[str, LogicalInput],
+            outputs: Dict[str, LogicalOutput]) -> None:
+        reader = inputs["tokenizer"].get_reader()
+        writer = outputs["sorter"].get_writer()
+        for word, counts in reader:
+            writer.write(sum(counts), word)
+
+
+class NoOpSorterProcessor(SimpleProcessor):
+    """Write the (count, word) stream — already globally count-ordered when
+    sorter parallelism is 1 (reference: OrderedWordCount NoOpSorter)."""
+
+    def run(self, inputs: Dict[str, LogicalInput],
+            outputs: Dict[str, LogicalOutput]) -> None:
+        reader = inputs["summation"].get_reader()
+        writer = outputs["output"].get_writer()
+        for count, words in reader:
+            for word in words:
+                writer.write(word, str(count))
+
+
+def build_dag(input_paths, output_path: str, tokenizer_parallelism: int = -1,
+              summation_parallelism: int = 2, sorter_parallelism: int = 1,
+              combine: bool = True, pipelined: bool = False) -> DAG:
+    tokenizer = Vertex.create("tokenizer", ProcessorDescriptor.create(
+        TokenProcessor), tokenizer_parallelism)
+    tokenizer.add_data_source("input", DataSourceDescriptor.create(
+        InputDescriptor.create("tez_tpu.io.text:TextInput"),
+        InputInitializerDescriptor.create(
+            "tez_tpu.io.text:TextSplitGenerator",
+            payload={"paths": list(input_paths),
+                     "desired_splits": tokenizer_parallelism}),
+    ))
+    summation = Vertex.create("summation", ProcessorDescriptor.create(
+        SumProcessor), summation_parallelism)
+    sorter = Vertex.create("sorter", ProcessorDescriptor.create(
+        NoOpSorterProcessor), sorter_parallelism)
+    sorter.add_data_sink("output", DataSinkDescriptor.create(
+        OutputDescriptor.create("tez_tpu.io.file_output:FileOutput",
+                                payload={"path": output_path,
+                                         "key_serde": "text",
+                                         "value_serde": "text"}),
+        OutputCommitterDescriptor.create(
+            "tez_tpu.io.file_output:FileOutputCommitter",
+            payload={"path": output_path})))
+
+    e1_builder = OrderedPartitionedKVEdgeConfig.new_builder("bytes", "long")
+    if combine:
+        e1_builder.set_combiner("sum_long")
+    if pipelined:
+        e1_builder.set_pipelined(True)
+    e1 = e1_builder.build()
+    e2 = OrderedPartitionedKVEdgeConfig.new_builder("long", "bytes").build()
+
+    dag = DAG.create("OrderedWordCount")
+    dag.add_vertex(tokenizer).add_vertex(summation).add_vertex(sorter)
+    dag.add_edge(Edge.create(tokenizer, summation,
+                             e1.create_default_edge_property()))
+    dag.add_edge(Edge.create(summation, sorter,
+                             e2.create_default_edge_property()))
+    return dag
+
+
+def run(input_paths, output_path: str, conf=None, **kw) -> str:
+    with TezClient.create("OrderedWordCount", conf or {}) as client:
+        dag = build_dag(input_paths, output_path, **kw)
+        status = client.submit_dag(dag).wait_for_completion()
+        return status.state.name
+
+
+def main() -> int:
+    if len(sys.argv) < 3:
+        print("usage: ordered_wordcount <input...> <output_dir>")
+        return 2
+    state = run(sys.argv[1:-1], sys.argv[-1])
+    print(state)
+    return 0 if state == "SUCCEEDED" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

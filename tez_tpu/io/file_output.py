@@ -1,0 +1,106 @@
+"""Leaf output writing part files with a rename-on-commit committer.
+
+Reference parity: tez-mapreduce MROutput.java:88 + MROutputCommitter (wraps
+FileOutputCommitter: write to a temporary attempt dir, commit renames into
+the final output dir).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, List, Sequence
+
+from tez_tpu.api.events import TezAPIEvent
+from tez_tpu.api.initializer import OutputCommitter
+from tez_tpu.api.runtime import KeyValueWriter, LogicalOutput, Writer
+from tez_tpu.common.counters import TaskCounter
+from tez_tpu.ops.serde import get_serde
+
+TMP_SUBDIR = "_temporary"
+
+
+class _PartWriter(KeyValueWriter):
+    def __init__(self, path: str, key_serde: Any, val_serde: Any,
+                 context: Any, sep: bytes = b"\t"):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._fh = open(path, "wb")
+        self.key_serde = key_serde
+        self.val_serde = val_serde
+        self.context = context
+        self.sep = sep
+
+    def write(self, key: Any, value: Any) -> None:
+        k = self.key_serde.to_bytes(key)
+        v = self.val_serde.to_bytes(value)
+        self._fh.write(k + self.sep + v + b"\n")
+        self.context.counters.increment(TaskCounter.OUTPUT_RECORDS)
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+class FileOutput(LogicalOutput):
+    """Payload: {"path": output dir, "key_serde": .., "value_serde": ..,
+    "separator": "\\t"}.  Writes part-{task:05d} under a temporary attempt
+    dir; the committer publishes them."""
+
+    def initialize(self) -> List[TezAPIEvent]:
+        payload = self.context.user_payload.load() or {}
+        self.out_dir = payload["path"]
+        self.key_serde = get_serde(payload.get("key_serde", "text"))
+        self.val_serde = get_serde(payload.get("value_serde", "text"))
+        self.sep = payload.get("separator", "\t").encode()
+        attempt = self.context.task_attempt_id
+        self.tmp_path = os.path.join(
+            self.out_dir, TMP_SUBDIR, str(attempt),
+            f"part-{self.context.task_index:05d}")
+        self._writer: _PartWriter | None = None
+        return []
+
+    def get_writer(self) -> Writer:
+        if self._writer is None:
+            self._writer = _PartWriter(self.tmp_path, self.key_serde,
+                                       self.val_serde, self.context, self.sep)
+        return self._writer
+
+    def handle_events(self, events: Sequence[TezAPIEvent]) -> None:
+        pass
+
+    def close(self) -> List[TezAPIEvent]:
+        if self._writer is not None:
+            self._writer.close()
+            # task-level "commit": move into the attempt-committed dir only
+            # if the AM lets this attempt commit (speculation arbitration)
+            committed = os.path.join(self.out_dir, TMP_SUBDIR, "committed",
+                                     os.path.basename(self.tmp_path))
+            os.makedirs(os.path.dirname(committed), exist_ok=True)
+            if not os.path.exists(committed):
+                os.replace(self.tmp_path, committed)
+        return []
+
+
+class FileOutputCommitter(OutputCommitter):
+    """Publishes committed part files to the output dir; abort removes
+    temporaries."""
+
+    def initialize(self) -> None:
+        payload = self.context.user_payload.load() or {}
+        self.out_dir = payload["path"]
+
+    def setup_output(self) -> None:
+        os.makedirs(os.path.join(self.out_dir, TMP_SUBDIR), exist_ok=True)
+
+    def commit_output(self) -> None:
+        committed = os.path.join(self.out_dir, TMP_SUBDIR, "committed")
+        if os.path.isdir(committed):
+            for f in sorted(os.listdir(committed)):
+                os.replace(os.path.join(committed, f),
+                           os.path.join(self.out_dir, f))
+        shutil.rmtree(os.path.join(self.out_dir, TMP_SUBDIR),
+                      ignore_errors=True)
+        with open(os.path.join(self.out_dir, "_SUCCESS"), "w"):
+            pass
+
+    def abort_output(self, final_state: str) -> None:
+        shutil.rmtree(os.path.join(self.out_dir, TMP_SUBDIR),
+                      ignore_errors=True)

@@ -1,0 +1,261 @@
+"""Stock inputs: the sorted-shuffle consumer side.
+
+Reference parity: tez-runtime-library/.../library/input/
+OrderedGroupedKVInput.java:101 (owns Shuffle orchestrator, blocking
+waitForInput, KeyValuesReader grouping via ValuesIterator) with the
+Shuffle/ShuffleScheduler/MergeManager trio collapsed into a fetch table +
+device merge: fetches are local buffer handoffs (or DCN fetches later), the
+final merge is the device k-way merge kernel.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tez_tpu.api.events import (CompositeRoutedDataMovementEvent,
+                                DataMovementEvent, InputFailedEvent,
+                                InputReadErrorEvent, ShufflePayload,
+                                TezAPIEvent)
+from tez_tpu.api.runtime import (KeyValueReader, KeyValuesReader,
+                                 LogicalInput, Reader)
+from tez_tpu.common.counters import TaskCounter
+from tez_tpu.ops.runformat import KVBatch, Run
+from tez_tpu.ops.serde import Serde, get_serde
+from tez_tpu.ops.sorter import merge_sorted_runs
+from tez_tpu.shuffle.service import (ShuffleDataNotFound,
+                                     local_shuffle_service)
+
+log = logging.getLogger(__name__)
+
+
+def _conf_get(context: Any, key: str, default: Any) -> Any:
+    payload = context.user_payload.load()
+    conf: Dict[str, Any] = dict(context.conf)
+    if isinstance(payload, dict):
+        conf.update(payload)
+    return conf.get(key, default)
+
+
+class _SlotState:
+    """Fetch bookkeeping for one physical input (one source task)."""
+    __slots__ = ("batches", "spills_seen", "complete", "version")
+
+    def __init__(self) -> None:
+        self.batches: List[KVBatch] = []
+        self.spills_seen: set = set()
+        self.complete = False
+        self.version = -1
+
+
+class ShuffleFetchTable:
+    """Tracks per-source fetch state; thread-safe (events arrive on the
+    heartbeat thread, the reader blocks on the processor thread).
+
+    This is the ShuffleScheduler+MergeManager seam: local fetches are
+    immediate; a DCN fetcher would enqueue here instead."""
+
+    def __init__(self, context: Any, num_slots: int, my_partition: int):
+        self.context = context
+        self.num_slots = num_slots
+        self.my_partition = my_partition
+        self.slots = [_SlotState() for _ in range(num_slots)]
+        self.completed = 0
+        self.lock = threading.Condition()
+        self.service = local_shuffle_service()
+        self.failed = False
+        self.diagnostics = ""
+
+    def on_payload(self, slot: int, partition: int, payload: ShufflePayload
+                   ) -> None:
+        with self.lock:
+            s = self.slots[slot]
+            if s.complete or \
+                    (payload.spill_id >= 0 and payload.spill_id in s.spills_seen):
+                return  # duplicate delivery (e.g. after slot reset race)
+        try:
+            if payload.is_empty(partition):
+                batch = None
+            else:
+                batch = self.service.fetch_partition(
+                    payload.path_component, payload.spill_id, partition)
+                self.context.counters.increment(
+                    TaskCounter.SHUFFLE_BYTES, batch.nbytes)
+                self.context.counters.increment(
+                    TaskCounter.SHUFFLE_BYTES_TO_MEM, batch.nbytes)
+                self.context.counters.increment(TaskCounter.NUM_SHUFFLED_INPUTS)
+        except ShuffleDataNotFound as e:
+            log.warning("fetch failed for slot %d: %s", slot, e)
+            self.context.send_events([InputReadErrorEvent(
+                diagnostics=str(e), index=slot, version=0,
+                is_local_fetch=True)])
+            self.context.counters.increment(
+                TaskCounter.NUM_FAILED_SHUFFLE_INPUTS)
+            return
+        with self.lock:
+            s = self.slots[slot]
+            if batch is not None:
+                s.batches.append(batch)
+            if payload.spill_id >= 0:
+                s.spills_seen.add(payload.spill_id)
+            if payload.last_event:
+                if not s.complete:
+                    s.complete = True
+                    self.completed += 1
+            self.lock.notify_all()
+
+    def on_input_failed(self, slot: int, version: int) -> None:
+        """Producer re-running: discard and re-wait (reference:
+        InputFailedEvent handling in shuffle event handlers)."""
+        with self.lock:
+            s = self.slots[slot]
+            if s.complete:
+                self.completed -= 1
+            self.slots[slot] = _SlotState()
+            self.lock.notify_all()
+
+    def wait_all(self, timeout: Optional[float] = None) -> List[KVBatch]:
+        import time
+        deadline = None if timeout is None else time.time() + timeout
+        with self.lock:
+            while True:
+                if self.failed:
+                    raise RuntimeError(f"shuffle failed: {self.diagnostics}")
+                if self.completed >= self.num_slots:
+                    out: List[KVBatch] = []
+                    for s in self.slots:
+                        out.extend(s.batches)
+                    return out
+                if deadline is not None and time.time() > deadline:
+                    raise TimeoutError(
+                        f"shuffle incomplete: {self.completed}/{self.num_slots}")
+                self.lock.wait(0.2)
+                # raises TaskKilledError if the AM killed this attempt (or
+                # the heartbeat died) — never block forever
+                self.context.notify_progress()
+
+
+class OrderedGroupedKVInput(LogicalInput):
+    """Sorted, grouped input (reduce side)."""
+
+    def initialize(self) -> List[TezAPIEvent]:
+        ctx = self.context
+        self.key_serde = get_serde(_conf_get(ctx, "tez.runtime.key.class",
+                                             "bytes"))
+        self.val_serde = get_serde(_conf_get(ctx, "tez.runtime.value.class",
+                                             "bytes"))
+        self.key_width = int(_conf_get(ctx, "tez.runtime.tpu.key.width.bytes",
+                                       16))
+        self.table = ShuffleFetchTable(ctx, self.num_physical_inputs,
+                                       my_partition=ctx.task_index)
+        ctx.request_initial_memory(0, None)
+        self._merged: Optional[KVBatch] = None
+        return []
+
+    def handle_events(self, events: Sequence[TezAPIEvent]) -> None:
+        for ev in events:
+            if isinstance(ev, CompositeRoutedDataMovementEvent):
+                # source_index = my partition, target_index_start = slot
+                payload = ev.user_payload
+                assert isinstance(payload, ShufflePayload), payload
+                for i in range(ev.count):
+                    self.table.on_payload(ev.target_index_start + i,
+                                          ev.source_index, payload)
+            elif isinstance(ev, DataMovementEvent):
+                payload = ev.user_payload
+                assert isinstance(payload, ShufflePayload), payload
+                self.table.on_payload(ev.target_index, ev.source_index,
+                                      payload)
+            elif isinstance(ev, InputFailedEvent):
+                self.table.on_input_failed(ev.target_index, ev.version)
+            else:
+                log.warning("OrderedGroupedKVInput: unexpected event %r", ev)
+
+    def _wait_and_merge(self) -> KVBatch:
+        if self._merged is None:
+            import time
+            t0 = time.time()
+            batches = self.table.wait_all()
+            self.context.counters.find_counter(TaskCounter.SHUFFLE_PHASE_TIME)\
+                .increment(int((time.time() - t0) * 1000))
+            t1 = time.time()
+            runs = [Run(b, np.array([0, b.num_records], dtype=np.int64))
+                    for b in batches if b.num_records > 0]
+            if runs:
+                merged = merge_sorted_runs(runs, 1, self.key_width,
+                                           counters=self.context.counters)
+                self._merged = merged.batch
+            else:
+                self._merged = KVBatch.empty()
+            self.context.counters.find_counter(TaskCounter.MERGE_PHASE_TIME)\
+                .increment(int((time.time() - t1) * 1000))
+            self.context.counters.increment(
+                TaskCounter.REDUCE_INPUT_RECORDS, self._merged.num_records)
+        return self._merged
+
+    def get_reader(self) -> "GroupedKVReader":
+        return GroupedKVReader(self._wait_and_merge(), self.key_serde,
+                               self.val_serde, self.context)
+
+    def close(self) -> List[TezAPIEvent]:
+        self._merged = None
+        return []
+
+
+class GroupedKVReader(KeyValuesReader):
+    """Groups adjacent equal keys (ValuesIterator analog, vectorized group
+    boundary detection)."""
+
+    def __init__(self, batch: KVBatch, key_serde: Serde, val_serde: Serde,
+                 context: Any):
+        self.batch = batch
+        self.key_serde = key_serde
+        self.val_serde = val_serde
+        self.context = context
+        self._group_starts = self._compute_groups(batch)
+
+    @staticmethod
+    def _compute_groups(batch: KVBatch) -> np.ndarray:
+        n = batch.num_records
+        if n == 0:
+            return np.zeros(0, dtype=np.int64)
+        ko = batch.key_offsets
+        lengths = ko[1:] - ko[:-1]
+        same = np.zeros(n, dtype=bool)
+        cand = np.flatnonzero(lengths[1:] == lengths[:-1])
+        kb = batch.key_bytes
+        for i in cand:
+            same[i + 1] = kb[ko[i]:ko[i + 1]].tobytes() == \
+                kb[ko[i + 1]:ko[i + 2]].tobytes()
+        return np.flatnonzero(~same).astype(np.int64)
+
+    def __iter__(self) -> Iterator[Tuple[Any, Iterator[Any]]]:
+        n = self.batch.num_records
+        bounds = np.append(self._group_starts, n)
+        groups = 0
+        for s, e in zip(bounds[:-1], bounds[1:]):
+            key = self.key_serde.from_bytes(self.batch.key(int(s)))
+            values = (self.val_serde.from_bytes(self.batch.value(i))
+                      for i in range(int(s), int(e)))
+            groups += 1
+            if (groups & 0x3FF) == 0:
+                self.context.notify_progress()
+            yield key, values
+        self.context.counters.increment(TaskCounter.REDUCE_INPUT_GROUPS,
+                                        groups)
+
+
+class UnorderedKVReaderAdapter(KeyValueReader):
+    """Flat (key, value) iteration over a batch (used by unordered inputs and
+    tests)."""
+
+    def __init__(self, batch: KVBatch, key_serde: Serde, val_serde: Serde):
+        self.batch = batch
+        self.key_serde = key_serde
+        self.val_serde = val_serde
+
+    def __iter__(self):
+        for k, v in self.batch.iter_pairs():
+            yield self.key_serde.from_bytes(k), self.val_serde.from_bytes(v)

@@ -1,0 +1,157 @@
+"""Stock outputs: the sorted-shuffle producer side.
+
+Reference parity: tez-runtime-library/.../library/output/
+OrderedPartitionedKVOutput.java (sorter selection :151, getWriter :168,
+close :189 -> DME events via ShuffleUtils.generateEventOnSpill) — the sorter
+behind it is the TPU DeviceSorter instead of PipelinedSorter.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from tez_tpu.api.events import (CompositeDataMovementEvent, ShufflePayload,
+                                TezAPIEvent, VertexManagerEvent,
+                                pack_empty_partitions)
+from tez_tpu.api.runtime import KeyValuesWriter, LogicalOutput, Writer
+from tez_tpu.common.counters import TaskCounter
+from tez_tpu.ops.runformat import Run
+from tez_tpu.ops.serde import get_serde
+from tez_tpu.ops.sorter import DeviceSorter, sum_long_combiner
+from tez_tpu.shuffle.service import local_shuffle_service
+
+log = logging.getLogger(__name__)
+
+_COMBINERS = {"sum_long": sum_long_combiner}
+
+
+def _conf_get(context: Any, key: str, default: Any) -> Any:
+    payload = context.user_payload.load()
+    conf: Dict[str, Any] = dict(context.conf)
+    if isinstance(payload, dict):
+        conf.update(payload)
+    return conf.get(key, default)
+
+
+def output_path_component(context: Any) -> str:
+    return f"{context.dag_name}/{context.task_attempt_id}/" \
+           f"{context.destination_vertex_name}"
+
+
+class _SorterWriter(KeyValuesWriter):
+    def __init__(self, sorter: DeviceSorter, key_serde: Any, val_serde: Any,
+                 context: Any):
+        self.sorter = sorter
+        self.key_serde = key_serde
+        self.val_serde = val_serde
+        self.context = context
+        self._n = 0
+
+    def write(self, key: Any, value: Any) -> None:
+        k = self.key_serde.to_bytes(key)
+        v = self.val_serde.to_bytes(value)
+        self.sorter.write(k, v)
+        self.context.counters.increment(TaskCounter.OUTPUT_BYTES,
+                                        len(k) + len(v))
+        self._n += 1
+        if (self._n & 0x3FFF) == 0:
+            self.context.notify_progress()
+
+
+class OrderedPartitionedKVOutput(LogicalOutput):
+    """Sorted, partitioned output feeding OrderedGroupedKVInput."""
+
+    def initialize(self) -> List[TezAPIEvent]:
+        ctx = self.context
+        sort_mb = int(_conf_get(ctx, "tez.runtime.io.sort.mb", 256))
+        self._pipelined = bool(_conf_get(
+            ctx, "tez.runtime.pipelined-shuffle.enabled", False))
+        key_width = int(_conf_get(ctx, "tez.runtime.tpu.key.width.bytes", 16))
+        combiner_name = _conf_get(ctx, "tez.runtime.combiner.class", "")
+        spill_dir = _conf_get(ctx, "tez.runtime.tpu.host.spill.dir", "") or \
+            os.path.join(ctx.work_dirs[0], "spill")
+        self.key_serde = get_serde(_conf_get(ctx, "tez.runtime.key.class",
+                                             "bytes"))
+        self.val_serde = get_serde(_conf_get(ctx, "tez.runtime.value.class",
+                                             "bytes"))
+        self.sorter = DeviceSorter(
+            num_partitions=self.num_physical_outputs,
+            key_width=key_width,
+            span_budget_bytes=sort_mb << 20,
+            spill_dir=spill_dir,
+            counters=ctx.counters,
+            combiner=_COMBINERS.get(combiner_name),
+        )
+        ctx.request_initial_memory(sort_mb << 20, None)
+        self._spills_sent = 0
+        if self._pipelined:
+            self.sorter.on_spill = self._ship_spill
+        self.service = local_shuffle_service()
+        self.host = ctx.get_service_provider_metadata("shuffle") or \
+            {"host": "local", "port": 0}
+        return []
+
+    def get_writer(self) -> Writer:
+        return _SorterWriter(self.sorter, self.key_serde, self.val_serde,
+                             self.context)
+
+    def handle_events(self, events: Sequence[TezAPIEvent]) -> None:
+        pass
+
+    # -- event generation (ShuffleUtils.generateEventOnSpill analog) ---------
+    def _events_for_run(self, run: Run, spill_id: int,
+                        last: bool) -> List[TezAPIEvent]:
+        payload = ShufflePayload(
+            host=self.host["host"], port=self.host["port"],
+            path_component=output_path_component(self.context),
+            empty_partitions=pack_empty_partitions(
+                run.empty_partition_flags()),
+            spill_id=spill_id if self._pipelined else -1,
+            last_event=last)
+        total = run.nbytes
+        partition_sizes = [run.partition_nbytes(p)
+                           for p in range(run.num_partitions)]
+        return [
+            CompositeDataMovementEvent(0, run.num_partitions, payload),
+            VertexManagerEvent(
+                target_vertex_name=self.context.destination_vertex_name,
+                user_payload={"output_size": total,
+                              "partition_sizes": partition_sizes}),
+        ]
+
+    def _ship_spill(self, run: Run, spill_id: int) -> None:
+        self.service.register(output_path_component(self.context), spill_id,
+                              run)
+        # last=False; close() sends the final marker
+        self.context.send_events(self._events_for_run(run, spill_id, False))
+        self._spills_sent += 1
+        self.context.counters.increment(TaskCounter.SHUFFLE_CHUNK_COUNT)
+
+    def close(self) -> List[TezAPIEvent]:
+        final_run = self.sorter.flush()
+        if self._pipelined:
+            # final empty marker event with last_event=True for completeness
+            payload = ShufflePayload(
+                host=self.host["host"], port=self.host["port"],
+                path_component=output_path_component(self.context),
+                empty_partitions=pack_empty_partitions(
+                    [True] * self.num_physical_outputs),
+                spill_id=self._spills_sent, last_event=True)
+            self.service.register(output_path_component(self.context),
+                                  self._spills_sent,
+                                  _empty_run(self.num_physical_outputs))
+            return [CompositeDataMovementEvent(0, self.num_physical_outputs,
+                                               payload)]
+        assert final_run is not None
+        self.service.register(output_path_component(self.context), -1,
+                              final_run)
+        self.context.counters.increment(
+            TaskCounter.OUTPUT_BYTES_PHYSICAL, final_run.nbytes)
+        return self._events_for_run(final_run, -1, True)
+
+
+def _empty_run(num_partitions: int):
+    import numpy as np
+    from tez_tpu.ops.runformat import KVBatch
+    return Run(KVBatch.empty(), np.zeros(num_partitions + 1, dtype=np.int64))

@@ -1,0 +1,184 @@
+"""jit'd device kernels for the data plane: hash-partition, segmented sort.
+
+These are the TPU replacements for the reference's byte-crunching loops
+(PipelinedSorter.collect/sort spans, HashPartitioner, TezMerger) —
+SURVEY.md §2.5 "TPU-native equivalent" column.  All kernels are shape-
+bucketed (power-of-two padding) so XLA compiles a bounded set of programs;
+compiled functions are cached per-process (jit cache) and survive across
+tasks via runner reuse.
+
+Sorting model: keys are fixed-width uint32 lanes (ops/keycodec); the sort is
+a single variadic stable `lax.sort` over (partition, lane_0..lane_{L-1})
+carrying the record permutation — XLA lowers this to its optimized on-device
+sort; the merge of k sorted runs reuses the same kernel on the concatenation
+(sort networks beat heap-merge on TPU's vector units; runs' stable order
+preserves within-key arrival order like the reference's MergeQueue).
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Persistent compilation cache: sort-kernel compiles are seconds-to-minutes
+# on TPU; cache them across processes (runner reuse only caches in-process).
+try:
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("TEZ_TPU_JAX_CACHE",
+                                     "/tmp/tez_tpu_jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:  # noqa: BLE001 — older jax without the knob
+    pass
+
+FNV_OFFSET = np.uint32(2166136261)
+FNV_PRIME = np.uint32(16777619)
+
+
+def _bucket(n: int, floor: int = 256) -> int:
+    """Round up to the shape bucket (power of two) to bound recompiles."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+# ---------------------------------------------------------------------------
+# hash partition
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("num_partitions",))
+def _fnv_partition(key_mat: jnp.ndarray, lengths: jnp.ndarray,
+                   num_partitions: int) -> jnp.ndarray:
+    """FNV-1a over each row's first `lengths[i]` bytes of key_mat[i, :].
+
+    Byte-identical to library.partitioners.HashPartitioner._stable_hash for
+    keys that fit the padded width.  key_mat: uint8[N, W]; returns int32[N].
+    """
+    w = key_mat.shape[1]
+
+    def body(j, h):
+        byte = key_mat[:, j].astype(jnp.uint32)
+        nh = ((h ^ byte) * FNV_PRIME).astype(jnp.uint32)
+        return jnp.where(j < lengths, nh, h)
+
+    h = jnp.full((key_mat.shape[0],), FNV_OFFSET, dtype=jnp.uint32)
+    h = jax.lax.fori_loop(0, w, body, h)
+    return (h % jnp.uint32(num_partitions)).astype(jnp.int32)
+
+
+def hash_partition(key_mat: np.ndarray, lengths: np.ndarray,
+                   num_partitions: int) -> np.ndarray:
+    """Host wrapper with shape bucketing."""
+    n = key_mat.shape[0]
+    if n == 0:
+        return np.zeros(0, dtype=np.int32)
+    nb = _bucket(n)
+    if nb != n:
+        key_mat = np.pad(key_mat, ((0, nb - n), (0, 0)))
+        lengths = np.pad(lengths, (0, nb - n))
+    out = _fnv_partition(key_mat, jnp.asarray(lengths), num_partitions)
+    return np.asarray(out)[:n]
+
+
+# ---------------------------------------------------------------------------
+# partitioned stable sort
+# ---------------------------------------------------------------------------
+@jax.jit
+def _sort_u32_with_perm(keys: jnp.ndarray,
+                        perm: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """THE sort kernel: stable single-key u32 sort carrying a permutation.
+
+    Every radix pass (and thus every key width) reuses this one compiled
+    program per bucket size — a variadic N-operand `lax.sort` costs minutes
+    of XLA compile time at large N on TPU, while this compiles once in
+    seconds.  u32 keeps everything TPU-native (no x64 emulation).
+    """
+    out = jax.lax.sort((keys, perm), dimension=0, is_stable=True, num_keys=1)
+    return out[0], out[1]
+
+
+@jax.jit
+def _gather_u32(col: jnp.ndarray, perm: jnp.ndarray) -> jnp.ndarray:
+    return col[perm]
+
+
+def sort_run(partitions: np.ndarray, lanes: np.ndarray,
+             lengths: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """LSD radix sort by (partition, key lanes, clamped length): a sequence
+    of stable single-key u32 passes from least- to most-significant key, all
+    through the one compiled `_sort_u32_with_perm` kernel.
+
+    The clamped length disambiguates keys whose zero padding collides (if
+    padded prefixes are equal, the longer key == shorter key + trailing
+    zeros, so byte order == length order); beyond-prefix lengths compare
+    equal and are resolved by the host tie-break pass.
+
+    Returns (sorted partition ids, permutation); padding rows (partition
+    = MAX) sort to the tail and are stripped by the caller.
+    """
+    n = partitions.shape[0]
+    if n == 0:
+        return partitions, np.zeros(0, dtype=np.int32)
+    width_cap = lanes.shape[1] * 4 + 1
+    lengths = np.minimum(lengths.astype(np.int64), width_cap)
+    nb = _bucket(n)
+    if nb != n:
+        partitions = np.pad(partitions, (0, nb - n),
+                            constant_values=np.iinfo(np.int32).max)
+        lanes = np.pad(lanes, ((0, nb - n), (0, 0)))
+        lengths = np.pad(lengths, (0, nb - n))
+    dev_lanes = jnp.asarray(lanes)                 # [nb, L] device-resident
+    perm = jnp.arange(nb, dtype=jnp.int32)
+    # pass 1 (least significant): clamped length
+    _, perm = _sort_u32_with_perm(
+        jnp.asarray(lengths.astype(np.uint32)), perm)
+    # per-lane passes, last lane first
+    for i in range(dev_lanes.shape[1] - 1, -1, -1):
+        keys = _gather_u32(dev_lanes[:, i], perm)
+        _, perm = _sort_u32_with_perm(keys, perm)
+    # most significant: partition (int32 >= 0; pad MAX stays max as u32)
+    pkeys = _gather_u32(jnp.asarray(partitions.astype(np.uint32)), perm)
+    sorted_parts, perm = _sort_u32_with_perm(pkeys, perm)
+    return (np.asarray(sorted_parts).astype(np.int32)[:n],
+            np.asarray(perm)[:n])
+
+
+# ---------------------------------------------------------------------------
+# merge of sorted runs = sort of concatenation (stable; run order preserved)
+# ---------------------------------------------------------------------------
+def merge_runs(lanes_list: list[np.ndarray],
+               lengths_list: list[np.ndarray]) -> np.ndarray:
+    """k-way merge of sorted key-lane arrays -> global permutation into the
+    concatenation.  Stability keeps equal keys in run order (TezMerger
+    segment-queue semantics)."""
+    if not lanes_list:
+        return np.zeros(0, dtype=np.int32)
+    lanes = np.concatenate(lanes_list, axis=0)
+    lengths = np.concatenate(lengths_list, axis=0)
+    zeros = np.zeros(lanes.shape[0], dtype=np.int32)
+    _, perm = sort_run(zeros, lanes, lengths)
+    return perm
+
+
+# ---------------------------------------------------------------------------
+# segmented (per-partition) counts
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("num_partitions",))
+def _partition_histogram(partitions: jnp.ndarray,
+                         num_partitions: int) -> jnp.ndarray:
+    one_hot = jax.nn.one_hot(partitions, num_partitions, dtype=jnp.int32)
+    return one_hot.sum(axis=0)
+
+
+def partition_counts(partitions: np.ndarray, num_partitions: int) -> np.ndarray:
+    n = partitions.shape[0]
+    if n == 0:
+        return np.zeros(num_partitions, dtype=np.int64)
+    nb = _bucket(n)
+    if nb != n:
+        partitions = np.pad(partitions, (0, nb - n), constant_values=-1)
+    out = _partition_histogram(jnp.asarray(partitions), num_partitions)
+    return np.asarray(out).astype(np.int64)

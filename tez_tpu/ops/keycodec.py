@@ -1,0 +1,59 @@
+"""Normalized fixed-width key encoding for device sort.
+
+The TPU sorter needs static shapes (SURVEY.md §7 "Variable-length KV on
+TPU"): variable-length keys are carried as (bytes, offsets) pairs and, for
+sorting, normalized into a fixed number of big-endian uint32 lanes so that
+lane-lexicographic order == raw-byte lexicographic order (the reference's
+raw-comparator semantics, ExternalSorter/IFile byte ordering).
+
+Keys longer than the configured width sort by their prefix; equal-prefix
+groups are then ordered by a host tie-break pass (sorter.py) so the final
+order is exact for any key length.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def pad_to_matrix(key_bytes: np.ndarray, offsets: np.ndarray,
+                  width: int) -> tuple[np.ndarray, np.ndarray]:
+    """Ragged bytes -> (padded uint8[N, width], lengths int32[N]).
+
+    Vectorized gather; pad value 0 sorts below every real byte, matching
+    shorter-key-first byte order ("a" < "ab")."""
+    n = len(offsets) - 1
+    lengths = (offsets[1:] - offsets[:-1]).astype(np.int64)
+    mat = np.zeros((n, width), dtype=np.uint8)
+    if n == 0:
+        return mat, lengths.astype(np.int32)
+    take = np.minimum(lengths, width)
+    # index matrix: offsets[i] + j  (clamped), masked by j < take[i]
+    j = np.arange(width)[None, :]
+    idx = offsets[:-1, None] + j
+    valid = j < take[:, None]
+    idx = np.where(valid, idx, 0)
+    vals = key_bytes[idx]
+    mat = np.where(valid, vals, 0).astype(np.uint8)
+    return mat, lengths.astype(np.int32)
+
+
+def matrix_to_lanes(mat: np.ndarray) -> np.ndarray:
+    """uint8[N, W] -> big-endian uint32[N, W/4] lanes; W padded to mult of 4.
+
+    Lexicographic comparison of lanes == lexicographic comparison of bytes.
+    """
+    n, w = mat.shape
+    pad = (-w) % 4
+    if pad:
+        mat = np.pad(mat, ((0, 0), (0, pad)))
+        w += pad
+    lanes = mat.reshape(n, w // 4, 4).astype(np.uint32)
+    return (lanes[..., 0] << 24) | (lanes[..., 1] << 16) | \
+        (lanes[..., 2] << 8) | lanes[..., 3]
+
+
+def encode_keys(key_bytes: np.ndarray, offsets: np.ndarray,
+                width: int) -> tuple[np.ndarray, np.ndarray]:
+    """Ragged keys -> (uint32 lanes [N, ceil(width/4)], lengths[N])."""
+    mat, lengths = pad_to_matrix(key_bytes, offsets, width)
+    return matrix_to_lanes(mat), lengths

@@ -1,0 +1,325 @@
+"""Device sorter: PipelinedSorter semantics on TPU kernels.
+
+Reference parity: tez-runtime-library/.../common/sort/impl/PipelinedSorter.java:75
+— records collect into spans; full spans sort independently (there: background
+threads, here: device kernels while the host keeps collecting); flush merges
+spans (or, pipelined, emits each span as its own spill).  Spill-to-host-disk
+replaces spill-to-local-FS.
+
+Exactness: the device sorts by (partition, fixed-width key prefix) stably;
+rows whose keys exceed the prefix width get a host tie-break pass so final
+order equals full raw-byte order for ANY key length (SURVEY.md §7
+"byte-identical ordered output").
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+import uuid
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tez_tpu.common.counters import TaskCounter, TezCounters
+from tez_tpu.ops import device
+from tez_tpu.ops.keycodec import encode_keys, pad_to_matrix, matrix_to_lanes
+from tez_tpu.ops.runformat import KVBatch, Run, gather_ragged
+
+log = logging.getLogger(__name__)
+
+
+def _exact_tiebreak(batch: KVBatch, partitions: np.ndarray,
+                    lanes: np.ndarray, width: int) -> Optional[np.ndarray]:
+    """Return a refinement permutation for rows whose sorted (partition,
+    prefix) group contains a key longer than `width`, or None if exact
+    already.  Host cost is proportional to colliding rows only."""
+    lengths = batch.key_offsets[1:] - batch.key_offsets[:-1]
+    if len(lengths) == 0 or lengths.max(initial=0) <= width:
+        return None
+    clamped = np.minimum(lengths, width + 1)
+    same_as_prev = np.zeros(len(lengths), dtype=bool)
+    if len(lengths) > 1:
+        same_as_prev[1:] = (partitions[1:] == partitions[:-1]) & \
+            (clamped[1:] == clamped[:-1]) & \
+            np.all(lanes[1:] == lanes[:-1], axis=1)
+    # group starts
+    starts = np.flatnonzero(~same_as_prev)
+    ends = np.append(starts[1:], len(lengths))
+    perm = np.arange(len(lengths), dtype=np.int64)
+    changed = False
+    for s, e in zip(starts, ends):
+        if e - s <= 1:
+            continue
+        if int(lengths[s:e].max()) <= width:
+            continue  # prefix fully determined the order
+        keys = [batch.key(i) for i in range(s, e)]
+        order = sorted(range(e - s), key=lambda j: keys[j])
+        if order != list(range(e - s)):
+            perm[s:e] = s + np.asarray(order, dtype=np.int64)
+            changed = True
+    return perm if changed else None
+
+
+class SpanBuffer:
+    """Collect-side buffer: raw bytes accumulated until the span budget."""
+
+    def __init__(self) -> None:
+        self.keys: List[bytes] = []
+        self.vals: List[bytes] = []
+        self.nbytes = 0
+        self.batches: List[KVBatch] = []
+
+    def add(self, key: bytes, value: bytes) -> None:
+        self.keys.append(key)
+        self.vals.append(value)
+        self.nbytes += len(key) + len(value) + 16
+
+    def add_batch(self, batch: KVBatch) -> None:
+        self.batches.append(batch)
+        self.nbytes += batch.nbytes
+
+    @property
+    def num_records(self) -> int:
+        return len(self.keys) + sum(b.num_records for b in self.batches)
+
+    def to_batch(self) -> KVBatch:
+        parts = list(self.batches)
+        if self.keys:
+            parts.append(KVBatch.from_pairs(list(zip(self.keys, self.vals))))
+        if not parts:
+            return KVBatch.empty()
+        return parts[0] if len(parts) == 1 else KVBatch.concat(parts)
+
+
+Combiner = Callable[[Run], Run]
+
+
+class DeviceSorter:
+    """The OrderedPartitionedKVOutput engine."""
+
+    def __init__(self, num_partitions: int, key_width: int = 16,
+                 span_budget_bytes: int = 256 << 20,
+                 spill_dir: Optional[str] = None,
+                 counters: Optional[TezCounters] = None,
+                 combiner: Optional[Combiner] = None,
+                 partitioner: str = "hash",
+                 mem_budget_bytes: Optional[int] = None):
+        self.num_partitions = num_partitions
+        self.key_width = max(4, key_width)
+        self.span_budget = span_budget_bytes
+        self.spill_dir = spill_dir
+        self.counters = counters or TezCounters()
+        self.combiner = combiner
+        self.partitioner = partitioner
+        self.mem_budget = mem_budget_bytes or (span_budget_bytes * 2)
+        self._span = SpanBuffer()
+        self._runs: List[Run | str] = []   # Run (in RAM) or path (spilled)
+        self._runs_nbytes = 0
+        self._closed = False
+        self.num_spills = 0
+        self.on_spill: Optional[Callable[[Run, int], None]] = None  # pipelined
+
+    # -- write side ----------------------------------------------------------
+    def write(self, key: bytes, value: bytes) -> None:
+        self._span.add(key, value)
+        self.counters.increment(TaskCounter.OUTPUT_RECORDS)
+        if self._span.nbytes >= self.span_budget:
+            self._sort_span()
+
+    def write_batch(self, batch: KVBatch) -> None:
+        self._span.add_batch(batch)
+        self.counters.increment(TaskCounter.OUTPUT_RECORDS, batch.num_records)
+        if self._span.nbytes >= self.span_budget:
+            self._sort_span()
+
+    # -- span sort (device) --------------------------------------------------
+    def _sort_span(self) -> None:
+        if self._span.num_records == 0:
+            return
+        batch = self._span.to_batch()
+        self._span = SpanBuffer()
+        run = self.sort_batch(batch)
+        if self.combiner is not None:
+            run = self.combiner(run)
+        if self.on_spill is not None:
+            # pipelined shuffle: each span ships immediately
+            self.on_spill(run, self.num_spills)
+        else:
+            self._store_run(run)
+        self.num_spills += 1
+
+    def sort_batch(self, batch: KVBatch) -> Run:
+        t0 = time.time()
+        mat, lengths = pad_to_matrix(batch.key_bytes, batch.key_offsets,
+                                     self.key_width)
+        lanes = matrix_to_lanes(mat)
+        if self.partitioner == "hash":
+            # full-key FNV hash: pad to the longest key in the batch so the
+            # hash covers every byte (host-partitioner parity)
+            klens = batch.key_offsets[1:] - batch.key_offsets[:-1]
+            wmax = int(klens.max(initial=1))
+            hash_w = 1 << max(2, (wmax - 1).bit_length())
+            hmat, hlens = pad_to_matrix(batch.key_bytes, batch.key_offsets,
+                                        hash_w)
+            partitions = device.hash_partition(hmat, hlens,
+                                               self.num_partitions)
+        else:
+            partitions = np.zeros(batch.num_records, dtype=np.int32)
+        sorted_partitions, perm = device.sort_run(partitions, lanes, lengths)
+        sorted_batch = batch.take(perm)
+        refinement = _exact_tiebreak(
+            sorted_batch, sorted_partitions, lanes[perm], self.key_width)
+        if refinement is not None:
+            sorted_batch = sorted_batch.take(refinement)
+        self.counters.find_counter(TaskCounter.DEVICE_SORT_MILLIS)\
+            .increment(int((time.time() - t0) * 1000))
+        return Run.from_sorted_batch(sorted_batch, sorted_partitions,
+                                     self.num_partitions)
+
+    def _store_run(self, run: Run) -> None:
+        self.counters.increment(TaskCounter.SPILLED_RECORDS,
+                                run.batch.num_records)
+        if self.spill_dir is not None and \
+                self._runs_nbytes + run.nbytes > self.mem_budget:
+            path = os.path.join(self.spill_dir,
+                                f"spill_{uuid.uuid4().hex}.run")
+            run.save(path)
+            self.counters.increment(TaskCounter.ADDITIONAL_SPILLS_BYTES_WRITTEN,
+                                    run.nbytes)
+            self.counters.increment(TaskCounter.ADDITIONAL_SPILL_COUNT)
+            self.counters.increment(TaskCounter.HOST_SPILL_BYTES, run.nbytes)
+            self._runs.append(path)
+        else:
+            self._runs.append(run)
+            self._runs_nbytes += run.nbytes
+
+    def _load_runs(self) -> List[Run]:
+        out = []
+        for r in self._runs:
+            if isinstance(r, str):
+                run = Run.load(r)
+                self.counters.increment(
+                    TaskCounter.ADDITIONAL_SPILLS_BYTES_READ, run.nbytes)
+                out.append(run)
+            else:
+                out.append(r)
+        return out
+
+    # -- flush ---------------------------------------------------------------
+    def flush(self) -> Optional[Run]:
+        """Final merge of all spans.  Returns None in pipelined mode (spans
+        already shipped via on_spill; a trailing partial span ships here)."""
+        assert not self._closed
+        self._closed = True
+        if self.on_spill is not None:
+            if self._span.num_records > 0:
+                self._sort_span()
+            return None
+        if self._span.num_records > 0 and not self._runs:
+            # common fast path: everything fit one span
+            batch = self._span.to_batch()
+            self._span = SpanBuffer()
+            run = self.sort_batch(batch)
+            if self.combiner is not None:
+                run = self.combiner(run)
+            self.num_spills += 1
+            return run
+        self._sort_span()
+        runs = self._load_runs()
+        self._runs = []
+        if not runs:
+            return Run(KVBatch.empty(),
+                       np.zeros(self.num_partitions + 1, dtype=np.int64))
+        if len(runs) == 1:
+            return runs[0]
+        merged = merge_sorted_runs(runs, self.num_partitions, self.key_width,
+                                   counters=self.counters)
+        if self.combiner is not None:
+            merged = self.combiner(merged)
+        return merged
+
+
+def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
+                      key_width: int,
+                      counters: Optional[TezCounters] = None) -> Run:
+    """k-way merge of partition-sorted runs (TezMerger analog): concatenate,
+    stable device sort by (partition, key prefix), host tie-break."""
+    t0 = time.time()
+    batch = KVBatch.concat([r.batch for r in runs])
+    partitions = np.concatenate([
+        np.repeat(np.arange(r.num_partitions, dtype=np.int32),
+                  np.diff(r.row_index)) for r in runs]) \
+        if runs else np.zeros(0, np.int32)
+    mat, lengths = pad_to_matrix(batch.key_bytes, batch.key_offsets, key_width)
+    lanes = matrix_to_lanes(mat)
+    sorted_partitions, perm = device.sort_run(partitions, lanes, lengths)
+    sorted_batch = batch.take(perm)
+    refinement = _exact_tiebreak(sorted_batch, sorted_partitions,
+                                 lanes[perm], key_width)
+    if refinement is not None:
+        sorted_batch = sorted_batch.take(refinement)
+    if counters is not None:
+        counters.find_counter(TaskCounter.DEVICE_MERGE_MILLIS)\
+            .increment(int((time.time() - t0) * 1000))
+        counters.increment(TaskCounter.MERGED_MAP_OUTPUTS, len(runs))
+    return Run.from_sorted_batch(sorted_batch, sorted_partitions,
+                                 num_partitions)
+
+
+# ---------------------------------------------------------------------------
+# combiners
+# ---------------------------------------------------------------------------
+def sum_long_combiner(run: Run) -> Run:
+    """Vectorized combine for 8-byte big-endian-long values: sums values of
+    equal (partition, key) groups (the WordCount/OrderedWordCount combiner)."""
+    from tez_tpu.ops.serde import VarLongSerde
+    batch = run.batch
+    n = batch.num_records
+    if n == 0:
+        return run
+    ko, kb = batch.key_offsets, batch.key_bytes
+    lengths = ko[1:] - ko[:-1]
+    partitions = np.repeat(np.arange(run.num_partitions, dtype=np.int32),
+                           np.diff(run.row_index))
+    # adjacent-equal detection (sorted within partition): same partition,
+    # same length, same bytes
+    same = np.zeros(n, dtype=bool)
+    if n > 1:
+        cand = (partitions[1:] == partitions[:-1]) & \
+            (lengths[1:] == lengths[:-1])
+        idx = np.flatnonzero(cand)
+        for i in idx:  # verify bytes only for candidates
+            same[i + 1] = kb[ko[i]:ko[i + 1]].tobytes() == \
+                kb[ko[i + 1]:ko[i + 2]].tobytes()
+    group_starts = np.flatnonzero(~same)
+    # decode values (8-byte BE unsigned with sign-flip encoding)
+    vals = batch.val_bytes.reshape(n, 8) if batch.val_bytes.size == n * 8 \
+        else None
+    serde = VarLongSerde()
+    if vals is not None:
+        nums = vals.astype(np.uint64)
+        weights = (256 ** np.arange(7, -1, -1)).astype(np.uint64)
+        unsigned = (nums * weights).sum(axis=1, dtype=np.uint64)
+        # encoding is val + 2^63 (mod 2^64) == top-bit flip of two's complement
+        decoded = (unsigned ^ np.uint64(1 << 63)).view(np.int64)
+        sums = np.add.reduceat(decoded, group_starts)
+        out_vals = b"".join(serde.to_bytes(int(s)) for s in sums)
+        vb = np.frombuffer(out_vals, dtype=np.uint8).copy()
+        vo = np.arange(len(group_starts) + 1, dtype=np.int64) * 8
+    else:
+        # ragged fallback
+        sums = []
+        bounds = np.append(group_starts, n)
+        for s, e in zip(bounds[:-1], bounds[1:]):
+            sums.append(sum(serde.from_bytes(batch.value(i))
+                            for i in range(s, e)))
+        out_vals = b"".join(serde.to_bytes(s) for s in sums)
+        vb = np.frombuffer(out_vals, dtype=np.uint8).copy()
+        vo = np.arange(len(group_starts) + 1, dtype=np.int64) * 8
+    kb2, ko2 = gather_ragged(kb, ko, group_starts)
+    new_counts = np.bincount(partitions[group_starts],
+                             minlength=run.num_partitions).astype(np.int64)
+    row_index = np.zeros(run.num_partitions + 1, dtype=np.int64)
+    np.cumsum(new_counts, out=row_index[1:])
+    return Run(KVBatch(kb2, ko2, vb, vo), row_index)

@@ -54,6 +54,13 @@ class TaskRunner:
         self._killed = threading.Event()
         self._done = threading.Event()
         self._fatal: Optional[Tuple[BaseException | None, str]] = None
+        # Incoming events arriving before IO initialize() completes are
+        # trapped and replayed (reference: TezTrapEventHandler).  The
+        # dispatch lock serializes replay vs. new heartbeat deliveries so
+        # handle_events is single-threaded and in arrival order.
+        self._inputs_ready = threading.Event()
+        self._dispatch_lock = threading.Lock()
+        self._trapped_incoming: List[Tuple[str, TezAPIEvent]] = []
 
     # -- called by contexts --------------------------------------------------
     def enqueue_events(self, events: Sequence[TezEvent]) -> None:
@@ -162,6 +169,14 @@ class TaskRunner:
             if not isinstance(inp, MergedLogicalInput):
                 inp.start()
 
+        # replay any events trapped while initializing (ready-flag flip and
+        # replay are atomic w.r.t. heartbeat deliveries)
+        with self._dispatch_lock:
+            trapped, self._trapped_incoming = self._trapped_incoming, []
+            self._inputs_ready.set()
+            if trapped:
+                self._dispatch_incoming(trapped)
+
     def _run_processor(self) -> None:
         self.check_killed()
         assert self.processor is not None
@@ -211,7 +226,11 @@ class TaskRunner:
         if resp.should_die:
             self._killed.set()
         if resp.events:
-            self._dispatch_incoming(resp.events)
+            with self._dispatch_lock:
+                if not self._inputs_ready.is_set():
+                    self._trapped_incoming.extend(resp.events)
+                else:
+                    self._dispatch_incoming(resp.events)
 
     def _dispatch_incoming(self, events: List[Tuple[str, TezAPIEvent]]) -> None:
         by_input: Dict[str, List[TezAPIEvent]] = {}

@@ -1,0 +1,83 @@
+"""Shuffle object service: producers register runs, consumers fetch slices.
+
+Reference parity: tez-plugins/tez-aux-services ShuffleHandler.java:159 (the
+NM-resident shuffle server with per-reduce index lookup) + the local-disk
+short-circuit (Fetcher.java:288).  In-process deployments hand buffers over
+directly (the "local fetch" path — zero copies of HBM/host-RAM data); the
+socket server for cross-host fetch lives in tez_tpu.shuffle.server (DCN
+path) and serves the same registry.
+
+Keys: (path_component, spill_id) where path_component identifies a producer
+attempt's output (reference: attempt path component in the shuffle URL).
+DAG/vertex deletion tracking mirrors the reference's DeletionTracker.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from tez_tpu.ops.runformat import KVBatch, Run
+
+
+class ShuffleDataNotFound(Exception):
+    pass
+
+
+class ShuffleService:
+    """Registry of completed (or pipelined-spill) runs on this host."""
+
+    def __init__(self) -> None:
+        self._runs: Dict[Tuple[str, int], Run] = {}
+        self._lock = threading.Lock()
+
+    # -- producer side -------------------------------------------------------
+    def register(self, path_component: str, spill_id: int, run: Run) -> None:
+        with self._lock:
+            self._runs[(path_component, spill_id)] = run
+
+    def unregister_prefix(self, prefix: str) -> int:
+        """Deletion tracker: drop all outputs whose path starts with prefix
+        (per-DAG / per-vertex cleanup)."""
+        with self._lock:
+            victims = [k for k in self._runs if k[0].startswith(prefix)]
+            for k in victims:
+                del self._runs[k]
+            return len(victims)
+
+    # -- consumer side (local short-circuit) ---------------------------------
+    def fetch_partition(self, path_component: str, spill_id: int,
+                        partition: int) -> KVBatch:
+        with self._lock:
+            run = self._runs.get((path_component, spill_id))
+        if run is None:
+            raise ShuffleDataNotFound(f"{path_component}/{spill_id}")
+        return run.partition(partition)
+
+    def fetch_partition_range(self, path_component: str, spill_id: int,
+                              start: int, stop: int) -> List[KVBatch]:
+        with self._lock:
+            run = self._runs.get((path_component, spill_id))
+        if run is None:
+            raise ShuffleDataNotFound(f"{path_component}/{spill_id}")
+        return [run.partition(p) for p in range(start, stop)]
+
+    def partition_size(self, path_component: str, spill_id: int,
+                       partition: int) -> int:
+        with self._lock:
+            run = self._runs.get((path_component, spill_id))
+        if run is None:
+            raise ShuffleDataNotFound(f"{path_component}/{spill_id}")
+        return run.partition_nbytes(partition)
+
+    def stats(self) -> Tuple[int, int]:
+        with self._lock:
+            return len(self._runs), sum(r.nbytes for r in self._runs.values())
+
+
+_local = ShuffleService()
+
+
+def local_shuffle_service() -> ShuffleService:
+    """The per-process service (one per host; shared by AM and runners in
+    local mode, exactly like the NM-singleton ShuffleHandler)."""
+    return _local

@@ -330,6 +330,8 @@ class TaskImpl:
             attempt_id=event.attempt_id))
 
     def _on_attempt_failed(self, event: TaskEvent) -> "TaskState":
+        if self.commit_attempt == event.attempt_id:
+            self.commit_attempt = None   # commit right dies with the attempt
         self.failed_attempts += 1
         fatal = getattr(event, "fatal", False)
         if not fatal and self.failed_attempts < self.max_failed_attempts:
@@ -353,6 +355,8 @@ class TaskImpl:
         return "; ".join(att.diagnostics) if att else ""
 
     def _on_attempt_killed(self, event: TaskEvent) -> "TaskState":
+        if self.commit_attempt == event.attempt_id:
+            self.commit_attempt = None
         # Killed attempts don't count against retries (reference semantics);
         # spawn a replacement unless the task itself is terminating.
         if self._terminating:

@@ -1,0 +1,98 @@
+"""Broadcast hash join: small dim table broadcast to every fact task.
+
+Reference parity: tez-examples/.../HashJoinExample.java:74 (benchmark
+workload 5, BASELINE.md): the small side ships over a BROADCAST edge with
+UnorderedKVOutput; each streaming (fact) task builds a hash set/table and
+joins its split of the big side.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict
+
+from tez_tpu.api.runtime import LogicalInput, LogicalOutput
+from tez_tpu.client.tez_client import TezClient
+from tez_tpu.common.payload import (InputDescriptor,
+                                    InputInitializerDescriptor,
+                                    OutputCommitterDescriptor,
+                                    OutputDescriptor, ProcessorDescriptor)
+from tez_tpu.dag.dag import (DAG, DataSinkDescriptor, DataSourceDescriptor,
+                             Edge, Vertex)
+from tez_tpu.library.conf import UnorderedKVEdgeConfig
+from tez_tpu.library.processors import SimpleProcessor
+
+
+class ForwardProcessor(SimpleProcessor):
+    """Reads the (small) hash side and forwards keys downstream."""
+
+    def run(self, inputs: Dict[str, LogicalInput],
+            outputs: Dict[str, LogicalOutput]) -> None:
+        reader = inputs["input"].get_reader()
+        writer = outputs["joiner"].get_writer()
+        for _offset, line in reader:
+            key = line.strip()
+            if key:
+                writer.write(key, b"")
+
+
+class HashJoinProcessor(SimpleProcessor):
+    """Builds the broadcast hash set, streams the big side, emits matches
+    (reference: HashJoinExample.HashJoinProcessor)."""
+
+    def run(self, inputs: Dict[str, LogicalInput],
+            outputs: Dict[str, LogicalOutput]) -> None:
+        hash_side = inputs["hashside"].get_reader()
+        keys = {k for k, _ in hash_side}
+        stream = inputs["input"].get_reader()
+        writer = outputs["output"].get_writer()
+        for _offset, line in stream:
+            word = line.strip()
+            if word in keys:
+                writer.write(word, "1")
+
+
+def build_dag(stream_paths, hash_paths, output_path: str,
+              num_joiners: int = 2) -> DAG:
+    hash_side = Vertex.create("hashside", ProcessorDescriptor.create(
+        ForwardProcessor), 1)
+    hash_side.add_data_source("input", DataSourceDescriptor.create(
+        InputDescriptor.create("tez_tpu.io.text:TextInput"),
+        InputInitializerDescriptor.create(
+            "tez_tpu.io.text:TextSplitGenerator",
+            payload={"paths": list(hash_paths), "desired_splits": 1})))
+    joiner = Vertex.create("joiner", ProcessorDescriptor.create(
+        HashJoinProcessor), num_joiners)
+    joiner.add_data_source("input", DataSourceDescriptor.create(
+        InputDescriptor.create("tez_tpu.io.text:TextInput"),
+        InputInitializerDescriptor.create(
+            "tez_tpu.io.text:TextSplitGenerator",
+            payload={"paths": list(stream_paths),
+                     "desired_splits": num_joiners})))
+    joiner.add_data_sink("output", DataSinkDescriptor.create(
+        OutputDescriptor.create("tez_tpu.io.file_output:FileOutput",
+                                payload={"path": output_path,
+                                         "key_serde": "text",
+                                         "value_serde": "text"}),
+        OutputCommitterDescriptor.create(
+            "tez_tpu.io.file_output:FileOutputCommitter",
+            payload={"path": output_path})))
+    edge = UnorderedKVEdgeConfig.new_builder("bytes", "bytes").build()
+    dag = DAG.create("HashJoin").add_vertex(hash_side).add_vertex(joiner)
+    # rename edge output key: hash_side -> joiner under input name "hashside"
+    dag.add_edge(Edge.create(hash_side, joiner,
+                             edge.create_default_broadcast_edge_property()))
+    return dag
+
+
+def run(stream_paths, hash_paths, output_path: str, conf=None, **kw) -> str:
+    with TezClient.create("HashJoin", conf or {}) as client:
+        status = client.submit_dag(build_dag(
+            stream_paths, hash_paths, output_path, **kw)).wait_for_completion()
+        return status.state.name
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 4:
+        print("usage: hash_join <stream_file> <hash_file> <output_dir>")
+        sys.exit(2)
+    print(run([sys.argv[1]], [sys.argv[2]], sys.argv[3]))

@@ -9,7 +9,6 @@ big-endian long serde so numeric order == byte order.
 """
 from __future__ import annotations
 
-import re
 import sys
 from typing import Dict
 
@@ -23,9 +22,6 @@ from tez_tpu.dag.dag import (DAG, DataSinkDescriptor, DataSourceDescriptor,
                              Edge, Vertex)
 from tez_tpu.library.conf import OrderedPartitionedKVEdgeConfig
 from tez_tpu.library.processors import SimpleProcessor
-
-TOKEN_RE = re.compile(rb"\\s+")
-
 
 class TokenProcessor(SimpleProcessor):
     """Split lines into words, emit (word, 1)."""

@@ -1,0 +1,112 @@
+"""Sort-merge join: two sorted scatter-gather edges into one joiner.
+
+Reference parity: tez-examples/.../SortMergeJoinExample.java:72 (benchmark
+workload 3, BASELINE.md): both sides shuffle sorted on the join key to the
+same partition space; the joiner walks the two grouped iterators in lockstep.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict
+
+from tez_tpu.api.runtime import LogicalInput, LogicalOutput
+from tez_tpu.client.tez_client import TezClient
+from tez_tpu.common.payload import (InputDescriptor,
+                                    InputInitializerDescriptor,
+                                    OutputCommitterDescriptor,
+                                    OutputDescriptor, ProcessorDescriptor)
+from tez_tpu.dag.dag import (DAG, DataSinkDescriptor, DataSourceDescriptor,
+                             Edge, Vertex)
+from tez_tpu.library.conf import OrderedPartitionedKVEdgeConfig
+from tez_tpu.library.processors import SimpleProcessor
+
+
+class PrepareProcessor(SimpleProcessor):
+    """Tokenize a side into (key, "") sorted output."""
+
+    def run(self, inputs: Dict[str, LogicalInput],
+            outputs: Dict[str, LogicalOutput]) -> None:
+        reader = inputs["input"].get_reader()
+        writer = outputs["joiner"].get_writer()
+        for _offset, line in reader:
+            for word in line.split():
+                writer.write(word, b"")
+
+
+class SortMergeJoinProcessor(SimpleProcessor):
+    """Lockstep merge of two key-sorted grouped inputs (inner join)."""
+
+    def run(self, inputs: Dict[str, LogicalInput],
+            outputs: Dict[str, LogicalOutput]) -> None:
+        left = iter(inputs["left"].get_reader())
+        right = iter(inputs["right"].get_reader())
+        writer = outputs["output"].get_writer()
+
+        def nxt(it):
+            try:
+                k, vs = next(it)
+                return k, vs
+            except StopIteration:
+                return None, None
+
+        lk, lv = nxt(left)
+        rk, rv = nxt(right)
+        while lk is not None and rk is not None:
+            if lk == rk:
+                writer.write(lk, "1")
+                lk, lv = nxt(left)
+                rk, rv = nxt(right)
+            elif lk < rk:
+                lk, lv = nxt(left)
+            else:
+                rk, rv = nxt(right)
+
+
+def _side(name: str, paths, parallelism: int) -> Vertex:
+    v = Vertex.create(name, ProcessorDescriptor.create(PrepareProcessor),
+                      parallelism)
+    v.add_data_source("input", DataSourceDescriptor.create(
+        InputDescriptor.create("tez_tpu.io.text:TextInput"),
+        InputInitializerDescriptor.create(
+            "tez_tpu.io.text:TextSplitGenerator",
+            payload={"paths": list(paths), "desired_splits": parallelism})))
+    return v
+
+
+def build_dag(left_paths, right_paths, output_path: str,
+              num_joiners: int = 2, side_parallelism: int = 2) -> DAG:
+    left = _side("left", left_paths, side_parallelism)
+    right = _side("right", right_paths, side_parallelism)
+    joiner = Vertex.create("joiner", ProcessorDescriptor.create(
+        SortMergeJoinProcessor), num_joiners)
+    joiner.add_data_sink("output", DataSinkDescriptor.create(
+        OutputDescriptor.create("tez_tpu.io.file_output:FileOutput",
+                                payload={"path": output_path,
+                                         "key_serde": "text",
+                                         "value_serde": "text"}),
+        OutputCommitterDescriptor.create(
+            "tez_tpu.io.file_output:FileOutputCommitter",
+            payload={"path": output_path})))
+    edge = OrderedPartitionedKVEdgeConfig.new_builder("bytes", "bytes")
+    dag = DAG.create("SortMergeJoin")
+    for v in (left, right, joiner):
+        dag.add_vertex(v)
+    dag.add_edge(Edge.create(left, joiner,
+                             edge.build().create_default_edge_property()))
+    dag.add_edge(Edge.create(right, joiner,
+                             edge.build().create_default_edge_property()))
+    return dag
+
+
+def run(left_paths, right_paths, output_path: str, conf=None, **kw) -> str:
+    with TezClient.create("SortMergeJoin", conf or {}) as client:
+        status = client.submit_dag(build_dag(
+            left_paths, right_paths, output_path, **kw)).wait_for_completion()
+        return status.state.name
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 4:
+        print("usage: sort_merge_join <left_file> <right_file> <output_dir>")
+        sys.exit(2)
+    print(run([sys.argv[1]], [sys.argv[2]], sys.argv[3]))

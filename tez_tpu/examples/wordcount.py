@@ -1,0 +1,86 @@
+"""WordCount over the unordered (hash-partition only) path.
+
+Reference parity: tez-examples/.../WordCount.java:58 — tokenizer
+--(UnorderedPartitionedKVOutput)--> summation, which aggregates with a hash
+map and writes counts (benchmark workload 2, BASELINE.md).
+"""
+from __future__ import annotations
+
+import sys
+from collections import Counter
+from typing import Dict
+
+from tez_tpu.api.runtime import LogicalInput, LogicalOutput
+from tez_tpu.client.tez_client import TezClient
+from tez_tpu.common.payload import (InputDescriptor,
+                                    InputInitializerDescriptor,
+                                    OutputCommitterDescriptor,
+                                    OutputDescriptor, ProcessorDescriptor)
+from tez_tpu.dag.dag import (DAG, DataSinkDescriptor, DataSourceDescriptor,
+                             Edge, Vertex)
+from tez_tpu.library.conf import UnorderedPartitionedKVEdgeConfig
+from tez_tpu.library.processors import SimpleProcessor
+
+
+class TokenProcessor(SimpleProcessor):
+    def run(self, inputs: Dict[str, LogicalInput],
+            outputs: Dict[str, LogicalOutput]) -> None:
+        reader = inputs["input"].get_reader()
+        writer = outputs["summation"].get_writer()
+        for _offset, line in reader:
+            for word in line.split():
+                writer.write(word, 1)
+
+
+class SumProcessor(SimpleProcessor):
+    def run(self, inputs: Dict[str, LogicalInput],
+            outputs: Dict[str, LogicalOutput]) -> None:
+        reader = inputs["tokenizer"].get_reader()
+        writer = outputs["output"].get_writer()
+        counts: Counter = Counter()
+        for word, one in reader:
+            counts[word] += one
+        for word, count in sorted(counts.items()):
+            writer.write(word, str(count))
+
+
+def build_dag(input_paths, output_path: str, tokenizer_parallelism: int = -1,
+              summation_parallelism: int = 2) -> DAG:
+    tokenizer = Vertex.create("tokenizer", ProcessorDescriptor.create(
+        TokenProcessor), tokenizer_parallelism)
+    tokenizer.add_data_source("input", DataSourceDescriptor.create(
+        InputDescriptor.create("tez_tpu.io.text:TextInput"),
+        InputInitializerDescriptor.create(
+            "tez_tpu.io.text:TextSplitGenerator",
+            payload={"paths": list(input_paths),
+                     "desired_splits": tokenizer_parallelism})))
+    summation = Vertex.create("summation", ProcessorDescriptor.create(
+        SumProcessor), summation_parallelism)
+    summation.add_data_sink("output", DataSinkDescriptor.create(
+        OutputDescriptor.create("tez_tpu.io.file_output:FileOutput",
+                                payload={"path": output_path,
+                                         "key_serde": "text",
+                                         "value_serde": "text"}),
+        OutputCommitterDescriptor.create(
+            "tez_tpu.io.file_output:FileOutputCommitter",
+            payload={"path": output_path})))
+    edge = UnorderedPartitionedKVEdgeConfig.new_builder(
+        "bytes", "pickle").build()
+    dag = DAG.create("WordCount").add_vertex(tokenizer).add_vertex(summation)
+    dag.add_edge(Edge.create(tokenizer, summation,
+                             edge.create_default_edge_property()))
+    return dag
+
+
+def run(input_paths, output_path: str, conf=None, **kw) -> str:
+    with TezClient.create("WordCount", conf or {}) as client:
+        status = client.submit_dag(
+            build_dag(input_paths, output_path, **kw)).wait_for_completion()
+        return status.state.name
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 3:
+        print("usage: wordcount <input...> <output_dir>")
+        sys.exit(2)
+    print(run(sys.argv[1:-1], sys.argv[-1]))

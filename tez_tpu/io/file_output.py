@@ -69,12 +69,14 @@ class FileOutput(LogicalOutput):
     def close(self) -> List[TezAPIEvent]:
         if self._writer is not None:
             self._writer.close()
-            # task-level "commit": move into the attempt-committed dir only
-            # if the AM lets this attempt commit (speculation arbitration)
-            committed = os.path.join(self.out_dir, TMP_SUBDIR, "committed",
-                                     os.path.basename(self.tmp_path))
-            os.makedirs(os.path.dirname(committed), exist_ok=True)
-            if not os.path.exists(committed):
+            # task-level commit: AM arbitration picks exactly one live
+            # attempt per task (speculation / retry safety); losers leave
+            # their file in the attempt dir, cleaned by the committer
+            if self.context.can_commit():
+                committed = os.path.join(self.out_dir, TMP_SUBDIR,
+                                         "committed",
+                                         os.path.basename(self.tmp_path))
+                os.makedirs(os.path.dirname(committed), exist_ok=True)
                 os.replace(self.tmp_path, committed)
         return []
 

@@ -31,12 +31,7 @@ from tez_tpu.shuffle.service import (ShuffleDataNotFound,
 log = logging.getLogger(__name__)
 
 
-def _conf_get(context: Any, key: str, default: Any) -> Any:
-    payload = context.user_payload.load()
-    conf: Dict[str, Any] = dict(context.conf)
-    if isinstance(payload, dict):
-        conf.update(payload)
-    return conf.get(key, default)
+from tez_tpu.library.util import conf_get as _conf_get  # noqa: E402
 
 
 class _SlotState:
@@ -68,13 +63,14 @@ class ShuffleFetchTable:
         self.failed = False
         self.diagnostics = ""
 
-    def on_payload(self, slot: int, partition: int, payload: ShufflePayload
-                   ) -> None:
+    def on_payload(self, slot: int, partition: int, payload: ShufflePayload,
+                   version: int = 0) -> None:
         with self.lock:
             s = self.slots[slot]
             if s.complete or \
                     (payload.spill_id >= 0 and payload.spill_id in s.spills_seen):
                 return  # duplicate delivery (e.g. after slot reset race)
+            s.version = version
         try:
             if payload.is_empty(partition):
                 batch = None
@@ -89,7 +85,7 @@ class ShuffleFetchTable:
         except ShuffleDataNotFound as e:
             log.warning("fetch failed for slot %d: %s", slot, e)
             self.context.send_events([InputReadErrorEvent(
-                diagnostics=str(e), index=slot, version=0,
+                diagnostics=str(e), index=slot, version=version,
                 is_local_fetch=True)])
             self.context.counters.increment(
                 TaskCounter.NUM_FAILED_SHUFFLE_INPUTS)
@@ -162,12 +158,13 @@ class OrderedGroupedKVInput(LogicalInput):
                 assert isinstance(payload, ShufflePayload), payload
                 for i in range(ev.count):
                     self.table.on_payload(ev.target_index_start + i,
-                                          ev.source_index, payload)
+                                          ev.source_index, payload,
+                                          version=ev.version)
             elif isinstance(ev, DataMovementEvent):
                 payload = ev.user_payload
                 assert isinstance(payload, ShufflePayload), payload
                 self.table.on_payload(ev.target_index, ev.source_index,
-                                      payload)
+                                      payload, version=ev.version)
             elif isinstance(ev, InputFailedEvent):
                 self.table.on_input_failed(ev.target_index, ev.version)
             else:

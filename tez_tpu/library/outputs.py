@@ -26,12 +26,7 @@ log = logging.getLogger(__name__)
 _COMBINERS = {"sum_long": sum_long_combiner}
 
 
-def _conf_get(context: Any, key: str, default: Any) -> Any:
-    payload = context.user_payload.load()
-    conf: Dict[str, Any] = dict(context.conf)
-    if isinstance(payload, dict):
-        conf.update(payload)
-    return conf.get(key, default)
+from tez_tpu.library.util import conf_get as _conf_get  # noqa: E402
 
 
 def output_path_component(context: Any) -> str:

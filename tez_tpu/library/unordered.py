@@ -1,0 +1,293 @@
+"""Unordered data path: hash-partitioned (no sort) outputs and streaming
+inputs.
+
+Reference parity: tez-runtime-library UnorderedPartitionedKVOutput +
+UnorderedPartitionedKVWriter.java:93 (per-partition chained buffers from a
+shared pool, background spill, final merge or per-spill events, skip-buffer
+direct-write for 1 partition), UnorderedKVOutput (broadcast writer),
+ShuffleManager.java:108 + UnorderedKVReader (streaming consumption as
+fetches complete, no merge).
+
+TPU shape: records batch into spans; a span is partitioned with the device
+hash kernel and grouped with a single-key partition sort pass (one u32 sort
+— no key ordering), yielding the same Run container the shuffle service
+serves.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tez_tpu.api.events import (CompositeDataMovementEvent,
+                                CompositeRoutedDataMovementEvent,
+                                DataMovementEvent, InputFailedEvent,
+                                ShufflePayload, TezAPIEvent,
+                                VertexManagerEvent, pack_empty_partitions)
+from tez_tpu.api.runtime import (KeyValueReader, KeyValuesWriter,
+                                 LogicalInput, LogicalOutput, Reader, Writer)
+from tez_tpu.common.counters import TaskCounter
+from tez_tpu.library.inputs import ShuffleFetchTable, _conf_get
+from tez_tpu.library.outputs import output_path_component
+from tez_tpu.ops import device
+from tez_tpu.ops.keycodec import pad_to_matrix
+from tez_tpu.ops.runformat import KVBatch, Run
+from tez_tpu.ops.serde import get_serde
+from tez_tpu.ops.sorter import SpanBuffer
+from tez_tpu.shuffle.service import local_shuffle_service
+
+log = logging.getLogger(__name__)
+
+
+class UnorderedPartitionedWriter:
+    """Hash-partition spans on device; no key sort."""
+
+    def __init__(self, num_partitions: int, span_budget_bytes: int,
+                 counters: Any, single_partition_skip_buffer: bool = True):
+        self.num_partitions = num_partitions
+        self.span_budget = span_budget_bytes
+        self.counters = counters
+        self._span = SpanBuffer()
+        self._runs: List[Run] = []
+        self.num_spills = 0
+        self.on_spill = None   # pipelined / no-final-merge mode
+
+    def write(self, key: bytes, value: bytes) -> None:
+        self._span.add(key, value)
+        self.counters.increment(TaskCounter.OUTPUT_RECORDS)
+        if self._span.nbytes >= self.span_budget:
+            self._partition_span()
+
+    def _partition_span(self) -> None:
+        if self._span.num_records == 0:
+            return
+        batch = self._span.to_batch()
+        self._span = SpanBuffer()
+        run = self.partition_batch(batch)
+        if self.on_spill is not None:
+            self.on_spill(run, self.num_spills)
+        else:
+            self._runs.append(run)
+            self.counters.increment(TaskCounter.SPILLED_RECORDS,
+                                    batch.num_records)
+        self.num_spills += 1
+
+    def partition_batch(self, batch: KVBatch) -> Run:
+        if self.num_partitions == 1:
+            # skip-buffer direct path (reference :direct-write mode)
+            return Run(batch, np.array([0, batch.num_records], dtype=np.int64))
+        klens = batch.key_offsets[1:] - batch.key_offsets[:-1]
+        wmax = int(klens.max(initial=1))
+        hash_w = 1 << max(2, (wmax - 1).bit_length())
+        mat, lengths = pad_to_matrix(batch.key_bytes, batch.key_offsets,
+                                     hash_w)
+        partitions = device.hash_partition(mat, lengths, self.num_partitions)
+        # single stable pass groups rows by partition, preserving arrival
+        # order within each partition
+        sorted_parts, perm = device.sort_run(
+            partitions, np.zeros((len(partitions), 0), dtype=np.uint32),
+            np.zeros(len(partitions), dtype=np.int64))
+        return Run.from_sorted_batch(batch.take(perm), sorted_parts,
+                                     self.num_partitions)
+
+    def flush(self) -> Optional[Run]:
+        if self.on_spill is not None:
+            self._partition_span()
+            return None
+        self._partition_span()
+        if not self._runs:
+            return Run(KVBatch.empty(),
+                       np.zeros(self.num_partitions + 1, dtype=np.int64))
+        if len(self._runs) == 1:
+            return self._runs[0]
+        # final "merge": per-partition concatenation (no ordering contract)
+        parts: List[KVBatch] = []
+        counts = np.zeros(self.num_partitions, dtype=np.int64)
+        for p in range(self.num_partitions):
+            for r in self._runs:
+                pb = r.partition(p)
+                if pb.num_records:
+                    parts.append(pb)
+                    counts[p] += pb.num_records
+        batch = KVBatch.concat(parts) if parts else KVBatch.empty()
+        row_index = np.zeros(self.num_partitions + 1, dtype=np.int64)
+        np.cumsum(counts, out=row_index[1:])
+        return Run(batch, row_index)
+
+
+class _UnorderedWriterFacade(KeyValuesWriter):
+    def __init__(self, writer: UnorderedPartitionedWriter, key_serde, val_serde,
+                 context: Any):
+        self.writer = writer
+        self.key_serde = key_serde
+        self.val_serde = val_serde
+        self.context = context
+        self._n = 0
+
+    def write(self, key: Any, value: Any) -> None:
+        k = self.key_serde.to_bytes(key)
+        v = self.val_serde.to_bytes(value)
+        self.writer.write(k, v)
+        self.context.counters.increment(TaskCounter.OUTPUT_BYTES,
+                                        len(k) + len(v))
+        self._n += 1
+        if (self._n & 0x3FFF) == 0:
+            self.context.notify_progress()
+
+
+class UnorderedPartitionedKVOutput(LogicalOutput):
+    """Hash-partitioned, unsorted output."""
+
+    def initialize(self) -> List[TezAPIEvent]:
+        ctx = self.context
+        buffer_mb = int(_conf_get(
+            ctx, "tez.runtime.unordered.output.buffer.size-mb", 100))
+        self.key_serde = get_serde(_conf_get(ctx, "tez.runtime.key.class",
+                                             "bytes"))
+        self.val_serde = get_serde(_conf_get(ctx, "tez.runtime.value.class",
+                                             "bytes"))
+        self._final_merge = bool(_conf_get(
+            ctx, "tez.runtime.enable.final-merge.in.output", True))
+        self.writer_impl = UnorderedPartitionedWriter(
+            self.num_physical_outputs, buffer_mb << 20, ctx.counters)
+        ctx.request_initial_memory(buffer_mb << 20, None)
+        self.service = local_shuffle_service()
+        self.host = ctx.get_service_provider_metadata("shuffle") or \
+            {"host": "local", "port": 0}
+        self._spills_sent = 0
+        if not self._final_merge:
+            self.writer_impl.on_spill = self._ship_spill
+        return []
+
+    def get_writer(self) -> Writer:
+        return _UnorderedWriterFacade(self.writer_impl, self.key_serde,
+                                      self.val_serde, self.context)
+
+    def handle_events(self, events: Sequence[TezAPIEvent]) -> None:
+        pass
+
+    def _payload(self, run: Run, spill_id: int, last: bool) -> ShufflePayload:
+        return ShufflePayload(
+            host=self.host["host"], port=self.host["port"],
+            path_component=output_path_component(self.context),
+            empty_partitions=pack_empty_partitions(
+                run.empty_partition_flags()),
+            spill_id=spill_id, last_event=last)
+
+    def _ship_spill(self, run: Run, spill_id: int) -> None:
+        self.service.register(output_path_component(self.context), spill_id,
+                              run)
+        self.context.send_events([
+            CompositeDataMovementEvent(0, run.num_partitions,
+                                       self._payload(run, spill_id, False))])
+        self._spills_sent += 1
+
+    def close(self) -> List[TezAPIEvent]:
+        run = self.writer_impl.flush()
+        path = output_path_component(self.context)
+        if run is None:   # per-spill mode: send final marker
+            empty = Run(KVBatch.empty(),
+                        np.zeros(self.num_physical_outputs + 1,
+                                 dtype=np.int64))
+            self.service.register(path, self._spills_sent, empty)
+            return [CompositeDataMovementEvent(
+                0, self.num_physical_outputs,
+                self._payload(empty, self._spills_sent, True))]
+        self.service.register(path, -1, run)
+        partition_sizes = [run.partition_nbytes(p)
+                           for p in range(run.num_partitions)]
+        return [
+            CompositeDataMovementEvent(
+                0, run.num_partitions,
+                ShufflePayload(host=self.host["host"], port=self.host["port"],
+                               path_component=path,
+                               empty_partitions=pack_empty_partitions(
+                                   run.empty_partition_flags()),
+                               spill_id=-1, last_event=True)),
+            VertexManagerEvent(
+                target_vertex_name=self.context.destination_vertex_name,
+                user_payload={"output_size": run.nbytes,
+                              "partition_sizes": partition_sizes}),
+        ]
+
+
+class UnorderedKVOutput(UnorderedPartitionedKVOutput):
+    """Single-partition / broadcast writer (reference: UnorderedKVOutput
+    wrapping the partitioned writer with 1 partition)."""
+
+
+class StreamingKVReader(KeyValueReader):
+    """Yields records as fetches complete — no global wait (reference:
+    UnorderedKVReader streaming from the completedInputs queue)."""
+
+    def __init__(self, table: ShuffleFetchTable, key_serde, val_serde,
+                 context: Any):
+        self.table = table
+        self.key_serde = key_serde
+        self.val_serde = val_serde
+        self.context = context
+
+    def __iter__(self) -> Iterator[Tuple[Any, Any]]:
+        import time
+        consumed_slots: set = set()
+        consumed_batches: Dict[int, int] = {}
+        n = 0
+        while True:
+            with self.table.lock:
+                ready: List[Tuple[int, KVBatch]] = []
+                done = self.table.completed >= self.table.num_slots
+                for si, s in enumerate(self.table.slots):
+                    start = consumed_batches.get(si, 0)
+                    for b in s.batches[start:]:
+                        ready.append((si, b))
+                    consumed_batches[si] = len(s.batches)
+            for si, batch in ready:
+                for k, v in batch.iter_pairs():
+                    yield (self.key_serde.from_bytes(k),
+                           self.val_serde.from_bytes(v))
+                    n += 1
+            if done and not ready:
+                break
+            if not ready:
+                time.sleep(0.02)
+                self.context.notify_progress()
+        self.context.counters.increment(TaskCounter.INPUT_RECORDS_PROCESSED, n)
+
+
+class UnorderedKVInput(LogicalInput):
+    """Streaming unordered input (ShuffleManager consumer side)."""
+
+    def initialize(self) -> List[TezAPIEvent]:
+        ctx = self.context
+        self.key_serde = get_serde(_conf_get(ctx, "tez.runtime.key.class",
+                                             "bytes"))
+        self.val_serde = get_serde(_conf_get(ctx, "tez.runtime.value.class",
+                                             "bytes"))
+        self.table = ShuffleFetchTable(ctx, self.num_physical_inputs,
+                                       my_partition=ctx.task_index)
+        ctx.request_initial_memory(0, None)
+        return []
+
+    def handle_events(self, events: Sequence[TezAPIEvent]) -> None:
+        for ev in events:
+            if isinstance(ev, CompositeRoutedDataMovementEvent):
+                payload = ev.user_payload
+                for i in range(ev.count):
+                    self.table.on_payload(ev.target_index_start + i,
+                                          ev.source_index, payload,
+                                          version=ev.version)
+            elif isinstance(ev, DataMovementEvent):
+                self.table.on_payload(ev.target_index, ev.source_index,
+                                      ev.user_payload, version=ev.version)
+            elif isinstance(ev, InputFailedEvent):
+                self.table.on_input_failed(ev.target_index, ev.version)
+
+    def get_reader(self) -> Reader:
+        return StreamingKVReader(self.table, self.key_serde, self.val_serde,
+                                 self.context)
+
+    def close(self) -> List[TezAPIEvent]:
+        return []

@@ -24,7 +24,8 @@ def pad_to_matrix(key_bytes: np.ndarray, offsets: np.ndarray,
     n = len(offsets) - 1
     lengths = (offsets[1:] - offsets[:-1]).astype(np.int64)
     mat = np.zeros((n, width), dtype=np.uint8)
-    if n == 0:
+    if n == 0 or key_bytes.size == 0:
+        # no rows, or every key empty — nothing to gather
         return mat, lengths.astype(np.int32)
     take = np.minimum(lengths, width)
     # index matrix: offsets[i] + j  (clamped), masked by j < take[i]

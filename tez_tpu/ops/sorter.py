@@ -293,9 +293,11 @@ def sum_long_combiner(run: Run) -> Run:
             same[i + 1] = kb[ko[i]:ko[i + 1]].tobytes() == \
                 kb[ko[i + 1]:ko[i + 2]].tobytes()
     group_starts = np.flatnonzero(~same)
-    # decode values (8-byte BE unsigned with sign-flip encoding)
-    vals = batch.val_bytes.reshape(n, 8) if batch.val_bytes.size == n * 8 \
-        else None
+    # decode values (8-byte BE unsigned with sign-flip encoding); the fast
+    # path requires every value to be exactly 8 bytes (long serde), not just
+    # the right total
+    uniform_long = bool(np.all(np.diff(batch.val_offsets) == 8))
+    vals = batch.val_bytes.reshape(n, 8) if uniform_long else None
     serde = VarLongSerde()
     if vals is not None:
         nums = vals.astype(np.uint64)

@@ -98,6 +98,12 @@ class _BaseContext:
     def fatal_error(self, exc: Optional[BaseException], message: str) -> None:
         self._runner.fatal_error(exc, message)
 
+    def can_commit(self) -> bool:
+        """Commit arbitration with the AM.  Available on every context so
+        leaf outputs can gate publishing (reference: canCommit flows through
+        the processor, but output commit also honors it)."""
+        return self._runner.umbilical.can_commit(self._runner.spec.attempt_id)
+
     @property
     def work_dirs(self) -> List[str]:
         return [self._runner.work_dir]

@@ -1,0 +1,163 @@
+"""Benchmark: OrderedWordCount-style shuffle+sort core on one TPU chip.
+
+Measures the partitioned sort + k-way merge data path (the part of the
+reference that PipelinedSorter/TezMerger implement — SURVEY.md §2.5 /
+BASELINE.md north star) on synthetic records: P producer tasks each
+partition+sort their span on device; C consumer tasks merge their partition's
+slices.  Baseline is a strong HOST implementation of the same semantics
+(vectorized numpy FNV hash + lexsort + stable merge) on this machine —
+record-at-a-time JVM-style sorting is far slower than this baseline, so
+vs_baseline understates the advantage over the reference.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": MB/s/chip, "unit": "MB/s", "vs_baseline": x}
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def make_records(num_records: int, key_len: int = 12, seed: int = 0):
+    """Synthetic word-count-ish records: zipfian keys, 8-byte long values."""
+    rng = np.random.default_rng(seed)
+    vocab = 50_000
+    word_ids = rng.zipf(1.3, num_records).astype(np.int64) % vocab
+    # fixed-width keys: "w%010d" style bytes
+    digits = np.zeros((num_records, key_len), dtype=np.uint8)
+    digits[:, 0] = ord("w")
+    ids = word_ids.copy()
+    for i in range(key_len - 1, 0, -1):
+        digits[:, i] = ord("0") + (ids % 10)
+        ids //= 10
+    key_bytes = digits.reshape(-1)
+    key_offsets = np.arange(num_records + 1, dtype=np.int64) * key_len
+    val_bytes = rng.integers(0, 256, num_records * 8, dtype=np.int64)\
+        .astype(np.uint8)
+    val_offsets = np.arange(num_records + 1, dtype=np.int64) * 8
+    return key_bytes, key_offsets, val_bytes, val_offsets
+
+
+def host_baseline(key_bytes, key_offsets, val_bytes, val_offsets,
+                  num_producers: int, num_partitions: int, key_len: int):
+    """Vectorized host implementation of the same partition+sort+merge."""
+    n = len(key_offsets) - 1
+    keys = key_bytes.reshape(n, key_len)
+    # FNV-1a per row (vectorized over rows, loop over key bytes)
+    h = np.full(n, 2166136261, dtype=np.uint64)
+    for j in range(key_len):
+        h = ((h ^ keys[:, j].astype(np.uint64)) * np.uint64(16777619)) \
+            & np.uint64(0xFFFFFFFF)
+    part = (h % np.uint64(num_partitions)).astype(np.int64)
+    per = n // num_producers
+    producer_runs = []
+    for p in range(num_producers):
+        sl = slice(p * per, (p + 1) * per if p < num_producers - 1 else n)
+        cols = [keys[sl, j] for j in range(key_len - 1, -1, -1)]
+        order = np.lexsort(cols + [part[sl]])
+        producer_runs.append((part[sl][order], keys[sl][order]))
+    # consumer merge: for each partition, concat producer slices + stable sort
+    out = []
+    for c in range(num_partitions):
+        segs = []
+        for parts, ks in producer_runs:
+            lo = np.searchsorted(parts, c, "left")
+            hi = np.searchsorted(parts, c, "right")
+            segs.append(ks[lo:hi])
+        allk = np.concatenate(segs) if segs else np.zeros((0, key_len),
+                                                          np.uint8)
+        cols = [allk[:, j] for j in range(key_len - 1, -1, -1)]
+        out.append(allk[np.lexsort(cols)])
+    return out
+
+
+def prepare_device_inputs(key_bytes, key_offsets, val_bytes, val_offsets,
+                          key_len: int):
+    """Normalize + upload ONCE (the data plane is HBM-resident: records are
+    produced on device and stay there; host<->device DMA is not part of the
+    shuffle+sort path being measured)."""
+    import jax
+    import jax.numpy as jnp
+    from tez_tpu.ops.keycodec import matrix_to_lanes, pad_to_matrix
+    n = len(key_offsets) - 1
+    mat, lengths = pad_to_matrix(key_bytes, key_offsets, key_len)
+    lanes = matrix_to_lanes(mat)
+    hash_w = 1 << max(2, (key_len - 1).bit_length())
+    hmat, hlens = pad_to_matrix(key_bytes, key_offsets, hash_w)
+    vals = np.ascontiguousarray(val_bytes.reshape(n, 8)).view(np.uint32)
+    dev = [jnp.asarray(x) for x in
+           (lanes, lengths.astype(np.int64), vals, hmat,
+            hlens.astype(np.int32))]
+    jax.block_until_ready(dev)
+    return dev
+
+
+def tpu_path(dev_inputs, num_partitions: int):
+    """The measured region: hash-partition + global (partition, key) sort +
+    payload gather + partition index, all device-resident — the single-chip
+    equivalent of producer sort + exchange + consumer merge (on one chip the
+    exchange is an HBM-resident buffer handoff).
+
+    Timing honesty: through the axon relay, block_until_ready can return
+    before remote execution finishes, so completion is forced by fetching a
+    scalar that depends on the whole pipeline (the tiny counts vector)."""
+    from tez_tpu.ops.device_pipeline import device_shuffle_sort
+    lanes, lengths, vals, hmat, hlens = dev_inputs
+    out = device_shuffle_sort(lanes, lengths, vals, hmat, hlens,
+                              num_partitions)
+    _ = np.asarray(out[4])   # counts: forces full execution, ~P ints D2H
+    return out
+
+
+def main() -> int:
+    num_records = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+    key_len = 12
+    num_producers, num_partitions = 4, 4
+    kb, ko, vb, vo = make_records(num_records, key_len)
+    total_mb = (kb.nbytes + vb.nbytes) / 1e6
+
+    dev = prepare_device_inputs(kb, ko, vb, vo, key_len)
+    # warm up (compile; persisted across runs via the jit cache)
+    tpu_path(dev, num_partitions)
+
+    t0 = time.time()
+    reps = 3
+    for _ in range(reps):
+        tpu_out = tpu_path(dev, num_partitions)
+    tpu_s = (time.time() - t0) / reps
+
+    t0 = time.time()
+    host_out = host_baseline(kb, ko, vb, vo, num_producers, num_partitions,
+                             key_len)
+    host_s = time.time() - t0
+
+    # sanity: same keys per partition in same order
+    sorted_parts, out_lanes, out_vals, perm, counts = \
+        [np.asarray(x) for x in tpu_out]
+    n = num_records
+    sorted_keys = kb.reshape(n, key_len)[perm[:n]]
+    bounds = np.zeros(num_partitions + 1, dtype=np.int64)
+    np.cumsum(counts, out=bounds[1:])
+    for c in range(num_partitions):
+        got = sorted_keys[bounds[c]:bounds[c + 1]]
+        assert got.shape == host_out[c].shape, \
+            f"partition {c}: {got.shape} vs {host_out[c].shape}"
+        assert np.array_equal(got, host_out[c]), f"partition {c} mismatch"
+
+    mbps = total_mb / tpu_s
+    print(json.dumps({
+        "metric": "ordered-shuffle-sort throughput "
+                  f"({num_records} recs, {num_partitions} partitions, "
+                  "HBM-resident)",
+        "value": round(mbps, 2),
+        "unit": "MB/s",
+        "vs_baseline": round(host_s / tpu_s, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,12 +3,19 @@ sharding paths compile and execute without TPU hardware (the driver's
 dryrun_multichip does the same)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The ambient sitecustomize may have registered the real-TPU backend and
+# pinned jax_platforms before this file runs; the config update (which
+# outranks the env var) forces tests onto the virtual CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -57,15 +57,7 @@ def _fnv_partition(key_mat: jnp.ndarray, lengths: jnp.ndarray,
     Byte-identical to library.partitioners.HashPartitioner._stable_hash for
     keys that fit the padded width.  key_mat: uint8[N, W]; returns int32[N].
     """
-    w = key_mat.shape[1]
-
-    def body(j, h):
-        byte = key_mat[:, j].astype(jnp.uint32)
-        nh = ((h ^ byte) * FNV_PRIME).astype(jnp.uint32)
-        return jnp.where(j < lengths, nh, h)
-
-    h = jnp.full((key_mat.shape[0],), FNV_OFFSET, dtype=jnp.uint32)
-    h = jax.lax.fori_loop(0, w, body, h)
+    h = _fnv_rows(key_mat, lengths)
     return (h % jnp.uint32(num_partitions)).astype(jnp.int32)
 
 
@@ -86,30 +78,101 @@ def hash_partition(key_mat: np.ndarray, lengths: np.ndarray,
 # ---------------------------------------------------------------------------
 # partitioned stable sort
 # ---------------------------------------------------------------------------
+def _fnv_rows(key_mat: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Traced FNV-1a over each row's first `lengths[i]` bytes — the ONE hash
+    body shared by every kernel (host-partitioner parity)."""
+    def body(j, h):
+        byte = key_mat[:, j].astype(jnp.uint32)
+        nh = ((h ^ byte) * FNV_PRIME).astype(jnp.uint32)
+        return jnp.where(j < lengths, nh, h)
+
+    h = jnp.full((key_mat.shape[0],), FNV_OFFSET, dtype=jnp.uint32)
+    return jax.lax.fori_loop(0, key_mat.shape[1], body, h)
+
+
+def _hash_to_partitions(key_mat: jnp.ndarray, hash_lengths: jnp.ndarray,
+                        num_partitions: int) -> jnp.ndarray:
+    """Hash + padding sentinel: rows with hash_lengths < 0 get partition MAX
+    so they sort to the tail."""
+    h = _fnv_rows(key_mat, hash_lengths)
+    return jnp.where(
+        hash_lengths < 0, jnp.int32(np.iinfo(np.int32).max),
+        (h % jnp.uint32(num_partitions)).astype(jnp.int32))
+
+
+def _lsd_passes(partitions: jnp.ndarray, lanes: jnp.ndarray,
+                lengths: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Traced body shared by the fused kernels: stable LSD passes by
+    (partition, lanes..., clamped length)."""
+    n = partitions.shape[0]
+    perm = jnp.arange(n, dtype=jnp.int32)
+    _, perm = jax.lax.sort((lengths.astype(jnp.uint32), perm),
+                           dimension=0, is_stable=True, num_keys=1)
+    for i in range(lanes.shape[1] - 1, -1, -1):
+        _, perm = jax.lax.sort((lanes[:, i][perm], perm),
+                               dimension=0, is_stable=True, num_keys=1)
+    sorted_parts, perm = jax.lax.sort(
+        (partitions.astype(jnp.uint32)[perm], perm),
+        dimension=0, is_stable=True, num_keys=1)
+    return sorted_parts.astype(jnp.int32), perm
+
+
+@functools.partial(jax.jit, static_argnames=("num_partitions",))
+def _fused_hash_sort(key_mat: jnp.ndarray, hash_lengths: jnp.ndarray,
+                     lanes: jnp.ndarray, sort_lengths: jnp.ndarray,
+                     num_partitions: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One dispatch: full-key FNV hash-partition + LSD sort.  Fusing all
+    passes into a single XLA program matters on TPU: per-dispatch latency
+    (host<->device round trips) would otherwise dominate small spans."""
+    partitions = _hash_to_partitions(key_mat, hash_lengths, num_partitions)
+    return _lsd_passes(partitions, lanes, sort_lengths)
+
+
 @jax.jit
-def _sort_u32_with_perm(keys: jnp.ndarray,
-                        perm: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """THE sort kernel: stable single-key u32 sort carrying a permutation.
-
-    Every radix pass (and thus every key width) reuses this one compiled
-    program per bucket size — a variadic N-operand `lax.sort` costs minutes
-    of XLA compile time at large N on TPU, while this compiles once in
-    seconds.  u32 keeps everything TPU-native (no x64 emulation).
-    """
-    out = jax.lax.sort((keys, perm), dimension=0, is_stable=True, num_keys=1)
-    return out[0], out[1]
+def _fused_sort(partitions: jnp.ndarray, lanes: jnp.ndarray,
+                lengths: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    return _lsd_passes(partitions, lanes, lengths)
 
 
-@jax.jit
-def _gather_u32(col: jnp.ndarray, perm: jnp.ndarray) -> jnp.ndarray:
-    return col[perm]
+def hash_sort_span(key_mat: np.ndarray, hash_lengths: np.ndarray,
+                   lanes: np.ndarray, lengths: np.ndarray,
+                   num_partitions: int) -> tuple[np.ndarray, np.ndarray]:
+    """Fused span kernel: hash-partition + stable (partition, key) sort in a
+    single device dispatch.  Returns (sorted partitions, permutation)."""
+    n = key_mat.shape[0]
+    if n == 0:
+        return np.zeros(0, np.int32), np.zeros(0, np.int32)
+    width_cap = lanes.shape[1] * 4 + 1
+    slen = np.minimum(lengths.astype(np.int64), width_cap)
+    nb = _bucket(n)
+    hash_lengths = hash_lengths.astype(np.int32)
+    if nb != n:
+        pad = nb - n
+        key_mat = np.pad(key_mat, ((0, pad), (0, 0)), constant_values=255)
+        hash_lengths = np.pad(hash_lengths, (0, pad), constant_values=-1)
+        lanes = np.pad(lanes, ((0, pad), (0, 0)),
+                       constant_values=np.uint32(0xFFFFFFFF))
+        slen = np.pad(slen, (0, pad), constant_values=width_cap)
+    sp, perm = _fused_hash_sort(jnp.asarray(key_mat),
+                                jnp.asarray(hash_lengths),
+                                jnp.asarray(lanes),
+                                jnp.asarray(slen.astype(np.uint32)),
+                                num_partitions)
+    sp = np.asarray(sp)
+    perm = np.asarray(perm)
+    if nb != n:
+        keep = perm < n
+        sp, perm = sp[keep], perm[keep]
+    return sp, perm
 
 
 def sort_run(partitions: np.ndarray, lanes: np.ndarray,
              lengths: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    """LSD radix sort by (partition, key lanes, clamped length): a sequence
-    of stable single-key u32 passes from least- to most-significant key, all
-    through the one compiled `_sort_u32_with_perm` kernel.
+    """LSD radix sort by (partition, key lanes, clamped length): stable
+    single-key u32 passes from least- to most-significant key, fused into
+    the one compiled `_fused_sort` program (variadic N-operand `lax.sort`
+    costs minutes of XLA compile time at large N on TPU; chained single-key
+    sorts compile in seconds).
 
     The clamped length disambiguates keys whose zero padding collides (if
     padded prefixes are equal, the longer key == shorter key + trailing
@@ -130,20 +193,10 @@ def sort_run(partitions: np.ndarray, lanes: np.ndarray,
                             constant_values=np.iinfo(np.int32).max)
         lanes = np.pad(lanes, ((0, nb - n), (0, 0)))
         lengths = np.pad(lengths, (0, nb - n))
-    dev_lanes = jnp.asarray(lanes)                 # [nb, L] device-resident
-    perm = jnp.arange(nb, dtype=jnp.int32)
-    # pass 1 (least significant): clamped length
-    _, perm = _sort_u32_with_perm(
-        jnp.asarray(lengths.astype(np.uint32)), perm)
-    # per-lane passes, last lane first
-    for i in range(dev_lanes.shape[1] - 1, -1, -1):
-        keys = _gather_u32(dev_lanes[:, i], perm)
-        _, perm = _sort_u32_with_perm(keys, perm)
-    # most significant: partition (int32 >= 0; pad MAX stays max as u32)
-    pkeys = _gather_u32(jnp.asarray(partitions.astype(np.uint32)), perm)
-    sorted_parts, perm = _sort_u32_with_perm(pkeys, perm)
-    return (np.asarray(sorted_parts).astype(np.int32)[:n],
-            np.asarray(perm)[:n])
+    sorted_parts, perm = _fused_sort(jnp.asarray(partitions),
+                                     jnp.asarray(lanes),
+                                     jnp.asarray(lengths.astype(np.uint32)))
+    return (np.asarray(sorted_parts)[:n], np.asarray(perm)[:n])
 
 
 # ---------------------------------------------------------------------------

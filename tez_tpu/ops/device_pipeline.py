@@ -1,0 +1,62 @@
+"""Device-resident shuffle+sort pipeline for fixed-width records.
+
+This is the HBM-resident heart of the data plane (SURVEY.md §2.5: "spans =
+device buffers", spill = device->host DMA only on overflow): records whose
+keys are normalized to u32 lanes and whose values are fixed-width words flow
+hash->sort->merge entirely on device — the host only sees control metadata
+(partition boundaries) and whatever a leaf output finally materializes.
+
+The variable-length KVBatch path (ops.sorter) wraps this with host ragged
+gathers; benchmarks and device-to-device edges use it directly.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tez_tpu.ops.device import _bucket, _hash_to_partitions, _lsd_passes
+
+
+@functools.partial(jax.jit, static_argnames=("num_partitions",))
+def _fused_pipeline(key_mat: jnp.ndarray, hash_lengths: jnp.ndarray,
+                    lanes: jnp.ndarray, sort_lengths: jnp.ndarray,
+                    vals: jnp.ndarray, num_partitions: int
+                    ) -> Tuple[jnp.ndarray, ...]:
+    """hash-partition + LSD (partition, lanes, length) sort + payload gather,
+    one dispatch, everything stays in HBM.  Hash and sort bodies are the
+    shared device.py helpers — one implementation for every kernel."""
+    partitions = _hash_to_partitions(key_mat, hash_lengths, num_partitions)
+    sorted_parts, perm = _lsd_passes(partitions, lanes, sort_lengths)
+    out_lanes = lanes[perm]
+    out_vals = vals[perm]
+    # per-partition row counts (for the partition index) on device
+    counts = jnp.bincount(
+        jnp.clip(sorted_parts.astype(jnp.int32), 0, num_partitions),
+        length=num_partitions + 1)[:num_partitions]
+    return sorted_parts.astype(jnp.int32), out_lanes, out_vals, perm, counts
+
+
+def device_shuffle_sort(lanes, lengths, vals, key_mat, hash_lengths,
+                        num_partitions: int):
+    """Device-resident pipeline over already-device (or host) arrays.
+    Returns device arrays (sorted_partitions, lanes, vals, perm, counts)."""
+    n = int(lanes.shape[0])
+    nb = _bucket(n)
+    width_cap = lanes.shape[1] * 4 + 1
+    if nb != n:
+        pad = nb - n
+        key_mat = jnp.pad(key_mat, ((0, pad), (0, 0)), constant_values=255)
+        hash_lengths = jnp.pad(hash_lengths, (0, pad), constant_values=-1)
+        lanes = jnp.pad(lanes, ((0, pad), (0, 0)),
+                        constant_values=np.uint32(0xFFFFFFFF))
+        lengths = jnp.pad(lengths, (0, pad), constant_values=width_cap)
+        vals = jnp.pad(vals, ((0, pad),) + ((0, 0),) * (vals.ndim - 1))
+    slen = jnp.minimum(lengths, width_cap).astype(jnp.uint32)
+    return _fused_pipeline(jnp.asarray(key_mat),
+                           jnp.asarray(hash_lengths, dtype=jnp.int32),
+                           jnp.asarray(lanes), slen, jnp.asarray(vals),
+                           num_partitions)

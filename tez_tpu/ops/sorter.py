@@ -155,18 +155,20 @@ class DeviceSorter:
                                      self.key_width)
         lanes = matrix_to_lanes(mat)
         if self.partitioner == "hash":
-            # full-key FNV hash: pad to the longest key in the batch so the
-            # hash covers every byte (host-partitioner parity)
+            # fused single-dispatch kernel: full-key FNV hash (matrix padded
+            # to the longest key so every byte is hashed — host-partitioner
+            # parity) + (partition, key) LSD sort
             klens = batch.key_offsets[1:] - batch.key_offsets[:-1]
             wmax = int(klens.max(initial=1))
             hash_w = 1 << max(2, (wmax - 1).bit_length())
             hmat, hlens = pad_to_matrix(batch.key_bytes, batch.key_offsets,
                                         hash_w)
-            partitions = device.hash_partition(hmat, hlens,
-                                               self.num_partitions)
+            sorted_partitions, perm = device.hash_sort_span(
+                hmat, hlens, lanes, lengths, self.num_partitions)
         else:
             partitions = np.zeros(batch.num_records, dtype=np.int32)
-        sorted_partitions, perm = device.sort_run(partitions, lanes, lengths)
+            sorted_partitions, perm = device.sort_run(partitions, lanes,
+                                                      lengths)
         sorted_batch = batch.take(perm)
         refinement = _exact_tiebreak(
             sorted_batch, sorted_partitions, lanes[perm], self.key_width)

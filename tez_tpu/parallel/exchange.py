@@ -1,0 +1,161 @@
+"""Distributed scatter-gather shuffle: the ICI all-to-all data plane.
+
+This is the multi-chip replacement for the reference's fetch-based shuffle
+(ShuffleHandler + Fetcher, SURVEY.md §2.10): instead of N^2 HTTP fetches, the
+whole exchange is ONE jitted SPMD program over a device mesh —
+
+    per worker:  hash-partition -> local segmented sort ->
+    all-to-all over ICI          (partition p's rows land on worker p) ->
+    local k-way merge (stable sort of concatenation)
+
+Everything is static-shape: each worker holds up to N rows (padding rows
+carry partition = P_MAX so they sort to the tail and exchange as slack), and
+the all-to-all moves a fixed [W, CAP] send buffer per worker — the padded
+formulation of a ragged all-to-all.  Skew beyond CAP is handled above this
+kernel by the fair-shuffle vertex manager splitting oversized partitions
+(SURVEY.md §5.7).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from tez_tpu.parallel.mesh import WORKER_AXIS
+
+INVALID = jnp.uint32(0xFFFFFFFF)
+
+
+def _fnv_lanes(lanes: jnp.ndarray) -> jnp.ndarray:
+    """FNV-1a over each row's lanes (u32 words); the distributed kernel's
+    partitioner (device-side analog of HashPartitioner over encoded keys)."""
+    h = jnp.full((lanes.shape[0],), 2166136261, dtype=jnp.uint32)
+    for i in range(lanes.shape[1]):
+        h = ((h ^ lanes[:, i]) * jnp.uint32(16777619)).astype(jnp.uint32)
+    return h
+
+
+def _stable_sort_rows(keys_cols, payload_cols):
+    """Stable lexicographic sort by `keys_cols` (list of u32[N] arrays),
+    implemented as LSD passes of single-key sorts (same trick as
+    ops.device.sort_run: cheap to compile, fast on TPU)."""
+    n = keys_cols[0].shape[0]
+    perm = jnp.arange(n, dtype=jnp.int32)
+    for col in reversed(keys_cols):
+        gathered = col[perm]
+        _, perm = jax.lax.sort((gathered, perm), dimension=0, is_stable=True,
+                               num_keys=1)
+    return [c[perm] for c in keys_cols], [p[perm] for p in payload_cols], perm
+
+
+def _shuffle_step_local(lanes: jnp.ndarray, values: jnp.ndarray,
+                        valid: jnp.ndarray, num_workers: int,
+                        cap: int) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                           jnp.ndarray]:
+    """Per-worker body run under shard_map.  lanes: u32[N, L]; values:
+    u32[N]; valid: bool[N].  Returns (lanes', values', valid', dropped)
+    holding this worker's partition, key-sorted, padded to [W*cap], plus a
+    per-worker count of rows lost to capacity overflow (must be zero)."""
+    n, num_lanes = lanes.shape
+    part = jnp.where(valid, _fnv_lanes(lanes) % num_workers,
+                     jnp.uint32(num_workers))
+    # local sort by (partition, key lanes): padding (part=W) goes last
+    key_cols = [part.astype(jnp.uint32)] + \
+        [lanes[:, i] for i in range(num_lanes)]
+    sorted_keys, sorted_payload, _ = _stable_sort_rows(
+        key_cols, [values, valid.astype(jnp.uint32)])
+    spart = sorted_keys[0]
+    slanes = jnp.stack(sorted_keys[1:], axis=1) if num_lanes else \
+        jnp.zeros((n, 0), jnp.uint32)
+    svalues, svalid = sorted_payload
+
+    # scatter rows into the fixed [W, cap] send buffer: row i of partition p
+    # goes to slot (p, rank_within_partition(i))
+    ranks = jnp.arange(n, dtype=jnp.int32) - \
+        jnp.searchsorted(spart, spart, side="left").astype(jnp.int32)
+    in_range = (spart < num_workers) & (ranks < cap) & (svalid > 0)
+    # out-of-range rows scatter to a sacrificial trailing slot (sliced off)
+    # so they can never clobber slot 0
+    dump = num_workers * cap
+    flat_slot = jnp.where(in_range, spart.astype(jnp.int32) * cap + ranks,
+                          dump)
+
+    send_lanes = jnp.full((num_workers * cap + 1, num_lanes), INVALID,
+                          dtype=jnp.uint32)
+    send_vals = jnp.zeros((num_workers * cap + 1,), dtype=jnp.uint32)
+    send_valid = jnp.zeros((num_workers * cap + 1,), dtype=jnp.uint32)
+    send_lanes = send_lanes.at[flat_slot].set(slanes)
+    send_vals = send_vals.at[flat_slot].set(svalues)
+    send_valid = send_valid.at[flat_slot].set(jnp.uint32(1))
+
+    # ICI all-to-all: block w of my send buffer -> worker w
+    send_lanes = send_lanes[:dump].reshape(num_workers, cap, num_lanes)
+    send_vals = send_vals[:dump].reshape(num_workers, cap)
+    send_valid = send_valid[:dump].reshape(num_workers, cap)
+    recv_lanes = jax.lax.all_to_all(send_lanes, WORKER_AXIS, 0, 0, tiled=False)
+    recv_vals = jax.lax.all_to_all(send_vals, WORKER_AXIS, 0, 0, tiled=False)
+    recv_valid = jax.lax.all_to_all(send_valid, WORKER_AXIS, 0, 0,
+                                    tiled=False)
+
+    # local merge: stable sort of the received concatenation by key lanes
+    # (invalid rows carry INVALID lanes -> tail)
+    m = num_workers * cap
+    rlanes = recv_lanes.reshape(m, num_lanes)
+    rvals = recv_vals.reshape(m)
+    rvalid = recv_valid.reshape(m)
+    key_cols = [jnp.where(rvalid > 0, jnp.uint32(0), jnp.uint32(1))] + \
+        [rlanes[:, i] for i in range(num_lanes)]
+    sorted_keys, sorted_payload, _ = _stable_sort_rows(
+        key_cols, [rvals, rvalid])
+    out_lanes = jnp.stack(sorted_keys[1:], axis=1) if num_lanes else rlanes
+    out_vals, out_valid = sorted_payload
+    # overflow signal: valid rows this worker could NOT send (rank >= cap).
+    # Zero in correct operation; the caller MUST check it — capacity
+    # overflow otherwise means silent data loss (skew handling above this
+    # kernel re-runs with a bigger cap or splits the partition).
+    dropped = jnp.sum((svalid > 0) & ~in_range).astype(jnp.int32)
+    return out_lanes, out_vals, out_valid.astype(jnp.bool_), dropped[None]
+
+
+def build_distributed_shuffle(mesh, num_lanes: int, rows_per_worker: int,
+                              cap_per_pair: int):
+    """Compile the SPMD shuffle step for a mesh.  Returns a jitted function
+    f(lanes u32[W*N, L], values u32[W*N], valid bool[W*N]) -> per-worker
+    sorted partitions, sharded over the mesh."""
+    from jax.experimental.shard_map import shard_map
+    num_workers = mesh.devices.size
+
+    body = functools.partial(_shuffle_step_local,
+                             num_workers=num_workers, cap=cap_per_pair)
+    smapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
+        out_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS),
+                   P(WORKER_AXIS)),
+        check_rep=False)
+    return jax.jit(smapped)
+
+
+def distributed_shuffle_reference(lanes: np.ndarray, values: np.ndarray,
+                                  valid: np.ndarray,
+                                  num_workers: int) -> list:
+    """Host golden: what each worker should hold after the exchange."""
+    rows = [(tuple(lanes[i].tolist()), int(values[i]))
+            for i in range(len(values)) if valid[i]]
+
+    def fnv(ls):
+        h = 2166136261
+        for w in ls:
+            h = ((h ^ w) * 16777619) & 0xFFFFFFFF
+        return h
+
+    out = [[] for _ in range(num_workers)]
+    for ls, v in rows:
+        out[fnv(ls) % num_workers].append((ls, v))
+    for part in out:
+        part.sort(key=lambda t: t[0])
+    return out

@@ -1,0 +1,38 @@
+"""Device-mesh helpers for the distributed data plane.
+
+The TPU replacement for the reference's cluster topology: a scatter-gather
+edge between two vertices placed on the same slice maps to an XLA all-to-all
+over ICI on a 1-D "workers" mesh (SURVEY.md §2.10 bulk-data-plane row);
+multi-slice crosses DCN via the shuffle object service instead.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+WORKER_AXIS = "workers"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the slice's chips; data-parallel shuffle workers."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"asked for {n_devices} devices, have {len(devices)}")
+            devices = devices[:n_devices]
+    import numpy as np
+    return Mesh(np.asarray(devices), (WORKER_AXIS,))
+
+
+def worker_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows sharded across workers (leading axis)."""
+    return NamedSharding(mesh, PartitionSpec(WORKER_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

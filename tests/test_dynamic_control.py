@@ -1,0 +1,100 @@
+"""Phase-5 tests: slow-start, auto-parallelism shrink, speculation."""
+import collections
+import os
+import random
+import time
+
+import pytest
+
+from tez_tpu.client.dag_client import DAGStatusState
+from tez_tpu.client.tez_client import TezClient
+from tez_tpu.common.payload import (InputDescriptor, OutputDescriptor,
+                                    ProcessorDescriptor,
+                                    VertexManagerPluginDescriptor)
+from tez_tpu.dag.dag import DAG, Edge, Vertex
+from tez_tpu.examples import ordered_wordcount
+
+
+@pytest.fixture()
+def client(tmp_staging):
+    c = TezClient.create("t", {"tez.staging-dir": tmp_staging,
+                               "tez.am.local.num-containers": 4}).start()
+    yield c
+    c.stop()
+
+
+def write_corpus(path, num_lines=400, seed=0):
+    rng = random.Random(seed)
+    words = [f"w{i:02d}" for i in range(30)]
+    counts = collections.Counter()
+    with open(path, "w") as fh:
+        for _ in range(num_lines):
+            line = [rng.choice(words) for _ in range(6)]
+            counts.update(line)
+            fh.write(" ".join(line) + "\n")
+    return counts
+
+
+def test_auto_parallelism_shrinks_summation(client, tmp_path):
+    """Summation declared with 8 tasks shrinks to fewer when the measured
+    output is tiny (reference: ShuffleVertexManager auto-parallelism)."""
+    corpus = tmp_path / "in.txt"
+    golden = write_corpus(str(corpus))
+    out = str(tmp_path / "out")
+    dag = ordered_wordcount.build_dag([str(corpus)], out,
+                                      tokenizer_parallelism=3,
+                                      summation_parallelism=8)
+    # switch summation's manager to auto-parallel with a large desired input
+    summation = dag.vertices["summation"]
+    summation.set_vertex_manager_plugin(VertexManagerPluginDescriptor.create(
+        "tez_tpu.library.vertex_managers:ShuffleVertexManager",
+        payload={"auto_parallel": True,
+                 "desired_task_input_size": 1 << 30,
+                 "min_task_parallelism": 1,
+                 "min_fraction": 0.5, "max_fraction": 0.75}))
+    dc = client.submit_dag(dag)
+    status = dc.wait_for_completion(timeout=60)
+    assert status.state is DAGStatusState.SUCCEEDED
+    # shrank all the way to 1 task
+    assert status.vertex_status["summation"].progress.total_task_count == 1
+    # output still exactly correct through the range edge manager
+    rows = {}
+    for f in sorted(os.listdir(out)):
+        if f.startswith("part-"):
+            for line in open(os.path.join(out, f), "rb"):
+                w, c = line.rstrip(b"\n").split(b"\t")
+                rows[w.decode()] = int(c)
+    assert rows == dict(golden)
+
+
+from tez_tpu.library.processors import SimpleProcessor
+
+
+class StragglerProcessor(SimpleProcessor):
+    """Task 1 attempt 0 stalls (cooperatively, checking for kill); all other
+    attempts finish fast."""
+
+    def run(self, inputs, outputs):
+        if self.context.task_index == 1 and \
+                self.context.task_attempt_number == 0:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                time.sleep(0.05)
+                self.context.notify_progress()
+        else:
+            time.sleep(0.05)
+
+
+def test_speculation_rescues_straggler(client, tmp_path):
+    """A task whose first attempt stalls gets a speculative attempt that
+    finishes (reference: LegacySpeculator)."""
+    v = Vertex.create("v", ProcessorDescriptor.create(StragglerProcessor), 3)
+    dag = DAG.create("spec").add_vertex(v)
+    dag.set_conf("tez.am.speculation.enabled", True)
+    dag.set_conf("tez.am.legacy.speculative.slowtask.threshold", 1.0)
+    dc = client.submit_dag(dag)
+    status = dc.wait_for_completion(timeout=45)
+    assert status.state is DAGStatusState.SUCCEEDED
+    am = client.framework_client.am
+    d = am.dag_counters.to_dict().get("DAGCounter", {})
+    assert d.get("NUM_SPECULATIONS", 0) >= 1

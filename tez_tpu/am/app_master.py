@@ -38,8 +38,9 @@ class DAGAppMaster:
     """The single-controller orchestrator."""
 
     def __init__(self, app_id: str, conf: C.TezConfiguration,
-                 recovery_data: Any = None):
+                 attempt: int = 1):
         self.app_id = app_id
+        self.attempt = attempt
         self.conf = conf
         self.node_id = "local-0"
         self.work_dir = os.path.join(
@@ -55,7 +56,10 @@ class DAGAppMaster:
         logging_service = HistoryEventHandler.create_logging_service(conf)
         from tez_tpu.am.recovery import RecoveryService
         recovery_enabled = conf.get(C.DAG_RECOVERY_ENABLED)
-        self.recovery_service = RecoveryService(self) if recovery_enabled else None
+        self.recovery_service = RecoveryService(self, attempt) \
+            if recovery_enabled else None
+        from tez_tpu.am.heartbeat import HeartbeatMonitor
+        self.heartbeat_monitor = HeartbeatMonitor(self)
         self.history_handler = HistoryEventHandler(
             logging_service, self.recovery_service)
         self.logging_service = logging_service
@@ -65,7 +69,6 @@ class DAGAppMaster:
         self.completed_dags: Dict[str, DAGState] = {}
         self._dag_seq = 0
         self._dag_done = threading.Condition()
-        self._recovery_data = recovery_data
         self._register_handlers()
         self._started = False
 
@@ -78,11 +81,19 @@ class DAGAppMaster:
             self.recovery_service.start()
         self.dispatcher.on_error = self._on_dispatcher_error
         self.dispatcher.start()
+        self.heartbeat_monitor.start()
         self._started = True
         self.history(HistoryEvent(HistoryEventType.AM_STARTED,
-                                  data={"app_id": self.app_id}))
+                                  data={"app_id": self.app_id,
+                                        "attempt": self.attempt}))
 
     def stop(self) -> None:
+        self.heartbeat_monitor.stop()
+        dag = self.current_dag
+        if dag is not None:
+            speculator = getattr(dag, "speculator", None)
+            if speculator is not None:
+                speculator.stop()
         self.task_scheduler.shutdown()
         self.runner_pool.shutdown()
         self.dispatcher.stop()
@@ -187,6 +198,15 @@ class DAGAppMaster:
                     att.attempt_id, list(events))
 
     def on_dag_finished(self, dag: DAGImpl, final: DAGState) -> None:
+        # deletion tracking: drop the finished DAG's shuffle data
+        # (reference: ContainerLauncherManager DeletionTracker)
+        from tez_tpu.shuffle.service import local_shuffle_service
+        n = local_shuffle_service().unregister_prefix(str(dag.dag_id))
+        if n:
+            log.info("dag %s: released %d shuffle outputs", dag.dag_id, n)
+        speculator = getattr(dag, "speculator", None)
+        if speculator is not None:
+            speculator.stop()
         with self._dag_done:
             self.completed_dags[str(dag.dag_id)] = final
             self._dag_done.notify_all()
@@ -206,6 +226,10 @@ class DAGAppMaster:
                   "plan": plan.serialize().hex()}))
         dag = DAGImpl(dag_id, plan, self)
         self.current_dag = dag
+        if dag.conf.get(C.SPECULATION_ENABLED):
+            from tez_tpu.am.speculation import Speculator
+            dag.speculator = Speculator(dag)
+            dag.speculator.start()
         self.dispatch(DAGEvent(DAGEventType.DAG_INIT, dag_id))
         self.dispatch(DAGEvent(DAGEventType.DAG_START, dag_id))
         return dag_id
@@ -222,6 +246,49 @@ class DAGAppMaster:
     def kill_dag(self, dag_id: DAGId, reason: str = "killed by client") -> None:
         self.dispatch(DAGEvent(DAGEventType.DAG_KILL, dag_id,
                                diagnostics=reason))
+
+    # -- AM-crash recovery (reference: DAGAppMaster serviceInit recovery
+    # path + RecoveryParser.parseRecoveryData:658) ---------------------------
+    def recover_and_resume(self) -> Optional[DAGId]:
+        """Parse prior attempts' journals; re-run the last in-progress DAG.
+
+        Semantics kept from the reference: a finished DAG is left alone; a
+        DAG whose commit had started but not completed is FAILED (partial
+        commits can't be trusted); an in-flight DAG is resubmitted.
+        Divergence (round 1): incomplete DAGs re-run from the start rather
+        than short-circuiting completed vertices from their Finished events.
+        """
+        from tez_tpu.am.recovery import RecoveryParser
+        parser = RecoveryParser(self.conf.get(C.STAGING_DIR), self.app_id)
+        data = parser.parse()
+        if data is None or data.dag_state is not None:
+            return None   # nothing in flight
+        try:
+            seq = int(data.dag_id.rsplit("_", 1)[1])
+        except (ValueError, IndexError):
+            seq = self._dag_seq + 1
+        dag_id = DAGId(self.app_id, seq)
+        if data.commit_in_flight:
+            log.warning("dag %s: commit was in flight at AM crash -> FAILED",
+                        data.dag_id)
+            self.history(HistoryEvent(
+                HistoryEventType.DAG_FINISHED, dag_id=data.dag_id,
+                data={"state": "FAILED",
+                      "diagnostics": "commit in flight during AM failure"}))
+            with self._dag_done:
+                self.completed_dags[data.dag_id] = DAGState.FAILED
+                self._dag_done.notify_all()
+            self._dag_seq = max(self._dag_seq, seq)
+            return dag_id
+        if data.plan is None:
+            log.warning("dag %s: no plan in journal, cannot recover",
+                        data.dag_id)
+            return None
+        log.info("recovering dag %s (attempt %d): resubmitting "
+                 "(%d vertices previously finished)", data.dag_id,
+                 self.attempt, len(data.completed_vertices))
+        self._dag_seq = seq - 1
+        return self.submit_dag(data.plan)
 
     def dag_status(self, dag_id: DAGId) -> Dict[str, Any]:
         dag = self.current_dag

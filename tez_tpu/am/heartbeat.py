@@ -1,0 +1,54 @@
+"""Liveness monitoring: stale task attempts are timed out.
+
+Reference parity: tez-dag/.../app/TaskHeartbeatHandler.java +
+ContainerHeartbeatHandler.java — attempts whose umbilical has gone silent
+past the timeout are failed so the task retries elsewhere.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any
+
+from tez_tpu.am.events import TaskAttemptEvent, TaskAttemptEventType
+from tez_tpu.common import config as C
+
+log = logging.getLogger(__name__)
+
+
+class HeartbeatMonitor:
+    def __init__(self, ctx: Any, check_interval: float = 1.0):
+        self.ctx = ctx
+        self.check_interval = check_interval
+        self.timeout_ms = ctx.conf.get(C.TASK_HEARTBEAT_TIMEOUT_MS)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="heartbeat-monitor")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.check_interval):
+            try:
+                self._check()
+            except BaseException:  # noqa: BLE001
+                log.exception("heartbeat check failed")
+
+    def _check(self) -> None:
+        if self.timeout_ms <= 0:
+            return
+        now = time.time()
+        cutoff = self.timeout_ms / 1000.0
+        for attempt_id, last in \
+                self.ctx.task_comm.sessions_snapshot().items():
+            if now - last > cutoff:
+                log.warning("attempt %s heartbeat timed out (%.1fs)",
+                            attempt_id, now - last)
+                self.ctx.dispatch(TaskAttemptEvent(
+                    TaskAttemptEventType.TA_TIMED_OUT, attempt_id,
+                    diagnostics=f"no heartbeat for {now - last:.1f}s"))

@@ -1,0 +1,93 @@
+"""Speculative execution of straggler attempts.
+
+Reference parity: tez-dag/.../dag/speculation/legacy/LegacySpeculator.java:63
+with the SimpleExponentialTaskRuntimeEstimator idea collapsed to a
+progress-rate estimator: per-vertex mean runtime of completed tasks; a
+running attempt whose estimated completion (from its progress rate) exceeds
+the mean by the slowtask threshold gets a speculative sibling, at most one
+per task, and only while spare capacity exists.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict
+
+from tez_tpu.am.events import TaskEvent, TaskEventType
+from tez_tpu.am.task_impl import TaskAttemptState, TaskState
+from tez_tpu.common import config as C
+
+log = logging.getLogger(__name__)
+
+#: Attempts younger than this are never speculated (reference:
+#: SOONEST_RETRY_AFTER_NO_SPECULATE spirit).
+MIN_RUNTIME_BEFORE_SPECULATION = 0.5
+SPECULATION_INTERVAL = 0.25
+
+
+class Speculator:
+    """Per-DAG straggler watcher; runs its own scan thread (the reference
+    speculator is also timer-driven outside the dispatcher)."""
+
+    def __init__(self, dag: Any):
+        self.dag = dag
+        self.ctx = dag.ctx
+        self.threshold = dag.conf.get(C.SPECULATION_SLOWTASK_THRESHOLD)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"speculator-{dag.dag_id}")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(SPECULATION_INTERVAL):
+            try:
+                self._scan()
+            except BaseException:  # noqa: BLE001
+                log.exception("speculator scan failed")
+
+    def _scan(self) -> None:
+        from tez_tpu.am.dag_impl import TERMINAL_DAG_STATES
+        if self.dag.state in TERMINAL_DAG_STATES:
+            self._stop.set()
+            return
+        now = time.time()
+        for vertex in self.dag.vertices.values():
+            completed: list = []
+            for task in vertex.tasks.values():
+                att = task.successful_attempt_impl()
+                if att is not None and att.launch_time:
+                    completed.append(att.finish_time - att.launch_time)
+            if not completed:
+                continue
+            mean_runtime = sum(completed) / len(completed)
+            for task in vertex.tasks.values():
+                if task.state is not TaskState.RUNNING:
+                    continue
+                live = task.live_attempts()
+                if len(live) != 1:
+                    continue  # already speculating (or nothing to watch)
+                att = live[0]
+                if att.state is not TaskAttemptState.RUNNING or \
+                        not att.launch_time:
+                    continue
+                runtime = now - att.launch_time
+                if runtime < max(MIN_RUNTIME_BEFORE_SPECULATION,
+                                 mean_runtime * (1 + self.threshold)):
+                    continue
+                # estimate completion from progress rate; no progress means
+                # estimate = infinity
+                progress = max(att.progress, 1e-6)
+                estimated_total = runtime / progress
+                if estimated_total <= mean_runtime * (1 + self.threshold):
+                    continue
+                log.info("speculating %s (runtime %.2fs, mean %.2fs, "
+                         "progress %.2f)", att.attempt_id, runtime,
+                         mean_runtime, att.progress)
+                self.ctx.dispatch(TaskEvent(
+                    TaskEventType.T_ADD_SPEC_ATTEMPT, task.task_id))

@@ -400,12 +400,13 @@ class TaskImpl:
         """SUCCEEDED task whose output was lost: re-run (reference:
         TaskImpl output-failure retroactive transition)."""
         log.info("task %s: output lost, rescheduling", self.task_id)
+        failed_version = event.attempt_id.id
         self.successful_attempt = None
         self.commit_attempt = None
         self.sm.force_state(TaskState.RUNNING)
         self.ctx.dispatch(VertexEvent(
             VertexEventType.V_TASK_RESCHEDULED, self.task_id.vertex_id,
-            task_id=self.task_id))
+            task_id=self.task_id, failed_version=failed_version))
         self._spawn_attempt()
 
     def _finish_history(self, final_state: str) -> None:

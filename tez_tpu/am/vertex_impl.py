@@ -349,9 +349,16 @@ class VertexImpl:
             self.vertex_manager.on_source_task_completed(event.attempt_id)
 
     def _on_task_rescheduled(self, event: VertexEvent) -> VertexState:
-        """A SUCCEEDED task is re-running (output loss)."""
+        """A SUCCEEDED task is re-running (output loss): tell consumers to
+        discard the dead attempt's outputs (reference: InputFailedEvent
+        routing on source-attempt output failure)."""
         self.completed_tasks -= 1
         self.succeeded_tasks -= 1
+        failed_version = getattr(event, "failed_version", 0)
+        for edge in self.out_edges.values():
+            edge.add_source_event(event.task_id.id, failed_version,
+                                  InputFailedEvent(target_index=-1,
+                                                   version=failed_version))
         if self.state is VertexState.SUCCEEDED:
             self.dag.on_vertex_rerunning(self)
         return VertexState.RUNNING

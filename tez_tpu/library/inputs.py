@@ -157,8 +157,10 @@ class OrderedGroupedKVInput(LogicalInput):
                 payload = ev.user_payload
                 assert isinstance(payload, ShufflePayload), payload
                 for i in range(ev.count):
+                    # expansion advances BOTH indices (reference:
+                    # CompositeRoutedDataMovementEvent.expand)
                     self.table.on_payload(ev.target_index_start + i,
-                                          ev.source_index, payload,
+                                          ev.source_index + i, payload,
                                           version=ev.version)
             elif isinstance(ev, DataMovementEvent):
                 payload = ev.user_payload

@@ -30,7 +30,10 @@ from tez_tpu.library.util import conf_get as _conf_get  # noqa: E402
 
 
 def output_path_component(context: Any) -> str:
-    return f"{context.dag_name}/{context.task_attempt_id}/" \
+    # leading DAG id segment enables per-DAG deletion tracking (reference:
+    # DeletionTracker / DagDeleteRunnable cleanup of finished DAGs' shuffle
+    # data)
+    return f"{context.task_attempt_id.dag_id}/{context.task_attempt_id}/" \
            f"{context.destination_vertex_name}"
 
 

@@ -116,3 +116,50 @@ class TestProcessor(LogicalIOProcessor):
 
     def close(self) -> None:
         pass
+
+
+class FlakyFetchOrderedInput(LogicalInput):
+    """OrderedGroupedKVInput wrapper that injects a fetch failure on the
+    first event delivery of attempt 0 of configured tasks (reference:
+    FetcherWithInjectableErrors + FetcherErrorTestingConfig).
+
+    Payload: {"failing_fetch_task_indices": [ints] (default [0])}.
+    """
+
+    def __new__(cls, context, num_physical_inputs):
+        from tez_tpu.library.inputs import OrderedGroupedKVInput
+
+        class _Impl(OrderedGroupedKVInput):
+            def initialize(self):
+                payload = self.context.user_payload.load() or {}
+                self._failing_tasks = payload.get(
+                    "failing_fetch_task_indices", [0]) \
+                    if isinstance(payload, dict) else [0]
+                self._injected = False
+                return super().initialize()
+
+            def handle_events(self, events):
+                from tez_tpu.api.events import (
+                    CompositeRoutedDataMovementEvent, DataMovementEvent,
+                    InputReadErrorEvent)
+                passthrough = []
+                for ev in events:
+                    if (not self._injected
+                            and self.context.task_attempt_number == 0
+                            and self.context.task_index in self._failing_tasks
+                            and isinstance(ev,
+                                           (CompositeRoutedDataMovementEvent,
+                                            DataMovementEvent))):
+                        self._injected = True
+                        slot = getattr(ev, "target_index_start",
+                                       getattr(ev, "target_index", 0))
+                        self.context.send_events([InputReadErrorEvent(
+                            diagnostics="injected fetch failure",
+                            index=slot, version=ev.version,
+                            is_local_fetch=True)])
+                        continue   # drop just this event: its fetch "failed"
+                    passthrough.append(ev)
+                if passthrough:
+                    super().handle_events(passthrough)
+
+        return _Impl(context, num_physical_inputs)

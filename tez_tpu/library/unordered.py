@@ -276,8 +276,10 @@ class UnorderedKVInput(LogicalInput):
             if isinstance(ev, CompositeRoutedDataMovementEvent):
                 payload = ev.user_payload
                 for i in range(ev.count):
+                    # expansion advances BOTH indices (reference:
+                    # CompositeRoutedDataMovementEvent.expand)
                     self.table.on_payload(ev.target_index_start + i,
-                                          ev.source_index, payload,
+                                          ev.source_index + i, payload,
                                           version=ev.version)
             elif isinstance(ev, DataMovementEvent):
                 self.table.on_payload(ev.target_index, ev.source_index,

@@ -179,7 +179,9 @@ class ShuffleVertexManager(VertexManagerPlugin):
             self._maybe_schedule()
 
     def on_vertex_manager_event_received(self, event: VertexManagerEvent) -> None:
-        """Collect per-task output sizes for auto-parallelism (phase 5)."""
+        """Collect per-task output sizes (feeds auto-parallelism; reference:
+        ShuffleVertexManagerBase.onVertexManagerEventReceived + compute of
+        expected total output)."""
         payload = event.user_payload
         if isinstance(payload, dict) and "output_size" in payload and \
                 event.producer_attempt is not None:
@@ -187,13 +189,76 @@ class ShuffleVertexManager(VertexManagerPlugin):
             key = (str(att.vertex_id), att.task_id.id) \
                 if hasattr(att, "task_id") else (str(att), 0)
             self._output_stats[key] = payload["output_size"]
+        if self._started:
+            self._maybe_schedule()
 
     def on_root_vertex_initialized(self, input_name: str, descriptor: Any,
                                    events: List[Any]) -> None:
         pass
 
+    # -- auto-parallelism (reference: ShuffleVertexManagerBase.computeRouting
+    # :444 — shrink to ceil(totalSize/desiredTaskInputDataSize) and swap the
+    # edge managers for range routing) ---------------------------------------
+    def _try_determine_parallelism(self) -> bool:
+        if self._parallelism_determined:
+            return True
+        total_sources = self._total_source_tasks()
+        if total_sources == 0:
+            self._parallelism_determined = True
+            return True
+        fraction = len(self._completed_sources) / total_sources
+        if not self._output_stats:
+            if fraction >= 1.0:
+                # every source finished without reporting stats (e.g. all
+                # outputs empty): finalize with no shrink rather than
+                # deadlocking the consumer (reference finalizes parallelism
+                # unconditionally once sources complete)
+                self._parallelism_determined = True
+                return True
+            return False
+        if fraction < self.min_fraction:
+            return False
+        expected_total = (sum(self._output_stats.values()) /
+                          len(self._output_stats)) * total_sources
+        current = self.context.get_vertex_num_tasks(self.context.vertex_name)
+        desired = int(math.ceil(expected_total /
+                                max(1, self.desired_task_input_size)))
+        desired = max(self.min_task_parallelism, min(desired, current))
+        if desired < current:
+            from tez_tpu.common.payload import EdgeManagerPluginDescriptor
+            from tez_tpu.dag.edge_property import (DataMovementType,
+                                                   EdgeProperty)
+            base_range = int(math.ceil(current / desired))
+            # recompute so no trailing task gets an empty partition range
+            # (e.g. 10 partitions, desired 6 -> base 2 -> 5 real tasks)
+            desired = int(math.ceil(current / base_range))
+            new_props = {}
+            for name, prop in \
+                    self.context.get_input_vertex_edge_properties().items():
+                if prop.data_movement_type is not \
+                        DataMovementType.SCATTER_GATHER:
+                    continue
+                desc = EdgeManagerPluginDescriptor.create(
+                    "tez_tpu.library.range_edge_manager:"
+                    "RangeScatterGatherEdgeManager",
+                    payload={"num_source_partitions": current,
+                             "base_range": base_range})
+                new_props[name] = EdgeProperty.create_custom(
+                    desc, prop.data_source_type, prop.edge_source,
+                    prop.edge_destination, prop.scheduling_type)
+            log.info("%s: auto-parallelism %d -> %d (expected %.0f bytes)",
+                     self.context.vertex_name, current, desired,
+                     expected_total)
+            self.context.reconfigure_vertex(desired,
+                                            source_edge_properties=new_props)
+            self.context.done_reconfiguring_vertex()
+        self._parallelism_determined = True
+        return True
+
     # -- scheduling ----------------------------------------------------------
     def _maybe_schedule(self) -> None:
+        if not self._try_determine_parallelism():
+            return
         total_sources = self._total_source_tasks()
         num_tasks = self.context.get_vertex_num_tasks(self.context.vertex_name)
         if num_tasks <= 0:

@@ -1,0 +1,212 @@
+"""Fair shuffle: skew-splitting vertex + edge managers.
+
+Reference parity: tez-runtime-library FairShuffleVertexManager.java (637 LoC)
++ FairShuffleEdgeManager + FairShufflePayloads.proto — oversized partitions
+are SPLIT across several consumer tasks by source-task range, small
+partitions are merged; each destination task covers
+(partition, [src_lo, src_hi)).
+
+This is the "one logical shuffle bigger than one task" machinery
+(SURVEY.md §5.7) — the context-parallel splitting analog.
+"""
+from __future__ import annotations
+
+import logging
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from tez_tpu.api.edge_manager import (CompositeEventRouteMetadata,
+                                      EdgeManagerPluginOnDemand,
+                                      EventRouteMetadata)
+from tez_tpu.api.events import VertexManagerEvent
+from tez_tpu.common.payload import EdgeManagerPluginDescriptor
+from tez_tpu.dag.edge_property import DataMovementType, EdgeProperty
+from tez_tpu.library.vertex_managers import ShuffleVertexManager
+
+log = logging.getLogger(__name__)
+
+#: mapping entry: (partition, src_lo, src_hi)
+DestMapping = Tuple[int, int, int]
+
+
+class FairShuffleEdgeManager(EdgeManagerPluginOnDemand):
+    """Routes (partition, source-range) slices to destination tasks.
+    Payload: {"mappings": [(partition, src_lo, src_hi), ...]} indexed by
+    destination task."""
+
+    def initialize(self) -> None:
+        payload = self.context.user_payload.load() or {}
+        self.mappings: List[DestMapping] = [tuple(m) for m in
+                                            payload["mappings"]]
+        self.num_source_partitions = payload["num_source_partitions"]
+
+    def _mapping(self, dest_task: int) -> DestMapping:
+        return self.mappings[dest_task]
+
+    def get_num_destination_task_physical_inputs(self, dest_task: int) -> int:
+        _, lo, hi = self._mapping(dest_task)
+        return hi - lo
+
+    def get_num_source_task_physical_outputs(self, src_task: int) -> int:
+        return self.num_source_partitions
+
+    def get_num_destination_consumer_tasks(self, src_task: int) -> int:
+        return sum(1 for (_, lo, hi) in self.mappings if lo <= src_task < hi)
+
+    def route_data_movement_event_to_destination(
+            self, src_task: int, src_output_index: int, dest_task: int
+    ) -> Optional[EventRouteMetadata]:
+        part, lo, hi = self._mapping(dest_task)
+        if src_output_index != part or not (lo <= src_task < hi):
+            return None
+        return EventRouteMetadata(1, (src_task - lo,), (src_output_index,))
+
+    def route_composite_data_movement_event_to_destination(
+            self, src_task: int, dest_task: int
+    ) -> Optional[CompositeEventRouteMetadata]:
+        part, lo, hi = self._mapping(dest_task)
+        if not (lo <= src_task < hi):
+            return None
+        return CompositeEventRouteMetadata(1, src_task - lo, part)
+
+    def route_input_source_task_failed_event_to_destination(
+            self, src_task: int, dest_task: int) -> Optional[EventRouteMetadata]:
+        part, lo, hi = self._mapping(dest_task)
+        if not (lo <= src_task < hi):
+            return None
+        return EventRouteMetadata(1, (src_task - lo,))
+
+    def route_input_error_event_to_source(self, dest_task: int,
+                                          dest_failed_input_index: int) -> int:
+        _, lo, _ = self._mapping(dest_task)
+        return lo + dest_failed_input_index
+
+
+def compute_fair_mappings(partition_totals: Sequence[int], num_sources: int,
+                          desired_task_input_size: int,
+                          max_tasks: int) -> List[DestMapping]:
+    """Split oversized partitions by source range, keep small ones whole
+    (reference: FairShuffleVertexManager routing computation).  When a task
+    cap is set, the per-task size target is grown until the slice count
+    fits — slices are COARSENED, never dropped (every (partition, source)
+    pair must keep exactly one destination)."""
+    size = max(1, desired_task_input_size)
+    while True:
+        mappings: List[DestMapping] = []
+        for p, total in enumerate(partition_totals):
+            pieces = max(1, int(math.ceil(total / size)))
+            pieces = min(pieces, num_sources)  # can't split finer than sources
+            if pieces == 1:
+                mappings.append((p, 0, num_sources))
+                continue
+            base = num_sources // pieces
+            extra = num_sources % pieces
+            lo = 0
+            for i in range(pieces):
+                hi = lo + base + (1 if i < extra else 0)
+                mappings.append((p, lo, hi))
+                lo = hi
+        if max_tasks <= 0 or len(mappings) <= max_tasks or \
+                len(mappings) <= len(partition_totals):
+            return mappings
+        size *= 2
+        log.info("fair shuffle: %d slices over cap %d, growing target to %d",
+                 len(mappings), max_tasks, size)
+
+
+class FairShuffleVertexManager(ShuffleVertexManager):
+    """ShuffleVertexManager whose parallelism decision both merges small
+    partitions AND splits skewed ones by source range."""
+
+    def initialize(self) -> None:
+        super().initialize()
+        payload = self.context.user_payload.load() or {}
+        if not isinstance(payload, dict):
+            payload = {}
+        self.max_task_parallelism = payload.get("max_task_parallelism", 0)
+        # the whole point of this manager is runtime routing — it always
+        # decides parallelism itself, auto_parallel flag or not
+        self._parallelism_determined = False
+        # keyed by (producer vertex, task): dedupes pipelined spills and
+        # speculative duplicate attempts (last vector wins)
+        self._partition_stats: Dict[Tuple[str, int], Sequence[int]] = {}
+
+    def _sg_source_names(self) -> List[str]:
+        return [name for name, p in
+                self.context.get_input_vertex_edge_properties().items()
+                if p.data_movement_type is DataMovementType.SCATTER_GATHER]
+
+    def on_vertex_manager_event_received(self, event: VertexManagerEvent) -> None:
+        payload = event.user_payload
+        att = event.producer_attempt
+        if isinstance(payload, dict) and "partition_sizes" in payload and \
+                att is not None:
+            vec = payload["partition_sizes"]
+            declared = self.context.get_vertex_num_tasks(
+                self.context.vertex_name)
+            # only scatter-gather stats match the partition space; e.g. a
+            # broadcast side-input reports a 1-element vector — ignore it
+            if len(vec) == declared:
+                key = (str(getattr(att, "vertex_id", att)),
+                       att.task_id.id if hasattr(att, "task_id") else 0)
+                self._partition_stats[key] = vec
+        super().on_vertex_manager_event_received(event)
+
+    def _try_determine_parallelism(self) -> bool:
+        if self._parallelism_determined:
+            return True
+        sg_sources = self._sg_source_names()
+        if len(sg_sources) != 1:
+            # source-range splitting needs ONE scatter-gather source; with
+            # several, ranges are ambiguous per edge — fall back to plain
+            # shuffle behavior (round-1 limitation; reference supports
+            # per-edge range payloads)
+            if len(sg_sources) > 1:
+                log.warning("%s: fair shuffle with %d SG sources -> "
+                            "no splitting", self.context.vertex_name,
+                            len(sg_sources))
+            self._parallelism_determined = True
+            return True
+        num_sources = self.context.get_vertex_num_tasks(sg_sources[0])
+        if num_sources <= 0:
+            return False
+        fraction = len(self._completed_sources) / num_sources
+        if not self._partition_stats:
+            if fraction >= 1.0:
+                self._parallelism_determined = True
+                return True
+            return False
+        if fraction < self.min_fraction:
+            return False
+        # project observed per-partition sizes to the full source count
+        observed = len(self._partition_stats)
+        vectors = list(self._partition_stats.values())
+        num_partitions = len(vectors[0])
+        totals = [0] * num_partitions
+        for vec in vectors:
+            for p, sz in enumerate(vec):
+                totals[p] += sz
+        scale = num_sources / observed
+        totals = [int(t * scale) for t in totals]
+
+        mappings = compute_fair_mappings(
+            totals, num_sources, self.desired_task_input_size,
+            self.max_task_parallelism)
+        current = self.context.get_vertex_num_tasks(self.context.vertex_name)
+        if mappings and len(mappings) != current:
+            prop = self.context.get_input_vertex_edge_properties()[
+                sg_sources[0]]
+            desc = EdgeManagerPluginDescriptor.create(
+                "tez_tpu.library.fair_shuffle:FairShuffleEdgeManager",
+                payload={"mappings": mappings,
+                         "num_source_partitions": current})
+            new_props = {sg_sources[0]: EdgeProperty.create_custom(
+                desc, prop.data_source_type, prop.edge_source,
+                prop.edge_destination, prop.scheduling_type)}
+            log.info("%s: fair shuffle %d partitions -> %d slices",
+                     self.context.vertex_name, num_partitions, len(mappings))
+            self.context.reconfigure_vertex(len(mappings),
+                                            source_edge_properties=new_props)
+            self.context.done_reconfiguring_vertex()
+        self._parallelism_determined = True
+        return True

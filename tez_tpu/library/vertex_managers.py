@@ -283,3 +283,11 @@ class ShuffleVertexManager(VertexManagerPlugin):
             self._scheduled.update(ready)
             self.context.schedule_tasks(
                 [ScheduleTaskRequest(i) for i in ready])
+
+
+class VertexManagerWithConcurrentInput(ImmediateStartVertexManager):
+    """Gang-schedules all tasks at vertex start: CONCURRENT edges mean the
+    consumer runs alongside its producers rather than after them
+    (reference: VertexManagerWithConcurrentInput.java, 248 LoC — the
+    concurrent-edge trigger variants collapse to start-time scheduling in
+    the runner-pool model)."""

@@ -1,0 +1,79 @@
+"""Phase-9 tests: history parser, analyzers, swimlane over a real run."""
+import os
+
+import pytest
+
+from tez_tpu.examples import ordered_wordcount
+from tez_tpu.tools.analyzers import (ALL_ANALYZERS, analyze_dag,
+                                     CriticalPathAnalyzer)
+from tez_tpu.tools.history_parser import parse_jsonl_files
+from tez_tpu.tools.swimlane import render_svg
+
+
+@pytest.fixture(scope="module")
+def history_dir(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("hist")
+    corpus = tmp / "in.txt"
+    corpus.write_text("alpha beta gamma alpha\n" * 200)
+    hist = str(tmp / "history")
+    state = ordered_wordcount.run(
+        [str(corpus)], str(tmp / "out"),
+        conf={"tez.staging-dir": str(tmp / "s"),
+              "tez.history.logging.service.class":
+                  "tez_tpu.am.history:JsonlHistoryLoggingService",
+              "tez.history.logging.log-dir": hist},
+        tokenizer_parallelism=2)
+    assert state == "SUCCEEDED"
+    return hist
+
+
+def test_parse_history(history_dir):
+    dags = parse_jsonl_files([os.path.join(history_dir, "*.jsonl")])
+    assert len(dags) == 1
+    dag = list(dags.values())[0]
+    assert dag.name == "OrderedWordCount"
+    assert dag.state == "SUCCEEDED"
+    assert {v.name for v in dag.vertices.values()} == \
+        {"tokenizer", "summation", "sorter"}
+    assert dag.duration > 0
+    tok = dag.vertex("tokenizer")
+    assert tok.num_tasks == 2 and len(tok.tasks) == 2
+    for t in tok.tasks.values():
+        att = t.successful_attempt
+        assert att is not None and att.container_id
+        assert att.counters  # per-attempt counters recorded
+
+
+def test_analyzers_produce_results(history_dir):
+    dags = parse_jsonl_files([os.path.join(history_dir, "*.jsonl")])
+    dag = list(dags.values())[0]
+    results = analyze_dag(dag)
+    assert len(results) == len(ALL_ANALYZERS)
+    by_name = {r.analyzer: r for r in results}
+    assert "tokenizer" in str(by_name["critical_path"].rows)
+    shuffled = by_name["shuffle_time"].rows
+    assert any(r["shuffle_bytes"] > 0 for r in shuffled)
+    assert by_name["hung_tasks"].rows == []
+    reuse = by_name["container_reuse"]
+    assert sum(r.get("tasks_run", 0) for r in reuse.rows) >= 5
+
+
+def test_swimlane_svg(history_dir):
+    dags = parse_jsonl_files([os.path.join(history_dir, "*.jsonl")])
+    dag = list(dags.values())[0]
+    svg = render_svg(dag)
+    assert svg.startswith("<svg") and svg.endswith("</svg>")
+    assert "tokenizer" in svg and "attempt_" in svg
+
+
+def test_analyzer_cli(history_dir, capsys):
+    import sys
+    from tez_tpu.tools import analyzers
+    old = sys.argv
+    try:
+        sys.argv = ["analyzers", os.path.join(history_dir, "*.jsonl")]
+        assert analyzers.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "critical_path" in out and "OrderedWordCount" in out

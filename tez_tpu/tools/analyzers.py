@@ -1,0 +1,219 @@
+"""Post-hoc DAG analyzers over DagInfo.
+
+Reference parity: tez-tools/analyzers/job-analyzer/.../plugins/ (19 analyzers
+via AnalyzerDriver) — the core set: CriticalPathAnalyzer:53,
+ShuffleTimeAnalyzer, SkewAnalyzer, SpillAnalyzerImpl, SlowestVertexAnalyzer,
+ContainerReuseAnalyzer, HungTaskAnalyzer, SpeculationAnalyzer.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+from typing import Any, Dict, List, Sequence
+
+from tez_tpu.tools.history_parser import DagInfo, parse_jsonl_files
+
+
+@dataclasses.dataclass
+class AnalyzerResult:
+    analyzer: str
+    headline: str
+    rows: List[Dict[str, Any]]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class Analyzer:
+    name = "abstract"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        raise NotImplementedError
+
+
+class CriticalPathAnalyzer(Analyzer):
+    """Longest chain of vertex (start..finish) spans ordered by start time —
+    which vertices bound the DAG wall-clock."""
+    name = "critical_path"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        rows = []
+        verts = sorted(dag.vertices.values(), key=lambda v: v.start_time)
+        total = dag.duration or 1e-9
+        for v in verts:
+            rows.append({
+                "vertex": v.name, "start_offset": v.start_time - dag.start_time,
+                "duration": v.duration,
+                "fraction_of_dag": round(v.duration / total, 3),
+            })
+        slowest = max(verts, key=lambda v: v.duration, default=None)
+        headline = (f"DAG {dag.name}: {dag.duration:.2f}s; dominant vertex "
+                    f"{slowest.name} ({slowest.duration:.2f}s)"
+                    if slowest else "empty DAG")
+        return AnalyzerResult(self.name, headline, rows)
+
+
+class ShuffleTimeAnalyzer(Analyzer):
+    """Shuffle/merge phase times + bytes per vertex (reference:
+    ShuffleTimeAnalyzer over SHUFFLE_PHASE_TIME/MERGE_PHASE_TIME)."""
+    name = "shuffle_time"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        rows = []
+        for v in dag.vertices.values():
+            tc = v.counters.get("TaskCounter", {})
+            if not tc.get("SHUFFLE_BYTES") and not tc.get("SHUFFLE_PHASE_TIME"):
+                continue
+            rows.append({
+                "vertex": v.name,
+                "shuffle_bytes": tc.get("SHUFFLE_BYTES", 0),
+                "shuffle_phase_ms": tc.get("SHUFFLE_PHASE_TIME", 0),
+                "merge_phase_ms": tc.get("MERGE_PHASE_TIME", 0),
+                "shuffled_inputs": tc.get("NUM_SHUFFLED_INPUTS", 0),
+                "failed_fetches": tc.get("NUM_FAILED_SHUFFLE_INPUTS", 0),
+            })
+        total = sum(r["shuffle_bytes"] for r in rows)
+        return AnalyzerResult(self.name,
+                              f"total shuffled: {total} bytes", rows)
+
+
+class SkewAnalyzer(Analyzer):
+    """Attempt-duration skew per vertex (reference: SkewAnalyzer)."""
+    name = "skew"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        rows = []
+        for v in dag.vertices.values():
+            durations = [t.successful_attempt.duration
+                         for t in v.tasks.values()
+                         if t.successful_attempt is not None]
+            if not durations:
+                continue
+            mean = sum(durations) / len(durations)
+            rows.append({
+                "vertex": v.name, "tasks": len(durations),
+                "mean_s": round(mean, 3),
+                "max_s": round(max(durations), 3),
+                "skew_ratio": round(max(durations) / mean, 2) if mean else 0,
+            })
+        worst = max(rows, key=lambda r: r["skew_ratio"], default=None)
+        return AnalyzerResult(
+            self.name,
+            f"worst skew {worst['skew_ratio']}x in {worst['vertex']}"
+            if worst else "no completed tasks", rows)
+
+
+class SpillAnalyzer(Analyzer):
+    """Spilled records / host-spill bytes per vertex (reference:
+    SpillAnalyzerImpl)."""
+    name = "spill"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        rows = []
+        for v in dag.vertices.values():
+            tc = v.counters.get("TaskCounter", {})
+            rows.append({
+                "vertex": v.name,
+                "spilled_records": tc.get("SPILLED_RECORDS", 0),
+                "additional_spill_count": tc.get("ADDITIONAL_SPILL_COUNT", 0),
+                "host_spill_bytes": tc.get("HOST_SPILL_BYTES", 0),
+                "output_bytes": tc.get("OUTPUT_BYTES", 0),
+            })
+        total = sum(r["host_spill_bytes"] for r in rows)
+        return AnalyzerResult(self.name, f"host spill: {total} bytes", rows)
+
+
+class SlowestVertexAnalyzer(Analyzer):
+    name = "slowest_vertex"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        rows = sorted(
+            ({"vertex": v.name, "duration_s": round(v.duration, 3),
+              "num_tasks": v.num_tasks}
+             for v in dag.vertices.values()),
+            key=lambda r: -r["duration_s"])
+        return AnalyzerResult(
+            self.name,
+            f"slowest: {rows[0]['vertex']}" if rows else "none", rows)
+
+
+class ContainerReuseAnalyzer(Analyzer):
+    """Tasks per runner (reference: ContainerReuseAnalyzer)."""
+    name = "container_reuse"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        rows = [{"container": cid, **info}
+                for cid, info in dag.containers.items()]
+        total = sum(r.get("tasks_run", 0) for r in rows)
+        return AnalyzerResult(
+            self.name,
+            f"{len(rows)} runners, {total} tasks ("
+            f"{total / len(rows):.1f} tasks/runner)" if rows else "no runners",
+            rows)
+
+
+class SpeculationAnalyzer(Analyzer):
+    """Attempts beyond the first per task (reference: SpeculationAnalyzer)."""
+    name = "speculation"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        rows = []
+        for v in dag.vertices.values():
+            for t in v.tasks.values():
+                if len(t.attempts) > 1:
+                    rows.append({"task": t.task_id,
+                                 "vertex": v.name,
+                                 "attempts": len(t.attempts),
+                                 "states": sorted(a.state for a in
+                                                  t.attempts.values())})
+        return AnalyzerResult(self.name,
+                              f"{len(rows)} tasks with extra attempts", rows)
+
+
+class HungTaskAnalyzer(Analyzer):
+    """Tasks started but never finished (reference: HungTaskAnalyzer)."""
+    name = "hung_tasks"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        rows = []
+        for v in dag.vertices.values():
+            for t in v.tasks.values():
+                if t.start_time and not t.finish_time:
+                    rows.append({"task": t.task_id, "vertex": v.name})
+        return AnalyzerResult(self.name, f"{len(rows)} hung tasks", rows)
+
+
+ALL_ANALYZERS: Sequence[Analyzer] = (
+    CriticalPathAnalyzer(), ShuffleTimeAnalyzer(), SkewAnalyzer(),
+    SpillAnalyzer(), SlowestVertexAnalyzer(), ContainerReuseAnalyzer(),
+    SpeculationAnalyzer(), HungTaskAnalyzer())
+
+
+def analyze_dag(dag: DagInfo,
+                analyzers: Sequence[Analyzer] = ALL_ANALYZERS
+                ) -> List[AnalyzerResult]:
+    return [a.analyze(dag) for a in analyzers]
+
+
+def main() -> int:
+    """AnalyzerDriver CLI: python -m tez_tpu.tools.analyzers <jsonl...>"""
+    if len(sys.argv) < 2:
+        print("usage: analyzers <history.jsonl | dir | glob>...")
+        return 2
+    dags = parse_jsonl_files(sys.argv[1:])
+    if not dags:
+        print("no DAGs found")
+        return 1
+    for dag in dags.values():
+        print(f"=== {dag.dag_id} ({dag.name}) state={dag.state} "
+              f"duration={dag.duration:.2f}s ===")
+        for result in analyze_dag(dag):
+            print(f"[{result.analyzer}] {result.headline}")
+            for row in result.rows:
+                print("   ", json.dumps(row))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,206 @@
+"""Parse history JSONL journals into an analyzable object model.
+
+Reference parity: tez-plugins/tez-history-parser (ATSFileParser /
+ProtoHistoryParser / SimpleHistoryParser -> DagInfo/VertexInfo/TaskInfo/
+AttemptInfo datamodel) reading the JsonlHistoryLoggingService output (which
+doubles as the recovery journal format).
+"""
+from __future__ import annotations
+
+import dataclasses
+import sys
+import glob as globlib
+import os
+from typing import Dict, List, Optional
+
+from tez_tpu.am.history import HistoryEvent, HistoryEventType
+
+
+@dataclasses.dataclass
+class AttemptInfo:
+    attempt_id: str
+    task_id: str
+    vertex_name: str
+    container_id: str = ""
+    start_time: float = 0.0
+    finish_time: float = 0.0
+    state: str = ""
+    diagnostics: str = ""
+    counters: Dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def duration(self) -> float:
+        return max(0.0, self.finish_time - self.start_time)
+
+    def counter(self, group: str, name: str, default: int = 0) -> int:
+        return self.counters.get(group, {}).get(name, default)
+
+
+@dataclasses.dataclass
+class TaskInfo:
+    task_id: str
+    vertex_name: str
+    start_time: float = 0.0
+    finish_time: float = 0.0
+    state: str = ""
+    attempts: Dict[str, AttemptInfo] = dataclasses.field(default_factory=dict)
+
+    @property
+    def successful_attempt(self) -> Optional[AttemptInfo]:
+        for a in self.attempts.values():
+            if a.state == "SUCCEEDED":
+                return a
+        return None
+
+
+@dataclasses.dataclass
+class VertexInfo:
+    vertex_id: str
+    name: str = ""
+    num_tasks: int = 0
+    init_time: float = 0.0
+    start_time: float = 0.0
+    finish_time: float = 0.0
+    state: str = ""
+    counters: Dict = dataclasses.field(default_factory=dict)
+    tasks: Dict[str, TaskInfo] = dataclasses.field(default_factory=dict)
+
+    @property
+    def duration(self) -> float:
+        return max(0.0, self.finish_time - self.start_time)
+
+
+@dataclasses.dataclass
+class DagInfo:
+    dag_id: str
+    name: str = ""
+    submit_time: float = 0.0
+    start_time: float = 0.0
+    finish_time: float = 0.0
+    state: str = ""
+    diagnostics: str = ""
+    counters: Dict = dataclasses.field(default_factory=dict)
+    vertices: Dict[str, VertexInfo] = dataclasses.field(default_factory=dict)
+    containers: Dict[str, Dict] = dataclasses.field(default_factory=dict)
+
+    @property
+    def duration(self) -> float:
+        return max(0.0, self.finish_time - self.start_time)
+
+    def vertex(self, name: str) -> Optional[VertexInfo]:
+        for v in self.vertices.values():
+            if v.name == name:
+                return v
+        return None
+
+    def all_attempts(self) -> List[AttemptInfo]:
+        return [a for v in self.vertices.values()
+                for t in v.tasks.values() for a in t.attempts.values()]
+
+
+def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
+    """Event stream -> {dag_id: DagInfo}."""
+    dags: Dict[str, DagInfo] = {}
+    containers: Dict[str, Dict] = {}
+
+    def dag(ev: HistoryEvent) -> Optional[DagInfo]:
+        if ev.dag_id is None:
+            return None
+        return dags.setdefault(ev.dag_id, DagInfo(ev.dag_id))
+
+    for ev in events:
+        t = ev.event_type
+        d = dag(ev)
+        if t is HistoryEventType.DAG_SUBMITTED and d:
+            d.name = ev.data.get("dag_name", "")
+            d.submit_time = ev.timestamp
+        elif t is HistoryEventType.DAG_STARTED and d:
+            d.start_time = ev.timestamp
+        elif t is HistoryEventType.DAG_FINISHED and d:
+            d.finish_time = ev.timestamp
+            d.state = ev.data.get("state", "")
+            d.diagnostics = ev.data.get("diagnostics", "")
+            d.counters = ev.data.get("counters", {})
+        elif t is HistoryEventType.VERTEX_INITIALIZED and d:
+            v = d.vertices.setdefault(ev.vertex_id,
+                                      VertexInfo(ev.vertex_id))
+            v.name = ev.data.get("vertex_name", "")
+            v.num_tasks = ev.data.get("num_tasks", 0)
+            v.init_time = ev.timestamp
+        elif t is HistoryEventType.VERTEX_STARTED and d:
+            v = d.vertices.setdefault(ev.vertex_id, VertexInfo(ev.vertex_id))
+            v.start_time = ev.timestamp
+        elif t is HistoryEventType.VERTEX_FINISHED and d:
+            v = d.vertices.setdefault(ev.vertex_id, VertexInfo(ev.vertex_id))
+            v.finish_time = ev.timestamp
+            v.state = ev.data.get("state", "")
+            v.counters = ev.data.get("counters", {})
+            v.name = v.name or ev.data.get("vertex_name", "")
+        elif t is HistoryEventType.TASK_STARTED and d:
+            v = d.vertices.setdefault(ev.vertex_id, VertexInfo(ev.vertex_id))
+            task = v.tasks.setdefault(ev.task_id, TaskInfo(
+                ev.task_id, ev.data.get("vertex_name", v.name)))
+            task.start_time = ev.timestamp
+        elif t is HistoryEventType.TASK_FINISHED and d:
+            v = d.vertices.setdefault(ev.vertex_id, VertexInfo(ev.vertex_id))
+            task = v.tasks.setdefault(ev.task_id, TaskInfo(
+                ev.task_id, ev.data.get("vertex_name", v.name)))
+            task.finish_time = ev.timestamp
+            task.state = ev.data.get("state", "")
+        elif t is HistoryEventType.TASK_ATTEMPT_STARTED and d:
+            v = d.vertices.setdefault(ev.vertex_id, VertexInfo(ev.vertex_id))
+            task = v.tasks.setdefault(ev.task_id, TaskInfo(
+                ev.task_id, ev.data.get("vertex_name", v.name)))
+            task.attempts[ev.attempt_id] = AttemptInfo(
+                ev.attempt_id, ev.task_id,
+                ev.data.get("vertex_name", v.name),
+                container_id=ev.container_id or "",
+                start_time=ev.timestamp)
+        elif t is HistoryEventType.TASK_ATTEMPT_FINISHED and d:
+            v = d.vertices.setdefault(ev.vertex_id, VertexInfo(ev.vertex_id))
+            task = v.tasks.setdefault(ev.task_id, TaskInfo(
+                ev.task_id, ev.data.get("vertex_name", v.name)))
+            a = task.attempts.setdefault(ev.attempt_id, AttemptInfo(
+                ev.attempt_id, ev.task_id,
+                ev.data.get("vertex_name", v.name)))
+            a.finish_time = ev.timestamp
+            a.state = ev.data.get("state", "")
+            a.diagnostics = ev.data.get("diagnostics", "")
+            a.counters = ev.data.get("counters", {})
+        elif t is HistoryEventType.CONTAINER_LAUNCHED:
+            containers[ev.container_id] = {"launched": ev.timestamp}
+        elif t is HistoryEventType.CONTAINER_STOPPED:
+            containers.setdefault(ev.container_id, {})["stopped"] = \
+                ev.timestamp
+            containers[ev.container_id]["tasks_run"] = \
+                ev.data.get("tasks_run", 0)
+    for d in dags.values():
+        d.containers = containers
+    return dags
+
+
+def parse_jsonl_files(paths: List[str]) -> Dict[str, DagInfo]:
+    events: List[HistoryEvent] = []
+    for pattern in paths:
+        matches = sorted(globlib.glob(pattern)) if any(
+            c in pattern for c in "*?[") else [pattern]
+        for path in matches:
+            if os.path.isdir(path):
+                matches.extend(sorted(
+                    os.path.join(path, f) for f in os.listdir(path)
+                    if f.endswith(".jsonl")))
+                continue
+            if not os.path.exists(path):
+                print(f"warning: no such history file: {path}",
+                      file=sys.stderr)
+                continue
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if line:
+                        try:
+                            events.append(HistoryEvent.from_json(line))
+                        except Exception:  # noqa: BLE001 — torn tail
+                            pass
+    events.sort(key=lambda e: e.timestamp)
+    return parse_history_events(events)

@@ -1,0 +1,83 @@
+// Native host ops for the tez_tpu data plane.
+//
+// The reference's byte-crunching data path is JVM code (SURVEY.md: the
+// performance-critical path is plain Java over byte[]); here the device
+// kernels do the heavy lifting and the host side only permutes/concatenates
+// ragged byte arrays when materializing runs.  That gather is memory-bound
+// and single-threaded in numpy (fancy indexing builds an index array of one
+// int64 per BYTE); this C++ version does per-row memcpy across threads and
+// skips the index materialization entirely.
+//
+// Build: make -C native   (g++ -O3 -shared; loaded via ctypes, with a numpy
+// fallback when the .so is missing).
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Permute rows of a ragged u8 array.
+//   data/offsets     : source (n_src rows; offsets has n_src+1 entries)
+//   perm             : n_out row indices into the source
+//   out_offsets      : n_out+1 entries, PRECOMPUTED by the caller
+//   out_data         : out_offsets[n_out] bytes
+void gather_ragged_u8(const uint8_t* data, const int64_t* offsets,
+                      const int64_t* perm, int64_t n_out,
+                      const int64_t* out_offsets, uint8_t* out_data,
+                      int32_t n_threads) {
+    if (n_out <= 0) return;
+    int threads = std::max(1, (int)n_threads);
+    int64_t total = out_offsets[n_out];
+    // Partition output rows so each thread copies ~equal BYTES, not rows
+    // (row sizes are ragged; equal-row chunks would skew badly).
+    std::vector<std::thread> pool;
+    pool.reserve(threads);
+    int64_t bytes_per_thread = (total + threads - 1) / threads;
+    int64_t row = 0;
+    for (int t = 0; t < threads && row < n_out; t++) {
+        int64_t start_row = row;
+        int64_t target = std::min(total, (int64_t)(t + 1) * bytes_per_thread);
+        // advance to the first row whose start offset reaches the target
+        while (row < n_out && out_offsets[row] < target) row++;
+        int64_t end_row = row;
+        pool.emplace_back([=]() {
+            for (int64_t i = start_row; i < end_row; i++) {
+                int64_t src = perm[i];
+                int64_t len = offsets[src + 1] - offsets[src];
+                if (len > 0) {
+                    std::memcpy(out_data + out_offsets[i],
+                                data + offsets[src], (size_t)len);
+                }
+            }
+        });
+    }
+    for (auto& th : pool) th.join();
+}
+
+// Concatenate ragged u8 arrays: caller passes flattened descriptor arrays.
+void concat_ragged_u8(const uint8_t** datas, const int64_t* sizes,
+                      int64_t n_parts, uint8_t* out_data,
+                      int32_t n_threads) {
+    std::vector<int64_t> starts(n_parts + 1, 0);
+    for (int64_t i = 0; i < n_parts; i++) starts[i + 1] = starts[i] + sizes[i];
+    int threads = std::max(1, (int)n_threads);
+    std::vector<std::thread> pool;
+    int64_t per = (n_parts + threads - 1) / threads;
+    for (int t = 0; t < threads; t++) {
+        int64_t lo = t * per, hi = std::min<int64_t>(n_parts, lo + per);
+        if (lo >= hi) break;
+        pool.emplace_back([=, &starts]() {
+            for (int64_t i = lo; i < hi; i++) {
+                if (sizes[i] > 0)
+                    std::memcpy(out_data + starts[i], datas[i],
+                                (size_t)sizes[i]);
+            }
+        });
+    }
+    for (auto& th : pool) th.join();
+}
+
+}  // extern "C"

@@ -1,0 +1,85 @@
+"""DCN shuffle transport tests: server + fetcher + HMAC auth + security."""
+import pytest
+
+from tez_tpu.common.security import (ACLManager, DAGAccessControls,
+                                     JobTokenSecretManager)
+from tez_tpu.ops.runformat import KVBatch, Run
+from tez_tpu.ops.sorter import DeviceSorter
+from tez_tpu.shuffle.server import ShuffleFetcher, ShuffleServer
+from tez_tpu.shuffle.service import ShuffleDataNotFound, ShuffleService
+
+
+@pytest.fixture()
+def served_run():
+    service = ShuffleService()
+    sorter = DeviceSorter(num_partitions=3)
+    for i in range(100):
+        sorter.write(f"k{i:03d}".encode(), f"v{i}".encode())
+    run = sorter.flush()
+    service.register("dagX/attempt_1/cons", -1, run)
+    secrets = JobTokenSecretManager()
+    server = ShuffleServer(secrets, service).start()
+    yield server, secrets, run
+    server.stop()
+
+
+def test_fetch_roundtrip(served_run):
+    server, secrets, run = served_run
+    fetcher = ShuffleFetcher(secrets)
+    for p in range(3):
+        got = fetcher.fetch("127.0.0.1", server.port, "dagX/attempt_1/cons",
+                            -1, p)[0]
+        assert list(got.iter_pairs()) == list(run.partition(p).iter_pairs())
+
+
+def test_fetch_partition_range_keepalive(served_run):
+    server, secrets, run = served_run
+    fetcher = ShuffleFetcher(secrets)
+    got = fetcher.fetch("127.0.0.1", server.port, "dagX/attempt_1/cons",
+                        -1, 0, 3)
+    assert len(got) == 3
+    total = sum(b.num_records for b in got)
+    assert total == run.batch.num_records
+
+
+def test_bad_hmac_rejected(served_run):
+    server, secrets, _ = served_run
+    wrong = JobTokenSecretManager(b"not-the-secret" * 2)
+    fetcher = ShuffleFetcher(wrong, retries=1)
+    with pytest.raises(PermissionError):
+        fetcher.fetch("127.0.0.1", server.port, "dagX/attempt_1/cons", -1, 0)
+    assert server.auth_failures >= 1
+
+
+def test_missing_data_not_found(served_run):
+    server, secrets, _ = served_run
+    fetcher = ShuffleFetcher(secrets)
+    with pytest.raises(ShuffleDataNotFound):
+        fetcher.fetch("127.0.0.1", server.port, "nope/nope", -1, 0)
+
+
+def test_connection_refused_retries_then_raises():
+    fetcher = ShuffleFetcher(JobTokenSecretManager(), retries=2,
+                             backoff=0.01)
+    with pytest.raises(ConnectionError, match="after 2 tries"):
+        fetcher.fetch("127.0.0.1", 1, "x", -1, 0)  # port 1: refused
+
+
+def test_acl_manager():
+    acls = ACLManager("owner", DAGAccessControls(view_users=("alice",),
+                                                 modify_users=()))
+    assert acls.check_view_access("owner")
+    assert acls.check_view_access("alice")
+    assert not acls.check_view_access("mallory")
+    assert not acls.check_modify_access("alice")
+    open_acls = ACLManager("owner")
+    assert open_acls.check_view_access("anyone")   # default view = '*'
+    assert not open_acls.check_modify_access("anyone")
+
+
+def test_token_hash_roundtrip():
+    s = JobTokenSecretManager()
+    h = s.compute_hash(b"msg")
+    assert s.verify_hash(h, b"msg")
+    assert not s.verify_hash(h, b"other")
+    assert not JobTokenSecretManager().verify_hash(h, b"msg")

@@ -77,3 +77,57 @@ def test_analyzer_cli(history_dir, capsys):
         sys.argv = old
     out = capsys.readouterr().out
     assert "critical_path" in out and "OrderedWordCount" in out
+
+
+def test_native_gather_matches_numpy():
+    """native/ragged.cpp gather == numpy fallback (skips if no toolchain)."""
+    import numpy as np
+    from tez_tpu.ops.native import gather_ragged_native, native_available
+    if not native_available():
+        import pytest
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(1)
+    n = 5000
+    lens = rng.integers(0, 30, n)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    data = rng.integers(0, 256, int(offsets[-1])).astype(np.uint8)
+    perm = rng.permutation(n)
+    out, oo = gather_ragged_native(data, offsets, perm)
+    # golden via pure-numpy path
+    from tez_tpu.ops.runformat import _ranges
+    nl = lens[perm]
+    golden_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(nl, out=golden_off[1:])
+    idx = np.repeat(offsets[:-1][perm], nl) + _ranges(nl)
+    assert np.array_equal(out, data[idx])
+    assert np.array_equal(oo, golden_off)
+
+
+def test_am_web_endpoint(tmp_path):
+    """AM web UI serves live status (AMWebController analog)."""
+    import json
+    import urllib.request
+    from tez_tpu.client.tez_client import TezClient
+    from tez_tpu.common.payload import ProcessorDescriptor
+    from tez_tpu.dag.dag import DAG, Vertex
+    c = TezClient.create("web", {"tez.staging-dir": str(tmp_path / "s"),
+                                 "tez.am.web.enabled": True}).start()
+    try:
+        dag = DAG.create("webdag").add_vertex(Vertex.create(
+            "v", ProcessorDescriptor.create(
+                "tez_tpu.library.processors:SleepProcessor",
+                payload={"sleep_ms": 1}), 2))
+        c.submit_dag(dag).wait_for_completion(timeout=30)
+        url = c.framework_client.am.web_ui.url
+        status = json.loads(urllib.request.urlopen(url + "status").read())
+        assert status["name"] == "webdag"
+        assert status["state"] == "SUCCEEDED"
+        assert status["vertices"]["v"]["succeeded"] == 2
+        counters = json.loads(urllib.request.urlopen(
+            url + "counters").read())
+        assert "TaskCounter" in counters
+        page = urllib.request.urlopen(url).read()
+        assert b"<html" in page
+    finally:
+        c.stop()

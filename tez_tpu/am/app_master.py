@@ -60,6 +60,10 @@ class DAGAppMaster:
             if recovery_enabled else None
         from tez_tpu.am.heartbeat import HeartbeatMonitor
         self.heartbeat_monitor = HeartbeatMonitor(self)
+        self.web_ui = None
+        if conf.get(C.AM_WEB_ENABLED):
+            from tez_tpu.am.web import WebUIService
+            self.web_ui = WebUIService(self, port=conf.get(C.AM_WEB_PORT))
         self.history_handler = HistoryEventHandler(
             logging_service, self.recovery_service)
         self.logging_service = logging_service
@@ -82,12 +86,16 @@ class DAGAppMaster:
         self.dispatcher.on_error = self._on_dispatcher_error
         self.dispatcher.start()
         self.heartbeat_monitor.start()
+        if self.web_ui is not None:
+            self.web_ui.start()
         self._started = True
         self.history(HistoryEvent(HistoryEventType.AM_STARTED,
                                   data={"app_id": self.app_id,
                                         "attempt": self.attempt}))
 
     def stop(self) -> None:
+        if self.web_ui is not None:
+            self.web_ui.stop()
         self.heartbeat_monitor.stop()
         dag = self.current_dag
         if dag is not None:

@@ -1,0 +1,102 @@
+"""Live AM status endpoint.
+
+Reference parity: tez-dag/.../app/web/{AMWebController.java:69,
+WebUIService.java} — the REST surface the Tez UI polls for live DAG/vertex
+progress.  Endpoints:
+  GET /            tiny HTML progress page (auto-refresh)
+  GET /status      JSON DAG status (DAGClient schema)
+  GET /counters    JSON aggregated DAG counters
+  GET /history     JSON recent history events (in-memory logger only)
+"""
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+_PAGE = """<!doctype html><html><head><title>tez_tpu AM</title>
+<meta http-equiv="refresh" content="2"><style>
+body{font-family:monospace;margin:2em} table{border-collapse:collapse}
+td,th{border:1px solid #999;padding:4px 10px;text-align:left}
+.bar{background:#ddd;width:240px}.fill{background:#4e79a7;height:12px}
+</style></head><body><h2 id="t"></h2><div id="c"></div>
+<script>
+fetch('/status').then(r=>r.json()).then(s=>{
+ document.getElementById('t').textContent =
+   s.name + ' — ' + s.state + ' (' + Math.round(s.progress*100) + '%)';
+ let h = '<table><tr><th>vertex</th><th>state</th><th>tasks</th>' +
+         '<th>progress</th></tr>';
+ for (const [n,v] of Object.entries(s.vertices)) {
+   h += '<tr><td>'+n+'</td><td>'+v.state+'</td><td>'+v.succeeded+'/'+
+        v.total_tasks+'</td><td><div class="bar"><div class="fill" '+
+        'style="width:'+Math.round(v.progress*240)+'px"></div></div></td></tr>';
+ }
+ document.getElementById('c').innerHTML = h + '</table>';
+});
+</script></body></html>"""
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    def log_message(self, *args: Any) -> None:
+        pass
+
+    def do_GET(self) -> None:  # noqa: N802 — stdlib naming
+        am = self.server.am  # type: ignore[attr-defined]
+        if self.path == "/":
+            self._send(200, _PAGE.encode(), "text/html")
+            return
+        if self.path == "/status":
+            dag = am.current_dag
+            body = dag.status_dict() if dag is not None else {
+                "name": None, "state": "IDLE", "progress": 0, "vertices": {}}
+            self._send(200, json.dumps(body, default=str).encode())
+            return
+        if self.path == "/counters":
+            dag = am.current_dag
+            body = dag.counters.to_dict() if dag is not None else {}
+            self._send(200, json.dumps(body).encode())
+            return
+        if self.path == "/history":
+            events = getattr(am.logging_service, "events", [])
+            body = [json.loads(e.to_json()) for e in events[-200:]]
+            self._send(200, json.dumps(body).encode())
+            return
+        self._send(404, b'{"error": "not found"}')
+
+    def _send(self, code: int, body: bytes,
+              ctype: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class WebUIService:
+    def __init__(self, am: Any, host: str = "127.0.0.1", port: int = 0):
+        self.am = am
+        self._httpd = http.server.ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.am = am  # type: ignore[attr-defined]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="am-web")
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/"
+
+    def start(self) -> "WebUIService":
+        self._thread.start()
+        log.info("AM web UI at %s", self.url)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

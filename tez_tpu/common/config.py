@@ -136,6 +136,9 @@ AM_CLIENT_HEARTBEAT_TIMEOUT_SECS = _key("tez.am.client.heartbeat.timeout.secs", 
 DAG_SCHEDULER_CLASS = _key("tez.am.dag.scheduler.class",
                            "tez_tpu.am.dag_scheduler:DAGSchedulerNaturalOrder", Scope.AM)
 THREAD_DUMP_INTERVAL_MS = _key("tez.thread.dump.interval.ms", 0, Scope.VERTEX)
+AM_WEB_ENABLED = _key("tez.am.web.enabled", False, Scope.AM,
+                      "Serve the live status endpoint (AMWebController analog)")
+AM_WEB_PORT = _key("tez.am.web.port", 0, Scope.AM, "0 = ephemeral")
 
 # --------------------------------------------------------------------------
 # Runtime (per-edge / per-IO) keys (TezRuntimeConfiguration.java analog)

@@ -1,0 +1,65 @@
+"""Security primitives: job tokens, shuffle-request HMAC, ACLs.
+
+Reference parity: tez-api/.../common/security/{JobTokenSecretManager.java:40,
+DAGAccessControls, ACLManager}.java + tez-runtime-library SecureShuffleUtils
+(SecureShuffleUtils.java:41 — URL-HMAC of the fetch request with the job
+token, reply-hash verification).  ICI transfers are intra-trust-domain; the
+HMAC protects the DCN fetch path (SURVEY.md §5.8).
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from typing import Dict, Iterable, Optional, Set
+
+
+class JobTokenSecretManager:
+    """Per-app shared secret between orchestrator and shuffle servers."""
+
+    def __init__(self, secret: Optional[bytes] = None):
+        self.secret = secret or os.urandom(32)
+
+    def compute_hash(self, msg: bytes) -> bytes:
+        return hmac.new(self.secret, msg, hashlib.sha256).digest()
+
+    def verify_hash(self, digest: bytes, msg: bytes) -> bool:
+        return hmac.compare_digest(digest, self.compute_hash(msg))
+
+
+def hash_from_request(secret: JobTokenSecretManager, path: str,
+                      spill_id: int, partition: int) -> bytes:
+    """Canonical request signature (SecureShuffleUtils.hashFromString
+    analog)."""
+    msg = f"{path}|{spill_id}|{partition}".encode()
+    return secret.compute_hash(msg)
+
+
+class DAGAccessControls:
+    """View/modify user lists; '*' = everyone (reference:
+    DAGAccessControls.java)."""
+
+    def __init__(self, view_users: Iterable[str] = ("*",),
+                 modify_users: Iterable[str] = ()):
+        self.view_users: Set[str] = set(view_users)
+        self.modify_users: Set[str] = set(modify_users)
+
+
+class ACLManager:
+    """Reference: ACLManager.java — owner always allowed; '*' wildcard."""
+
+    def __init__(self, owner: str, dag_acls: Optional[DAGAccessControls] = None,
+                 enabled: bool = True):
+        self.owner = owner
+        self.acls = dag_acls or DAGAccessControls()
+        self.enabled = enabled
+
+    def check_view_access(self, user: str) -> bool:
+        if not self.enabled or user == self.owner:
+            return True
+        return "*" in self.acls.view_users or user in self.acls.view_users
+
+    def check_modify_access(self, user: str) -> bool:
+        if not self.enabled or user == self.owner:
+            return True
+        return "*" in self.acls.modify_users or user in self.acls.modify_users

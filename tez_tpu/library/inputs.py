@@ -20,7 +20,7 @@ from tez_tpu.api.events import (CompositeRoutedDataMovementEvent,
                                 InputReadErrorEvent, ShufflePayload,
                                 TezAPIEvent)
 from tez_tpu.api.runtime import (KeyValueReader, KeyValuesReader,
-                                 LogicalInput, Reader)
+                                 LogicalInput, MergedLogicalInput, Reader)
 from tez_tpu.common.counters import TaskCounter
 from tez_tpu.ops.runformat import KVBatch, Run
 from tez_tpu.ops.serde import Serde, get_serde
@@ -62,6 +62,33 @@ class ShuffleFetchTable:
         self.service = local_shuffle_service()
         self.failed = False
         self.diagnostics = ""
+        meta = context.get_service_provider_metadata("shuffle") or {}
+        self.local_host = meta.get("host", "local")
+        self.local_port = meta.get("port", 0)
+        self._secret = meta.get("secret")
+
+    def _fetch(self, payload: ShufflePayload, partition: int) -> KVBatch:
+        """Local short-circuit or DCN socket fetch (Fetcher.java:288 local
+        short-circuit vs HTTP fetch split)."""
+        if payload.port == 0 or (payload.host, payload.port) == \
+                (self.local_host, self.local_port):
+            return self.service.fetch_partition(
+                payload.path_component, payload.spill_id, partition)
+        from tez_tpu.shuffle.server import ShuffleFetcher
+        if self._secret is None:
+            # config gap on THIS consumer, not producer data loss: must not
+            # masquerade as a local fetch failure (which force-reruns the
+            # healthy producer)
+            raise PermissionError(
+                f"no shuffle secret for remote fetch from "
+                f"{payload.host}:{payload.port}")
+        fetcher = ShuffleFetcher(self._secret)
+        batch = fetcher.fetch(payload.host, payload.port,
+                              payload.path_component, payload.spill_id,
+                              partition)[0]
+        self.context.counters.increment(TaskCounter.SHUFFLE_BYTES_DISK_DIRECT,
+                                        batch.nbytes)
+        return batch
 
     def on_payload(self, slot: int, partition: int, payload: ShufflePayload,
                    version: int = 0) -> None:
@@ -75,18 +102,17 @@ class ShuffleFetchTable:
             if payload.is_empty(partition):
                 batch = None
             else:
-                batch = self.service.fetch_partition(
-                    payload.path_component, payload.spill_id, partition)
+                batch = self._fetch(payload, partition)
                 self.context.counters.increment(
                     TaskCounter.SHUFFLE_BYTES, batch.nbytes)
                 self.context.counters.increment(
                     TaskCounter.SHUFFLE_BYTES_TO_MEM, batch.nbytes)
                 self.context.counters.increment(TaskCounter.NUM_SHUFFLED_INPUTS)
-        except ShuffleDataNotFound as e:
+        except (ShuffleDataNotFound, ConnectionError, PermissionError) as e:
             log.warning("fetch failed for slot %d: %s", slot, e)
             self.context.send_events([InputReadErrorEvent(
                 diagnostics=str(e), index=slot, version=version,
-                is_local_fetch=True)])
+                is_local_fetch=isinstance(e, ShuffleDataNotFound))])
             self.context.counters.increment(
                 TaskCounter.NUM_FAILED_SHUFFLE_INPUTS)
             return
@@ -258,3 +284,27 @@ class UnorderedKVReaderAdapter(KeyValueReader):
     def __iter__(self):
         for k, v in self.batch.iter_pairs():
             yield self.key_serde.from_bytes(k), self.val_serde.from_bytes(v)
+
+
+class ConcatenatedMergedKVInput(MergedLogicalInput):
+    """Merged view over a vertex group's constituent inputs: concatenates
+    their readers (reference: tez-runtime-library
+    ConcatenatedMergedKeyValueInput / the MergedLogicalInput family)."""
+
+    def get_reader(self) -> Reader:
+        merged_self = self
+
+        class _Concat(KeyValueReader):
+            def __iter__(self):
+                for inp in merged_self.inputs:
+                    reader = inp.get_reader()
+                    # grouped readers yield (k, values); flat ones (k, v)
+                    if isinstance(reader, KeyValuesReader):
+                        for k, vs in reader:
+                            for v in vs:
+                                yield k, v
+                    else:
+                        for k, v in reader:
+                            yield k, v
+
+        return _Concat()

@@ -1,0 +1,88 @@
+"""ctypes bindings for the native host ops (native/ragged.cpp).
+
+Auto-builds `native/libtezhost.so` with g++ on first use (cached); every
+caller has a numpy fallback, so a missing toolchain degrades gracefully.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libtezhost.so")
+
+_lib: "ctypes.CDLL | None | bool" = None   # None=untried, False=unavailable
+_lock = threading.Lock()
+
+#: Below this many bytes the thread spawn outweighs the copy.
+MIN_NATIVE_BYTES = 1 << 20
+
+
+def _load() -> "ctypes.CDLL | None":
+    global _lib
+    if _lib is False:
+        return None
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib not in (None,):
+            return _lib if _lib is not False else None
+        try:
+            if not os.path.exists(_SO_PATH):
+                subprocess.run(["make", "-C", _NATIVE_DIR, "-s"],
+                               check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(_SO_PATH)
+            lib.gather_ragged_u8.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int32]
+            lib.gather_ragged_u8.restype = None
+            _lib = lib
+            log.info("native host ops loaded from %s", _SO_PATH)
+        except Exception as e:  # noqa: BLE001 — toolchain may be absent
+            log.warning("native host ops unavailable (%s); numpy fallback",
+                        e)
+            _lib = False
+            return None
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def gather_ragged_native(data: np.ndarray, offsets: np.ndarray,
+                         perm: np.ndarray
+                         ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Multithreaded ragged permute; returns None when the native lib is
+    unavailable (caller falls back to numpy)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n_out = len(perm)
+    lengths = offsets[1:] - offsets[:-1]
+    out_offsets = np.zeros(n_out + 1, dtype=np.int64)
+    np.cumsum(lengths[perm], out=out_offsets[1:])
+    out = np.empty(int(out_offsets[-1]), dtype=np.uint8)
+    data = np.ascontiguousarray(data)
+    offsets = np.ascontiguousarray(offsets.astype(np.int64))
+    perm64 = np.ascontiguousarray(perm.astype(np.int64))
+    threads = min(8, os.cpu_count() or 1)
+    lib.gather_ragged_u8(
+        data.ctypes.data_as(ctypes.c_void_p),
+        offsets.ctypes.data_as(ctypes.c_void_p),
+        perm64.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n_out),
+        out_offsets.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int32(threads))
+    return out, out_offsets

@@ -35,7 +35,16 @@ def _ranges(lengths: np.ndarray) -> np.ndarray:
 
 def gather_ragged(data: np.ndarray, offsets: np.ndarray,
                   perm: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """Permute a ragged array: returns (new_data, new_offsets)."""
+    """Permute a ragged array: returns (new_data, new_offsets).
+
+    Large batches go through the native multithreaded per-row memcpy
+    (native/ragged.cpp); numpy fancy indexing otherwise."""
+    from tez_tpu.ops.native import MIN_NATIVE_BYTES
+    if data.nbytes >= MIN_NATIVE_BYTES:
+        from tez_tpu.ops.native import gather_ragged_native
+        native = gather_ragged_native(data, offsets, perm)
+        if native is not None:
+            return native
     lengths = offsets[1:] - offsets[:-1]
     new_lengths = lengths[perm]
     new_offsets = np.zeros(len(perm) + 1, dtype=np.int64)
@@ -162,7 +171,7 @@ class Run:
         return self.batch.nbytes
 
     # -- host-spill serialization (checksummed; IFileOutputStream analog) ----
-    def save(self, path: str, codec: Optional[str] = None) -> None:
+    def to_bytes(self, codec: Optional[str] = None) -> bytes:
         buf = io.BytesIO()
         arrays = (self.batch.key_bytes, self.batch.key_offsets,
                   self.batch.val_bytes, self.batch.val_offsets,
@@ -177,24 +186,18 @@ class Run:
         header = MAGIC + struct.pack(
             "<BIQ", 1 if codec == "zlib" else 0,
             zlib.crc32(payload), len(payload))
-        tmp = path + ".tmp"
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(tmp, "wb") as fh:
-            fh.write(header)
-            fh.write(payload)
-        os.replace(tmp, path)
+        return header + payload
 
     @staticmethod
-    def load(path: str) -> "Run":
-        with open(path, "rb") as fh:
-            magic = fh.read(len(MAGIC))
-            if magic != MAGIC:
-                raise IOError(f"bad run file magic in {path}")
-            compressed, crc, size = struct.unpack("<BIQ",
-                                                  fh.read(1 + 4 + 8))
-            payload = fh.read(size)
+    def from_bytes(data: bytes, where: str = "<bytes>") -> "Run":
+        if data[:len(MAGIC)] != MAGIC:
+            raise IOError(f"bad run magic in {where}")
+        off = len(MAGIC)
+        compressed, crc, size = struct.unpack_from("<BIQ", data, off)
+        off += 1 + 4 + 8
+        payload = data[off:off + size]
         if zlib.crc32(payload) != crc:
-            raise IOError(f"checksum mismatch in {path}")
+            raise IOError(f"checksum mismatch in {where}")
         buf = io.BytesIO(payload)
         arrays = []
         for _ in range(5):
@@ -206,6 +209,18 @@ class Run:
                 dtype_c.decode())).copy())
         kb, ko, vb, vo, ri = arrays
         return Run(KVBatch(kb, ko, vb, vo), ri)
+
+    def save(self, path: str, codec: Optional[str] = None) -> None:
+        tmp = path + ".tmp"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "wb") as fh:
+            fh.write(self.to_bytes(codec))
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load(path: str) -> "Run":
+        with open(path, "rb") as fh:
+            return Run.from_bytes(fh.read(), where=path)
 
     @staticmethod
     def from_sorted_batch(batch: KVBatch, sorted_partitions: np.ndarray,

@@ -1,0 +1,186 @@
+"""Cross-host shuffle transport: TCP server + fetcher (the DCN path).
+
+Reference parity: tez-plugins/tez-aux-services ShuffleHandler.java:159 (the
+host-resident server every job's consumers fetch from, with job-token HMAC
+auth and keep-alive batching) and tez-runtime-library Fetcher.java:79 (retry
+with backoff, penalty accounting).  Intra-host fetches short-circuit through
+tez_tpu.shuffle.service; this socket path carries inter-host (DCN) fetches
+and AM-recovery reads.
+
+Wire format (length-prefixed):
+  request : u32 len | JSON {path, spill, partition_lo, partition_hi, hmac-hex}
+  response: u32 len | JSON {status, sizes:[...]} | concatenated Run blobs
+Each requested partition ships as one checksummed single-partition Run blob
+(ops.runformat serialization), so corruption is detected end-to-end.
+"""
+from __future__ import annotations
+
+import io
+import json
+import logging
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from tez_tpu.common.security import (JobTokenSecretManager,
+                                     hash_from_request)
+from tez_tpu.ops.runformat import KVBatch, Run
+from tez_tpu.shuffle.service import (ShuffleDataNotFound, ShuffleService,
+                                     local_shuffle_service)
+
+log = logging.getLogger(__name__)
+
+
+def _run_blob(batch: KVBatch) -> bytes:
+    """Serialize one partition as a single-partition Run blob (checksummed)."""
+    run = Run(batch, np.array([0, batch.num_records], dtype=np.int64))
+    return run.to_bytes()
+
+
+def _blob_to_batch(blob: bytes) -> KVBatch:
+    return Run.from_bytes(blob, where="<shuffle fetch>").batch
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        server: "ShuffleServer" = self.server  # type: ignore[assignment]
+        try:
+            while True:  # keep-alive: serve multiple fetches per connection
+                raw_len = self.rfile.read(4)
+                if len(raw_len) < 4:
+                    return
+                (req_len,) = struct.unpack("<I", raw_len)
+                req = json.loads(self.rfile.read(req_len))
+                self._serve_one(server, req)
+        except (ConnectionError, json.JSONDecodeError, struct.error):
+            return
+
+    def _serve_one(self, server: "ShuffleServer", req: dict) -> None:
+        path = req.get("path", "")
+        spill = int(req.get("spill", -1))
+        lo = int(req.get("partition_lo", 0))
+        hi = int(req.get("partition_hi", lo + 1))
+        sig = bytes.fromhex(req.get("hmac", ""))
+        if not server.secrets.verify_hash(
+                sig, f"{path}|{spill}|{lo}".encode()):
+            self._reply({"status": "forbidden"}, [])
+            server.auth_failures += 1
+            return
+        try:
+            blobs = [
+                _run_blob(server.service.fetch_partition(path, spill, p))
+                for p in range(lo, hi)]
+        except ShuffleDataNotFound:
+            self._reply({"status": "not_found"}, [])
+            return
+        self._reply({"status": "ok",
+                     "sizes": [len(b) for b in blobs]}, blobs)
+        server.bytes_served += sum(len(b) for b in blobs)
+
+    def _reply(self, header: dict, blobs: List[bytes]) -> None:
+        hdr = json.dumps(header).encode()
+        self.wfile.write(struct.pack("<I", len(hdr)) + hdr)
+        for b in blobs:
+            self.wfile.write(b)
+        self.wfile.flush()
+
+
+class ShuffleServer:
+    """Host-resident shuffle server (one per runner host)."""
+
+    def __init__(self, secrets: JobTokenSecretManager,
+                 service: Optional[ShuffleService] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.secrets = secrets
+        self.service = service or local_shuffle_service()
+        self._tcp = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True)
+        self._tcp.daemon_threads = True
+        # handler back-references
+        self._tcp.secrets = secrets          # type: ignore[attr-defined]
+        self._tcp.service = self.service     # type: ignore[attr-defined]
+        self._tcp.auth_failures = 0          # type: ignore[attr-defined]
+        self._tcp.bytes_served = 0           # type: ignore[attr-defined]
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True, name="shuffle-server")
+
+    @property
+    def port(self) -> int:
+        return self._tcp.server_address[1]
+
+    @property
+    def auth_failures(self) -> int:
+        return self._tcp.auth_failures  # type: ignore[attr-defined]
+
+    @property
+    def bytes_served(self) -> int:
+        return self._tcp.bytes_served   # type: ignore[attr-defined]
+
+    def start(self) -> "ShuffleServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+
+class ShuffleFetcher:
+    """Client side: fetch with retry/backoff (Fetcher.java penalty-box lite).
+
+    Raises ShuffleDataNotFound on a definitive miss (drives the
+    InputReadErrorEvent path) and ConnectionError after retries."""
+
+    def __init__(self, secrets: JobTokenSecretManager, retries: int = 3,
+                 backoff: float = 0.2, connect_timeout: float = 5.0):
+        self.secrets = secrets
+        self.retries = retries
+        self.backoff = backoff
+        self.connect_timeout = connect_timeout
+
+    def fetch(self, host: str, port: int, path: str, spill: int,
+              partition_lo: int, partition_hi: int = -1) -> List[KVBatch]:
+        if partition_hi < 0:
+            partition_hi = partition_lo + 1
+        last: Optional[Exception] = None
+        for attempt in range(self.retries):
+            try:
+                return self._fetch_once(host, port, path, spill,
+                                        partition_lo, partition_hi)
+            except (ShuffleDataNotFound, PermissionError):
+                raise   # definitive: retrying cannot help
+            except (OSError, ValueError, struct.error) as e:
+                # struct.error covers truncated responses (server died
+                # mid-reply) — retryable like any connection fault
+                last = e
+                if attempt < self.retries - 1:
+                    time.sleep(self.backoff * (2 ** attempt))
+        raise ConnectionError(
+            f"fetch {host}:{port}/{path} failed after "
+            f"{self.retries} tries: {last!r}")
+
+    def _fetch_once(self, host: str, port: int, path: str, spill: int,
+                    lo: int, hi: int) -> List[KVBatch]:
+        req = json.dumps({
+            "path": path, "spill": spill,
+            "partition_lo": lo, "partition_hi": hi,
+            "hmac": hash_from_request(self.secrets, path, spill, lo).hex(),
+        }).encode()
+        with socket.create_connection((host, port),
+                                      timeout=self.connect_timeout) as sk:
+            sk.sendall(struct.pack("<I", len(req)) + req)
+            fh = sk.makefile("rb")
+            (hdr_len,) = struct.unpack("<I", fh.read(4))
+            header = json.loads(fh.read(hdr_len))
+            status = header.get("status")
+            if status == "not_found":
+                raise ShuffleDataNotFound(f"{path}/{spill}")
+            if status != "ok":
+                raise PermissionError(f"shuffle fetch {status}: {path}")
+            return [
+                _blob_to_batch(fh.read(size)) for size in header["sizes"]]

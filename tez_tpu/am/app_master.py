@@ -51,8 +51,19 @@ class DAGAppMaster:
         num_slots = conf.get(C.AM_NUM_CONTAINERS) or max(2, os.cpu_count() or 2)
         self.task_scheduler = LocalTaskSchedulerService(self, num_slots)
         self.scheduler_manager = TaskSchedulerManager(self, self.task_scheduler)
-        self.runner_pool = RunnerPool(self, num_slots)
         self.task_comm = TaskCommunicatorManager(self)
+        from tez_tpu.common.security import JobTokenSecretManager
+        self.secrets = JobTokenSecretManager()
+        self.umbilical_server = None
+        if conf.get(C.RUNNER_MODE) == "subprocess":
+            from tez_tpu.am.launcher import SubprocessRunnerPool
+            from tez_tpu.am.umbilical_server import UmbilicalServer
+            self.umbilical_server = UmbilicalServer(
+                self.task_comm, self.secrets,
+                host=conf.get(C.UMBILICAL_BIND_HOST))
+            self.runner_pool = SubprocessRunnerPool(self, num_slots)
+        else:
+            self.runner_pool = RunnerPool(self, num_slots)
         logging_service = HistoryEventHandler.create_logging_service(conf)
         from tez_tpu.am.recovery import RecoveryService
         recovery_enabled = conf.get(C.DAG_RECOVERY_ENABLED)
@@ -86,6 +97,8 @@ class DAGAppMaster:
         self.dispatcher.on_error = self._on_dispatcher_error
         self.dispatcher.start()
         self.heartbeat_monitor.start()
+        if self.umbilical_server is not None:
+            self.umbilical_server.start()
         if self.web_ui is not None:
             self.web_ui.start()
         self._started = True
@@ -104,6 +117,8 @@ class DAGAppMaster:
                 speculator.stop()
         self.task_scheduler.shutdown()
         self.runner_pool.shutdown()
+        if self.umbilical_server is not None:
+            self.umbilical_server.stop()
         self.dispatcher.stop()
         self.executor.shutdown(wait=False)
         if self.recovery_service is not None:

@@ -40,6 +40,13 @@ class HeartbeatMonitor:
                 log.exception("heartbeat check failed")
 
     def _check(self) -> None:
+        # Watchdog for the runner pool: a runner deciding to idle-exit still
+        # counts as capacity at schedule time, so queued work could strand
+        # with nothing re-triggering a spawn.  Re-examine the backlog every
+        # tick (reference: the AM's scheduling heartbeat serves this role).
+        backlog = self.ctx.task_scheduler.backlog()
+        if backlog > 0:
+            self.ctx.ensure_runners(backlog)
         if self.timeout_ms <= 0:
             return
         now = time.time()

@@ -1,0 +1,162 @@
+"""Socket transport for the task umbilical: AM server + runner-side client.
+
+Reference parity: the TezTaskUmbilicalProtocol RPC boundary
+(tez-runtime-internals common/TezTaskUmbilicalProtocol.java:42 served by
+tez-dag's TaskCommunicator RPC server) — the control-plane seam between the
+orchestrator process and out-of-process runners (TezChild JVMs there, runner
+processes here; a multi-host deployment points runners at the AM host over
+DCN).
+
+Wire format: job-token handshake, then length-prefixed pickled
+(method, args) requests / (ok, payload) responses.  Pickle is acceptable on
+this channel because both ends are the framework's own trusted processes
+inside one job (the reference's Writable RPC makes the same assumption);
+the handshake rejects foreign connections.
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Optional, Tuple
+
+from tez_tpu.common.security import JobTokenSecretManager
+
+log = logging.getLogger(__name__)
+
+_METHODS = frozenset({"get_task", "heartbeat", "can_commit", "task_done",
+                      "task_failed", "task_killed", "should_die"})
+
+
+def _send_msg(wfile: Any, obj: Any) -> None:
+    blob = pickle.dumps(obj, protocol=4)
+    wfile.write(struct.pack("<I", len(blob)) + blob)
+    wfile.flush()
+
+
+def _recv_msg(rfile: Any) -> Any:
+    raw = rfile.read(4)
+    if len(raw) < 4:
+        raise ConnectionError("umbilical closed")
+    (n,) = struct.unpack("<I", raw)
+    blob = rfile.read(n)
+    if len(blob) < n:
+        raise ConnectionError("umbilical truncated")
+    return pickle.loads(blob)
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        server = self.server
+        comm = server.task_comm          # type: ignore[attr-defined]
+        secrets = server.secrets         # type: ignore[attr-defined]
+        try:
+            hello = _recv_msg(self.rfile)
+            if not (isinstance(hello, dict) and
+                    secrets.verify_hash(hello.get("sig", b""),
+                                        b"umbilical-hello")):
+                _send_msg(self.wfile, (False, "auth failed"))
+                return
+            _send_msg(self.wfile, (True, "ok"))
+            while True:
+                method, args, kwargs = _recv_msg(self.rfile)
+                if method not in _METHODS:
+                    _send_msg(self.wfile, (False, f"no method {method}"))
+                    continue
+                try:
+                    result = getattr(comm, method)(*args, **kwargs)
+                    _send_msg(self.wfile, (True, result))
+                except BaseException as e:  # noqa: BLE001 — ship to runner
+                    try:
+                        _send_msg(self.wfile, (False, e))
+                    except (pickle.PicklingError, TypeError, AttributeError):
+                        # unpicklable exception: ship a repr instead of
+                        # killing the connection
+                        _send_msg(self.wfile, (False, RuntimeError(repr(e))))
+        except (ConnectionError, EOFError, pickle.UnpicklingError):
+            return
+
+
+class UmbilicalServer:
+    """Serves the AM's TaskCommunicatorManager to remote runners."""
+
+    def __init__(self, task_comm: Any, secrets: JobTokenSecretManager,
+                 host: str = "127.0.0.1", port: int = 0):
+        # host "0.0.0.0" for multi-host deployments
+        # (conf: tez.am.umbilical.bind-host)
+        self._tcp = socketserver.ThreadingTCPServer((host, port), _Handler)
+        self._tcp.daemon_threads = True
+        self._tcp.task_comm = task_comm     # type: ignore[attr-defined]
+        self._tcp.secrets = secrets         # type: ignore[attr-defined]
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True, name="umbilical-server")
+
+    @property
+    def port(self) -> int:
+        return self._tcp.server_address[1]
+
+    def start(self) -> "UmbilicalServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+
+class RemoteUmbilical:
+    """Runner-side client with the TaskCommunicatorManager surface that
+    TaskRunner expects.  One connection, requests serialized by a lock
+    (the runner's main + heartbeat threads share it, mirroring the
+    reference's single umbilical RPC proxy per TezChild)."""
+
+    def __init__(self, host: str, port: int,
+                 secrets: JobTokenSecretManager, timeout: float = 60.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._rfile = self._sock.makefile("rb")
+        self._wfile = self._sock.makefile("wb")
+        self._lock = threading.Lock()
+        _send_msg(self._wfile,
+                  {"sig": secrets.compute_hash(b"umbilical-hello")})
+        ok, msg = _recv_msg(self._rfile)
+        if not ok:
+            raise PermissionError(f"umbilical handshake failed: {msg}")
+
+    def _call(self, method: str, *args: Any, **kwargs: Any) -> Any:
+        with self._lock:
+            _send_msg(self._wfile, (method, args, kwargs))
+            ok, payload = _recv_msg(self._rfile)
+        if not ok:
+            if isinstance(payload, BaseException):
+                raise payload
+            raise RuntimeError(str(payload))
+        return payload
+
+    def get_task(self, container_id: Any, timeout: float = 1.0) -> Any:
+        return self._call("get_task", container_id, timeout)
+
+    def heartbeat(self, request: Any) -> Any:
+        return self._call("heartbeat", request)
+
+    def can_commit(self, attempt_id: Any) -> bool:
+        return self._call("can_commit", attempt_id)
+
+    def task_done(self, attempt_id: Any, events: Any, counters: Any) -> None:
+        self._call("task_done", attempt_id, events, counters)
+
+    def task_failed(self, attempt_id: Any, diagnostics: str,
+                    fatal: bool = False, counters: Any = None) -> None:
+        self._call("task_failed", attempt_id, diagnostics, fatal=fatal,
+                   counters=counters)
+
+    def task_killed(self, attempt_id: Any, diagnostics: str) -> None:
+        self._call("task_killed", attempt_id, diagnostics)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

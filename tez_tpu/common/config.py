@@ -139,6 +139,14 @@ THREAD_DUMP_INTERVAL_MS = _key("tez.thread.dump.interval.ms", 0, Scope.VERTEX)
 AM_WEB_ENABLED = _key("tez.am.web.enabled", False, Scope.AM,
                       "Serve the live status endpoint (AMWebController analog)")
 AM_WEB_PORT = _key("tez.am.web.port", 0, Scope.AM, "0 = ephemeral")
+RUNNER_ENV = _key("tez.am.runner.env", {}, Scope.AM,
+                  "Env overrides for runner subprocesses; '' value = unset")
+UMBILICAL_BIND_HOST = _key("tez.am.umbilical.bind-host", "127.0.0.1",
+                           Scope.AM, "'0.0.0.0' for multi-host deployments")
+RUNNER_MODE = _key("tez.runner.mode", "threads", Scope.AM,
+                   "'threads' (in-process, reference local mode) or "
+                   "'subprocess' (out-of-process runners over the socket "
+                   "umbilical — the TezChild-per-container model)")
 
 # --------------------------------------------------------------------------
 # Runtime (per-edge / per-IO) keys (TezRuntimeConfiguration.java analog)

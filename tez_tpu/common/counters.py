@@ -121,6 +121,15 @@ class CounterGroup:
         self._counters: Dict[str, TezCounter] = {}
         self._lock = threading.Lock()
 
+    def __getstate__(self) -> dict:
+        state = self.__dict__.copy()
+        del state["_lock"]   # locks don't cross the umbilical wire
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
     def find_counter(self, name: str, create: bool = True) -> TezCounter:
         # Truncate BEFORE lookup so the dict key and TezCounter.name always
         # agree (names longer than the limit collapse consistently).
@@ -155,6 +164,15 @@ class TezCounters:
 
     def __init__(self) -> None:
         self._groups: Dict[str, CounterGroup] = {}
+        self._lock = threading.Lock()
+
+    def __getstate__(self) -> dict:
+        state = self.__dict__.copy()
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
         self._lock = threading.Lock()
 
     def group(self, name: str) -> CounterGroup:

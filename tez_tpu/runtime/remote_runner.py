@@ -1,0 +1,93 @@
+"""Out-of-process runner: the TezChild-as-a-process analog.
+
+Reference parity: tez-runtime-internals TezChild.java:214 — a separate
+process that connects back to the AM's umbilical, loops getTask, runs tasks,
+and dies when told.  Each runner also hosts a ShuffleServer so its outputs
+are fetchable across process/host boundaries (the NM-resident ShuffleHandler
+role collapses onto the runner host here).
+
+Launch: python -m tez_tpu.runtime.remote_runner
+            --am-host H --am-port P --node-id NAME
+  with the job token in the TEZ_TPU_JOB_TOKEN env var (hex), mirroring the
+  reference's credential handoff via the container environment.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import tempfile
+
+log = logging.getLogger(__name__)
+
+
+def run_loop(am_host: str, am_port: int, node_id: str, token_hex: str,
+             idle_timeout: float = 5.0, work_dir: str = "",
+             container_id: str = "", advertise_host: str = "127.0.0.1") -> int:
+    from tez_tpu.am.umbilical_server import RemoteUmbilical
+    from tez_tpu.api.runtime import ObjectRegistry
+    from tez_tpu.common.ids import ContainerId
+    from tez_tpu.common.security import JobTokenSecretManager
+    from tez_tpu.runtime.task_runner import TaskRunner
+    from tez_tpu.shuffle.server import ShuffleServer
+    from tez_tpu.shuffle.service import local_shuffle_service
+
+    secrets = JobTokenSecretManager(bytes.fromhex(token_hex))
+    umbilical = RemoteUmbilical(am_host, am_port, secrets)
+    shuffle_server = ShuffleServer(secrets, local_shuffle_service()).start()
+    if not container_id:
+        container_id = str(ContainerId(f"app_proc_{node_id}", os.getpid()))
+    registry = ObjectRegistry()
+    work_dir = work_dir or tempfile.mkdtemp(prefix=f"tez-runner-{node_id}-")
+    # advertise_host is what consumers dial for shuffle fetches; on a
+    # multi-host deployment pass this worker's reachable address
+    shuffle_meta = {"host": advertise_host, "port": shuffle_server.port,
+                    "secret": secrets}
+    log.info("runner %s up: shuffle port %d, am %s:%d", node_id,
+             shuffle_server.port, am_host, am_port)
+    tasks_run = 0
+    try:
+        while True:
+            try:
+                spec = umbilical.get_task(container_id, timeout=idle_timeout)
+            except ConnectionError:
+                log.info("umbilical gone; runner exiting")
+                break
+            if spec is None:
+                break  # idle: release this runner (container release)
+            runner = TaskRunner(spec, umbilical, registry,
+                                work_dir=work_dir, node_id=node_id,
+                                service_metadata={"shuffle": shuffle_meta})
+            runner.run()
+            registry.clear_scope(ObjectRegistry.VERTEX)
+            tasks_run += 1
+    finally:
+        shuffle_server.stop()
+        umbilical.close()
+        log.info("runner %s done after %d tasks", node_id, tasks_run)
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--am-host", default="127.0.0.1")
+    parser.add_argument("--am-port", type=int, required=True)
+    parser.add_argument("--node-id", default=f"proc-{os.getpid()}")
+    parser.add_argument("--container-id", default="")
+    parser.add_argument("--advertise-host", default="127.0.0.1")
+    parser.add_argument("--idle-timeout", type=float, default=5.0)
+    args = parser.parse_args()
+    token = os.environ.get("TEZ_TPU_JOB_TOKEN", "")
+    if not token:
+        print("TEZ_TPU_JOB_TOKEN env var required", file=sys.stderr)
+        return 2
+    logging.basicConfig(level=os.environ.get("TEZ_TPU_LOG", "INFO"))
+    return run_loop(args.am_host, args.am_port, args.node_id, token,
+                    idle_timeout=args.idle_timeout,
+                    container_id=args.container_id,
+                    advertise_host=args.advertise_host)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

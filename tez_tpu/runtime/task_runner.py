@@ -33,7 +33,8 @@ class TaskRunner:
 
     def __init__(self, spec: TaskSpec, umbilical: Any,
                  registry: Optional[ObjectRegistry] = None,
-                 work_dir: str = "/tmp", node_id: str = "local"):
+                 work_dir: str = "/tmp", node_id: str = "local",
+                 service_metadata: Optional[Dict[str, Any]] = None):
         self.spec = spec
         self.umbilical = umbilical
         self.registry = registry or ObjectRegistry()
@@ -44,7 +45,7 @@ class TaskRunner:
             int(spec.conf.get("tez.task.hbm.budget.bytes",
                               DEFAULT_TASK_BUDGET)))
         self.progress = 0.0
-        self.service_metadata: Dict[str, Any] = {
+        self.service_metadata: Dict[str, Any] = service_metadata or {
             "shuffle": {"host": node_id, "port": 0}}
         self.inputs: Dict[str, LogicalInput] = {}
         self.outputs: Dict[str, LogicalOutput] = {}

@@ -88,11 +88,12 @@ def prepare_device_inputs(key_bytes, key_offsets, val_bytes, val_offsets,
     hash_w = 1 << max(2, (key_len - 1).bit_length())
     hmat, hlens = pad_to_matrix(key_bytes, key_offsets, hash_w)
     vals = np.ascontiguousarray(val_bytes.reshape(n, 8)).view(np.uint32)
-    dev = [jnp.asarray(x) for x in
-           (lanes, lengths.astype(np.int64), vals, hmat,
-            hlens.astype(np.int32))]
+    from tez_tpu.ops.device import uniform_clamped_lengths
+    uniform, _ = uniform_clamped_lengths(lengths, lanes.shape[1] * 4 + 1)
+    dev = [jnp.asarray(x) for x in (lanes, lengths.astype(np.int64), vals,
+                                    hmat, hlens.astype(np.int32))]
     jax.block_until_ready(dev)
-    return dev
+    return dev + [uniform]
 
 
 def tpu_path(dev_inputs, num_partitions: int):
@@ -105,9 +106,9 @@ def tpu_path(dev_inputs, num_partitions: int):
     before remote execution finishes, so completion is forced by fetching a
     scalar that depends on the whole pipeline (the tiny counts vector)."""
     from tez_tpu.ops.device_pipeline import device_shuffle_sort
-    lanes, lengths, vals, hmat, hlens = dev_inputs
+    lanes, lengths, vals, hmat, hlens, uniform = dev_inputs
     out = device_shuffle_sort(lanes, lengths, vals, hmat, hlens,
-                              num_partitions)
+                              num_partitions, uniform_length=uniform)
     _ = np.asarray(out[4])   # counts: forces full execution, ~P ints D2H
     return out
 

@@ -131,3 +131,52 @@ def test_am_web_endpoint(tmp_path):
         assert b"<html" in page
     finally:
         c.stop()
+
+
+def test_host_sorter_engine_byte_exact():
+    """'host' sorter engine (np.lexsort) output == device engine output."""
+    import random
+    from tez_tpu.ops.sorter import DeviceSorter
+    rng = random.Random(11)
+    pairs = [(bytes(rng.randrange(256) for _ in range(rng.randrange(1, 20))),
+              bytes(rng.randrange(256) for _ in range(4)))
+             for _ in range(800)]
+    runs = []
+    for engine in ("device", "host"):
+        s = DeviceSorter(num_partitions=3, engine=engine)
+        for k, v in pairs:
+            s.write(k, v)
+        runs.append(s.flush())
+    assert list(runs[0].batch.iter_pairs()) == list(runs[1].batch.iter_pairs())
+    import numpy as np
+    np.testing.assert_array_equal(runs[0].row_index, runs[1].row_index)
+
+
+def test_thread_dump_and_stats():
+    from io import StringIO
+    from tez_tpu.runtime.diagnostics import (RuntimeStatsUpdater,
+                                             dump_thread_stacks)
+    from tez_tpu.common.counters import TaskCounter, TezCounters
+    text = dump_thread_stacks()
+    assert "MainThread" in text
+    c = TezCounters()
+    u = RuntimeStatsUpdater(c)
+    sum(i * i for i in range(100000))
+    u.update()
+    assert c.find_counter(TaskCounter.CPU_MILLISECONDS).value >= 0
+    assert c.find_counter(TaskCounter.PHYSICAL_MEMORY_BYTES).value > 0
+
+
+def test_counter_diff_cli(history_dir, capsys):
+    import sys
+    from tez_tpu.tools import counter_diff
+    import glob as g
+    f = sorted(g.glob(os.path.join(history_dir, "*.jsonl")))[0]
+    old = sys.argv
+    try:
+        sys.argv = ["counter_diff", f, f]
+        assert counter_diff.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "wall delta" in out

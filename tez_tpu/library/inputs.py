@@ -209,8 +209,11 @@ class OrderedGroupedKVInput(LogicalInput):
             runs = [Run(b, np.array([0, b.num_records], dtype=np.int64))
                     for b in batches if b.num_records > 0]
             if runs:
+                engine = _conf_get(self.context, "tez.runtime.sorter.class",
+                                   "device")
                 merged = merge_sorted_runs(runs, 1, self.key_width,
-                                           counters=self.context.counters)
+                                           counters=self.context.counters,
+                                           engine=engine)
                 self._merged = merged.batch
             else:
                 self._merged = KVBatch.empty()

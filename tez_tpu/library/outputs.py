@@ -73,6 +73,7 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                                              "bytes"))
         self.val_serde = get_serde(_conf_get(ctx, "tez.runtime.value.class",
                                              "bytes"))
+        engine = _conf_get(ctx, "tez.runtime.sorter.class", "device")
         self.sorter = DeviceSorter(
             num_partitions=self.num_physical_outputs,
             key_width=key_width,
@@ -80,6 +81,7 @@ class OrderedPartitionedKVOutput(LogicalOutput):
             spill_dir=spill_dir,
             counters=ctx.counters,
             combiner=_COMBINERS.get(combiner_name),
+            engine=engine,
         )
         ctx.request_initial_memory(sort_mb << 20, None)
         self._spills_sent = 0

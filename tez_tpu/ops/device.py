@@ -38,6 +38,17 @@ FNV_OFFSET = np.uint32(2166136261)
 FNV_PRIME = np.uint32(16777619)
 
 
+def uniform_clamped_lengths(lengths: np.ndarray, width_cap: int):
+    """(is_uniform, pad_value) over CLAMPED lengths — the shared uniformity
+    test for the skip-length-pass optimization (clamp first: all-long keys
+    compare equal at the cap)."""
+    if len(lengths) == 0:
+        return False, width_cap
+    clamped = np.minimum(lengths.astype(np.int64), width_cap)
+    lo, hi = int(clamped.min()), int(clamped.max())
+    return lo == hi, (lo if lo == hi else width_cap)
+
+
 def _bucket(n: int, floor: int = 256) -> int:
     """Round up to the shape bucket (power of two) to bound recompiles."""
     b = floor
@@ -101,13 +112,21 @@ def _hash_to_partitions(key_mat: jnp.ndarray, hash_lengths: jnp.ndarray,
 
 
 def _lsd_passes(partitions: jnp.ndarray, lanes: jnp.ndarray,
-                lengths: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                lengths: jnp.ndarray,
+                skip_length_pass: bool = False
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Traced body shared by the fused kernels: stable LSD passes by
-    (partition, lanes..., clamped length)."""
+    (partition, lanes..., clamped length).
+
+    skip_length_pass: set when every key in the span has the same length —
+    the pass would be an identity reorder (fixed-width key workloads save a
+    full sort pass).  The partition pass always runs: it doubles as the
+    padding separator (pad rows carry partition MAX)."""
     n = partitions.shape[0]
     perm = jnp.arange(n, dtype=jnp.int32)
-    _, perm = jax.lax.sort((lengths.astype(jnp.uint32), perm),
-                           dimension=0, is_stable=True, num_keys=1)
+    if not skip_length_pass:
+        _, perm = jax.lax.sort((lengths.astype(jnp.uint32), perm),
+                               dimension=0, is_stable=True, num_keys=1)
     for i in range(lanes.shape[1] - 1, -1, -1):
         _, perm = jax.lax.sort((lanes[:, i][perm], perm),
                                dimension=0, is_stable=True, num_keys=1)
@@ -117,21 +136,25 @@ def _lsd_passes(partitions: jnp.ndarray, lanes: jnp.ndarray,
     return sorted_parts.astype(jnp.int32), perm
 
 
-@functools.partial(jax.jit, static_argnames=("num_partitions",))
+@functools.partial(jax.jit,
+                   static_argnames=("num_partitions", "skip_length_pass"))
 def _fused_hash_sort(key_mat: jnp.ndarray, hash_lengths: jnp.ndarray,
                      lanes: jnp.ndarray, sort_lengths: jnp.ndarray,
-                     num_partitions: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                     num_partitions: int,
+                     skip_length_pass: bool = False
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One dispatch: full-key FNV hash-partition + LSD sort.  Fusing all
     passes into a single XLA program matters on TPU: per-dispatch latency
     (host<->device round trips) would otherwise dominate small spans."""
     partitions = _hash_to_partitions(key_mat, hash_lengths, num_partitions)
-    return _lsd_passes(partitions, lanes, sort_lengths)
+    return _lsd_passes(partitions, lanes, sort_lengths, skip_length_pass)
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("skip_length_pass",))
 def _fused_sort(partitions: jnp.ndarray, lanes: jnp.ndarray,
-                lengths: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    return _lsd_passes(partitions, lanes, lengths)
+                lengths: jnp.ndarray, skip_length_pass: bool = False
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    return _lsd_passes(partitions, lanes, lengths, skip_length_pass)
 
 
 def hash_sort_span(key_mat: np.ndarray, hash_lengths: np.ndarray,
@@ -144,6 +167,11 @@ def hash_sort_span(key_mat: np.ndarray, hash_lengths: np.ndarray,
         return np.zeros(0, np.int32), np.zeros(0, np.int32)
     width_cap = lanes.shape[1] * 4 + 1
     slen = np.minimum(lengths.astype(np.int64), width_cap)
+    # uniform clamped lengths over REAL rows: the length pass would be an
+    # identity reorder — skip a full sort pass.  Pad rows are irrelevant to
+    # every pass but the final partition one (which sweeps them to the tail
+    # as a block), so they are padded with the same uniform value.
+    uniform, pad_len = uniform_clamped_lengths(slen, width_cap)
     nb = _bucket(n)
     hash_lengths = hash_lengths.astype(np.int32)
     if nb != n:
@@ -152,12 +180,13 @@ def hash_sort_span(key_mat: np.ndarray, hash_lengths: np.ndarray,
         hash_lengths = np.pad(hash_lengths, (0, pad), constant_values=-1)
         lanes = np.pad(lanes, ((0, pad), (0, 0)),
                        constant_values=np.uint32(0xFFFFFFFF))
-        slen = np.pad(slen, (0, pad), constant_values=width_cap)
+        slen = np.pad(slen, (0, pad), constant_values=pad_len)
     sp, perm = _fused_hash_sort(jnp.asarray(key_mat),
                                 jnp.asarray(hash_lengths),
                                 jnp.asarray(lanes),
                                 jnp.asarray(slen.astype(np.uint32)),
-                                num_partitions)
+                                num_partitions,
+                                skip_length_pass=uniform)
     sp = np.asarray(sp)
     perm = np.asarray(perm)
     if nb != n:

@@ -18,19 +18,24 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tez_tpu.ops.device import _bucket, _hash_to_partitions, _lsd_passes
+from tez_tpu.ops.device import (_bucket, _hash_to_partitions,
+                                _lsd_passes,
+                                uniform_clamped_lengths)
 
 
-@functools.partial(jax.jit, static_argnames=("num_partitions",))
+@functools.partial(jax.jit,
+                   static_argnames=("num_partitions", "skip_length_pass"))
 def _fused_pipeline(key_mat: jnp.ndarray, hash_lengths: jnp.ndarray,
                     lanes: jnp.ndarray, sort_lengths: jnp.ndarray,
-                    vals: jnp.ndarray, num_partitions: int
+                    vals: jnp.ndarray, num_partitions: int,
+                    skip_length_pass: bool = False
                     ) -> Tuple[jnp.ndarray, ...]:
     """hash-partition + LSD (partition, lanes, length) sort + payload gather,
     one dispatch, everything stays in HBM.  Hash and sort bodies are the
     shared device.py helpers — one implementation for every kernel."""
     partitions = _hash_to_partitions(key_mat, hash_lengths, num_partitions)
-    sorted_parts, perm = _lsd_passes(partitions, lanes, sort_lengths)
+    sorted_parts, perm = _lsd_passes(partitions, lanes, sort_lengths,
+                                     skip_length_pass)
     out_lanes = lanes[perm]
     out_vals = vals[perm]
     # per-partition row counts (for the partition index) on device
@@ -41,12 +46,20 @@ def _fused_pipeline(key_mat: jnp.ndarray, hash_lengths: jnp.ndarray,
 
 
 def device_shuffle_sort(lanes, lengths, vals, key_mat, hash_lengths,
-                        num_partitions: int):
+                        num_partitions: int, uniform_length=None):
     """Device-resident pipeline over already-device (or host) arrays.
-    Returns device arrays (sorted_partitions, lanes, vals, perm, counts)."""
+    Returns device arrays (sorted_partitions, lanes, vals, perm, counts).
+
+    uniform_length: pass True/False when the caller already knows (keeps the
+    lengths array device-resident); None = detect from a host array."""
     n = int(lanes.shape[0])
     nb = _bucket(n)
     width_cap = lanes.shape[1] * 4 + 1
+    if uniform_length is None:
+        uniform = isinstance(lengths, np.ndarray) and \
+            uniform_clamped_lengths(lengths, width_cap)[0]
+    else:
+        uniform = bool(uniform_length)
     if nb != n:
         pad = nb - n
         key_mat = jnp.pad(key_mat, ((0, pad), (0, 0)), constant_values=255)
@@ -59,4 +72,4 @@ def device_shuffle_sort(lanes, lengths, vals, key_mat, hash_lengths,
     return _fused_pipeline(jnp.asarray(key_mat),
                            jnp.asarray(hash_lengths, dtype=jnp.int32),
                            jnp.asarray(lanes), slen, jnp.asarray(vals),
-                           num_partitions)
+                           num_partitions, skip_length_pass=uniform)

@@ -104,9 +104,11 @@ class DeviceSorter:
                  counters: Optional[TezCounters] = None,
                  combiner: Optional[Combiner] = None,
                  partitioner: str = "hash",
-                 mem_budget_bytes: Optional[int] = None):
+                 mem_budget_bytes: Optional[int] = None,
+                 engine: str = "device"):
         self.num_partitions = num_partitions
         self.key_width = max(4, key_width)
+        self.engine = engine   # 'device' (TPU kernels) | 'host' (np.lexsort)
         self.span_budget = span_budget_bytes
         self.spill_dir = spill_dir
         self.counters = counters or TezCounters()
@@ -163,12 +165,25 @@ class DeviceSorter:
             hash_w = 1 << max(2, (wmax - 1).bit_length())
             hmat, hlens = pad_to_matrix(batch.key_bytes, batch.key_offsets,
                                         hash_w)
-            sorted_partitions, perm = device.hash_sort_span(
-                hmat, hlens, lanes, lengths, self.num_partitions)
+            if self.engine == "host":
+                from tez_tpu.ops.host_sort import (host_hash_partition,
+                                                   host_sort_run)
+                partitions = host_hash_partition(hmat, hlens,
+                                                 self.num_partitions)
+                sorted_partitions, perm = host_sort_run(partitions, lanes,
+                                                        lengths)
+            else:
+                sorted_partitions, perm = device.hash_sort_span(
+                    hmat, hlens, lanes, lengths, self.num_partitions)
         else:
             partitions = np.zeros(batch.num_records, dtype=np.int32)
-            sorted_partitions, perm = device.sort_run(partitions, lanes,
-                                                      lengths)
+            if self.engine == "host":
+                from tez_tpu.ops.host_sort import host_sort_run
+                sorted_partitions, perm = host_sort_run(partitions, lanes,
+                                                        lengths)
+            else:
+                sorted_partitions, perm = device.sort_run(partitions, lanes,
+                                                          lengths)
         sorted_batch = batch.take(perm)
         refinement = _exact_tiebreak(
             sorted_batch, sorted_partitions, lanes[perm], self.key_width)
@@ -236,7 +251,7 @@ class DeviceSorter:
         if len(runs) == 1:
             return runs[0]
         merged = merge_sorted_runs(runs, self.num_partitions, self.key_width,
-                                   counters=self.counters)
+                                   counters=self.counters, engine=self.engine)
         if self.combiner is not None:
             merged = self.combiner(merged)
         return merged
@@ -244,7 +259,8 @@ class DeviceSorter:
 
 def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
                       key_width: int,
-                      counters: Optional[TezCounters] = None) -> Run:
+                      counters: Optional[TezCounters] = None,
+                      engine: str = "device") -> Run:
     """k-way merge of partition-sorted runs (TezMerger analog): concatenate,
     stable device sort by (partition, key prefix), host tie-break."""
     t0 = time.time()
@@ -255,7 +271,11 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
         if runs else np.zeros(0, np.int32)
     mat, lengths = pad_to_matrix(batch.key_bytes, batch.key_offsets, key_width)
     lanes = matrix_to_lanes(mat)
-    sorted_partitions, perm = device.sort_run(partitions, lanes, lengths)
+    if engine == "host":
+        from tez_tpu.ops.host_sort import host_sort_run
+        sorted_partitions, perm = host_sort_run(partitions, lanes, lengths)
+    else:
+        sorted_partitions, perm = device.sort_run(partitions, lanes, lengths)
     sorted_batch = batch.take(perm)
     refinement = _exact_tiebreak(sorted_batch, sorted_partitions,
                                  lanes[perm], key_width)

@@ -80,6 +80,12 @@ class TaskRunner:
     def run(self) -> str:
         """Returns final state string: SUCCEEDED | FAILED | KILLED."""
         start = time.time()
+        from tez_tpu.runtime.diagnostics import (RuntimeStatsUpdater,
+                                                 ThreadDumpHelper)
+        stats = RuntimeStatsUpdater(self.counters)
+        dump_ms = int(self.spec.conf.get("tez.thread.dump.interval.ms", 0))
+        dumper = ThreadDumpHelper(dump_ms,
+                                  label=str(self.spec.attempt_id)).start()
         reporter = threading.Thread(target=self._heartbeat_loop,
                                     name=f"reporter-{self.spec.attempt_id}",
                                     daemon=True)
@@ -100,7 +106,9 @@ class TaskRunner:
                 f"{type(e).__name__}: {e}\n{traceback.format_exc(limit=20)}")
         finally:
             self._done.set()
+            dumper.stop()
             reporter.join(timeout=5)
+        stats.update()
         self.counters.find_counter(TaskCounter.WALL_CLOCK_MILLISECONDS)\
             .set_value(int((time.time() - start) * 1000))
         if state == "SUCCEEDED":

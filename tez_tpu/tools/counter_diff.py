@@ -1,0 +1,44 @@
+"""Compare counters between two DAG runs.
+
+Reference parity: tez-tools counter-diff.  Usage:
+  python -m tez_tpu.tools.counter_diff <history_a.jsonl> <history_b.jsonl>
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict
+
+from tez_tpu.tools.history_parser import parse_jsonl_files
+
+
+def flatten(counters: Dict) -> Dict[str, int]:
+    return {f"{g}.{name}": v for g, cs in counters.items()
+            for name, v in cs.items()}
+
+
+def main() -> int:
+    if len(sys.argv) != 3:
+        print("usage: counter_diff <history_a> <history_b>")
+        return 2
+    runs = []
+    for path in sys.argv[1:]:
+        dags = parse_jsonl_files([path])
+        if not dags:
+            print(f"no DAG in {path}")
+            return 1
+        runs.append(list(dags.values())[-1])
+    a, b = runs
+    fa, fb = flatten(a.counters), flatten(b.counters)
+    print(f"{'counter':60} {'A':>14} {'B':>14} {'delta':>14}")
+    for key in sorted(set(fa) | set(fb)):
+        va, vb = fa.get(key, 0), fb.get(key, 0)
+        if va != vb:
+            print(f"{key:60} {va:14d} {vb:14d} {vb - va:+14d}")
+    print(f"\nA: {a.dag_id} ({a.state}, {a.duration:.2f}s)  "
+          f"B: {b.dag_id} ({b.state}, {b.duration:.2f}s)  "
+          f"wall delta {b.duration - a.duration:+.2f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

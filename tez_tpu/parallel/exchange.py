@@ -52,6 +52,38 @@ def _stable_sort_rows(keys_cols, payload_cols):
     return [c[perm] for c in keys_cols], [p[perm] for p in payload_cols], perm
 
 
+
+def _partition_sort(lanes, values, valid, num_workers):
+    """Shared prologue: hash-partition + stable local sort by
+    (partition, key lanes); invalid rows carry partition == num_workers so
+    they sort to the tail.  Returns (spart, slanes, svalues, svalid)."""
+    n, num_lanes = lanes.shape
+    part = jnp.where(valid, _fnv_lanes(lanes) % num_workers,
+                     jnp.uint32(num_workers))
+    key_cols = [part.astype(jnp.uint32)] + \
+        [lanes[:, i] for i in range(num_lanes)]
+    sorted_keys, sorted_payload, _ = _stable_sort_rows(
+        key_cols, [values, valid.astype(jnp.uint32)])
+    spart = sorted_keys[0]
+    slanes = jnp.stack(sorted_keys[1:], axis=1) if num_lanes else \
+        jnp.zeros((n, 0), jnp.uint32)
+    svalues, svalid = sorted_payload
+    return spart, slanes, svalues, svalid
+
+
+def _merge_received(rlanes, rvals, rvalid):
+    """Shared epilogue: stable sort of the received concatenation by key
+    lanes, validity-major (invalid rows to the tail)."""
+    num_lanes = rlanes.shape[1]
+    key_cols = [jnp.where(rvalid > 0, jnp.uint32(0), jnp.uint32(1))] + \
+        [rlanes[:, i] for i in range(num_lanes)]
+    sorted_keys, sorted_payload, _ = _stable_sort_rows(
+        key_cols, [rvals, rvalid])
+    out_lanes = jnp.stack(sorted_keys[1:], axis=1) if num_lanes else rlanes
+    out_vals, out_valid = sorted_payload
+    return out_lanes, out_vals, out_valid
+
+
 def _shuffle_step_local(lanes: jnp.ndarray, values: jnp.ndarray,
                         valid: jnp.ndarray, num_workers: int,
                         cap: int) -> Tuple[jnp.ndarray, jnp.ndarray,
@@ -61,17 +93,8 @@ def _shuffle_step_local(lanes: jnp.ndarray, values: jnp.ndarray,
     holding this worker's partition, key-sorted, padded to [W*cap], plus a
     per-worker count of rows lost to capacity overflow (must be zero)."""
     n, num_lanes = lanes.shape
-    part = jnp.where(valid, _fnv_lanes(lanes) % num_workers,
-                     jnp.uint32(num_workers))
-    # local sort by (partition, key lanes): padding (part=W) goes last
-    key_cols = [part.astype(jnp.uint32)] + \
-        [lanes[:, i] for i in range(num_lanes)]
-    sorted_keys, sorted_payload, _ = _stable_sort_rows(
-        key_cols, [values, valid.astype(jnp.uint32)])
-    spart = sorted_keys[0]
-    slanes = jnp.stack(sorted_keys[1:], axis=1) if num_lanes else \
-        jnp.zeros((n, 0), jnp.uint32)
-    svalues, svalid = sorted_payload
+    spart, slanes, svalues, svalid = _partition_sort(lanes, values, valid,
+                                                     num_workers)
 
     # scatter rows into the fixed [W, cap] send buffer: row i of partition p
     # goes to slot (p, rank_within_partition(i))
@@ -104,15 +127,9 @@ def _shuffle_step_local(lanes: jnp.ndarray, values: jnp.ndarray,
     # local merge: stable sort of the received concatenation by key lanes
     # (invalid rows carry INVALID lanes -> tail)
     m = num_workers * cap
-    rlanes = recv_lanes.reshape(m, num_lanes)
-    rvals = recv_vals.reshape(m)
-    rvalid = recv_valid.reshape(m)
-    key_cols = [jnp.where(rvalid > 0, jnp.uint32(0), jnp.uint32(1))] + \
-        [rlanes[:, i] for i in range(num_lanes)]
-    sorted_keys, sorted_payload, _ = _stable_sort_rows(
-        key_cols, [rvals, rvalid])
-    out_lanes = jnp.stack(sorted_keys[1:], axis=1) if num_lanes else rlanes
-    out_vals, out_valid = sorted_payload
+    out_lanes, out_vals, out_valid = _merge_received(
+        recv_lanes.reshape(m, num_lanes), recv_vals.reshape(m),
+        recv_valid.reshape(m))
     # overflow signal: valid rows this worker could NOT send (rank >= cap).
     # Zero in correct operation; the caller MUST check it — capacity
     # overflow otherwise means silent data loss (skew handling above this
@@ -121,16 +138,80 @@ def _shuffle_step_local(lanes: jnp.ndarray, values: jnp.ndarray,
     return out_lanes, out_vals, out_valid.astype(jnp.bool_), dropped[None]
 
 
+def _shuffle_step_local_ragged(lanes: jnp.ndarray, values: jnp.ndarray,
+                               valid: jnp.ndarray, num_workers: int,
+                               out_cap: int) -> Tuple[jnp.ndarray, ...]:
+    """Ragged variant: only real rows cross ICI (jax.lax.ragged_all_to_all).
+
+    Offsets choreography: senders lay rows out destination-contiguously
+    (the partition sort), sizes are exchanged with a [W]-int all_to_all,
+    receivers compute exclusive output offsets and send them BACK so each
+    sender knows where its block lands.  TPU-only today (XLA:CPU lacks the
+    ragged-all-to-all thunk), so the padded formulation stays the portable
+    default.
+    """
+    n, num_lanes = lanes.shape
+    spart, slanes, svalues, _ = _partition_sort(lanes, values, valid,
+                                                num_workers)
+
+    raw_sizes = jnp.bincount(
+        jnp.minimum(spart, num_workers).astype(jnp.int32),
+        length=num_workers + 1)[:num_workers].astype(jnp.int32)
+    input_offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32),
+         jnp.cumsum(raw_sizes)[:-1].astype(jnp.int32)])
+    raw_recv = jax.lax.all_to_all(
+        raw_sizes.reshape(num_workers, 1), WORKER_AXIS, 0, 0
+    ).reshape(num_workers).astype(jnp.int32)
+    excl = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32),
+         jnp.cumsum(raw_recv)[:-1].astype(jnp.int32)])
+    output_offsets = jax.lax.all_to_all(
+        excl.reshape(num_workers, 1), WORKER_AXIS, 0, 0
+    ).reshape(num_workers).astype(jnp.int32)
+    # Sender-side overflow clamp: never write past the receiver's out_cap.
+    # Each sender keeps the prefix of its block that fits below the cap
+    # (offsets are the unclamped cumulative, so prefixes tile exactly);
+    # clamped counts are re-exchanged so recv_sizes matches what is sent.
+    send_sizes = jnp.clip(out_cap - output_offsets, 0, raw_sizes)
+    recv_sizes = jax.lax.all_to_all(
+        send_sizes.reshape(num_workers, 1), WORKER_AXIS, 0, 0
+    ).reshape(num_workers).astype(jnp.int32)
+
+    out_lanes = jnp.full((out_cap, num_lanes), INVALID, dtype=jnp.uint32)
+    out_vals = jnp.zeros((out_cap,), dtype=jnp.uint32)
+    out_lanes = jax.lax.ragged_all_to_all(
+        slanes, out_lanes, input_offsets, send_sizes, output_offsets,
+        recv_sizes, axis_name=WORKER_AXIS)
+    out_vals = jax.lax.ragged_all_to_all(
+        svalues, out_vals, input_offsets, send_sizes, output_offsets,
+        recv_sizes, axis_name=WORKER_AXIS)
+    n_recv = jnp.sum(recv_sizes)
+    rvalid = (jnp.arange(out_cap) < n_recv).astype(jnp.uint32)
+
+    final_lanes, final_vals, final_valid = _merge_received(
+        out_lanes, out_vals, rvalid)
+    # overflow signal: rows this worker could not SEND (receiver cap hit)
+    dropped = jnp.sum(raw_sizes - send_sizes).astype(jnp.int32)
+    return final_lanes, final_vals, final_valid.astype(jnp.bool_), \
+        dropped[None]
+
+
 def build_distributed_shuffle(mesh, num_lanes: int, rows_per_worker: int,
-                              cap_per_pair: int):
+                              cap_per_pair: int, ragged: bool = False):
     """Compile the SPMD shuffle step for a mesh.  Returns a jitted function
     f(lanes u32[W*N, L], values u32[W*N], valid bool[W*N]) -> per-worker
     sorted partitions, sharded over the mesh."""
     from jax.experimental.shard_map import shard_map
     num_workers = mesh.devices.size
 
-    body = functools.partial(_shuffle_step_local,
-                             num_workers=num_workers, cap=cap_per_pair)
+    if ragged:
+        body = functools.partial(_shuffle_step_local_ragged,
+                                 num_workers=num_workers,
+                                 out_cap=num_workers * cap_per_pair)
+    else:
+        body = functools.partial(_shuffle_step_local,
+                                 num_workers=num_workers, cap=cap_per_pair)
     smapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),

@@ -1,0 +1,111 @@
+"""Service-plugin SPI tests: a custom TaskSchedulerService slots in behind
+the same seam a TPU-pod/GKE executor would use (the tez-ext-service-tests
+analog, SURVEY.md §4 tier 5), plus memory distributor + prewarm + MRR."""
+import collections
+
+import pytest
+
+from tez_tpu.am.task_scheduler import LocalTaskSchedulerService
+from tez_tpu.client.dag_client import DAGStatusState
+from tez_tpu.client.tez_client import TezClient
+from tez_tpu.common.payload import ProcessorDescriptor
+from tez_tpu.dag.dag import DAG, Vertex
+from tez_tpu.runtime.memory import MemoryDistributor
+
+
+class RecordingScheduler(LocalTaskSchedulerService):
+    """External-service-style scheduler: observes every allocation."""
+
+    def __init__(self, ctx, num_slots):
+        super().__init__(ctx, num_slots)
+        self.scheduled = []
+        self.deallocated = []
+
+    def schedule(self, attempt_id, task_spec, priority):
+        self.scheduled.append((str(attempt_id), priority))
+        super().schedule(attempt_id, task_spec, priority)
+
+    def deallocate(self, attempt_id):
+        self.deallocated.append(str(attempt_id))
+        super().deallocate(attempt_id)
+
+
+def test_custom_task_scheduler_plugin(tmp_staging):
+    c = TezClient.create("t", {"tez.staging-dir": tmp_staging}).start()
+    try:
+        am = c.framework_client.am
+        # swap the scheduler behind the SPI seam before any DAG runs
+        rec = RecordingScheduler(am, am.task_scheduler.num_slots)
+        am.task_scheduler = rec
+        am.scheduler_manager.scheduler = rec
+        dag = DAG.create("d").add_vertex(Vertex.create(
+            "v", ProcessorDescriptor.create(
+                "tez_tpu.library.processors:SleepProcessor",
+                payload={"sleep_ms": 1}), 3))
+        status = c.submit_dag(dag).wait_for_completion(timeout=30)
+        assert status.state is DAGStatusState.SUCCEEDED
+        assert len(rec.scheduled) == 3
+        assert len(rec.deallocated) == 3
+        # priorities follow the DAG scheduler's band assignment
+        assert all(p == 3 for _, p in rec.scheduled)
+    finally:
+        c.stop()
+
+
+def test_prewarm_spins_runners(tmp_staging):
+    c = TezClient.create("t", {"tez.staging-dir": tmp_staging,
+                               "tez.am.local.num-containers": 3}).start()
+    try:
+        c.pre_warm()
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                c.framework_client.am.runner_pool.live_count() < 3:
+            time.sleep(0.05)
+        assert c.framework_client.am.runner_pool.live_count() == 3
+    finally:
+        c.stop()
+
+
+def test_weighted_memory_scaling():
+    grants = {}
+    md = MemoryDistributor(budget_bytes=1000)
+    md.budget = 1000  # exact budget for the math below
+    md.request_memory(1000, lambda g: grants.__setitem__("sorted", g),
+                      component_type="PARTITIONED_SORTED_OUTPUT")
+    md.request_memory(1000, lambda g: grants.__setitem__("unsorted", g),
+                      component_type="PARTITIONED_UNSORTED_OUTPUT")
+    md.make_initial_allocations()
+    # 3:1 weights -> sorted gets 750, unsorted 250
+    assert grants["sorted"] == 750
+    assert grants["unsorted"] == 250
+    # under-subscribed: full grants
+    md2 = MemoryDistributor(budget_bytes=10_000)
+    md2.request_memory(100, lambda g: grants.__setitem__("a", g))
+    md2.make_initial_allocations()
+    assert grants["a"] == 100
+
+
+def test_mrr_three_stage(tmp_path, tmp_staging):
+    from tez_tpu.examples import mrr
+    data = tmp_path / "in.txt"
+    rows = {f"k{i:03d}": "v" * (i % 17 + 1) for i in range(120)}
+    data.write_text("".join(f"{k}\t{v}\n" for k, v in rows.items()))
+    out = str(tmp_path / "out")
+    state = mrr.run([str(data)], out,
+                    conf={"tez.staging-dir": tmp_staging},
+                    map_parallelism=2, r1_parallelism=2, r2_parallelism=1)
+    assert state == "SUCCEEDED"
+    import os
+    got = {}
+    order_ok = True
+    prev = -1
+    for f in sorted(os.listdir(out)):
+        if f.startswith("part-"):
+            for line in open(os.path.join(out, f)):
+                k, total = line.rstrip("\n").split("\t")
+                got[k] = int(total)
+                order_ok = order_ok and int(total) >= prev
+                prev = int(total)
+    assert got == {k: len(v) for k, v in rows.items()}
+    assert order_ok

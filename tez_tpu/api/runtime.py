@@ -95,7 +95,10 @@ class TaskContext(abc.ABC):
 
     @abc.abstractmethod
     def request_initial_memory(self, size: int,
-                               callback: "MemoryUpdateCallback | None") -> None: ...
+                               callback: "MemoryUpdateCallback | None",
+                               component_type: str = "OTHER") -> None:
+        """Ask for task memory; component_type weights oversubscription
+        scaling (see runtime.memory.DEFAULT_WEIGHTS)."""
 
     @abc.abstractmethod
     def notify_progress(self) -> None: ...

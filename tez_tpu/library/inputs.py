@@ -172,7 +172,8 @@ class OrderedGroupedKVInput(LogicalInput):
                                        16))
         self.table = ShuffleFetchTable(ctx, self.num_physical_inputs,
                                        my_partition=ctx.task_index)
-        ctx.request_initial_memory(0, None)
+        ctx.request_initial_memory(0, None,
+                           component_type="SORTED_MERGED_INPUT")
         self._merged: Optional[KVBatch] = None
         return []
 

@@ -83,7 +83,8 @@ class OrderedPartitionedKVOutput(LogicalOutput):
             combiner=_COMBINERS.get(combiner_name),
             engine=engine,
         )
-        ctx.request_initial_memory(sort_mb << 20, None)
+        ctx.request_initial_memory(sort_mb << 20, None,
+                           component_type="PARTITIONED_SORTED_OUTPUT")
         self._spills_sent = 0
         if self._pipelined:
             self.sorter.on_spill = self._ship_spill

@@ -153,7 +153,8 @@ class UnorderedPartitionedKVOutput(LogicalOutput):
             ctx, "tez.runtime.enable.final-merge.in.output", True))
         self.writer_impl = UnorderedPartitionedWriter(
             self.num_physical_outputs, buffer_mb << 20, ctx.counters)
-        ctx.request_initial_memory(buffer_mb << 20, None)
+        ctx.request_initial_memory(buffer_mb << 20, None,
+                           component_type="PARTITIONED_UNSORTED_OUTPUT")
         self.service = local_shuffle_service()
         self.host = ctx.get_service_provider_metadata("shuffle") or \
             {"host": "local", "port": 0}

@@ -84,9 +84,11 @@ class _BaseContext:
 
     # -- memory / progress ---------------------------------------------------
     def request_initial_memory(self, size: int,
-                               callback: "MemoryUpdateCallback | None") -> None:
+                               callback: "MemoryUpdateCallback | None",
+                               component_type: str = "OTHER") -> None:
         cb = callback.memory_assigned if callback is not None else None
-        self._runner.memory.request_memory(size, cb, requester=repr(self))
+        self._runner.memory.request_memory(size, cb, requester=repr(self),
+                                           component_type=component_type)
 
     def notify_progress(self) -> None:
         self._runner.check_killed()

@@ -64,14 +64,26 @@ class ThreadDumpHelper:
 
 class RuntimeStatsUpdater:
     """Snapshot-based CPU/GC counters for one task (reference:
-    TaskCounterUpdater + GcTimeUpdater)."""
+    TaskCounterUpdater + GcTimeUpdater).  GC pause time is measured for
+    real via gc callbacks (start/stop timestamps), matching the reference
+    counter's milliseconds unit."""
 
     def __init__(self, counters: TezCounters):
         self.counters = counters
         self._t0 = time.process_time()
-        self._gc0 = sum(s.get("collections", 0) for s in gc.get_stats())
+        self._gc_ns = 0
+        self._gc_start: Optional[int] = None
+        self._cb = self._on_gc
+        gc.callbacks.append(self._cb)
 
-    def update(self) -> None:
+    def _on_gc(self, phase: str, info: dict) -> None:
+        if phase == "start":
+            self._gc_start = time.monotonic_ns()
+        elif phase == "stop" and self._gc_start is not None:
+            self._gc_ns += time.monotonic_ns() - self._gc_start
+            self._gc_start = None
+
+    def update(self, final: bool = False) -> None:
         cpu_ms = int((time.process_time() - self._t0) * 1000)
         self.counters.find_counter(TaskCounter.CPU_MILLISECONDS)\
             .set_value(cpu_ms)
@@ -82,6 +94,10 @@ class RuntimeStatsUpdater:
                 .set_value(usage.ru_maxrss * 1024)
         except ImportError:
             pass
-        gc_n = sum(s.get("collections", 0) for s in gc.get_stats())
         self.counters.find_counter(TaskCounter.GC_TIME_MILLIS)\
-            .set_value(gc_n - self._gc0)   # collection count proxy
+            .set_value(self._gc_ns // 1_000_000)
+        if final:
+            try:
+                gc.callbacks.remove(self._cb)
+            except ValueError:
+                pass

@@ -24,40 +24,91 @@ DEFAULT_TASK_BUDGET = 2 << 30
 RESERVE_FRACTION = 0.05
 
 
+#: Component-type weights for oversubscription scaling (reference:
+#: WeightedScalingMemoryDistributor ratios — sorted outputs and merged
+#: inputs get proportionally more than unsorted buffers).
+DEFAULT_WEIGHTS = {
+    "PARTITIONED_SORTED_OUTPUT": 3,
+    "PARTITIONED_UNSORTED_OUTPUT": 1,
+    "SORTED_MERGED_INPUT": 3,
+    "UNSORTED_INPUT": 1,
+    "PROCESSOR": 1,
+    "OTHER": 1,
+}
+
+
 @dataclasses.dataclass
 class _Request:
     requester: str
     requested: int
     callback: Optional[Callable[[int], None]]
+    component_type: str = "OTHER"
     granted: int = 0
 
 
 class MemoryDistributor:
-    def __init__(self, budget_bytes: int = DEFAULT_TASK_BUDGET):
+    def __init__(self, budget_bytes: int = DEFAULT_TASK_BUDGET,
+                 weights: Optional[dict] = None):
         self.budget = int(budget_bytes * (1 - RESERVE_FRACTION))
+        self.weights = weights or DEFAULT_WEIGHTS
         self._requests: List[_Request] = []
         self._allocated = False
 
     def request_memory(self, size: int, callback: Optional[Callable[[int], None]],
-                       requester: str = "") -> None:
+                       requester: str = "",
+                       component_type: str = "OTHER") -> None:
         assert not self._allocated, "requests closed after allocation"
-        self._requests.append(_Request(requester, int(size), callback))
+        self._requests.append(_Request(requester, int(size), callback,
+                                       component_type))
 
     def make_initial_allocations(self) -> None:
-        """Scale every request proportionally when oversubscribed
-        (reference: MemoryDistributor.makeInitialAllocations:120)."""
+        """Scale requests to fit the budget when oversubscribed, weighted by
+        component type (reference: MemoryDistributor.makeInitialAllocations
+        :120 + WeightedScalingMemoryDistributor)."""
         total = sum(r.requested for r in self._requests)
-        scale = 1.0 if total <= self.budget or total == 0 else \
-            self.budget / total
-        for r in self._requests:
-            r.granted = int(r.requested * scale)
+        if total <= self.budget or total == 0:
+            for r in self._requests:
+                r.granted = r.requested
+                if r.callback is not None:
+                    r.callback(r.granted)
+            self._allocated = True
+            return
+        weighted = [(r, self.weights.get(r.component_type, 1))
+                    for r in self._requests]
+        # iterative weighted fill: capped requests release their surplus to
+        # the still-unmet ones (few components per task, so 2-3 rounds)
+        remaining = self.budget
+        pending = list(weighted)
+        grants = {id(r): 0 for r, _ in weighted}
+        while pending and remaining > 0:
+            total_weight = sum(w * (r.requested - grants[id(r)])
+                               for r, w in pending)
+            if total_weight <= 0:
+                break
+            next_pending = []
+            progressed = False
+            for r, w in pending:
+                need = r.requested - grants[id(r)]
+                share = int(remaining * (w * need) / total_weight)
+                give = min(need, share)
+                if give > 0:
+                    grants[id(r)] += give
+                    progressed = True
+                if grants[id(r)] < r.requested:
+                    next_pending.append((r, w))
+            spent = sum(grants.values())
+            remaining = self.budget - spent
+            if not progressed:
+                break
+            pending = next_pending
+        for r, _ in weighted:
+            r.granted = grants[id(r)]
             if r.callback is not None:
                 r.callback(r.granted)
         self._allocated = True
-        if scale < 1.0:
-            log.info("memory oversubscribed: scaled %d requests by %.2f "
-                     "(asked %d, budget %d)", len(self._requests), scale,
-                     total, self.budget)
+        log.info("memory oversubscribed: weighted-scaled %d requests "
+                 "(asked %d, budget %d)", len(self._requests), total,
+                 self.budget)
 
     def total_granted(self) -> int:
         return sum(r.granted for r in self._requests)

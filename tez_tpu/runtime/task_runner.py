@@ -108,7 +108,7 @@ class TaskRunner:
             self._done.set()
             dumper.stop()
             reporter.join(timeout=5)
-        stats.update()
+        stats.update(final=True)
         self.counters.find_counter(TaskCounter.WALL_CLOCK_MILLISECONDS)\
             .set_value(int((time.time() - start) * 1000))
         if state == "SUCCEEDED":

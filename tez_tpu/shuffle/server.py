@@ -68,8 +68,8 @@ class _Handler(socketserver.StreamRequestHandler):
         sig = bytes.fromhex(req.get("hmac", ""))
         if not server.secrets.verify_hash(
                 sig, f"{path}|{spill}|{lo}".encode()):
-            self._reply({"status": "forbidden"}, [])
-            server.auth_failures += 1
+            server.auth_failures += 1   # count BEFORE replying (clients may
+            self._reply({"status": "forbidden"}, [])  # observe immediately)
             return
         try:
             blobs = [

@@ -1,0 +1,87 @@
+"""Standalone AM + remote client: the full cross-process control plane
+(client -> AM over DAGClientServer, AM -> runners over the umbilical)."""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tez_tpu.client.dag_client import DAGStatusState
+from tez_tpu.client.tez_client import TezClient
+from tez_tpu.common.payload import ProcessorDescriptor
+from tez_tpu.common.security import JobTokenSecretManager
+from tez_tpu.dag.dag import DAG, Vertex
+
+
+@pytest.fixture()
+def standalone_am(tmp_path):
+    token = JobTokenSecretManager().secret.hex()
+    env = dict(os.environ)
+    env["TEZ_TPU_JOB_TOKEN"] = token
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tez_tpu.am.client_server",
+         "--staging-dir", str(tmp_path / "stg"),
+         "--num-containers", "2"],
+        env=env, stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline().strip()
+    assert line.startswith("READY "), line
+    port = int(line.split()[1])
+    yield port, token
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def test_remote_client_runs_dag_on_standalone_am(standalone_am):
+    port, token = standalone_am
+    client = TezClient.create("remote", {
+        "tez.framework.mode": "remote",
+        "tez.am.address": f"127.0.0.1:{port}",
+        "tez.job.token": token,
+    }).start()
+    try:
+        dag = DAG.create("remote-dag").add_vertex(Vertex.create(
+            "v", ProcessorDescriptor.create(
+                "tez_tpu.library.processors:SleepProcessor",
+                payload={"sleep_ms": 1}), 3))
+        status = client.submit_dag(dag).wait_for_completion(timeout=60)
+        assert status.state is DAGStatusState.SUCCEEDED
+        assert status.vertex_status["v"].progress.succeeded_task_count == 3
+    finally:
+        client.stop()
+
+
+def test_remote_client_bad_token_rejected(standalone_am):
+    port, _ = standalone_am
+    bad = TezClient.create("bad", {
+        "tez.framework.mode": "remote",
+        "tez.am.address": f"127.0.0.1:{port}",
+        "tez.job.token": JobTokenSecretManager().secret.hex(),
+    })
+    with pytest.raises(PermissionError):
+        bad.start()
+
+
+def test_remote_kill(standalone_am):
+    port, token = standalone_am
+    client = TezClient.create("remote", {
+        "tez.framework.mode": "remote",
+        "tez.am.address": f"127.0.0.1:{port}",
+        "tez.job.token": token,
+    }).start()
+    try:
+        dag = DAG.create("tokill").add_vertex(Vertex.create(
+            "v", ProcessorDescriptor.create(
+                "tez_tpu.library.processors:SleepProcessor",
+                payload={"sleep_ms": 30_000}), 2))
+        dc = client.submit_dag(dag)
+        time.sleep(0.5)
+        dc.try_kill_dag("remote kill")
+        status = dc.wait_for_completion(timeout=30)
+        assert status.state is DAGStatusState.KILLED
+    finally:
+        client.stop()

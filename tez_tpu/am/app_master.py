@@ -53,7 +53,9 @@ class DAGAppMaster:
         self.scheduler_manager = TaskSchedulerManager(self, self.task_scheduler)
         self.task_comm = TaskCommunicatorManager(self)
         from tez_tpu.common.security import JobTokenSecretManager
-        self.secrets = JobTokenSecretManager()
+        token_hex = conf.get("tez.job.token", "")
+        self.secrets = JobTokenSecretManager(
+            bytes.fromhex(token_hex) if token_hex else None)
         self.umbilical_server = None
         if conf.get(C.RUNNER_MODE) == "subprocess":
             from tez_tpu.am.launcher import SubprocessRunnerPool
@@ -203,6 +205,12 @@ class DAGAppMaster:
 
     def total_slots(self) -> int:
         return self.task_scheduler.total_slots()
+
+    def prewarm(self) -> None:
+        """Spin runners up before the first DAG (reference: TezClient
+        preWarm:897 submitting a pre-warm DAG; the runner-pool model just
+        needs the pool filled)."""
+        self.ensure_runners(self.total_slots())
 
     def ensure_runners(self, backlog: int) -> None:
         self.runner_pool.ensure_runners(backlog)

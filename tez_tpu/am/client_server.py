@@ -1,0 +1,140 @@
+"""Client <-> AM control transport: the DAGClientAMProtocol analog.
+
+Reference parity: tez-dag DAGClientServer.java:48 + DAGClientHandler serving
+DAGClientAMProtocol.proto:100-108 (submitDAG, getDAGStatus, tryKillDAG,
+shutdownSession, getWebUIAddress) — here a token-authenticated socket server
+in front of DAGAppMaster, so clients on other hosts can submit and monitor
+DAGs (the reference's ZK-standalone mode with a well-known address instead
+of a ZK registry).
+"""
+from __future__ import annotations
+
+import logging
+import socketserver
+import threading
+from typing import Any, Optional
+
+from tez_tpu.am.umbilical_server import (_recv_msg, _send_msg,
+                                          authenticate_stream)
+from tez_tpu.common.security import JobTokenSecretManager
+
+log = logging.getLogger(__name__)
+
+_METHODS = frozenset({"submit_dag", "dag_status", "kill_dag", "wait_for_dag",
+                      "web_ui_address", "shutdown_session", "prewarm"})
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        server = self.server
+        am = server.am                   # type: ignore[attr-defined]
+        secrets = server.secrets         # type: ignore[attr-defined]
+        try:
+            if not authenticate_stream(self.rfile, self.wfile, secrets,
+                                       b"client-hello"):
+                return
+            while True:
+                method, args, kwargs = _recv_msg(self.rfile)
+                if method not in _METHODS:
+                    _send_msg(self.wfile, (False, f"no method {method}"))
+                    continue
+                try:
+                    if method == "web_ui_address":
+                        result = am.web_ui.url if am.web_ui else None
+                    elif method == "shutdown_session":
+                        result = None
+                        def _shutdown():
+                            am.stop()
+                            ev = getattr(server, "shutdown_event", None)
+                            if ev is not None:
+                                ev.set()
+                        threading.Thread(target=_shutdown,
+                                         daemon=True).start()
+                    else:
+                        result = getattr(am, method)(*args, **kwargs)
+                    _send_msg(self.wfile, (True, result))
+                except BaseException as e:  # noqa: BLE001
+                    try:
+                        _send_msg(self.wfile, (False, e))
+                    except Exception:  # noqa: BLE001 — unpicklable
+                        _send_msg(self.wfile, (False, RuntimeError(repr(e))))
+        except (ConnectionError, EOFError, Exception):  # noqa: BLE001 —
+            # malformed input must never kill the server loop with a
+            # traceback; the connection just closes
+            return
+
+
+class DAGClientServer:
+    def __init__(self, am: Any, secrets: JobTokenSecretManager,
+                 host: str = "127.0.0.1", port: int = 0):
+        self._tcp = socketserver.ThreadingTCPServer((host, port), _Handler)
+        self._tcp.daemon_threads = True
+        self._tcp.am = am                # type: ignore[attr-defined]
+        self._tcp.secrets = secrets      # type: ignore[attr-defined]
+        self.shutdown_event = threading.Event()
+        self._tcp.shutdown_event = self.shutdown_event  # type: ignore
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True, name="dag-client-server")
+
+    @property
+    def port(self) -> int:
+        return self._tcp.server_address[1]
+
+    def start(self) -> "DAGClientServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+
+def main() -> int:
+    """Standalone AM: python -m tez_tpu.am.client_server --port P
+    [--umbilical-mode subprocess] with TEZ_TPU_JOB_TOKEN in the env.
+
+    Prints 'READY <client-port>' once accepting submissions (reference:
+    standalone AM registering its address for clients to find).
+    """
+    import argparse
+    import os
+    import sys
+    from tez_tpu.am.app_master import DAGAppMaster
+    from tez_tpu.common import config as C
+    from tez_tpu.common.ids import new_app_id
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--bind-host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--runner-mode", default="threads")
+    parser.add_argument("--num-containers", type=int, default=0)
+    parser.add_argument("--staging-dir", default="/tmp/tez-tpu-staging")
+    args = parser.parse_args()
+    token = os.environ.get("TEZ_TPU_JOB_TOKEN", "")
+    if not token:
+        print("TEZ_TPU_JOB_TOKEN env var required", file=sys.stderr)
+        return 2
+    logging.basicConfig(level=os.environ.get("TEZ_TPU_LOG", "INFO"))
+    conf = C.TezConfiguration({
+        "tez.staging-dir": args.staging_dir,
+        "tez.runner.mode": args.runner_mode,
+        "tez.am.local.num-containers": args.num_containers,
+        "tez.am.umbilical.bind-host": args.bind_host,
+        "tez.job.token": token,
+    })
+    am = DAGAppMaster(new_app_id(), conf)
+    am.start()
+    server = DAGClientServer(am, am.secrets, host=args.bind_host,
+                             port=args.port).start()
+    print(f"READY {server.port}", flush=True)
+    try:
+        server.shutdown_event.wait()   # set by shutdown_session (or Ctrl-C)
+    except KeyboardInterrupt:
+        am.stop()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -7,11 +7,13 @@ orchestrator process and out-of-process runners (TezChild JVMs there, runner
 processes here; a multi-host deployment points runners at the AM host over
 DCN).
 
-Wire format: job-token handshake, then length-prefixed pickled
-(method, args) requests / (ok, payload) responses.  Pickle is acceptable on
-this channel because both ends are the framework's own trusted processes
-inside one job (the reference's Writable RPC makes the same assumption);
-the handshake rejects foreign connections.
+Wire format: a RAW 32-byte HMAC handshake (no deserialization of untrusted
+bytes before authentication), then length-prefixed pickled (method, args)
+requests / (ok, payload) responses.  Pickle is acceptable on the
+post-handshake channel because both ends are the framework's own trusted
+processes inside one job holding the job token (the reference's Writable
+RPC makes the same assumption); unauthenticated peers never reach the
+unpickler.
 """
 from __future__ import annotations
 
@@ -48,19 +50,42 @@ def _recv_msg(rfile: Any) -> Any:
     return pickle.loads(blob)
 
 
+def authenticate_stream(rfile, wfile, secrets: JobTokenSecretManager,
+                        purpose: bytes) -> bool:
+    """Server side of the raw handshake: read EXACTLY 32 bytes (the HMAC of
+    `purpose`), compare, reply b"OK"/b"NO".  Nothing is unpickled before
+    this succeeds."""
+    sig = rfile.read(32)
+    if len(sig) != 32 or not secrets.verify_hash(sig, purpose):
+        try:
+            wfile.write(b"NO")
+            wfile.flush()
+        except OSError:
+            pass
+        return False
+    wfile.write(b"OK")
+    wfile.flush()
+    return True
+
+
+def client_handshake(rfile, wfile, secrets: JobTokenSecretManager,
+                     purpose: bytes) -> None:
+    wfile.write(secrets.compute_hash(purpose))
+    wfile.flush()
+    reply = rfile.read(2)
+    if reply != b"OK":
+        raise PermissionError(f"handshake rejected ({reply!r})")
+
+
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self) -> None:
         server = self.server
         comm = server.task_comm          # type: ignore[attr-defined]
         secrets = server.secrets         # type: ignore[attr-defined]
         try:
-            hello = _recv_msg(self.rfile)
-            if not (isinstance(hello, dict) and
-                    secrets.verify_hash(hello.get("sig", b""),
-                                        b"umbilical-hello")):
-                _send_msg(self.wfile, (False, "auth failed"))
+            if not authenticate_stream(self.rfile, self.wfile, secrets,
+                                       b"umbilical-hello"):
                 return
-            _send_msg(self.wfile, (True, "ok"))
             while True:
                 method, args, kwargs = _recv_msg(self.rfile)
                 if method not in _METHODS:
@@ -107,11 +132,11 @@ class UmbilicalServer:
         self._tcp.server_close()
 
 
-class RemoteUmbilical:
-    """Runner-side client with the TaskCommunicatorManager surface that
-    TaskRunner expects.  One connection, requests serialized by a lock
-    (the runner's main + heartbeat threads share it, mirroring the
-    reference's single umbilical RPC proxy per TezChild)."""
+class FramedClient:
+    """Shared wire-protocol client: raw handshake + locked request/reply
+    framing.  One connection; requests serialized by a lock."""
+
+    _purpose = b"override-me"
 
     def __init__(self, host: str, port: int,
                  secrets: JobTokenSecretManager, timeout: float = 60.0):
@@ -119,11 +144,7 @@ class RemoteUmbilical:
         self._rfile = self._sock.makefile("rb")
         self._wfile = self._sock.makefile("wb")
         self._lock = threading.Lock()
-        _send_msg(self._wfile,
-                  {"sig": secrets.compute_hash(b"umbilical-hello")})
-        ok, msg = _recv_msg(self._rfile)
-        if not ok:
-            raise PermissionError(f"umbilical handshake failed: {msg}")
+        client_handshake(self._rfile, self._wfile, secrets, self._purpose)
 
     def _call(self, method: str, *args: Any, **kwargs: Any) -> Any:
         with self._lock:
@@ -134,6 +155,20 @@ class RemoteUmbilical:
                 raise payload
             raise RuntimeError(str(payload))
         return payload
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RemoteUmbilical(FramedClient):
+    """Runner-side client with the TaskCommunicatorManager surface that
+    TaskRunner expects (mirroring the reference's single umbilical RPC proxy
+    per TezChild)."""
+
+    _purpose = b"umbilical-hello"
 
     def get_task(self, container_id: Any, timeout: float = 1.0) -> Any:
         return self._call("get_task", container_id, timeout)
@@ -154,9 +189,3 @@ class RemoteUmbilical:
 
     def task_killed(self, attempt_id: Any, diagnostics: str) -> None:
         self._call("task_killed", attempt_id, diagnostics)
-
-    def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass

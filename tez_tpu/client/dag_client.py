@@ -91,7 +91,8 @@ class DAGClient:
                 diagnostics=d.get("diagnostics", []))
         counters = None
         if with_counters:
-            dag = self._am.current_dag
+            dag = getattr(self._am, "current_dag", None)  # local AM only;
+            # remote proxies report counters via history instead
             if dag is not None and dag.dag_id == self.dag_id:
                 counters = dag.counters
         return DAGStatus(

@@ -1,0 +1,83 @@
+"""Remote framework client: reach a standalone AM over the wire.
+
+Reference parity: FrameworkClient SPI (tez-api FrameworkClient.java:58) —
+the standalone/ZK mode where the AM runs independently and clients connect
+by address (ZkStandaloneClientFrameworkService analog with a well-known
+address instead of a ZK registry).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from tez_tpu.am.umbilical_server import FramedClient
+from tez_tpu.common.security import JobTokenSecretManager
+
+#: Server-side wait slices stay well under the socket timeout so the
+#: request/reply framing never desyncs on long DAGs.
+_WAIT_SLICE = 20.0
+
+
+class RemoteAMProxy(FramedClient):
+    """DAGClient-compatible surface (dag_status/kill_dag/wait_for_dag) plus
+    submit_dag, over the DAGClientServer socket protocol."""
+
+    _purpose = b"client-hello"
+
+    def submit_dag(self, plan: Any) -> Any:
+        return self._call("submit_dag", plan)
+
+    def dag_status(self, dag_id: Any) -> Any:
+        return self._call("dag_status", dag_id)
+
+    def kill_dag(self, dag_id: Any, reason: str = "killed by client") -> None:
+        self._call("kill_dag", dag_id, reason)
+
+    def wait_for_dag(self, dag_id: Any, timeout: Optional[float] = None):
+        """Client-side polling in slices: each server call blocks at most
+        _WAIT_SLICE seconds, far below the socket timeout, so a stalled DAG
+        can never desynchronize the connection."""
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            remaining = _WAIT_SLICE if deadline is None else \
+                min(_WAIT_SLICE, max(0.05, deadline - time.time()))
+            try:
+                return self._call("wait_for_dag", dag_id, remaining)
+            except TimeoutError:
+                if deadline is not None and time.time() >= deadline:
+                    raise
+
+    def prewarm(self) -> None:
+        self._call("prewarm")
+
+    def web_ui_address(self) -> Optional[str]:
+        return self._call("web_ui_address")
+
+    def shutdown_session(self) -> None:
+        self._call("shutdown_session")
+
+
+class RemoteFrameworkClient:
+    """FrameworkClient connecting to an already-running standalone AM."""
+
+    def __init__(self, conf: Any):
+        self.conf = conf
+        self.am: Optional[RemoteAMProxy] = None
+
+    def start(self) -> None:
+        addr = self.conf.get("tez.am.address")
+        token = self.conf.get("tez.job.token", "")
+        if not addr or not token:
+            raise ValueError("remote mode needs tez.am.address and "
+                             "tez.job.token")
+        host, _, port = addr.partition(":")
+        self.am = RemoteAMProxy(host, int(port),
+                                JobTokenSecretManager(bytes.fromhex(token)))
+
+    def stop(self) -> None:
+        if self.am is not None:
+            self.am.close()
+            self.am = None
+
+    def submit_dag(self, plan: Any) -> Any:
+        return self.am.submit_dag(plan)

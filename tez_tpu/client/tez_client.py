@@ -69,21 +69,32 @@ class TezClient:
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "TezClient":
         assert not self._started
-        self.framework_client = LocalFrameworkClient(self.conf)
+        if self.conf.get("tez.framework.mode") == "remote":
+            from tez_tpu.client.remote import RemoteFrameworkClient
+            self.framework_client = RemoteFrameworkClient(self.conf)
+        else:
+            self.framework_client = LocalFrameworkClient(self.conf)
         self.framework_client.start()
         self._started = True
         return self
 
+    #: client-side-only keys never shipped into DAG plans (the job token
+    #: must not leak into the plan -> history journal on disk)
+    _CLIENT_ONLY_KEYS = ("tez.job.token", "tez.am.address",
+                         "tez.framework.mode")
+
     def submit_dag(self, dag: DAG) -> DAGClient:
         assert self._started, "client not started"
-        plan = dag.create_dag_plan(dict(self.conf))
+        conf = {k: v for k, v in self.conf.items()
+                if k not in self._CLIENT_ONLY_KEYS}
+        plan = dag.create_dag_plan(conf)
         dag_id = self.framework_client.submit_dag(plan)
         return DAGClient(self.framework_client.am, dag_id)
 
     def pre_warm(self) -> None:
-        """Spin runners up before the first DAG (reference: preWarm:897)."""
-        am = self.framework_client.am
-        am.ensure_runners(am.total_slots())
+        """Spin runners up before the first DAG (reference: preWarm:897).
+        Works for both local and remote framework clients."""
+        self.framework_client.am.prewarm()
 
     def stop(self) -> None:
         if self._started:

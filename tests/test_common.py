@@ -170,3 +170,26 @@ def test_ids_format():
     assert str(a).startswith("attempt_")
     assert a.dag_id is d
     assert sorted([t.attempt(1), a]) == [a, t.attempt(1)]
+
+
+def test_sharded_dispatcher_preserves_per_entity_order():
+    from tez_tpu.common.dispatcher import ShardedDispatcher
+
+    class KeyedEv(Event):
+        def __init__(self, t, vertex_id, seq):
+            super().__init__(t)
+            self.vertex_id = vertex_id
+            self.seq = seq
+
+    d = ShardedDispatcher(num_shards=4)
+    got = {}
+    d.register(Color, lambda e: got.setdefault(e.vertex_id, []).append(e.seq))
+    d.start()
+    for seq in range(200):
+        for vid in ("a", "b", "c", "d", "e"):
+            d.dispatch(KeyedEv(Color.PING, vid, seq))
+    assert d.await_drained(10)
+    d.stop()
+    for vid, seqs in got.items():
+        assert seqs == list(range(200)), vid
+    assert len(got) == 5

@@ -74,6 +74,14 @@ class OrderedPartitionedKVOutput(LogicalOutput):
         self.val_serde = get_serde(_conf_get(ctx, "tez.runtime.value.class",
                                              "bytes"))
         engine = _conf_get(ctx, "tez.runtime.sorter.class", "device")
+        partitioner_cls = _conf_get(ctx, "tez.runtime.partitioner.class",
+                                    "tez_tpu.library.partitioners:"
+                                    "HashPartitioner")
+        partition_fn = None
+        if partitioner_cls != ("tez_tpu.library.partitioners:"
+                               "HashPartitioner"):
+            from tez_tpu.common.payload import resolve_class
+            partition_fn = resolve_class(partitioner_cls)().get_partition
         self.sorter = DeviceSorter(
             num_partitions=self.num_physical_outputs,
             key_width=key_width,
@@ -82,6 +90,7 @@ class OrderedPartitionedKVOutput(LogicalOutput):
             counters=ctx.counters,
             combiner=_COMBINERS.get(combiner_name),
             engine=engine,
+            partition_fn=partition_fn,
         )
         ctx.request_initial_memory(sort_mb << 20, None,
                            component_type="PARTITIONED_SORTED_OUTPUT")

@@ -105,7 +105,8 @@ class DeviceSorter:
                  combiner: Optional[Combiner] = None,
                  partitioner: str = "hash",
                  mem_budget_bytes: Optional[int] = None,
-                 engine: str = "device"):
+                 engine: str = "device",
+                 partition_fn: Optional[Callable] = None):
         self.num_partitions = num_partitions
         self.key_width = max(4, key_width)
         self.engine = engine   # 'device' (TPU kernels) | 'host' (np.lexsort)
@@ -114,6 +115,9 @@ class DeviceSorter:
         self.counters = counters or TezCounters()
         self.combiner = combiner
         self.partitioner = partitioner
+        #: optional custom per-record partitioner (reference: Partitioner
+        #: SPI via tez.runtime.partitioner.class); overrides the device hash
+        self.partition_fn = partition_fn
         self.mem_budget = mem_budget_bytes or (span_budget_bytes * 2)
         self._span = SpanBuffer()
         self._runs: List[Run | str] = []   # Run (in RAM) or path (spilled)
@@ -156,7 +160,20 @@ class DeviceSorter:
         mat, lengths = pad_to_matrix(batch.key_bytes, batch.key_offsets,
                                      self.key_width)
         lanes = matrix_to_lanes(mat)
-        if self.partitioner == "hash":
+        if self.partition_fn is not None:
+            partitions = np.fromiter(
+                (self.partition_fn(batch.key(i), batch.value(i),
+                                   self.num_partitions)
+                 for i in range(batch.num_records)),
+                dtype=np.int32, count=batch.num_records)
+            if self.engine == "host":
+                from tez_tpu.ops.host_sort import host_sort_run
+                sorted_partitions, perm = host_sort_run(partitions, lanes,
+                                                        lengths)
+            else:
+                sorted_partitions, perm = device.sort_run(partitions, lanes,
+                                                          lengths)
+        elif self.partitioner == "hash":
             # fused single-dispatch kernel: full-key FNV hash (matrix padded
             # to the longest key so every byte is hashed — host-partitioner
             # parity) + (partition, key) LSD sort

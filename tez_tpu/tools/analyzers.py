@@ -184,10 +184,73 @@ class HungTaskAnalyzer(Analyzer):
         return AnalyzerResult(self.name, f"{len(rows)} hung tasks", rows)
 
 
+class TaskConcurrencyAnalyzer(Analyzer):
+    """Peak/avg concurrently-running attempts over time (reference:
+    TaskConcurrencyAnalyzer)."""
+    name = "task_concurrency"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        points = []
+        for a in dag.all_attempts():
+            if a.start_time:
+                points.append((a.start_time, 1))
+                points.append((a.finish_time or dag.finish_time, -1))
+        points.sort()
+        cur = peak = 0
+        area = 0.0
+        last_t = points[0][0] if points else 0
+        for t, d in points:
+            area += cur * (t - last_t)
+            cur += d
+            peak = max(peak, cur)
+            last_t = t
+        span = dag.duration or 1e-9
+        return AnalyzerResult(
+            self.name,
+            f"peak {peak} concurrent attempts, avg {area / span:.1f}",
+            [{"peak": peak, "avg": round(area / span, 2)}])
+
+
+class SlowTaskAttemptAnalyzer(Analyzer):
+    """Slowest attempts across the DAG (reference: SlowTaskIdentifier)."""
+    name = "slow_attempts"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        attempts = sorted(dag.all_attempts(), key=lambda a: -a.duration)[:10]
+        rows = [{"attempt": a.attempt_id, "vertex": a.vertex_name,
+                 "duration_s": round(a.duration, 3), "state": a.state}
+                for a in attempts]
+        return AnalyzerResult(
+            self.name,
+            f"slowest attempt {rows[0]['duration_s']}s in "
+            f"{rows[0]['vertex']}" if rows else "none", rows)
+
+
+class InputOutputRatioAnalyzer(Analyzer):
+    """Bytes out / bytes in per vertex — where data amplifies or reduces
+    (reference: the IO-ratio family of analyzers)."""
+    name = "io_ratio"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        rows = []
+        for v in dag.vertices.values():
+            tc = v.counters.get("TaskCounter", {})
+            inp = tc.get("SHUFFLE_BYTES", 0) or \
+                tc.get("INPUT_SPLIT_LENGTH_BYTES", 0)
+            out = tc.get("OUTPUT_BYTES", 0)
+            if inp or out:
+                rows.append({"vertex": v.name, "in_bytes": inp,
+                             "out_bytes": out,
+                             "ratio": round(out / inp, 3) if inp else None})
+        return AnalyzerResult(self.name, f"{len(rows)} vertices with IO",
+                              rows)
+
+
 ALL_ANALYZERS: Sequence[Analyzer] = (
     CriticalPathAnalyzer(), ShuffleTimeAnalyzer(), SkewAnalyzer(),
     SpillAnalyzer(), SlowestVertexAnalyzer(), ContainerReuseAnalyzer(),
-    SpeculationAnalyzer(), HungTaskAnalyzer())
+    SpeculationAnalyzer(), HungTaskAnalyzer(), TaskConcurrencyAnalyzer(),
+    SlowTaskAttemptAnalyzer(), InputOutputRatioAnalyzer())
 
 
 def analyze_dag(dag: DagInfo,

@@ -211,18 +211,18 @@ def test_split_boundary_no_lost_or_duplicated_lines(tmp_path):
 
 
 def test_custom_partitioner_spi():
-    """tez.runtime.partitioner.class routes records through a user
-    partitioner instead of the device hash."""
+    """Explicit per-record partitions (a custom Partitioner's output over
+    logical keys) route records instead of the device hash."""
     from tez_tpu.ops.sorter import DeviceSorter
 
-    def by_first_byte(key, value, num_partitions):
-        return key[0] % num_partitions
-
-    sorter = DeviceSorter(num_partitions=3, partition_fn=by_first_byte)
+    sorter = DeviceSorter(num_partitions=3)
     pairs = [(bytes([i % 7]) + b"key", b"v") for i in range(60)]
     for k, v in pairs:
-        sorter.write(k, v)
+        sorter.write(k, v, partition=k[0] % 3)
     run = sorter.flush()
+    total = 0
     for p in range(3):
         for k, _ in run.partition(p).iter_pairs():
             assert k[0] % 3 == p
+            total += 1
+    assert total == 60

@@ -189,6 +189,15 @@ class ShardedDispatcher(Dispatcher):
                         for i in range(self.num_shards)]
         for s in self._shards:
             s._handlers = self._handlers   # shared registry
+            # forward errors at delivery time so assigning self.on_error
+            # after start() still reaches the funnel
+            s.on_error = self._forward_error
+
+    def _forward_error(self, exc: BaseException, event: Event) -> None:
+        if self.on_error is not None:
+            self.on_error(exc, event)
+        else:
+            raise exc
 
     def _shard_key(self, event: Event) -> int:
         for attr in ("attempt_id", "task_id", "vertex_id", "dag_id"):
@@ -202,7 +211,6 @@ class ShardedDispatcher(Dispatcher):
 
     def start(self) -> None:
         for s in self._shards:
-            s.on_error = self.on_error
             s.start()
 
     def stop(self) -> None:
@@ -210,4 +218,20 @@ class ShardedDispatcher(Dispatcher):
             s.stop()
 
     def await_drained(self, timeout: float | None = None) -> bool:
-        return all(s.await_drained(timeout) for s in self._shards)
+        """Drained only when a full pass over every shard observes empty —
+        handlers may cascade events ACROSS shards, so one quiet pass is not
+        enough; the shared deadline bounds total wait at `timeout`."""
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            for s in self._shards:
+                remaining = None if deadline is None else \
+                    max(0.0, deadline - _time.monotonic())
+                if not s.await_drained(remaining):
+                    return False
+            # recheck: a cascade may have refilled an earlier shard
+            if all(sh._queue.empty() and sh._in_flight == 0
+                   for sh in self._shards):
+                return True
+            if deadline is not None and _time.monotonic() >= deadline:
+                return False

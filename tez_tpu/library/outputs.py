@@ -39,17 +39,26 @@ def output_path_component(context: Any) -> str:
 
 class _SorterWriter(KeyValuesWriter):
     def __init__(self, sorter: DeviceSorter, key_serde: Any, val_serde: Any,
-                 context: Any):
+                 context: Any, partition_fn: Any = None,
+                 num_partitions: int = 1):
         self.sorter = sorter
         self.key_serde = key_serde
         self.val_serde = val_serde
         self.context = context
+        self.partition_fn = partition_fn
+        self.num_partitions = num_partitions
         self._n = 0
 
     def write(self, key: Any, value: Any) -> None:
+        # a custom Partitioner sees the LOGICAL key/value (pre-serde),
+        # matching the reference Partitioner.getPartition contract
+        partition = None
+        if self.partition_fn is not None:
+            partition = int(self.partition_fn(key, value,
+                                              self.num_partitions))
         k = self.key_serde.to_bytes(key)
         v = self.val_serde.to_bytes(value)
-        self.sorter.write(k, v)
+        self.sorter.write(k, v, partition=partition)
         self.context.counters.increment(TaskCounter.OUTPUT_BYTES,
                                         len(k) + len(v))
         self._n += 1
@@ -77,11 +86,11 @@ class OrderedPartitionedKVOutput(LogicalOutput):
         partitioner_cls = _conf_get(ctx, "tez.runtime.partitioner.class",
                                     "tez_tpu.library.partitioners:"
                                     "HashPartitioner")
-        partition_fn = None
+        self.partition_fn = None
         if partitioner_cls != ("tez_tpu.library.partitioners:"
                                "HashPartitioner"):
             from tez_tpu.common.payload import resolve_class
-            partition_fn = resolve_class(partitioner_cls)().get_partition
+            self.partition_fn = resolve_class(partitioner_cls)().get_partition
         self.sorter = DeviceSorter(
             num_partitions=self.num_physical_outputs,
             key_width=key_width,
@@ -90,7 +99,6 @@ class OrderedPartitionedKVOutput(LogicalOutput):
             counters=ctx.counters,
             combiner=_COMBINERS.get(combiner_name),
             engine=engine,
-            partition_fn=partition_fn,
         )
         ctx.request_initial_memory(sort_mb << 20, None,
                            component_type="PARTITIONED_SORTED_OUTPUT")
@@ -104,7 +112,8 @@ class OrderedPartitionedKVOutput(LogicalOutput):
 
     def get_writer(self) -> Writer:
         return _SorterWriter(self.sorter, self.key_serde, self.val_serde,
-                             self.context)
+                             self.context, partition_fn=self.partition_fn,
+                             num_partitions=self.num_physical_outputs)
 
     def handle_events(self, events: Sequence[TezAPIEvent]) -> None:
         pass

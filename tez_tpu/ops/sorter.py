@@ -67,12 +67,16 @@ class SpanBuffer:
     def __init__(self) -> None:
         self.keys: List[bytes] = []
         self.vals: List[bytes] = []
+        self.parts: List[int] = []     # only when a custom partitioner runs
         self.nbytes = 0
         self.batches: List[KVBatch] = []
 
-    def add(self, key: bytes, value: bytes) -> None:
+    def add(self, key: bytes, value: bytes,
+            partition: Optional[int] = None) -> None:
         self.keys.append(key)
         self.vals.append(value)
+        if partition is not None:
+            self.parts.append(partition)
         self.nbytes += len(key) + len(value) + 16
 
     def add_batch(self, batch: KVBatch) -> None:
@@ -105,8 +109,7 @@ class DeviceSorter:
                  combiner: Optional[Combiner] = None,
                  partitioner: str = "hash",
                  mem_budget_bytes: Optional[int] = None,
-                 engine: str = "device",
-                 partition_fn: Optional[Callable] = None):
+                 engine: str = "device"):
         self.num_partitions = num_partitions
         self.key_width = max(4, key_width)
         self.engine = engine   # 'device' (TPU kernels) | 'host' (np.lexsort)
@@ -115,9 +118,6 @@ class DeviceSorter:
         self.counters = counters or TezCounters()
         self.combiner = combiner
         self.partitioner = partitioner
-        #: optional custom per-record partitioner (reference: Partitioner
-        #: SPI via tez.runtime.partitioner.class); overrides the device hash
-        self.partition_fn = partition_fn
         self.mem_budget = mem_budget_bytes or (span_budget_bytes * 2)
         self._span = SpanBuffer()
         self._runs: List[Run | str] = []   # Run (in RAM) or path (spilled)
@@ -127,8 +127,11 @@ class DeviceSorter:
         self.on_spill: Optional[Callable[[Run, int], None]] = None  # pipelined
 
     # -- write side ----------------------------------------------------------
-    def write(self, key: bytes, value: bytes) -> None:
-        self._span.add(key, value)
+    def write(self, key: bytes, value: bytes,
+              partition: Optional[int] = None) -> None:
+        """partition: pre-computed by a custom Partitioner over the LOGICAL
+        key/value (the serde runs before this layer); None = device hash."""
+        self._span.add(key, value, partition)
         self.counters.increment(TaskCounter.OUTPUT_RECORDS)
         if self._span.nbytes >= self.span_budget:
             self._sort_span()
@@ -144,8 +147,10 @@ class DeviceSorter:
         if self._span.num_records == 0:
             return
         batch = self._span.to_batch()
+        custom_parts = np.asarray(self._span.parts, dtype=np.int32) \
+            if self._span.parts else None
         self._span = SpanBuffer()
-        run = self.sort_batch(batch)
+        run = self.sort_batch(batch, custom_partitions=custom_parts)
         if self.combiner is not None:
             run = self.combiner(run)
         if self.on_spill is not None:
@@ -155,17 +160,16 @@ class DeviceSorter:
             self._store_run(run)
         self.num_spills += 1
 
-    def sort_batch(self, batch: KVBatch) -> Run:
+    def sort_batch(self, batch: KVBatch,
+                   custom_partitions: Optional[np.ndarray] = None) -> Run:
         t0 = time.time()
         mat, lengths = pad_to_matrix(batch.key_bytes, batch.key_offsets,
                                      self.key_width)
         lanes = matrix_to_lanes(mat)
-        if self.partition_fn is not None:
-            partitions = np.fromiter(
-                (self.partition_fn(batch.key(i), batch.value(i),
-                                   self.num_partitions)
-                 for i in range(batch.num_records)),
-                dtype=np.int32, count=batch.num_records)
+        if custom_partitions is not None:
+            assert len(custom_partitions) == batch.num_records, \
+                "custom partitions must cover every record in the span"
+            partitions = custom_partitions
             if self.engine == "host":
                 from tez_tpu.ops.host_sort import host_sort_run
                 sorted_partitions, perm = host_sort_run(partitions, lanes,
@@ -253,8 +257,10 @@ class DeviceSorter:
         if self._span.num_records > 0 and not self._runs:
             # common fast path: everything fit one span
             batch = self._span.to_batch()
+            custom_parts = np.asarray(self._span.parts, dtype=np.int32) \
+                if self._span.parts else None
             self._span = SpanBuffer()
-            run = self.sort_batch(batch)
+            run = self.sort_batch(batch, custom_partitions=custom_parts)
             if self.combiner is not None:
                 run = self.combiner(run)
             self.num_spills += 1

@@ -190,11 +190,16 @@ class TaskConcurrencyAnalyzer(Analyzer):
     name = "task_concurrency"
 
     def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        attempts = [a for a in dag.all_attempts() if a.start_time]
+        # open intervals (in-progress/crashed DAGs) close at the latest
+        # timestamp seen, never at the 0.0 "unset" sentinel
+        horizon = max([dag.finish_time] +
+                      [a.finish_time for a in attempts] +
+                      [a.start_time for a in attempts], default=0.0)
         points = []
-        for a in dag.all_attempts():
-            if a.start_time:
-                points.append((a.start_time, 1))
-                points.append((a.finish_time or dag.finish_time, -1))
+        for a in attempts:
+            points.append((a.start_time, 1))
+            points.append((a.finish_time or horizon, -1))
         points.sort()
         cur = peak = 0
         area = 0.0

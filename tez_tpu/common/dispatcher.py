@@ -51,6 +51,7 @@ class Dispatcher:
         self._stopped = threading.Event()
         self._drained = threading.Condition()
         self._in_flight = 0
+        self._delivered = 0   # monotonically counts handled events
         self.on_error: Callable[[BaseException, Event], None] | None = None
 
     # -- registration -------------------------------------------------------
@@ -88,6 +89,7 @@ class Dispatcher:
         finally:
             with self._drained:
                 self._in_flight -= 1
+                self._delivered += 1
                 if self._in_flight == 0 and self._queue.empty():
                     self._drained.notify_all()
 
@@ -218,20 +220,24 @@ class ShardedDispatcher(Dispatcher):
             s.stop()
 
     def await_drained(self, timeout: float | None = None) -> bool:
-        """Drained only when a full pass over every shard observes empty —
-        handlers may cascade events ACROSS shards, so one quiet pass is not
-        enough; the shared deadline bounds total wait at `timeout`."""
+        """Drained only when TWO consecutive full passes observe every shard
+        empty with no deliveries in between — handlers may cascade events
+        ACROSS shards, so a single quiet pass has a TOCTOU window.  The
+        shared deadline bounds total wait at `timeout`."""
         import time as _time
         deadline = None if timeout is None else _time.monotonic() + timeout
+        prev_gen = -1
         while True:
             for s in self._shards:
                 remaining = None if deadline is None else \
                     max(0.0, deadline - _time.monotonic())
                 if not s.await_drained(remaining):
                     return False
-            # recheck: a cascade may have refilled an earlier shard
-            if all(sh._queue.empty() and sh._in_flight == 0
-                   for sh in self._shards):
+            gen = sum(sh._delivered for sh in self._shards)
+            if gen == prev_gen:
+                # nothing was delivered between two fully-drained passes:
+                # no cascade can be in flight
                 return True
+            prev_gen = gen
             if deadline is not None and _time.monotonic() >= deadline:
                 return False

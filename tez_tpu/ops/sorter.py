@@ -70,9 +70,19 @@ class SpanBuffer:
         self.parts: List[int] = []     # only when a custom partitioner runs
         self.nbytes = 0
         self.batches: List[KVBatch] = []
+        self._partitioned: Optional[bool] = None   # set by the first add
+
+    def _set_mode(self, partitioned: bool) -> None:
+        if self._partitioned is None:
+            self._partitioned = partitioned
+        elif self._partitioned != partitioned:
+            raise ValueError(
+                "cannot mix partitioned and unpartitioned writes in one "
+                "span (custom Partitioner output must cover every record)")
 
     def add(self, key: bytes, value: bytes,
             partition: Optional[int] = None) -> None:
+        self._set_mode(partition is not None)
         self.keys.append(key)
         self.vals.append(value)
         if partition is not None:
@@ -80,6 +90,7 @@ class SpanBuffer:
         self.nbytes += len(key) + len(value) + 16
 
     def add_batch(self, batch: KVBatch) -> None:
+        self._set_mode(False)
         self.batches.append(batch)
         self.nbytes += batch.nbytes
 
@@ -131,6 +142,10 @@ class DeviceSorter:
               partition: Optional[int] = None) -> None:
         """partition: pre-computed by a custom Partitioner over the LOGICAL
         key/value (the serde runs before this layer); None = device hash."""
+        if partition is not None and not 0 <= partition < self.num_partitions:
+            raise ValueError(
+                f"partitioner returned {partition}, valid range is "
+                f"[0, {self.num_partitions})")
         self._span.add(key, value, partition)
         self.counters.increment(TaskCounter.OUTPUT_RECORDS)
         if self._span.nbytes >= self.span_budget:
@@ -143,9 +158,8 @@ class DeviceSorter:
             self._sort_span()
 
     # -- span sort (device) --------------------------------------------------
-    def _sort_span(self) -> None:
-        if self._span.num_records == 0:
-            return
+    def _finalize_span(self) -> Run:
+        """Sort + combine the current span (shared by spill and flush)."""
         batch = self._span.to_batch()
         custom_parts = np.asarray(self._span.parts, dtype=np.int32) \
             if self._span.parts else None
@@ -153,12 +167,18 @@ class DeviceSorter:
         run = self.sort_batch(batch, custom_partitions=custom_parts)
         if self.combiner is not None:
             run = self.combiner(run)
+        self.num_spills += 1
+        return run
+
+    def _sort_span(self) -> None:
+        if self._span.num_records == 0:
+            return
+        run = self._finalize_span()
         if self.on_spill is not None:
             # pipelined shuffle: each span ships immediately
-            self.on_spill(run, self.num_spills)
+            self.on_spill(run, self.num_spills - 1)
         else:
             self._store_run(run)
-        self.num_spills += 1
 
     def sort_batch(self, batch: KVBatch,
                    custom_partitions: Optional[np.ndarray] = None) -> Run:
@@ -256,15 +276,7 @@ class DeviceSorter:
             return None
         if self._span.num_records > 0 and not self._runs:
             # common fast path: everything fit one span
-            batch = self._span.to_batch()
-            custom_parts = np.asarray(self._span.parts, dtype=np.int32) \
-                if self._span.parts else None
-            self._span = SpanBuffer()
-            run = self.sort_batch(batch, custom_partitions=custom_parts)
-            if self.combiner is not None:
-                run = self.combiner(run)
-            self.num_spills += 1
-            return run
+            return self._finalize_span()
         self._sort_span()
         runs = self._load_runs()
         self._runs = []

@@ -161,3 +161,17 @@ def test_history_events_emitted(client, tmp_staging):
               HistoryEventType.TASK_ATTEMPT_STARTED,
               HistoryEventType.DAG_FINISHED):
         assert t in types, f"missing {t}"
+
+
+def test_exception_propagation_to_diagnostics(client):
+    """Task exception text reaches DAGStatus diagnostics (reference:
+    TestExceptionPropagation.java:100)."""
+    v = make_test_vertex("v", 1, payload={"do_fail": True,
+                                          "failing_task_indices": [-1]})
+    status = client.submit_dag(
+        DAG.create("diag").add_vertex(v)).wait_for_completion(timeout=30)
+    assert status.state is DAGStatusState.FAILED
+    text = " ".join(status.diagnostics) + " ".join(
+        status.vertex_status["v"].diagnostics)
+    assert "TestProcessor failing" in text
+    assert "RuntimeError" in text

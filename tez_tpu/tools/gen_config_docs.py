@@ -1,0 +1,44 @@
+"""Generate docs/configuration.md from the config registry.
+
+Reference parity: the reference documents TezConfiguration keys via
+annotations + generated config docs; here the registry IS the source of
+truth (run: python -m tez_tpu.tools.gen_config_docs > docs/configuration.md).
+"""
+from __future__ import annotations
+
+import sys
+
+from tez_tpu.common.config import Scope, TezConfiguration
+
+
+def render() -> str:
+    lines = [
+        "# Configuration reference",
+        "",
+        "Generated from `tez_tpu.common.config` "
+        "(`python -m tez_tpu.tools.gen_config_docs`).  Keys with the "
+        "`tez.runtime.` prefix travel inside edge payloads (set them via "
+        "the edge config builders); everything else is AM/DAG/client scope.",
+        "",
+    ]
+    by_scope = {s: [] for s in Scope}
+    for key in sorted(TezConfiguration.registry(), key=lambda k: k.name):
+        by_scope[key.scope].append(key)
+    for scope in Scope:
+        keys = by_scope[scope]
+        if not keys:
+            continue
+        lines.append(f"## Scope: {scope.value}")
+        lines.append("")
+        lines.append("| key | default | doc |")
+        lines.append("|---|---|---|")
+        for k in keys:
+            default = repr(k.default)
+            doc = (k.doc or "").replace("|", "\\|").replace("\n", " ")
+            lines.append(f"| `{k.name}` | `{default}` | {doc} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    sys.stdout.write(render())

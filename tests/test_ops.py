@@ -226,3 +226,35 @@ def test_custom_partitioner_spi():
             assert k[0] % 3 == p
             total += 1
     assert total == 60
+
+
+def test_multi_pass_merge_factor():
+    """More runs than io.sort.factor merge hierarchically with identical
+    output (TezMerger computeBytesInMerges semantics)."""
+    from tez_tpu.ops.sorter import DeviceSorter, merge_sorted_runs
+    pairs = random_pairs(600, seed=21)
+    runs = []
+    for i in range(0, 600, 60):   # 10 runs
+        s = DeviceSorter(num_partitions=2)
+        for k, v in pairs[i:i + 60]:
+            s.write(k, v)
+        runs.append(s.flush())
+    one_pass = merge_sorted_runs(runs, 2, 16)
+    multi = merge_sorted_runs(runs, 2, 16, merge_factor=3)
+    assert list(one_pass.batch.iter_pairs()) == \
+        list(multi.batch.iter_pairs())
+
+
+def test_async_sortmaster_matches_sync():
+    """Background span sorting produces the same result as inline."""
+    from tez_tpu.ops.sorter import DeviceSorter
+    pairs = random_pairs(2500, seed=22)
+    outs = []
+    for threads in (0, 2):
+        s = DeviceSorter(num_partitions=3, span_budget_bytes=4096,
+                         sort_threads=threads)
+        for k, v in pairs:
+            s.write(k, v)
+        outs.append(s.flush())
+    assert list(outs[0].batch.iter_pairs()) == \
+        list(outs[1].batch.iter_pairs())

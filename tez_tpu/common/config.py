@@ -160,6 +160,9 @@ IO_SORT_FACTOR = _key("tez.runtime.io.sort.factor", 64, Scope.VERTEX,
 SORTER_CLASS = _key("tez.runtime.sorter.class", "device", Scope.VERTEX,
                     "'device' (TPU radix/segmented sort) or 'host' (numpy fallback)")
 COMBINER_CLASS = _key("tez.runtime.combiner.class", "", Scope.VERTEX)
+SORT_THREADS = _key("tez.runtime.sort.threads", 0, Scope.VERTEX,
+                    "Background sortmaster workers (0 = sort spans inline); "
+                    "reference: PipelinedSorter sortmaster executor")
 PARTITIONER_CLASS = _key("tez.runtime.partitioner.class",
                          "tez_tpu.library.partitioners:HashPartitioner", Scope.VERTEX)
 PIPELINED_SHUFFLE_ENABLED = _key("tez.runtime.pipelined-shuffle.enabled", False, Scope.VERTEX,

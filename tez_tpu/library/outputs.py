@@ -83,6 +83,8 @@ class OrderedPartitionedKVOutput(LogicalOutput):
         self.val_serde = get_serde(_conf_get(ctx, "tez.runtime.value.class",
                                              "bytes"))
         engine = _conf_get(ctx, "tez.runtime.sorter.class", "device")
+        merge_factor = int(_conf_get(ctx, "tez.runtime.io.sort.factor", 64))
+        sort_threads = int(_conf_get(ctx, "tez.runtime.sort.threads", 0))
         partitioner_cls = _conf_get(ctx, "tez.runtime.partitioner.class",
                                     "tez_tpu.library.partitioners:"
                                     "HashPartitioner")
@@ -99,6 +101,8 @@ class OrderedPartitionedKVOutput(LogicalOutput):
             counters=ctx.counters,
             combiner=_COMBINERS.get(combiner_name),
             engine=engine,
+            sort_threads=sort_threads,
+            merge_factor=merge_factor,
         )
         ctx.request_initial_memory(sort_mb << 20, None,
                            component_type="PARTITIONED_SORTED_OUTPUT")

@@ -120,7 +120,9 @@ class DeviceSorter:
                  combiner: Optional[Combiner] = None,
                  partitioner: str = "hash",
                  mem_budget_bytes: Optional[int] = None,
-                 engine: str = "device"):
+                 engine: str = "device",
+                 sort_threads: int = 0,
+                 merge_factor: int = 64):
         self.num_partitions = num_partitions
         self.key_width = max(4, key_width)
         self.engine = engine   # 'device' (TPU kernels) | 'host' (np.lexsort)
@@ -130,6 +132,16 @@ class DeviceSorter:
         self.combiner = combiner
         self.partitioner = partitioner
         self.mem_budget = mem_budget_bytes or (span_budget_bytes * 2)
+        #: bounded k-way merge width (reference: io.sort.factor)
+        self.merge_factor = merge_factor
+        #: background span sorting ("sortmaster" analog: collection
+        #: continues while a full span sorts; PipelinedSorter.java:326)
+        self._executor = None
+        if sort_threads > 0:
+            import concurrent.futures
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=sort_threads, thread_name_prefix="sortmaster")
+        self._pending = []
         self._span = SpanBuffer()
         self._runs: List[Run | str] = []   # Run (in RAM) or path (spilled)
         self._runs_nbytes = 0
@@ -172,6 +184,25 @@ class DeviceSorter:
 
     def _sort_span(self) -> None:
         if self._span.num_records == 0:
+            return
+        if self._executor is not None:
+            # hand the full span to the sortmaster; keep collecting
+            batch = self._span.to_batch()
+            custom_parts = np.asarray(self._span.parts, dtype=np.int32) \
+                if self._span.parts else None
+            self._span = SpanBuffer()
+            spill_id = self.num_spills
+            self.num_spills += 1
+
+            def _bg() -> Run:
+                run = self.sort_batch(batch, custom_partitions=custom_parts)
+                if self.combiner is not None:
+                    run = self.combiner(run)
+                if self.on_spill is not None:
+                    self.on_spill(run, spill_id)
+                return run
+
+            self._pending.append(self._executor.submit(_bg))
             return
         run = self._finalize_span()
         if self.on_spill is not None:
@@ -252,6 +283,18 @@ class DeviceSorter:
             self._runs.append(run)
             self._runs_nbytes += run.nbytes
 
+    def _drain_pending(self, store: bool) -> None:
+        """Wait for sortmaster spans; store (normal) or just join
+        (pipelined — on_spill already shipped them from the worker)."""
+        for fut in self._pending:
+            run = fut.result()
+            if store and self.on_spill is None:
+                self._store_run(run)
+        self._pending = []
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
+
     def _load_runs(self) -> List[Run]:
         out = []
         for r in self._runs:
@@ -273,11 +316,14 @@ class DeviceSorter:
         if self.on_spill is not None:
             if self._span.num_records > 0:
                 self._sort_span()
+            self._drain_pending(store=False)
             return None
-        if self._span.num_records > 0 and not self._runs:
+        if self._span.num_records > 0 and not self._runs and \
+                not self._pending:
             # common fast path: everything fit one span
             return self._finalize_span()
         self._sort_span()
+        self._drain_pending(store=True)
         runs = self._load_runs()
         self._runs = []
         if not runs:
@@ -286,7 +332,8 @@ class DeviceSorter:
         if len(runs) == 1:
             return runs[0]
         merged = merge_sorted_runs(runs, self.num_partitions, self.key_width,
-                                   counters=self.counters, engine=self.engine)
+                                   counters=self.counters, engine=self.engine,
+                                   merge_factor=self.merge_factor)
         if self.combiner is not None:
             merged = self.combiner(merged)
         return merged
@@ -295,9 +342,25 @@ class DeviceSorter:
 def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
                       key_width: int,
                       counters: Optional[TezCounters] = None,
-                      engine: str = "device") -> Run:
+                      engine: str = "device",
+                      merge_factor: int = 0) -> Run:
     """k-way merge of partition-sorted runs (TezMerger analog): concatenate,
-    stable device sort by (partition, key prefix), host tie-break."""
+    stable device sort by (partition, key prefix), host tie-break.
+
+    merge_factor > 0 bounds how many runs merge per pass (io.sort.factor —
+    the multi-pass external merge that keeps peak memory at
+    factor x run-size instead of total size; SURVEY.md §5.7)."""
+    if merge_factor > 1 and len(runs) > merge_factor:
+        level = list(runs)
+        while len(level) > merge_factor:
+            nxt = []
+            for i in range(0, len(level), merge_factor):
+                chunk = level[i:i + merge_factor]
+                nxt.append(chunk[0] if len(chunk) == 1 else
+                           merge_sorted_runs(chunk, num_partitions,
+                                             key_width, counters, engine))
+            level = nxt
+        runs = level
     t0 = time.time()
     batch = KVBatch.concat([r.batch for r in runs])
     partitions = np.concatenate([

@@ -212,9 +212,12 @@ class OrderedGroupedKVInput(LogicalInput):
             if runs:
                 engine = _conf_get(self.context, "tez.runtime.sorter.class",
                                    "device")
+                factor = int(_conf_get(self.context,
+                                       "tez.runtime.io.sort.factor", 64))
                 merged = merge_sorted_runs(runs, 1, self.key_width,
                                            counters=self.context.counters,
-                                           engine=engine)
+                                           engine=engine,
+                                           merge_factor=factor)
                 self._merged = merged.batch
             else:
                 self._merged = KVBatch.empty()

@@ -135,13 +135,19 @@ class DeviceSorter:
         #: bounded k-way merge width (reference: io.sort.factor)
         self.merge_factor = merge_factor
         #: background span sorting ("sortmaster" analog: collection
-        #: continues while a full span sorts; PipelinedSorter.java:326)
+        #: continues while a full span sorts; PipelinedSorter.java:326).
+        #: Capped at ONE worker: counters follow a single-writer-per-counter
+        #: rule (the collector thread owns OUTPUT_*, the sortmaster owns the
+        #: sort/merge/spill counters) and on_spill consumers are not
+        #: required to be re-entrant.
         self._executor = None
         if sort_threads > 0:
             import concurrent.futures
             self._executor = concurrent.futures.ThreadPoolExecutor(
-                max_workers=sort_threads, thread_name_prefix="sortmaster")
+                max_workers=1, thread_name_prefix="sortmaster")
         self._pending = []
+        import threading as _threading
+        self._store_lock = _threading.Lock()
         self._span = SpanBuffer()
         self._runs: List[Run | str] = []   # Run (in RAM) or path (spilled)
         self._runs_nbytes = 0
@@ -194,13 +200,17 @@ class DeviceSorter:
             spill_id = self.num_spills
             self.num_spills += 1
 
-            def _bg() -> Run:
+            def _bg() -> None:
                 run = self.sort_batch(batch, custom_partitions=custom_parts)
                 if self.combiner is not None:
                     run = self.combiner(run)
                 if self.on_spill is not None:
                     self.on_spill(run, spill_id)
-                return run
+                else:
+                    # store (and possibly disk-spill) AS spans finish so RAM
+                    # stays bounded by mem_budget, same as the sync path
+                    with self._store_lock:
+                        self._store_run(run)
 
             self._pending.append(self._executor.submit(_bg))
             return
@@ -284,16 +294,24 @@ class DeviceSorter:
             self._runs_nbytes += run.nbytes
 
     def _drain_pending(self, store: bool) -> None:
-        """Wait for sortmaster spans; store (normal) or just join
-        (pipelined — on_spill already shipped them from the worker)."""
-        for fut in self._pending:
-            run = fut.result()
-            if store and self.on_spill is None:
-                self._store_run(run)
-        self._pending = []
-        if self._executor is not None:
-            self._executor.shutdown(wait=True)
-            self._executor = None
+        """Join the sortmaster (workers stored/shipped their runs already).
+        Exception-safe: the executor always shuts down, then the first
+        worker error re-raises."""
+        error: Optional[BaseException] = None
+        try:
+            for fut in self._pending:
+                try:
+                    fut.result()
+                except BaseException as e:  # noqa: BLE001
+                    if error is None:
+                        error = e
+        finally:
+            self._pending = []
+            if self._executor is not None:
+                self._executor.shutdown(wait=True)
+                self._executor = None
+        if error is not None:
+            raise error
 
     def _load_runs(self) -> List[Run]:
         out = []
@@ -347,18 +365,22 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
     """k-way merge of partition-sorted runs (TezMerger analog): concatenate,
     stable device sort by (partition, key prefix), host tie-break.
 
-    merge_factor > 0 bounds how many runs merge per pass (io.sort.factor —
-    the multi-pass external merge that keeps peak memory at
-    factor x run-size instead of total size; SURVEY.md §5.7)."""
+    merge_factor > 0 bounds how many runs merge per pass (io.sort.factor):
+    each device sort then works on at most factor runs' worth of rows, which
+    bounds the PER-MERGE device working set (HBM buffers + sort scratch);
+    host-side runs still coexist — the host-spill path in DeviceSorter is
+    what bounds host RAM (SURVEY.md §5.7 multi-pass external merge)."""
     if merge_factor > 1 and len(runs) > merge_factor:
         level = list(runs)
         while len(level) > merge_factor:
             nxt = []
             for i in range(0, len(level), merge_factor):
                 chunk = level[i:i + merge_factor]
+                # inner passes skip counters: only the final pass reports
+                # (avoids double-counting MERGED_MAP_OUTPUTS / merge millis)
                 nxt.append(chunk[0] if len(chunk) == 1 else
                            merge_sorted_runs(chunk, num_partitions,
-                                             key_width, counters, engine))
+                                             key_width, None, engine))
             level = nxt
         runs = level
     t0 = time.time()

@@ -25,9 +25,9 @@ class RecordingScheduler(LocalTaskSchedulerService):
         self.scheduled.append((str(attempt_id), priority))
         super().schedule(attempt_id, task_spec, priority)
 
-    def deallocate(self, attempt_id):
+    def deallocate(self, attempt_id, failed=False):
         self.deallocated.append(str(attempt_id))
-        super().deallocate(attempt_id)
+        super().deallocate(attempt_id, failed=failed)
 
 
 def test_custom_task_scheduler_plugin(tmp_staging):

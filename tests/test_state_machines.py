@@ -257,3 +257,31 @@ def test_speculative_attempt_loser_killed():
     assert task.successful_attempt == second
     loser = task.attempt(first)
     assert loser.state is TaskAttemptState.KILLED
+
+
+def test_container_blacklisted_after_repeated_failures():
+    """A container accumulating failures stops receiving work (AMNode
+    blacklisting analog)."""
+    from tez_tpu.am.task_scheduler import LocalTaskSchedulerService
+
+    class Ctx:
+        def ensure_runners(self, backlog):
+            pass
+
+    sched = LocalTaskSchedulerService(Ctx(), 2)
+    from tez_tpu.common.ids import DAGId
+    vid = DAGId("app_0_bl", 1).vertex(0)
+    cid = "container-x"
+    for i in range(3):
+        att = vid.task(i).attempt(0)
+        sched.schedule(att, object(), priority=1)
+        got = sched.get_task(cid, timeout=0.1)
+        assert got is not None
+        sched.deallocate(att, failed=True)
+    assert sched.is_blacklisted(cid)
+    # further pulls from the bad container are refused...
+    att = vid.task(9).attempt(0)
+    sched.schedule(att, object(), priority=1)
+    assert sched.get_task(cid, timeout=0.1) is None
+    # ...but a healthy container still gets the work
+    assert sched.get_task("container-y", timeout=0.1) is not None

@@ -143,7 +143,7 @@ class TaskAttemptImpl:
                                     self.attempt_id.task_id,
                                     attempt_id=self.attempt_id,
                                     fatal=self.failure_fatal))
-        self._notify_scheduler_ended()
+        self._notify_scheduler_ended(failed=True)
 
     def _on_killed(self, event: TaskAttemptEvent) -> None:
         self.finish_time = time.time()
@@ -199,9 +199,10 @@ class TaskAttemptImpl:
                   "diagnostics": "; ".join(self.diagnostics),
                   "counters": self.counters.to_dict()}))
 
-    def _notify_scheduler_ended(self) -> None:
+    def _notify_scheduler_ended(self, failed: bool = False) -> None:
         self.ctx.dispatch(SchedulerEvent(SchedulerEventType.S_TA_ENDED,
-                                         attempt_id=self.attempt_id))
+                                         attempt_id=self.attempt_id,
+                                         failed=failed))
 
 
 def _build_attempt_factory() -> StateMachineFactory:

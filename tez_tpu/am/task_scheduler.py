@@ -35,7 +35,9 @@ class TaskSchedulerService:
                  priority: int) -> None:
         raise NotImplementedError
 
-    def deallocate(self, attempt_id: TaskAttemptId) -> None:
+    def deallocate(self, attempt_id: TaskAttemptId,
+                   failed: bool = False) -> None:
+        """failed=True feeds container-health accounting (blacklisting)."""
         raise NotImplementedError
 
     def total_slots(self) -> int:
@@ -49,6 +51,10 @@ class LocalTaskSchedulerService(TaskSchedulerService):
     """Priority queue + pull model (reference: LocalTaskSchedulerService.java:54
     merged with the container-side getTask loop)."""
 
+    #: container failure count that triggers blacklisting (reference:
+    #: AMNodeImpl blacklisting via tez.am.maxtaskfailures.per.node)
+    MAX_FAILURES_PER_CONTAINER = 3
+
     def __init__(self, ctx: Any, num_slots: int):
         self.ctx = ctx
         self.num_slots = num_slots
@@ -58,6 +64,8 @@ class LocalTaskSchedulerService(TaskSchedulerService):
         self._seq = itertools.count()
         self._queued: Set[TaskAttemptId] = set()
         self._running: Dict[TaskAttemptId, ContainerId] = {}
+        self._container_failures: Dict[Any, int] = {}
+        self._blacklisted: Set[Any] = set()
         self._shutdown = False
 
     def schedule(self, attempt_id: TaskAttemptId, task_spec: TaskSpec,
@@ -69,10 +77,23 @@ class LocalTaskSchedulerService(TaskSchedulerService):
             self._available.notify()
         self.ctx.ensure_runners(self.backlog())
 
-    def deallocate(self, attempt_id: TaskAttemptId) -> None:
+    def deallocate(self, attempt_id: TaskAttemptId,
+                   failed: bool = False) -> None:
         with self._lock:
             self._queued.discard(attempt_id)
-            self._running.pop(attempt_id, None)
+            container = self._running.pop(attempt_id, None)
+            if failed and container is not None:
+                n = self._container_failures.get(container, 0) + 1
+                self._container_failures[container] = n
+                if n >= self.MAX_FAILURES_PER_CONTAINER and \
+                        container not in self._blacklisted:
+                    self._blacklisted.add(container)
+                    log.warning("container %s blacklisted after %d failures",
+                                container, n)
+
+    def is_blacklisted(self, container_id: Any) -> bool:
+        with self._lock:
+            return container_id in self._blacklisted
 
     def backlog(self) -> int:
         with self._lock:
@@ -83,9 +104,12 @@ class LocalTaskSchedulerService(TaskSchedulerService):
 
     def get_task(self, container_id: ContainerId,
                  timeout: float) -> Optional[TaskSpec]:
-        """Runner pull (the allocation point).  Returns None on idle timeout
-        or shutdown."""
+        """Runner pull (the allocation point).  Returns None on idle timeout,
+        shutdown, or when this container is blacklisted (the runner exits
+        and the pool replaces it — container loss recovery)."""
         with self._lock:
+            if container_id in self._blacklisted:
+                return None
             while True:
                 while self._heap:
                     prio, seq, attempt_id, spec = heapq.heappop(self._heap)
@@ -117,4 +141,5 @@ class TaskSchedulerManager:
             self.scheduler.schedule(event.attempt_id, event.task_spec,
                                     event.priority)
         elif event.event_type is SchedulerEventType.S_TA_ENDED:
-            self.scheduler.deallocate(event.attempt_id)
+            self.scheduler.deallocate(event.attempt_id,
+                                      failed=getattr(event, "failed", False))

@@ -188,14 +188,13 @@ def test_split_boundary_no_lost_or_duplicated_lines(tmp_path):
     exactly at a split boundary (LineRecordReader semantics)."""
     from tez_tpu.io.text import FileSplit, _LineReader, compute_splits
 
+    from tez_tpu.common.counters import TezCounters
+
     class _Ctx:
+        counters = TezCounters()
+
         def notify_progress(self):
             pass
-
-        class counters:
-            @staticmethod
-            def increment(*a):
-                pass
 
     p = tmp_path / "t.txt"
     lines = [f"line{i:04d}" for i in range(1000)]

@@ -105,3 +105,42 @@ def test_sort_merge_join_e2e(tmp_path):
     got = sorted(k for k, _ in read_kv_output(out))
     want = sorted(f"k{i:03d}" for i in range(0, 200, 6))
     assert got == want
+
+
+def test_unordered_pipelined_no_final_merge(tmp_path):
+    """Unordered output with final merge disabled ships per-spill events
+    and the streaming consumer still sees every record."""
+    from tez_tpu.client.tez_client import TezClient
+    from tez_tpu.client.dag_client import DAGStatusState
+    corpus = tmp_path / "in.txt"
+    golden = write_corpus(str(corpus), num_lines=300)
+    out = str(tmp_path / "out")
+    from tez_tpu.examples import wordcount as wc
+    dag = wc.build_dag([str(corpus)], out, tokenizer_parallelism=2,
+                       summation_parallelism=2)
+    # force tiny buffers + no final merge => many per-spill shipments
+    for v in dag.vertices.values():
+        v.set_conf("tez.runtime.enable.final-merge.in.output", False)
+        v.set_conf("tez.runtime.unordered.output.buffer.size-mb", 1)
+    with TezClient.create("t", {"tez.staging-dir":
+                                str(tmp_path / "s")}) as c:
+        status = c.submit_dag(dag).wait_for_completion(timeout=60)
+    assert status.state is DAGStatusState.SUCCEEDED
+    got = {k: int(v) for k, v in read_kv_output(out)}
+    assert got == dict(golden)
+
+
+def test_filesystem_counters_populated(tmp_path):
+    from tez_tpu.client.tez_client import TezClient
+    from tez_tpu.examples import ordered_wordcount
+    corpus = tmp_path / "in.txt"
+    corpus.write_text("a b c\n" * 100)
+    with TezClient.create("t", {"tez.staging-dir":
+                                str(tmp_path / "s")}) as c:
+        dag = ordered_wordcount.build_dag([str(corpus)],
+                                          str(tmp_path / "out"),
+                                          tokenizer_parallelism=2)
+        status = c.submit_dag(dag).wait_for_completion(timeout=60)
+    fs = status.counters.to_dict().get("FileSystemCounter", {})
+    assert fs.get("FILE_BYTES_READ", 0) >= 600
+    assert fs.get("FILE_BYTES_WRITTEN", 0) > 0

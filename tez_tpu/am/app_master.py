@@ -73,6 +73,10 @@ class DAGAppMaster:
             if recovery_enabled else None
         from tez_tpu.am.heartbeat import HeartbeatMonitor
         self.heartbeat_monitor = HeartbeatMonitor(self)
+        from tez_tpu.runtime.diagnostics import ThreadDumpHelper
+        self.thread_dumper = ThreadDumpHelper(
+            int(conf.get(C.THREAD_DUMP_INTERVAL_MS) or 0),
+            label=f"am-{app_id}")
         self.web_ui = None
         if conf.get(C.AM_WEB_ENABLED):
             from tez_tpu.am.web import WebUIService
@@ -99,6 +103,7 @@ class DAGAppMaster:
         self.dispatcher.on_error = self._on_dispatcher_error
         self.dispatcher.start()
         self.heartbeat_monitor.start()
+        self.thread_dumper.start()
         if self.umbilical_server is not None:
             self.umbilical_server.start()
         if self.web_ui is not None:
@@ -111,6 +116,7 @@ class DAGAppMaster:
     def stop(self) -> None:
         if self.web_ui is not None:
             self.web_ui.stop()
+        self.thread_dumper.stop()
         self.heartbeat_monitor.stop()
         dag = self.current_dag
         if dag is not None:

@@ -60,6 +60,17 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             body = dag.counters.to_dict() if dag is not None else {}
             self._send(200, json.dumps(body).encode())
             return
+        if self.path == "/swimlane.svg":
+            events = list(getattr(am.logging_service, "events", []))
+            from tez_tpu.tools.history_parser import parse_history_events
+            from tez_tpu.tools.swimlane import render_svg
+            dags = parse_history_events(events)
+            if dags:
+                svg = render_svg(list(dags.values())[-1])
+                self._send(200, svg.encode(), "image/svg+xml")
+            else:
+                self._send(404, b'{"error": "no DAG yet"}')
+            return
         if self.path == "/history":
             events = getattr(am.logging_service, "events", [])
             body = [json.loads(e.to_json()) for e in events[-200:]]

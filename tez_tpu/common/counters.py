@@ -77,6 +77,14 @@ class TaskCounter(enum.Enum):
     D2H_TRANSFER_BYTES = enum.auto()
 
 
+class FileSystemCounter(enum.Enum):
+    """Reference: FileSystemCounterGroup (per-FS bytes/ops)."""
+    FILE_BYTES_READ = enum.auto()
+    FILE_BYTES_WRITTEN = enum.auto()
+    FILE_READ_OPS = enum.auto()
+    FILE_WRITE_OPS = enum.auto()
+
+
 class DAGCounter(enum.Enum):
     """Reference: DAGCounter.java."""
     NUM_FAILED_TASKS = enum.auto()

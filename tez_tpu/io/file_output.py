@@ -13,7 +13,7 @@ from typing import Any, List, Sequence
 from tez_tpu.api.events import TezAPIEvent
 from tez_tpu.api.initializer import OutputCommitter
 from tez_tpu.api.runtime import KeyValueWriter, LogicalOutput, Writer
-from tez_tpu.common.counters import TaskCounter
+from tez_tpu.common.counters import FileSystemCounter, TaskCounter
 from tez_tpu.ops.serde import get_serde
 
 TMP_SUBDIR = "_temporary"
@@ -28,15 +28,23 @@ class _PartWriter(KeyValueWriter):
         self.val_serde = val_serde
         self.context = context
         self.sep = sep
+        # hot path: cache the counter objects once (group+name lookup per
+        # record is pure dictionary churn)
+        self._records_ctr = context.counters.find_counter(
+            TaskCounter.OUTPUT_RECORDS)
+        self._bytes_ctr = context.counters.find_counter(
+            FileSystemCounter.FILE_BYTES_WRITTEN)
 
     def write(self, key: Any, value: Any) -> None:
         k = self.key_serde.to_bytes(key)
         v = self.val_serde.to_bytes(value)
         self._fh.write(k + self.sep + v + b"\n")
-        self.context.counters.increment(TaskCounter.OUTPUT_RECORDS)
+        self._records_ctr.increment()
+        self._bytes_ctr.increment(len(k) + len(self.sep) + len(v) + 1)
 
     def close(self) -> None:
         self._fh.close()
+        self.context.counters.increment(FileSystemCounter.FILE_WRITE_OPS)
 
 
 class FileOutput(LogicalOutput):

@@ -16,7 +16,7 @@ from tez_tpu.api.events import InputDataInformationEvent, TezAPIEvent
 from tez_tpu.api.initializer import (InputConfigureVertexTasksEvent,
                                      InputInitializer)
 from tez_tpu.api.runtime import KeyValueReader, LogicalInput, Reader
-from tez_tpu.common.counters import TaskCounter
+from tez_tpu.common.counters import FileSystemCounter, TaskCounter
 
 
 @dataclasses.dataclass(frozen=True)
@@ -121,14 +121,25 @@ class _LineReader(KeyValueReader):
         self.context = context
 
     def __iter__(self) -> Iterator[Tuple[int, bytes]]:
+        # counters update incrementally inside the loop (a consumer may stop
+        # early, closing the generator — a post-loop epilogue would be
+        # skipped entirely; and re-iteration must not double-count)
+        records = self.context.counters.find_counter(
+            TaskCounter.INPUT_RECORDS_PROCESSED)
+        bytes_read = self.context.counters.find_counter(
+            FileSystemCounter.FILE_BYTES_READ)
+        read_ops = self.context.counters.find_counter(
+            FileSystemCounter.FILE_READ_OPS)
         n = 0
         for split in self.splits:
             with open(split.path, "rb") as fh:
+                read_ops.increment()
                 fh.seek(split.start)
                 pos = split.start
                 if split.start > 0:
                     skipped = fh.readline()  # partial record owned by prev
                     pos += len(skipped)
+                    bytes_read.increment(len(skipped))
                 end = split.start + split.length
                 # a line STARTING exactly at `end` belongs to this split
                 # (the next split discards its first line since start > 0) —
@@ -139,10 +150,11 @@ class _LineReader(KeyValueReader):
                         break
                     yield pos, line.rstrip(b"\r\n")
                     pos += len(line)
+                    bytes_read.increment(len(line))   # ACTUAL bytes consumed
+                    records.increment()
                     n += 1
                     if (n & 0x3FFF) == 0:
                         self.context.notify_progress()
-        self.context.counters.increment(TaskCounter.INPUT_RECORDS_PROCESSED, n)
 
 
 class TextInput(LogicalInput):

@@ -257,3 +257,17 @@ def test_async_sortmaster_matches_sync():
         outs.append(s.flush())
     assert list(outs[0].batch.iter_pairs()) == \
         list(outs[1].batch.iter_pairs())
+
+
+def test_pallas_fnv_matches_reference_kernel():
+    """Pallas FNV hash (interpret mode on CPU) == the XLA kernel == the host
+    partitioner."""
+    from tez_tpu.ops.pallas_kernels import hash_partition_pallas
+    pairs = random_pairs(700, seed=31, max_key=24)
+    b = KVBatch.from_pairs(pairs)
+    klens = b.key_offsets[1:] - b.key_offsets[:-1]
+    w = 1 << max(2, (int(klens.max()) - 1).bit_length())
+    mat, lengths = pad_to_matrix(b.key_bytes, b.key_offsets, w)
+    golden = device.hash_partition(mat, lengths, 5)
+    got = hash_partition_pallas(mat, lengths, 5, interpret=True)
+    np.testing.assert_array_equal(got, golden)

@@ -175,3 +175,20 @@ def test_exception_propagation_to_diagnostics(client):
         status.vertex_status["v"].diagnostics)
     assert "TestProcessor failing" in text
     assert "RuntimeError" in text
+
+
+def test_concurrent_dispatcher_mode(tmp_staging):
+    """The sharded AM dispatcher runs whole DAGs correctly (per-entity
+    ordering preserved across shards)."""
+    c = TezClient.create("t", {"tez.staging-dir": tmp_staging,
+                               "tez.am.concurrent.dispatcher.shards": 4,
+                               "tez.am.local.num-containers": 4}).start()
+    try:
+        a, b = make_test_vertex("a", 4), make_test_vertex("b", 3)
+        dag = DAG.create("sharded").add_vertex(a).add_vertex(b).add_edge(
+            tedge(a, b))
+        status = c.submit_dag(dag).wait_for_completion(timeout=60)
+        assert status.state is DAGStatusState.SUCCEEDED
+        assert status.vertex_status["b"].progress.succeeded_task_count == 3
+    finally:
+        c.stop()

@@ -46,7 +46,13 @@ class DAGAppMaster:
         self.work_dir = os.path.join(
             conf.get(C.STAGING_DIR), app_id, "work")
         os.makedirs(self.work_dir, exist_ok=True)
-        self.dispatcher = Dispatcher(f"am-{app_id}")
+        shards = int(conf.get(C.AM_CONCURRENT_DISPATCHER_SHARDS) or 0)
+        if shards > 1:
+            from tez_tpu.common.dispatcher import ShardedDispatcher
+            self.dispatcher = ShardedDispatcher(f"am-{app_id}",
+                                                num_shards=shards)
+        else:
+            self.dispatcher = Dispatcher(f"am-{app_id}")
         self.dag_counters = TezCounters()
         num_slots = conf.get(C.AM_NUM_CONTAINERS) or max(2, os.cpu_count() or 2)
         self.task_scheduler = LocalTaskSchedulerService(self, num_slots)

@@ -143,6 +143,10 @@ RUNNER_ENV = _key("tez.am.runner.env", {}, Scope.AM,
                   "Env overrides for runner subprocesses; '' value = unset")
 UMBILICAL_BIND_HOST = _key("tez.am.umbilical.bind-host", "127.0.0.1",
                            Scope.AM, "'0.0.0.0' for multi-host deployments")
+AM_CONCURRENT_DISPATCHER_SHARDS = _key(
+    "tez.am.concurrent.dispatcher.shards", 0, Scope.AM,
+    "0 = single dispatcher thread (reference default); N>1 = hash-sharded "
+    "concurrent dispatcher for event storms (AsyncDispatcherConcurrent)")
 RUNNER_MODE = _key("tez.runner.mode", "threads", Scope.AM,
                    "'threads' (in-process, reference local mode) or "
                    "'subprocess' (out-of-process runners over the socket "

@@ -1,0 +1,76 @@
+"""Pallas TPU kernels for the data plane's hot byte-level ops.
+
+The LSD sort rides XLA's native `lax.sort` (already optimal); the remaining
+hot op with awkward XLA lowering is the per-row FNV-1a hash over key bytes —
+a `fori_loop` of masked u32 multiplies that XLA materializes as W sequential
+HLO ops over the full column.  The Pallas version tiles rows into VMEM and
+keeps the hash accumulator in registers across the byte loop (unrolled at
+trace time, W is static).
+
+Enabled via tez.runtime.tpu.pallas.hash (default off until profiled on the
+target chip); CPU tests run the same kernel in interpret mode.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tez_tpu.ops.device import FNV_OFFSET, FNV_PRIME
+
+ROW_BLOCK = 1024
+
+
+def _fnv_kernel(key_ref, len_ref, out_ref):
+    """One grid step: hash ROW_BLOCK rows of a u32-cast byte matrix."""
+    w = key_ref.shape[1]
+    h = jnp.full((key_ref.shape[0],), FNV_OFFSET, dtype=jnp.uint32)
+    lengths = len_ref[:]
+    for j in range(w):   # static unroll: W is a trace-time constant
+        byte = key_ref[:, j]
+        nh = ((h ^ byte) * FNV_PRIME).astype(jnp.uint32)
+        h = jnp.where(j < lengths, nh, h)
+    out_ref[:] = h
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fnv_hash_pallas(key_mat_u32: jnp.ndarray, lengths: jnp.ndarray,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Row-wise FNV-1a over key bytes.
+
+    key_mat_u32: uint32[N, W] (bytes pre-cast to u32; N multiple of
+    ROW_BLOCK — callers pad), lengths: int32[N].  Returns uint32[N].
+    """
+    from jax.experimental import pallas as pl
+
+    n, w = key_mat_u32.shape
+    grid = (n // ROW_BLOCK,)
+    return pl.pallas_call(
+        _fnv_kernel,
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.uint32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ROW_BLOCK, w), lambda i: (i, 0)),
+            pl.BlockSpec((ROW_BLOCK,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((ROW_BLOCK,), lambda i: (i,)),
+        interpret=interpret,
+    )(key_mat_u32, lengths)
+
+
+def hash_partition_pallas(key_mat: np.ndarray, lengths: np.ndarray,
+                          num_partitions: int,
+                          interpret: bool = False) -> np.ndarray:
+    """Drop-in twin of device.hash_partition backed by the Pallas kernel."""
+    n = key_mat.shape[0]
+    if n == 0:
+        return np.zeros(0, dtype=np.int32)
+    pad = (-n) % ROW_BLOCK
+    mat = np.pad(key_mat, ((0, pad), (0, 0))) if pad else key_mat
+    lens = np.pad(lengths, (0, pad)) if pad else lengths
+    h = fnv_hash_pallas(jnp.asarray(mat, dtype=jnp.uint32),
+                        jnp.asarray(lens, dtype=jnp.int32),
+                        interpret=interpret)
+    return (np.asarray(h)[:n] % num_partitions).astype(np.int32)

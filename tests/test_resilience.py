@@ -106,6 +106,76 @@ def test_am_recovery_resubmits_inflight_dag(tmp_staging):
     am2.stop()
 
 
+class GatedCountProcessor(SimpleProcessor):
+    """Blocks until a sentinel file appears, then counts the sorted input and
+    writes the total to a result file (payload: gate_path, result_path)."""
+
+    def run(self, inputs, outputs):
+        payload = self.context.user_payload.load() or {}
+        while not os.path.exists(payload["gate_path"]):
+            time.sleep(0.05)
+        reader = inputs["producer"].get_reader()
+        total = sum(sum(vs) for _k, vs in reader)
+        with open(payload["result_path"], "w") as fh:
+            fh.write(str(total))
+
+
+def test_am_recovery_short_circuits_succeeded_tasks(tmp_staging, tmp_path):
+    """Producer vertex completes before the AM crash; after recovery its
+    tasks are restored from the journal (not re-run) and their replayed
+    DataMovementEvents feed the consumer, which produces correct data
+    (reference: RecoveryParser completed-work short-circuit, SURVEY.md §5.4)."""
+    gate = str(tmp_path / "gate")
+    result = str(tmp_path / "result")
+    conf_kv = {"tez.runtime.key.class": "bytes",
+               "tez.runtime.value.class": "long"}
+    producer = Vertex.create("producer", ProcessorDescriptor.create(
+        EmitProcessor), 2)
+    consumer = Vertex.create("consumer", ProcessorDescriptor.create(
+        GatedCountProcessor,
+        payload={"gate_path": gate, "result_path": result}), 1)
+    prop = EdgeProperty.create(
+        DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create(
+            "tez_tpu.library.outputs:OrderedPartitionedKVOutput",
+            payload=conf_kv),
+        InputDescriptor.create(
+            "tez_tpu.library.inputs:OrderedGroupedKVInput", payload=conf_kv))
+    dag = DAG.create("recov_sc").add_vertex(producer).add_vertex(consumer)
+    dag.add_edge(Edge.create(producer, consumer, prop))
+    plan = dag.create_dag_plan()
+
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging,
+                               "tez.am.local.num-containers": 3})
+    am1 = DAGAppMaster("app_1_recsc", conf, attempt=1)
+    am1.start()
+    am1.submit_dag(plan)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = am1.current_dag.status_dict()
+        if st["vertices"].get("producer", {}).get("state") == "SUCCEEDED":
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("producer vertex never finished")
+    am1.stop()            # crash while the consumer is gated
+
+    am2 = DAGAppMaster("app_1_recsc", conf, attempt=2)
+    am2.start()
+    recovered = am2.recover_and_resume()
+    assert recovered is not None
+    open(gate, "w").close()          # release the consumer
+    assert am2.wait_for_dag(recovered, timeout=60) is DAGState.SUCCEEDED
+    with open(result) as fh:
+        assert int(fh.read()) == 100  # 2 producers x 50 records x value 1
+    # Producer tasks were restored, not re-launched: only the consumer ran.
+    d = am2.dag_counters.to_dict().get("DAGCounter", {})
+    assert d.get("TOTAL_LAUNCHED_TASKS", 0) == 1
+    assert d.get("NUM_SUCCEEDED_TASKS", 0) == 3
+    am2.stop()
+
+
 def test_am_recovery_finished_dag_untouched(tmp_staging):
     conf = C.TezConfiguration({"tez.staging-dir": tmp_staging})
     am1 = DAGAppMaster("app_1_fin", conf, attempt=1)

@@ -255,7 +255,7 @@ class DAGAppMaster:
             self._dag_done.notify_all()
 
     # -- DAG submission (client-facing) --------------------------------------
-    def submit_dag(self, plan: DAGPlan) -> DAGId:
+    def submit_dag(self, plan: DAGPlan, recovery_data: Any = None) -> DAGId:
         assert self._started, "AM not started"
         with self._dag_done:
             if self.current_dag is not None and \
@@ -267,7 +267,7 @@ class DAGAppMaster:
             HistoryEventType.DAG_SUBMITTED, dag_id=str(dag_id),
             data={"dag_name": plan.name,
                   "plan": plan.serialize().hex()}))
-        dag = DAGImpl(dag_id, plan, self)
+        dag = DAGImpl(dag_id, plan, self, recovery_data=recovery_data)
         self.current_dag = dag
         if dag.conf.get(C.SPECULATION_ENABLED):
             from tez_tpu.am.speculation import Speculator
@@ -297,9 +297,12 @@ class DAGAppMaster:
 
         Semantics kept from the reference: a finished DAG is left alone; a
         DAG whose commit had started but not completed is FAILED (partial
-        commits can't be trusted); an in-flight DAG is resubmitted.
-        Divergence (round 1): incomplete DAGs re-run from the start rather
-        than short-circuiting completed vertices from their Finished events.
+        commits can't be trusted); an in-flight DAG is resubmitted with its
+        journaled SUCCEEDED tasks short-circuited — their generated
+        DataMovementEvents replay into the edges instead of re-running
+        (RecoveryParser.parseRecoveryData:658 semantics; if the restored
+        output data died with the runner, the fetch-failure -> producer-rerun
+        path recovers, as it does in the reference on node loss).
         """
         from tez_tpu.am.recovery import RecoveryParser
         parser = RecoveryParser(self.conf.get(C.STAGING_DIR), self.app_id)
@@ -328,10 +331,13 @@ class DAGAppMaster:
                         data.dag_id)
             return None
         log.info("recovering dag %s (attempt %d): resubmitting "
-                 "(%d vertices previously finished)", data.dag_id,
-                 self.attempt, len(data.completed_vertices))
+                 "(%d vertices finished, %d tasks restorable)", data.dag_id,
+                 self.attempt, len(data.completed_vertices),
+                 len(data.task_data))
         self._dag_seq = seq - 1
-        return self.submit_dag(data.plan)
+        data.events = []   # only task_data/vertex_num_tasks are consulted;
+        # don't pin the whole prior journal in AM memory for the DAG lifetime
+        return self.submit_dag(data.plan, recovery_data=data)
 
     def dag_status(self, dag_id: DAGId) -> Dict[str, Any]:
         dag = self.current_dag

@@ -45,11 +45,15 @@ TERMINAL_DAG_STATES = frozenset(
 class DAGImpl:
     _factory: StateMachineFactory = None
 
-    def __init__(self, dag_id: DAGId, plan: DAGPlan, ctx: Any):
+    def __init__(self, dag_id: DAGId, plan: DAGPlan, ctx: Any,
+                 recovery_data: Any = None):
         self.dag_id = dag_id
         self.plan = plan
         self.name = plan.name
         self.ctx = ctx
+        # DAGRecoveryData from a prior AM attempt's journal, or None.
+        # Vertices consult this to short-circuit journaled SUCCEEDED tasks.
+        self.recovery_data = recovery_data
         self.conf = ctx.conf.merged(plan.dag_conf)
         self.vertices: Dict[str, VertexImpl] = {}
         self.vertices_by_id: Dict[VertexId, VertexImpl] = {}

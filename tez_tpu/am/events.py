@@ -60,6 +60,7 @@ class VertexEvent(Event):
 # -- Task --------------------------------------------------------------------
 class TaskEventType(enum.Enum):
     T_SCHEDULE = enum.auto()
+    T_RECOVER = enum.auto()              # AM recovery: restore SUCCEEDED task
     T_ATTEMPT_LAUNCHED = enum.auto()
     T_ATTEMPT_SUCCEEDED = enum.auto()
     T_ATTEMPT_FAILED = enum.auto()

@@ -61,6 +61,87 @@ class DAGRecoveryData:
     completed_vertices: Dict[str, Dict[str, Any]]   # vertex name -> finish data
     succeeded_tasks: Set[str]                 # task id strings
     events: List[HistoryEvent]
+    # task id string -> {"attempt": attempt id str, "generated_events": wire,
+    # "counters": dict} — only for tasks whose final state was SUCCEEDED and
+    # whose successful attempt journaled its generated events.
+    task_data: Dict[str, Dict[str, Any]] = dataclasses.field(default_factory=dict)
+    # vertex name -> num_tasks at crash time (last INITIALIZED/CONFIGURE_DONE);
+    # a vertex is only short-circuitable when its new parallelism matches.
+    vertex_num_tasks: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Wire (de)serialization of task-generated events for the journal.
+# Reference: TaskAttemptFinishedEvent carries taGeneratedEvents so a new AM
+# attempt can re-route completed producers' DataMovementEvents without
+# re-running the tasks (RecoveryParser.parseRecoveryData:658).
+# ---------------------------------------------------------------------------
+
+def _payload_to_wire(p: Any) -> Any:
+    from tez_tpu.api.events import ShufflePayload
+    if p is None:
+        return None
+    if isinstance(p, ShufflePayload):
+        d = dataclasses.asdict(p)
+        ep = d.get("empty_partitions")
+        d["empty_partitions"] = ep.hex() if ep is not None else None
+        return {"__kind__": "shuffle", **d}
+    if isinstance(p, (bytes, bytearray)):
+        return {"__kind__": "bytes", "hex": bytes(p).hex()}
+    if isinstance(p, (dict, list, str, int, float, bool)):
+        return {"__kind__": "json", "value": p}
+    import pickle
+    return {"__kind__": "pickle", "hex": pickle.dumps(p).hex()}
+
+
+def _payload_from_wire(w: Any) -> Any:
+    from tez_tpu.api.events import ShufflePayload
+    if w is None:
+        return None
+    kind = w.get("__kind__")
+    if kind == "shuffle":
+        d = {k: v for k, v in w.items() if k != "__kind__"}
+        ep = d.get("empty_partitions")
+        d["empty_partitions"] = bytes.fromhex(ep) if ep else None
+        return ShufflePayload(**d)
+    if kind == "bytes":
+        return bytes.fromhex(w["hex"])
+    if kind == "json":
+        return w["value"]
+    import pickle
+    return pickle.loads(bytes.fromhex(w["hex"]))
+
+
+def event_to_wire(ev: Any) -> Dict[str, Any]:
+    from tez_tpu.api.events import (CompositeDataMovementEvent,
+                                    DataMovementEvent)
+    if isinstance(ev, DataMovementEvent):
+        return {"t": "DME", "source_index": ev.source_index,
+                "version": ev.version,
+                "payload": _payload_to_wire(ev.user_payload)}
+    if isinstance(ev, CompositeDataMovementEvent):
+        return {"t": "CDME", "source_index_start": ev.source_index_start,
+                "count": ev.count, "version": ev.version,
+                "payload": _payload_to_wire(ev.user_payload)}
+    import pickle
+    return {"t": "pickle", "hex": pickle.dumps(ev).hex()}
+
+
+def event_from_wire(w: Dict[str, Any]) -> Any:
+    from tez_tpu.api.events import (CompositeDataMovementEvent,
+                                    DataMovementEvent)
+    t = w["t"]
+    if t == "DME":
+        return DataMovementEvent(source_index=w["source_index"],
+                                 user_payload=_payload_from_wire(w["payload"]),
+                                 version=w["version"])
+    if t == "CDME":
+        return CompositeDataMovementEvent(
+            source_index_start=w["source_index_start"], count=w["count"],
+            user_payload=_payload_from_wire(w["payload"]),
+            version=w["version"])
+    import pickle
+    return pickle.loads(bytes.fromhex(w["hex"]))
 
 
 class RecoveryParser:
@@ -109,7 +190,9 @@ class RecoveryParser:
         dag_state = None
         commit_started = False
         completed_vertices: Dict[str, Dict[str, Any]] = {}
-        succeeded_tasks: Set[str] = set()
+        attempt_records: Dict[str, Dict[str, Any]] = {}  # attempt id -> data
+        task_last: Dict[str, Dict[str, Any]] = {}        # task id -> last finish
+        vertex_num_tasks: Dict[str, int] = {}
         for ev in dag_events:
             t = ev.event_type
             if t is HistoryEventType.DAG_FINISHED:
@@ -121,11 +204,36 @@ class RecoveryParser:
             elif t is HistoryEventType.VERTEX_FINISHED and \
                     ev.data.get("state") == "SUCCEEDED":
                 completed_vertices[ev.data.get("vertex_name")] = ev.data
-            elif t is HistoryEventType.TASK_FINISHED and \
+            elif t in (HistoryEventType.VERTEX_INITIALIZED,
+                       HistoryEventType.VERTEX_CONFIGURE_DONE):
+                name = ev.data.get("vertex_name")
+                n = ev.data.get("num_tasks")
+                if name is not None and n is not None:
+                    vertex_num_tasks[name] = n
+            elif t is HistoryEventType.TASK_ATTEMPT_FINISHED and \
                     ev.data.get("state") == "SUCCEEDED":
-                succeeded_tasks.add(ev.task_id)
+                attempt_records[ev.attempt_id] = ev.data
+            elif t is HistoryEventType.TASK_FINISHED:
+                task_last[ev.task_id] = ev.data    # last record wins: a task
+                # re-run after output loss journals a second TASK_FINISHED
+        succeeded_tasks: Set[str] = set()
+        task_data: Dict[str, Dict[str, Any]] = {}
+        for tid, td in task_last.items():
+            if td.get("state") != "SUCCEEDED":
+                continue
+            succeeded_tasks.add(tid)
+            att_id = td.get("successful_attempt")
+            att = attempt_records.get(att_id) if att_id else None
+            if att is None:
+                continue
+            task_data[tid] = {
+                "attempt": att_id,
+                "generated_events": att.get("generated_events", []),
+                "counters": att.get("counters", {}),
+            }
         return DAGRecoveryData(
             dag_id=last_dag_id, plan=plan, dag_state=dag_state,
             commit_in_flight=commit_started and dag_state is None,
             completed_vertices=completed_vertices,
-            succeeded_tasks=succeeded_tasks, events=dag_events)
+            succeeded_tasks=succeeded_tasks, events=dag_events,
+            task_data=task_data, vertex_num_tasks=vertex_num_tasks)

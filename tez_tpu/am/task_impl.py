@@ -71,6 +71,10 @@ class TaskAttemptImpl:
         self.creation_time: float = time.time()
         self.is_speculative = False
         self.output_failure_reports: Dict[int, int] = {}  # consumer task -> count
+        # (edge dest vertex name, event) pairs this attempt produced — journaled
+        # on success so AM recovery can re-route them without re-running the
+        # task (reference: TaskAttemptFinishedEvent taGeneratedEvents).
+        self.generated_events: List[tuple] = []
         self.sm = self._factory.make(self)
 
     @property
@@ -186,18 +190,23 @@ class TaskAttemptImpl:
                                         was_succeeded=True))
 
     def _finish_history(self, final_state: str) -> None:
+        data = {"state": final_state,
+                "vertex_name": self.vertex.name,
+                "time_taken": self.finish_time - (self.launch_time or
+                                                  self.finish_time),
+                "diagnostics": "; ".join(self.diagnostics),
+                "counters": self.counters.to_dict()}
+        if final_state == "SUCCEEDED" and self.generated_events:
+            from tez_tpu.am.recovery import event_to_wire
+            data["generated_events"] = [
+                [name, event_to_wire(ev)] for name, ev in self.generated_events]
         self.ctx.history(HistoryEvent(
             HistoryEventType.TASK_ATTEMPT_FINISHED,
             dag_id=str(self.attempt_id.dag_id),
             vertex_id=str(self.attempt_id.vertex_id),
             task_id=str(self.attempt_id.task_id),
             attempt_id=str(self.attempt_id),
-            data={"state": final_state,
-                  "vertex_name": self.vertex.name,
-                  "time_taken": self.finish_time - (self.launch_time or
-                                                    self.finish_time),
-                  "diagnostics": "; ".join(self.diagnostics),
-                  "counters": self.counters.to_dict()}))
+            data=data))
 
     def _notify_scheduler_ended(self, failed: bool = False) -> None:
         self.ctx.dispatch(SchedulerEvent(SchedulerEventType.S_TA_ENDED,
@@ -307,6 +316,53 @@ class TaskImpl:
     def _on_attempt_launched(self, event: TaskEvent) -> None:
         pass
 
+    def _on_recover(self, event: TaskEvent) -> None:
+        """AM recovery: restore this task as SUCCEEDED from journal data and
+        re-route its successful attempt's DataMovementEvents into the out-
+        edges, without launching anything (reference: RecoveryParser short-
+        circuit of TaskFinished/TaskAttemptFinished events, SURVEY.md §5.4).
+
+        If the restored output data turns out to be gone (runner died with
+        the AM), consumers report InputReadErrorEvents and the normal output-
+        loss path re-runs the task — same guarantee the reference gets when
+        a node is lost after recovery."""
+        rec: Dict[str, Any] = event.recovered
+        att_str: str = rec["attempt"]
+        try:
+            n = int(att_str.rsplit("_", 1)[1])
+        except (ValueError, IndexError):
+            n = 0
+        self.next_attempt_number = max(self.next_attempt_number, n + 1)
+        att = TaskAttemptImpl(self.task_id.attempt(n), self.vertex)
+        att.sm.force_state(TaskAttemptState.SUCCEEDED)
+        now = time.time()
+        att.progress = 1.0
+        att.launch_time = att.finish_time = now
+        counters = rec.get("counters")
+        if counters:
+            att.counters = TezCounters.from_dict(counters)
+        self.attempts[n] = att
+        self.successful_attempt = att.attempt_id
+        self.scheduled_time = self.finish_time = now
+        from tez_tpu.am.recovery import event_from_wire
+        for edge_name, wire in rec.get("generated_events", []):
+            ev = event_from_wire(wire)
+            edge = self.vertex.out_edges.get(edge_name)
+            if edge is None:
+                continue
+            edge.add_source_event(self.task_id.id, n, ev)
+            att.generated_events.append((edge_name, ev))
+            self.vertex.dag.notify_new_edge_events(edge)
+        self.ctx.dag_counters.increment(DAGCounter.NUM_SUCCEEDED_TASKS)
+        # Re-journal so the *next* AM attempt can recover from this journal
+        # alone (recovery is idempotent across attempts).
+        att._finish_history("SUCCEEDED")
+        self._finish_history("SUCCEEDED")
+        self.ctx.dispatch(VertexEvent(
+            VertexEventType.V_TASK_COMPLETED, self.task_id.vertex_id,
+            task_id=self.task_id, final_state=TaskState.SUCCEEDED,
+            attempt_id=att.attempt_id))
+
     def _on_add_spec_attempt(self, event: TaskEvent) -> None:
         if len(self.live_attempts()) < 2:
             self._spawn_attempt(speculative=True)
@@ -411,13 +467,16 @@ class TaskImpl:
         self._spawn_attempt()
 
     def _finish_history(self, final_state: str) -> None:
+        data = {"state": final_state, "vertex_name": self.vertex.name,
+                "time_taken": self.finish_time - self.scheduled_time}
+        if final_state == "SUCCEEDED" and self.successful_attempt is not None:
+            data["successful_attempt"] = str(self.successful_attempt)
         self.ctx.history(HistoryEvent(
             HistoryEventType.TASK_FINISHED,
             dag_id=str(self.task_id.dag_id),
             vertex_id=str(self.task_id.vertex_id),
             task_id=str(self.task_id),
-            data={"state": final_state, "vertex_name": self.vertex.name,
-                  "time_taken": self.finish_time - self.scheduled_time}))
+            data=data))
 
     def successful_attempt_impl(self) -> Optional[TaskAttemptImpl]:
         if self.successful_attempt is None:
@@ -429,6 +488,7 @@ def _build_task_factory() -> StateMachineFactory:
     S, E = TaskState, TaskEventType
     f = StateMachineFactory(S.NEW)
     f.add(S.NEW, S.SCHEDULED, E.T_SCHEDULE, TaskImpl._on_schedule)
+    f.add(S.NEW, S.SUCCEEDED, E.T_RECOVER, TaskImpl._on_recover)
     f.add_multi(S.NEW, (S.RUNNING, S.KILLED), E.T_TERMINATE,
                 TaskImpl._on_terminate)
     f.add(S.SCHEDULED, S.RUNNING, E.T_ATTEMPT_LAUNCHED, TaskImpl._on_attempt_launched)

@@ -91,6 +91,7 @@ class VertexImpl:
         self.initializers: Dict[str, Any] = {}
         self.vm_tasks_scheduled = False
         self.start_requested = False
+        self._recovered_tasks: Dict[int, Any] = {}  # task index -> journal data
         self.started_sources: Set[str] = set()
         self.completed_source_attempts: Set[TaskAttemptId] = set()
         self.sm = self._factory.make(self)
@@ -207,6 +208,7 @@ class VertexImpl:
             self._abort("FAILED")
             return VertexState.FAILED
         self._create_tasks()
+        self._load_recovered_tasks()
         self._create_committers()
         self._create_vertex_manager()
         self.ctx.history(HistoryEvent(
@@ -256,12 +258,31 @@ class VertexImpl:
             committer.setup_output()
             self.committers[sink.name] = committer
 
+    def _load_recovered_tasks(self) -> None:
+        """AM recovery: map journaled SUCCEEDED tasks onto this vertex's task
+        indices.  Only valid when the vertex's parallelism matches what the
+        journal recorded — a vertex whose auto-parallelism decision could
+        differ this run re-executes from scratch (safe default)."""
+        rec = getattr(self.dag, "recovery_data", None)
+        if rec is None or not rec.task_data:
+            return
+        if rec.vertex_num_tasks.get(self.name) != self.num_tasks:
+            return
+        for i in range(self.num_tasks):
+            td = rec.task_data.get(str(self.vertex_id.task(i)))
+            if td is not None:
+                self._recovered_tasks[i] = td
+        if self._recovered_tasks:
+            log.info("vertex %s: %d/%d tasks restorable from recovery journal",
+                     self.name, len(self._recovered_tasks), self.num_tasks)
+
     def _recreate_tasks(self, new_parallelism: int) -> None:
         """Auto-parallelism reconfiguration before any task scheduled."""
         assert not self.scheduled_task_indices, \
             "cannot reconfigure after tasks scheduled"
         self.num_tasks = new_parallelism
         self.tasks.clear()
+        self._recovered_tasks.clear()   # indices no longer meaningful
         self._create_tasks()
 
     def _create_vertex_manager(self) -> None:
@@ -309,8 +330,14 @@ class VertexImpl:
             if i in self.scheduled_task_indices:
                 continue
             self.scheduled_task_indices.add(i)
-            self.ctx.dispatch(TaskEvent(TaskEventType.T_SCHEDULE,
-                                        self.vertex_id.task(i)))
+            recovered = self._recovered_tasks.get(i)
+            if recovered is not None:
+                self.ctx.dispatch(TaskEvent(TaskEventType.T_RECOVER,
+                                            self.vertex_id.task(i),
+                                            recovered=recovered))
+            else:
+                self.ctx.dispatch(TaskEvent(TaskEventType.T_SCHEDULE,
+                                            self.vertex_id.task(i)))
 
     # ------------------------------------------------- completion tracking
     def _on_task_completed(self, event: VertexEvent) -> VertexState:
@@ -442,6 +469,12 @@ class VertexImpl:
                             src.edge_vertex_name if src else None)
                 return
             edge.add_source_event(src_task, version, ev)
+            # Remember what this attempt generated: journaled on success so AM
+            # recovery can re-route without re-running (taGeneratedEvents).
+            task = self.tasks.get(src_task)
+            att = task.attempts.get(version) if task is not None else None
+            if att is not None:
+                att.generated_events.append((src.edge_vertex_name, ev))
             self.dag.notify_new_edge_events(edge)
         elif isinstance(ev, InputFailedEvent):
             edge = self.out_edges.get(src.edge_vertex_name) if src else None

@@ -73,11 +73,18 @@ def _fnv_partition(key_mat: jnp.ndarray, lengths: jnp.ndarray,
 
 
 def hash_partition(key_mat: np.ndarray, lengths: np.ndarray,
-                   num_partitions: int) -> np.ndarray:
-    """Host wrapper with shape bucketing."""
+                   num_partitions: int, use_pallas: bool = False) -> np.ndarray:
+    """Host wrapper with shape bucketing.
+
+    use_pallas routes to the Pallas FNV kernel (same hash body) on TPU
+    backends; elsewhere it falls back to the XLA path so the flag is safe to
+    set fleet-wide."""
     n = key_mat.shape[0]
     if n == 0:
         return np.zeros(0, dtype=np.int32)
+    if use_pallas and jax.default_backend() == "tpu":
+        from tez_tpu.ops.pallas_kernels import hash_partition_pallas
+        return hash_partition_pallas(key_mat, lengths, num_partitions)
     nb = _bucket(n)
     if nb != n:
         key_mat = np.pad(key_mat, ((0, nb - n), (0, 0)))

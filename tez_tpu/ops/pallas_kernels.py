@@ -18,21 +18,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tez_tpu.ops.device import FNV_OFFSET, FNV_PRIME
-
 ROW_BLOCK = 1024
 
 
 def _fnv_kernel(key_ref, len_ref, out_ref):
-    """One grid step: hash ROW_BLOCK rows of a u32-cast byte matrix."""
-    w = key_ref.shape[1]
-    h = jnp.full((key_ref.shape[0],), FNV_OFFSET, dtype=jnp.uint32)
-    lengths = len_ref[:]
-    for j in range(w):   # static unroll: W is a trace-time constant
-        byte = key_ref[:, j]
-        nh = ((h ^ byte) * FNV_PRIME).astype(jnp.uint32)
-        h = jnp.where(j < lengths, nh, h)
-    out_ref[:] = h
+    """One grid step: hash ROW_BLOCK rows of a u32-cast byte matrix.
+
+    Delegates to device._fnv_rows — the ONE hash body shared by every kernel
+    — so the Pallas partitioner can never diverge from the host partitioner."""
+    from tez_tpu.ops.device import _fnv_rows
+    out_ref[:] = _fnv_rows(key_ref[:], len_ref[:])
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -56,6 +56,76 @@ def test_analyzers_produce_results(history_dir):
     assert by_name["hung_tasks"].rows == []
     reuse = by_name["container_reuse"]
     assert sum(r.get("tasks_run", 0) for r in reuse.rows) >= 5
+    # full reference plugin set
+    overview = by_name["dag_overview"]
+    assert {r["vertex"] for r in overview.rows} == \
+        {"tokenizer", "summation", "sorter"}
+    assert all(r["task_states"].get("SUCCEEDED") for r in overview.rows)
+    assert by_name["input_read_errors"].rows == []
+    loc = by_name["locality"].rows
+    assert loc and all(r["local_fraction"] == 1.0 for r in loc)  # single host
+    crit = by_name["vertex_critical_path"]
+    assert [r["vertex"] for r in crit.rows] == \
+        ["tokenizer", "summation", "sorter"]
+    assert by_name["task_assignment"].rows
+    assert by_name["attempt_result_stats"].rows
+    assert by_name["slow_nodes"].rows
+    assert by_name["one_on_one_edges"].rows == []  # no 1-1 edges in this DAG
+
+
+from tez_tpu.library.processors import SimpleProcessor  # noqa: E402
+
+
+class OneToOneEmitter(SimpleProcessor):
+    """Module-level so descriptors can resolve tests.test_tools:OneToOneEmitter."""
+
+    def run(self, inputs, outputs):
+        outputs["b"].get_writer().write(b"k", b"v")
+
+
+class OneToOneReader(SimpleProcessor):
+    def run(self, inputs, outputs):
+        list(inputs["a"].get_reader())
+
+
+def test_one_on_one_edge_analyzer(tmp_path):
+    """ONE_TO_ONE edge placement analysis over a real 1-1 DAG run."""
+    from tez_tpu.client.tez_client import TezClient
+    from tez_tpu.common.payload import (InputDescriptor, OutputDescriptor,
+                                        ProcessorDescriptor)
+    from tez_tpu.dag.dag import DAG, Edge, Vertex
+    from tez_tpu.dag.edge_property import (DataMovementType, DataSourceType,
+                                           EdgeProperty, SchedulingType)
+    from tez_tpu.tools.analyzers import OneOnOneEdgeAnalyzer
+    hist = str(tmp_path / "hist")
+    c = TezClient.create("oo", {
+        "tez.staging-dir": str(tmp_path / "s"),
+        "tez.history.logging.service.class":
+            "tez_tpu.am.history:JsonlHistoryLoggingService",
+        "tez.history.logging.log-dir": hist}).start()
+    try:
+        kv = {"tez.runtime.key.class": "bytes",
+              "tez.runtime.value.class": "bytes"}
+        a = Vertex.create("a", ProcessorDescriptor.create(OneToOneEmitter), 2)
+        b = Vertex.create("b", ProcessorDescriptor.create(OneToOneReader), 2)
+        prop = EdgeProperty.create(
+            DataMovementType.ONE_TO_ONE, DataSourceType.PERSISTED,
+            SchedulingType.SEQUENTIAL,
+            OutputDescriptor.create(
+                "tez_tpu.library.unordered:UnorderedKVOutput", payload=kv),
+            InputDescriptor.create(
+                "tez_tpu.library.unordered:UnorderedKVInput", payload=kv))
+        dag = DAG.create("oodag").add_vertex(a).add_vertex(b)
+        dag.add_edge(Edge.create(a, b, prop))
+        st = c.submit_dag(dag).wait_for_completion(timeout=30)
+        assert st.state.name == "SUCCEEDED"
+    finally:
+        c.stop()
+    dags = parse_jsonl_files([os.path.join(hist, "*.jsonl")])
+    dag_info = list(dags.values())[0]
+    assert dag_info.edges and dag_info.edges[0]["movement"] == "ONE_TO_ONE"
+    res = OneOnOneEdgeAnalyzer().analyze(dag_info)
+    assert res.rows == [{"edge": "a->b", "pairs": 2, "colocated": 2}]
 
 
 def test_swimlane_svg(history_dir):

@@ -113,7 +113,8 @@ class TaskAttemptImpl:
             task_id=str(self.attempt_id.task_id),
             attempt_id=str(self.attempt_id),
             container_id=str(self.container_id),
-            data={"vertex_name": self.vertex.name}))
+            data={"vertex_name": self.vertex.name,
+                  "node_id": self.node_id}))
         self.ctx.dispatch(TaskEvent(TaskEventType.T_ATTEMPT_LAUNCHED,
                                     self.attempt_id.task_id,
                                     attempt_id=self.attempt_id))

@@ -58,6 +58,7 @@ class TaskCounter(enum.Enum):
     FIRST_EVENT_RECEIVED = enum.auto()
     LAST_EVENT_RECEIVED = enum.auto()
     NUM_SHUFFLED_INPUTS = enum.auto()
+    LOCAL_SHUFFLED_INPUTS = enum.auto()   # same-host handoff (DATA_LOCAL analog)
     NUM_SKIPPED_INPUTS = enum.auto()
     NUM_FAILED_SHUFFLE_INPUTS = enum.auto()
     MERGED_MAP_OUTPUTS = enum.auto()

@@ -72,8 +72,11 @@ class ShuffleFetchTable:
         short-circuit vs HTTP fetch split)."""
         if payload.port == 0 or (payload.host, payload.port) == \
                 (self.local_host, self.local_port):
-            return self.service.fetch_partition(
+            batch = self.service.fetch_partition(
                 payload.path_component, payload.spill_id, partition)
+            self.context.counters.increment(
+                TaskCounter.LOCAL_SHUFFLED_INPUTS)
+            return batch
         from tez_tpu.shuffle.server import ShuffleFetcher
         if self._secret is None:
             # config gap on THIS consumer, not producer data loss: must not

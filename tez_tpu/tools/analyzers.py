@@ -1,9 +1,13 @@
 """Post-hoc DAG analyzers over DagInfo.
 
-Reference parity: tez-tools/analyzers/job-analyzer/.../plugins/ (19 analyzers
-via AnalyzerDriver) — the core set: CriticalPathAnalyzer:53,
+Reference parity: tez-tools/analyzers/job-analyzer/.../plugins/ via
+AnalyzerDriver — full plugin set: CriticalPathAnalyzer:53,
 ShuffleTimeAnalyzer, SkewAnalyzer, SpillAnalyzerImpl, SlowestVertexAnalyzer,
-ContainerReuseAnalyzer, HungTaskAnalyzer, SpeculationAnalyzer.
+ContainerReuseAnalyzer, HungTaskAnalyzer, TaskConcurrencyAnalyzer,
+SlowTaskIdentifier, DagOverviewAnalyzer, InputReadErrorAnalyzer,
+LocalityAnalyzer, OneOnOneEdgeAnalyzer, SlowNodeAnalyzer,
+TaskAssignmentAnalyzer, TaskAttemptResultStatisticsAnalyzer,
+VertexLevelCriticalPathAnalyzer (+ speculation and IO-ratio extras).
 """
 from __future__ import annotations
 
@@ -251,11 +255,233 @@ class InputOutputRatioAnalyzer(Analyzer):
                               rows)
 
 
+class DagOverviewAnalyzer(Analyzer):
+    """One-row-per-vertex DAG summary (reference: DagOverviewAnalyzer)."""
+    name = "dag_overview"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        rows = []
+        for v in sorted(dag.vertices.values(), key=lambda v: v.start_time):
+            states: Dict[str, int] = {}
+            for t in v.tasks.values():
+                states[t.state or "RUNNING"] = \
+                    states.get(t.state or "RUNNING", 0) + 1
+            rows.append({
+                "vertex": v.name, "state": v.state, "num_tasks": v.num_tasks,
+                "task_states": states,
+                "duration_s": round(v.duration, 3),
+            })
+            first = min((t.start_time for t in v.tasks.values()
+                         if t.start_time), default=v.start_time)
+            # vertices that never started (upstream failure) have no offset
+            rows[-1]["first_task_start_offset"] = \
+                round(first - dag.start_time, 3) if first else None
+        return AnalyzerResult(
+            self.name,
+            f"{dag.name}: {dag.state}, {len(rows)} vertices, "
+            f"{sum(r['num_tasks'] for r in rows)} tasks, "
+            f"{dag.duration:.2f}s", rows)
+
+
+class InputReadErrorAnalyzer(Analyzer):
+    """Fetch failures and output-loss reruns (reference:
+    InputReadErrorAnalyzer over INPUT_READ_ERROR events)."""
+    name = "input_read_errors"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        rows = []
+        for a in dag.all_attempts():
+            failed = a.counter("TaskCounter", "NUM_FAILED_SHUFFLE_INPUTS")
+            output_lost = "output lost" in (a.diagnostics or "")
+            if failed or output_lost:
+                rows.append({"attempt": a.attempt_id, "vertex": a.vertex_name,
+                             "failed_fetches": failed,
+                             "output_lost_rerun": output_lost,
+                             "state": a.state})
+        return AnalyzerResult(
+            self.name,
+            f"{sum(r['failed_fetches'] for r in rows)} failed fetches, "
+            f"{sum(r['output_lost_rerun'] for r in rows)} output-loss reruns",
+            rows)
+
+
+class LocalityAnalyzer(Analyzer):
+    """Local vs remote shuffle reads per vertex (reference: LocalityAnalyzer
+    over DATA_LOCAL_TASKS; here locality = same-host buffer handoff vs DCN
+    fetch, SURVEY.md §2.10)."""
+    name = "locality"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        rows = []
+        for v in dag.vertices.values():
+            tc = v.counters.get("TaskCounter", {})
+            local = tc.get("LOCAL_SHUFFLED_INPUTS", 0)
+            total = tc.get("NUM_SHUFFLED_INPUTS", 0)
+            if total:
+                rows.append({"vertex": v.name, "shuffled_inputs": total,
+                             "local_inputs": local,
+                             "local_fraction": round(local / total, 3)})
+        return AnalyzerResult(
+            self.name,
+            (f"{sum(r['local_inputs'] for r in rows)}/"
+             f"{sum(r['shuffled_inputs'] for r in rows)} inputs read locally"
+             if rows else "no shuffled inputs"), rows)
+
+
+class OneOnOneEdgeAnalyzer(Analyzer):
+    """For ONE_TO_ONE edges: did task i of src and dst land on the same
+    node (affinity working)? (reference: OneOnOneEdgeAnalyzer)."""
+    name = "one_on_one_edges"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        def placement(vertex_name: str) -> Dict[int, str]:
+            v = dag.vertex(vertex_name)
+            out: Dict[int, str] = {}
+            if v is None:
+                return out
+            for t in v.tasks.values():
+                a = t.successful_attempt
+                if a is None:
+                    continue
+                try:
+                    idx = int(t.task_id.rsplit("_", 1)[1])
+                except (ValueError, IndexError):
+                    continue
+                where = a.node_id or a.container_id
+                if where:          # unknown placement must not count as a
+                    out[idx] = where   # colocated ''=='' match
+            return out
+
+        rows = []
+        for e in dag.edges:
+            if e.get("movement") != "ONE_TO_ONE":
+                continue
+            src, dst = placement(e["src"]), placement(e["dst"])
+            common = set(src) & set(dst)
+            colocated = sum(1 for i in common if src[i] == dst[i])
+            rows.append({"edge": f"{e['src']}->{e['dst']}",
+                         "pairs": len(common), "colocated": colocated})
+        return AnalyzerResult(
+            self.name,
+            f"{len(rows)} ONE_TO_ONE edges" if rows
+            else "no ONE_TO_ONE edges", rows)
+
+
+class SlowNodeAnalyzer(Analyzer):
+    """Mean attempt duration + failure count per node — is one host slow or
+    flaky? (reference: SlowNodeAnalyzer)."""
+    name = "slow_nodes"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        per_node: Dict[str, List] = {}
+        for a in dag.all_attempts():
+            if not a.finish_time:
+                continue
+            per_node.setdefault(a.node_id or a.container_id or "?",
+                                []).append(a)
+        rows = []
+        for node, atts in sorted(per_node.items()):
+            durs = [a.duration for a in atts]
+            rows.append({
+                "node": node, "attempts": len(atts),
+                "mean_s": round(sum(durs) / len(durs), 3),
+                "failed": sum(1 for a in atts if a.state == "FAILED"),
+            })
+        slowest = max(rows, key=lambda r: r["mean_s"], default=None)
+        return AnalyzerResult(
+            self.name,
+            f"slowest node {slowest['node']} (mean {slowest['mean_s']}s)"
+            if slowest else "no finished attempts", rows)
+
+
+class TaskAssignmentAnalyzer(Analyzer):
+    """Attempts per node per vertex — assignment spread (reference:
+    TaskAssignmentAnalyzer)."""
+    name = "task_assignment"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        rows = []
+        for v in dag.vertices.values():
+            per_node: Dict[str, int] = {}
+            for t in v.tasks.values():
+                for a in t.attempts.values():
+                    key = a.node_id or a.container_id or "?"
+                    per_node[key] = per_node.get(key, 0) + 1
+            if per_node:
+                rows.append({"vertex": v.name, "per_node": per_node,
+                             "nodes_used": len(per_node)})
+        return AnalyzerResult(self.name,
+                              f"{len(rows)} vertices placed", rows)
+
+
+class TaskAttemptResultStatisticsAnalyzer(Analyzer):
+    """Attempt terminal-state counts per (vertex, node) (reference:
+    TaskAttemptResultStatisticsAnalyzer)."""
+    name = "attempt_result_stats"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        stats: Dict[tuple, Dict[str, int]] = {}
+        for a in dag.all_attempts():
+            key = (a.vertex_name, a.node_id or a.container_id or "?")
+            bucket = stats.setdefault(key, {})
+            state = a.state or "RUNNING"
+            bucket[state] = bucket.get(state, 0) + 1
+        rows = [{"vertex": v, "node": n, "states": s}
+                for (v, n), s in sorted(stats.items())]
+        total_failed = sum(s.get("FAILED", 0) for s in stats.values())
+        return AnalyzerResult(
+            self.name,
+            f"{len(rows)} (vertex,node) buckets, {total_failed} failed",
+            rows)
+
+
+class VertexLevelCriticalPathAnalyzer(Analyzer):
+    """Longest dependency chain through the DAG's edges weighted by vertex
+    durations (reference: VertexLevelCriticalPathAnalyzer; the flat
+    CriticalPathAnalyzer above ranks by span only)."""
+    name = "vertex_critical_path"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        preds: Dict[str, List[str]] = {}
+        for e in dag.edges:
+            preds.setdefault(e["dst"], []).append(e["src"])
+        names = [v.name for v in dag.vertices.values()]
+        durs = {v.name: v.duration for v in dag.vertices.values()}
+        memo: Dict[str, tuple] = {}
+
+        def longest(name: str) -> tuple:
+            """(total duration, path list) of the heaviest chain ending at
+            `name`; cycles are impossible (DAG.verify)."""
+            if name in memo:
+                return memo[name]
+            best = (0.0, [])
+            for p in preds.get(name, []):
+                cand = longest(p)
+                if cand[0] > best[0]:
+                    best = cand
+            memo[name] = (best[0] + durs.get(name, 0.0), best[1] + [name])
+            return memo[name]
+
+        if not names:
+            return AnalyzerResult(self.name, "empty DAG", [])
+        total, path = max((longest(n) for n in names), key=lambda x: x[0])
+        rows = [{"vertex": n, "duration_s": round(durs.get(n, 0.0), 3)}
+                for n in path]
+        frac = f" ({total / dag.duration:.0%} of DAG)" if dag.duration else \
+            " (DAG unfinished)"
+        return AnalyzerResult(
+            self.name,
+            f"critical path {' -> '.join(path)} = {total:.2f}s{frac}", rows)
+
+
 ALL_ANALYZERS: Sequence[Analyzer] = (
     CriticalPathAnalyzer(), ShuffleTimeAnalyzer(), SkewAnalyzer(),
     SpillAnalyzer(), SlowestVertexAnalyzer(), ContainerReuseAnalyzer(),
     SpeculationAnalyzer(), HungTaskAnalyzer(), TaskConcurrencyAnalyzer(),
-    SlowTaskAttemptAnalyzer(), InputOutputRatioAnalyzer())
+    SlowTaskAttemptAnalyzer(), InputOutputRatioAnalyzer(),
+    DagOverviewAnalyzer(), InputReadErrorAnalyzer(), LocalityAnalyzer(),
+    OneOnOneEdgeAnalyzer(), SlowNodeAnalyzer(), TaskAssignmentAnalyzer(),
+    TaskAttemptResultStatisticsAnalyzer(), VertexLevelCriticalPathAnalyzer())
 
 
 def analyze_dag(dag: DagInfo,

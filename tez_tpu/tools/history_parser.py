@@ -22,6 +22,7 @@ class AttemptInfo:
     task_id: str
     vertex_name: str
     container_id: str = ""
+    node_id: str = ""
     start_time: float = 0.0
     finish_time: float = 0.0
     state: str = ""
@@ -82,6 +83,9 @@ class DagInfo:
     counters: Dict = dataclasses.field(default_factory=dict)
     vertices: Dict[str, VertexInfo] = dataclasses.field(default_factory=dict)
     containers: Dict[str, Dict] = dataclasses.field(default_factory=dict)
+    # DAG structure recovered from the journaled plan: list of
+    # {"src": name, "dst": name, "movement": DataMovementType name}
+    edges: List[Dict] = dataclasses.field(default_factory=list)
 
     @property
     def duration(self) -> float:
@@ -114,6 +118,18 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
         if t is HistoryEventType.DAG_SUBMITTED and d:
             d.name = ev.data.get("dag_name", "")
             d.submit_time = ev.timestamp
+            raw = ev.data.get("plan")
+            if raw:
+                try:
+                    from tez_tpu.dag.plan import DAGPlan
+                    plan = DAGPlan.deserialize(bytes.fromhex(raw))
+                    d.edges = [
+                        {"src": e.input_vertex, "dst": e.output_vertex,
+                         "movement":
+                         e.edge_property.data_movement_type.name}
+                        for e in plan.edges]
+                except Exception:  # noqa: BLE001 — plan schema drift is
+                    pass           # tolerable; edge-aware analyzers degrade
         elif t is HistoryEventType.DAG_STARTED and d:
             d.start_time = ev.timestamp
         elif t is HistoryEventType.DAG_FINISHED and d:
@@ -155,6 +171,7 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
                 ev.attempt_id, ev.task_id,
                 ev.data.get("vertex_name", v.name),
                 container_id=ev.container_id or "",
+                node_id=ev.data.get("node_id", ""),
                 start_time=ev.timestamp)
         elif t is HistoryEventType.TASK_ATTEMPT_FINISHED and d:
             v = d.vertices.setdefault(ev.vertex_id, VertexInfo(ev.vertex_id))

@@ -12,8 +12,8 @@ from tez_tpu.common.payload import (InputDescriptor, OutputDescriptor,
                                     EdgeManagerPluginDescriptor,
                                     OutputCommitterDescriptor)
 from tez_tpu.dag.dag import (DAG, DataSinkDescriptor, Edge, Vertex)
-from tez_tpu.dag.edge_property import (DataSourceType, EdgeProperty,
-                                       SchedulingType)
+from tez_tpu.dag.edge_property import (DataMovementType, DataSourceType,
+                                       EdgeProperty, SchedulingType)
 from tez_tpu.library.cartesian_product import CartesianProductCombination
 from tez_tpu.library.fair_shuffle import compute_fair_mappings
 from tez_tpu.library.processors import SimpleProcessor
@@ -122,6 +122,221 @@ def test_fair_mapping_splits_skew():
     slices = sorted((lo, hi) for p, lo, hi in mappings if p == 1)
     assert slices[0][0] == 0 and slices[-1][1] == 4
     assert all(s[1] == t[0] for s, t in zip(slices, slices[1:]))
+
+
+class SkewedEmitter(SimpleProcessor):
+    """Emits one hot key heavily plus a few cold keys (payload: n_hot)."""
+
+    def run(self, inputs, outputs):
+        payload = self.context.user_payload.load() or {}
+        w = outputs["sum"].get_writer()
+        for _ in range(payload.get("n_hot", 200)):
+            w.write(b"hotkey", 1)
+        for i in range(10):
+            w.write(f"cold{i}".encode(), 1)
+
+
+class TwoInputSummer(SimpleProcessor):
+    """Sums grouped counts from BOTH source edges into a per-task file."""
+
+    def run(self, inputs, outputs):
+        payload = self.context.user_payload.load() or {}
+        totals = collections.Counter()
+        for name in ("a", "b"):
+            for k, vs in inputs[name].get_reader():
+                totals[k] += sum(vs)
+        path = os.path.join(payload["out_dir"],
+                            f"part-{self.context.task_index}")
+        with open(path, "w") as fh:
+            for k, v in totals.items():
+                fh.write(f"{k.decode()}\t{v}\n")
+
+
+class _FakeVMContext:
+    """Minimal VertexManagerPluginContext stub for decision-logic tests."""
+
+    def __init__(self, payload, in_edges, num_tasks):
+        from tez_tpu.common.payload import UserPayload
+        self._payload = UserPayload.of(payload)
+        self._in_edges = in_edges              # name -> EdgeProperty
+        self._num_tasks = dict(num_tasks)      # vertex name -> parallelism
+        self.vertex_name_ = "consumer"
+        self.scheduled = []
+        self.reconfigured = None               # (parallelism, edge props)
+
+    @property
+    def vertex_name(self):
+        return self.vertex_name_
+
+    @property
+    def user_payload(self):
+        return self._payload
+
+    def get_vertex_num_tasks(self, name):
+        return self._num_tasks[name]
+
+    def get_input_vertex_edge_properties(self):
+        return dict(self._in_edges)
+
+    def get_output_vertex_edge_properties(self):
+        return {}
+
+    def get_input_vertex_groups(self):
+        return {}
+
+    def schedule_tasks(self, requests):
+        self.scheduled.extend(r.task_index for r in requests)
+
+    def reconfigure_vertex(self, parallelism, source_edge_properties=None,
+                           **_kw):
+        self.reconfigured = (parallelism, source_edge_properties)
+        self._num_tasks[self.vertex_name_] = parallelism
+
+    def vertex_reconfiguration_planned(self):
+        pass
+
+    def done_reconfiguring_vertex(self):
+        pass
+
+    def register_for_vertex_state_updates(self, vertex_name, states):
+        pass
+
+
+def _sg_prop():
+    kv = {"tez.runtime.key.class": "bytes", "tez.runtime.value.class": "long"}
+    return EdgeProperty.create(
+        DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create(
+            "tez_tpu.library.outputs:OrderedPartitionedKVOutput", payload=kv),
+        InputDescriptor.create(
+            "tez_tpu.library.inputs:OrderedGroupedKVInput", payload=kv))
+
+
+def _bc_prop():
+    kv = {"tez.runtime.key.class": "bytes", "tez.runtime.value.class": "bytes"}
+    return EdgeProperty.create(
+        DataMovementType.BROADCAST, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create(
+            "tez_tpu.library.unordered:UnorderedKVOutput", payload=kv),
+        InputDescriptor.create(
+            "tez_tpu.library.unordered:UnorderedKVInput", payload=kv))
+
+
+def _vm_event(vec, vertex, task):
+    from tez_tpu.api.events import VertexManagerEvent
+
+    class _Att:
+        class task_id:
+            id = task
+        vertex_id = vertex
+    ev = VertexManagerEvent("consumer", {"partition_sizes": vec,
+                                         "output_size": sum(vec)})
+    ev.producer_attempt = _Att()
+    ev.producer_vertex_name = vertex
+    return ev
+
+
+def test_fair_shuffle_broadcast_does_not_inflate_fraction():
+    """A finished BROADCAST side-input must not count toward the shuffle
+    completion fraction, which gates the (irreversible) split decision."""
+    from tez_tpu.api.vertex_manager import TaskAttemptIdentifier
+    from tez_tpu.library.fair_shuffle import FairShuffleVertexManager
+    ctx = _FakeVMContext(
+        {"desired_task_input_size": 100, "min_fraction": 1.0,
+         "max_fraction": 1.0},
+        {"sg": _sg_prop(), "bc": _bc_prop()},
+        {"sg": 4, "bc": 4, "consumer": 2})
+    vm = FairShuffleVertexManager(ctx)
+    vm.initialize()
+    vm.on_vertex_started([])
+    # the whole broadcast source finishes first, no SG stats yet
+    for i in range(4):
+        vm.on_source_task_completed(TaskAttemptIdentifier("bc", i, 0))
+    assert not vm._parallelism_determined, \
+        "broadcast completions finalized the split decision prematurely"
+    # now the skewed SG source reports and completes -> split happens
+    for i in range(4):
+        vm.on_vertex_manager_event_received(_vm_event([400, 10], "sg", i))
+        vm.on_source_task_completed(TaskAttemptIdentifier("sg", i, 0))
+    assert ctx.reconfigured is not None
+    assert ctx.reconfigured[0] > 2     # hot partition split
+
+
+def test_fair_shuffle_projects_unreported_source():
+    """An SG source vertex with no stats yet is projected at the observed
+    per-task average, not counted as zero (which would hide its skew)."""
+    from tez_tpu.api.vertex_manager import TaskAttemptIdentifier
+    from tez_tpu.library.fair_shuffle import FairShuffleVertexManager
+    # a: 3 tasks reporting [400, 10]; b: 2 tasks, silent.
+    # a-only projection: partition0 = 1200 < 1500 -> no split.
+    # with b projected at avg: 1200 + 2*400 = 2000 >= 1500 -> split.
+    ctx = _FakeVMContext(
+        {"desired_task_input_size": 1500, "min_fraction": 0.5,
+         "max_fraction": 0.5},
+        {"a": _sg_prop(), "b": _sg_prop()},
+        {"a": 3, "b": 2, "consumer": 2})
+    vm = FairShuffleVertexManager(ctx)
+    vm.initialize()
+    vm.on_vertex_started([])
+    for i in range(3):
+        vm.on_vertex_manager_event_received(_vm_event([400, 10], "a", i))
+        vm.on_source_task_completed(TaskAttemptIdentifier("a", i, 0))
+    # fraction = 3/5 >= 0.5 -> decision ran with b unreported
+    assert vm._parallelism_determined
+    assert ctx.reconfigured is not None, \
+        "unreported source counted as zero; skew split skipped"
+    assert ctx.reconfigured[0] > 2
+    # every slice carries per-edge ranges for BOTH edges
+    assert set(ctx.reconfigured[1]) == {"a", "b"}
+
+
+def test_fair_shuffle_multi_source(client, tmp_path):
+    """Two scatter-gather sources with different parallelism feed one fair-
+    shuffle consumer: the hot partition is split with per-edge source ranges
+    (reference: FairShuffleVertexManager over multiple edges) and global
+    sums stay correct."""
+    out_dir = str(tmp_path / "out")
+    os.makedirs(out_dir)
+    kv = {"tez.runtime.key.class": "bytes", "tez.runtime.value.class": "long"}
+    a = Vertex.create("a", ProcessorDescriptor.create(
+        SkewedEmitter, payload={"n_hot": 300}), 3)
+    b = Vertex.create("b", ProcessorDescriptor.create(
+        SkewedEmitter, payload={"n_hot": 200}), 2)
+    consumer = Vertex.create("sum", ProcessorDescriptor.create(
+        TwoInputSummer, payload={"out_dir": out_dir}), 2)
+    consumer.set_vertex_manager_plugin(VertexManagerPluginDescriptor.create(
+        "tez_tpu.library.fair_shuffle:FairShuffleVertexManager",
+        payload={"desired_task_input_size": 512,
+                 "min_fraction": 1.0, "max_fraction": 1.0}))
+
+    def sg_edge(src):
+        return Edge.create(src, consumer, EdgeProperty.create(
+            DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+            SchedulingType.SEQUENTIAL,
+            OutputDescriptor.create(
+                "tez_tpu.library.outputs:OrderedPartitionedKVOutput",
+                payload=kv),
+            InputDescriptor.create(
+                "tez_tpu.library.inputs:OrderedGroupedKVInput", payload=kv)))
+
+    dag = DAG.create("fair_multi").add_vertex(a).add_vertex(b) \
+        .add_vertex(consumer)
+    dag.add_edge(sg_edge(a)).add_edge(sg_edge(b))
+    status = client.submit_dag(dag).wait_for_completion(timeout=60)
+    assert status.state is DAGStatusState.SUCCEEDED
+    got = collections.Counter()
+    for f in os.listdir(out_dir):
+        for line in open(os.path.join(out_dir, f)):
+            k, v = line.rstrip("\n").split("\t")
+            got[k] += int(v)
+    expected = collections.Counter({"hotkey": 3 * 300 + 2 * 200})
+    for i in range(10):
+        expected[f"cold{i}"] = 5   # 3 a-tasks + 2 b-tasks, 1 each
+    assert got == dict(expected)
+    # the hot partition was split across source ranges on BOTH edges
+    assert status.vertex_status["sum"].progress.total_task_count > 2
 
 
 def test_fair_shuffle_e2e_splits_hot_partition(client, tmp_path):

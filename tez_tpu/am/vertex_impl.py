@@ -484,6 +484,7 @@ class VertexImpl:
             target = self.dag.vertex_by_name(ev.target_vertex_name)
             if target is not None and target.vertex_manager is not None:
                 ev.producer_attempt = attempt_id
+                ev.producer_vertex_name = src.task_vertex_name if src else ""
                 target.vertex_manager.on_vertex_manager_event(ev)
         elif isinstance(ev, InputReadErrorEvent):
             self._handle_input_read_error(ev, src, src_task)

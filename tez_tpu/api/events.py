@@ -87,6 +87,7 @@ class VertexManagerEvent(TezAPIEvent):
     target_vertex_name: str
     user_payload: Any
     producer_attempt: Any = None
+    producer_vertex_name: str = ""   # filled by the AM during routing
 
 
 @dataclasses.dataclass

@@ -82,36 +82,52 @@ class FairShuffleEdgeManager(EdgeManagerPluginOnDemand):
         return lo + dest_failed_input_index
 
 
+#: abstract slice: (partition, piece index, total pieces of that partition)
+FairSlice = Tuple[int, int, int]
+
+
+def compute_fair_slices(partition_totals: Sequence[float],
+                        desired_task_input_size: int, max_tasks: int,
+                        max_split: int) -> List[FairSlice]:
+    """Split oversized partitions into abstract pieces, keep small ones whole
+    (reference: FairShuffleVertexManager routing computation).  Pieces are
+    edge-independent — each edge later maps piece i/n onto its OWN source
+    range, which is how several scatter-gather sources share one slicing.
+    When a task cap is set, the per-task size target is grown until the
+    slice count fits — slices are COARSENED, never dropped (every
+    (partition, source) pair must keep exactly one destination)."""
+    size = max(1, desired_task_input_size)
+    while True:
+        slices: List[FairSlice] = []
+        for p, total in enumerate(partition_totals):
+            pieces = max(1, int(math.ceil(total / size)))
+            pieces = min(pieces, max(1, max_split))
+            for i in range(pieces):
+                slices.append((p, i, pieces))
+        if max_tasks <= 0 or len(slices) <= max_tasks or \
+                len(slices) <= len(partition_totals):
+            return slices
+        size *= 2
+        log.info("fair shuffle: %d slices over cap %d, growing target to %d",
+                 len(slices), max_tasks, size)
+
+
+def source_range(piece: int, pieces: int, num_sources: int) -> Tuple[int, int]:
+    """Piece i of n over this edge's source tasks.  Ranges tile
+    [0, num_sources) exactly; an edge with fewer sources than pieces yields
+    empty ranges for some pieces (that slice just reads nothing here)."""
+    return (piece * num_sources // pieces,
+            (piece + 1) * num_sources // pieces)
+
+
 def compute_fair_mappings(partition_totals: Sequence[int], num_sources: int,
                           desired_task_input_size: int,
                           max_tasks: int) -> List[DestMapping]:
-    """Split oversized partitions by source range, keep small ones whole
-    (reference: FairShuffleVertexManager routing computation).  When a task
-    cap is set, the per-task size target is grown until the slice count
-    fits — slices are COARSENED, never dropped (every (partition, source)
-    pair must keep exactly one destination)."""
-    size = max(1, desired_task_input_size)
-    while True:
-        mappings: List[DestMapping] = []
-        for p, total in enumerate(partition_totals):
-            pieces = max(1, int(math.ceil(total / size)))
-            pieces = min(pieces, num_sources)  # can't split finer than sources
-            if pieces == 1:
-                mappings.append((p, 0, num_sources))
-                continue
-            base = num_sources // pieces
-            extra = num_sources % pieces
-            lo = 0
-            for i in range(pieces):
-                hi = lo + base + (1 if i < extra else 0)
-                mappings.append((p, lo, hi))
-                lo = hi
-        if max_tasks <= 0 or len(mappings) <= max_tasks or \
-                len(mappings) <= len(partition_totals):
-            return mappings
-        size *= 2
-        log.info("fair shuffle: %d slices over cap %d, growing target to %d",
-                 len(mappings), max_tasks, size)
+    """Single-edge convenience: slices resolved to concrete source ranges."""
+    slices = compute_fair_slices(partition_totals, desired_task_input_size,
+                                 max_tasks, num_sources)
+    return [(p, *source_range(i, pieces, num_sources))
+            for p, i, pieces in slices]
 
 
 class FairShuffleVertexManager(ShuffleVertexManager):
@@ -147,7 +163,9 @@ class FairShuffleVertexManager(ShuffleVertexManager):
             # only scatter-gather stats match the partition space; e.g. a
             # broadcast side-input reports a 1-element vector — ignore it
             if len(vec) == declared:
-                key = (str(getattr(att, "vertex_id", att)),
+                vname = event.producer_vertex_name or \
+                    str(getattr(att, "vertex_id", att))
+                key = (vname,
                        att.task_id.id if hasattr(att, "task_id") else 0)
                 self._partition_stats[key] = vec
         super().on_vertex_manager_event_received(event)
@@ -156,21 +174,15 @@ class FairShuffleVertexManager(ShuffleVertexManager):
         if self._parallelism_determined:
             return True
         sg_sources = self._sg_source_names()
-        if len(sg_sources) != 1:
-            # source-range splitting needs ONE scatter-gather source; with
-            # several, ranges are ambiguous per edge — fall back to plain
-            # shuffle behavior (round-1 limitation; reference supports
-            # per-edge range payloads)
-            if len(sg_sources) > 1:
-                log.warning("%s: fair shuffle with %d SG sources -> "
-                            "no splitting", self.context.vertex_name,
-                            len(sg_sources))
+        if not sg_sources:
             self._parallelism_determined = True
             return True
-        num_sources = self.context.get_vertex_num_tasks(sg_sources[0])
-        if num_sources <= 0:
+        num_by_src = {s: self.context.get_vertex_num_tasks(s)
+                      for s in sg_sources}
+        if any(n <= 0 for n in num_by_src.values()):
             return False
-        fraction = len(self._completed_sources) / num_sources
+        total_sources = sum(num_by_src.values())
+        fraction = self._completed_fraction(sg_sources, total_sources)
         if not self._partition_stats:
             if fraction >= 1.0:
                 self._parallelism_determined = True
@@ -178,34 +190,59 @@ class FairShuffleVertexManager(ShuffleVertexManager):
             return False
         if fraction < self.min_fraction:
             return False
-        # project observed per-partition sizes to the full source count
-        observed = len(self._partition_stats)
-        vectors = list(self._partition_stats.values())
-        num_partitions = len(vectors[0])
-        totals = [0] * num_partitions
-        for vec in vectors:
+        # Project observed per-partition sizes to full scale, per source
+        # vertex where attributable (stats missing a vertex name fall back to
+        # a global projection).  An SG source with NO reports yet still
+        # contributes: it's projected at the observed per-task average —
+        # counting it as zero would hide its skew permanently.
+        grouped: Dict[str, List[Sequence[int]]] = {}
+        for (vname, _task), vec in self._partition_stats.items():
+            grouped.setdefault(vname, []).append(vec)
+        num_partitions = len(next(iter(self._partition_stats.values())))
+        totals = [0.0] * num_partitions
+        reported = len(self._partition_stats)
+        avg = [0.0] * num_partitions
+        for vec in self._partition_stats.values():
             for p, sz in enumerate(vec):
-                totals[p] += sz
-        scale = num_sources / observed
-        totals = [int(t * scale) for t in totals]
+                avg[p] += sz / reported
+        if all(v in num_by_src for v in grouped):
+            for vname, vecs in grouped.items():
+                scale = num_by_src[vname] / len(vecs)
+                for vec in vecs:
+                    for p, sz in enumerate(vec):
+                        totals[p] += sz * scale
+            for vname, n in num_by_src.items():
+                if vname not in grouped:
+                    for p in range(num_partitions):
+                        totals[p] += avg[p] * n
+        else:
+            for p in range(num_partitions):
+                totals[p] = avg[p] * total_sources
 
-        mappings = compute_fair_mappings(
-            totals, num_sources, self.desired_task_input_size,
-            self.max_task_parallelism)
+        # One edge-independent slicing; each edge resolves pieces onto its
+        # own source range (reference: per-edge FairShufflePayloads ranges).
+        slices = compute_fair_slices(
+            totals, self.desired_task_input_size, self.max_task_parallelism,
+            max_split=max(num_by_src.values()))
         current = self.context.get_vertex_num_tasks(self.context.vertex_name)
-        if mappings and len(mappings) != current:
-            prop = self.context.get_input_vertex_edge_properties()[
-                sg_sources[0]]
-            desc = EdgeManagerPluginDescriptor.create(
-                "tez_tpu.library.fair_shuffle:FairShuffleEdgeManager",
-                payload={"mappings": mappings,
-                         "num_source_partitions": current})
-            new_props = {sg_sources[0]: EdgeProperty.create_custom(
-                desc, prop.data_source_type, prop.edge_source,
-                prop.edge_destination, prop.scheduling_type)}
-            log.info("%s: fair shuffle %d partitions -> %d slices",
-                     self.context.vertex_name, num_partitions, len(mappings))
-            self.context.reconfigure_vertex(len(mappings),
+        if slices and len(slices) != current:
+            props = self.context.get_input_vertex_edge_properties()
+            new_props = {}
+            for name in sg_sources:
+                prop = props[name]
+                mappings = [(p, *source_range(i, pieces, num_by_src[name]))
+                            for p, i, pieces in slices]
+                desc = EdgeManagerPluginDescriptor.create(
+                    "tez_tpu.library.fair_shuffle:FairShuffleEdgeManager",
+                    payload={"mappings": mappings,
+                             "num_source_partitions": current})
+                new_props[name] = EdgeProperty.create_custom(
+                    desc, prop.data_source_type, prop.edge_source,
+                    prop.edge_destination, prop.scheduling_type)
+            log.info("%s: fair shuffle %d partitions -> %d slices over %d "
+                     "source edges", self.context.vertex_name, num_partitions,
+                     len(slices), len(sg_sources))
+            self.context.reconfigure_vertex(len(slices),
                                             source_edge_properties=new_props)
             self.context.done_reconfiguring_vertex()
         self._parallelism_determined = True

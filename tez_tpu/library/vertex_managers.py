@@ -159,13 +159,25 @@ class ShuffleVertexManager(VertexManagerPlugin):
         self._parallelism_determined = not self.auto_parallel
 
     # -- source bookkeeping --------------------------------------------------
+    def _shuffle_source_names(self) -> List[str]:
+        return [name for name, prop in
+                self.context.get_input_vertex_edge_properties().items()
+                if prop.data_movement_type in (DataMovementType.SCATTER_GATHER,
+                                               DataMovementType.CUSTOM)]
+
     def _total_source_tasks(self) -> int:
-        total = 0
-        for name, prop in self.context.get_input_vertex_edge_properties().items():
-            if prop.data_movement_type in (DataMovementType.SCATTER_GATHER,
-                                           DataMovementType.CUSTOM):
-                total += max(0, self.context.get_vertex_num_tasks(name))
-        return total
+        return sum(max(0, self.context.get_vertex_num_tasks(name))
+                   for name in self._shuffle_source_names())
+
+    def _completed_fraction(self, source_names: Sequence[str],
+                            total_sources: int) -> float:
+        """Completions from the given sources only — a BROADCAST side-input
+        finishing first must not inflate the shuffle completion fraction
+        (reference: ShuffleVertexManagerBase tracks per-srcVertex stats)."""
+        names = set(source_names)
+        done = sum(1 for (vname, _t) in self._completed_sources
+                   if vname in names)
+        return done / total_sources if total_sources else 1.0
 
     def on_vertex_started(self, completions: Sequence[TaskAttemptIdentifier]) -> None:
         self._started = True
@@ -206,7 +218,8 @@ class ShuffleVertexManager(VertexManagerPlugin):
         if total_sources == 0:
             self._parallelism_determined = True
             return True
-        fraction = len(self._completed_sources) / total_sources
+        fraction = self._completed_fraction(self._shuffle_source_names(),
+                                            total_sources)
         if not self._output_stats:
             if fraction >= 1.0:
                 # every source finished without reporting stats (e.g. all
@@ -263,10 +276,8 @@ class ShuffleVertexManager(VertexManagerPlugin):
         num_tasks = self.context.get_vertex_num_tasks(self.context.vertex_name)
         if num_tasks <= 0:
             return
-        if total_sources == 0:
-            fraction = 1.0
-        else:
-            fraction = len(self._completed_sources) / total_sources
+        fraction = self._completed_fraction(self._shuffle_source_names(),
+                                            total_sources)
         if fraction < self.min_fraction:
             return
         if self.max_fraction <= self.min_fraction:

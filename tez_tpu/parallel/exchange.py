@@ -202,7 +202,10 @@ def build_distributed_shuffle(mesh, num_lanes: int, rows_per_worker: int,
     """Compile the SPMD shuffle step for a mesh.  Returns a jitted function
     f(lanes u32[W*N, L], values u32[W*N], valid bool[W*N]) -> per-worker
     sorted partitions, sharded over the mesh."""
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map          # jax >= 0.8
+    except ImportError:                    # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
     num_workers = mesh.devices.size
 
     if ragged:
@@ -212,12 +215,16 @@ def build_distributed_shuffle(mesh, num_lanes: int, rows_per_worker: int,
     else:
         body = functools.partial(_shuffle_step_local,
                                  num_workers=num_workers, cap=cap_per_pair)
+    import inspect
+    # replication-check kwarg was renamed check_rep -> check_vma in jax 0.8
+    check_kw = "check_vma" if "check_vma" in \
+        inspect.signature(shard_map).parameters else "check_rep"
     smapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
         out_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS),
                    P(WORKER_AXIS)),
-        check_rep=False)
+        **{check_kw: False})
     return jax.jit(smapped)
 
 

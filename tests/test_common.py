@@ -193,3 +193,37 @@ def test_sharded_dispatcher_preserves_per_entity_order():
     for vid, seqs in got.items():
         assert seqs == list(range(200)), vid
     assert len(got) == 5
+
+
+def test_ndc_context_and_propagation():
+    """NDC stack tags log records and survives executor handoff
+    (CallableWithNdc semantics)."""
+    import concurrent.futures
+    import logging
+    from tez_tpu.common import ndc
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    logger = logging.getLogger("test.ndc")
+    h = Capture()
+    h.addFilter(ndc.NdcFilter())
+    logger.addHandler(h)
+    try:
+        logger.warning("outside")
+        with ndc.context("attempt_1"):
+            with ndc.context("input_a"):
+                logger.warning("inside")
+                wrapped = ndc.with_current_ndc(
+                    lambda: ndc.current())
+        assert records[0].ndc == ""
+        assert records[1].ndc == "attempt_1:input_a"
+        # captured stack re-applies on a foreign thread, then unwinds
+        with concurrent.futures.ThreadPoolExecutor(1) as ex:
+            assert ex.submit(wrapped).result() == "attempt_1:input_a"
+            assert ex.submit(ndc.current).result() == ""
+    finally:
+        logger.removeHandler(h)

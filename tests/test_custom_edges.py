@@ -292,6 +292,31 @@ def test_fair_shuffle_projects_unreported_source():
     assert set(ctx.reconfigured[1]) == {"a", "b"}
 
 
+def test_auto_parallel_ignores_broadcast_output_stats():
+    """A BROADCAST side-input's tiny output reports must not drag down the
+    per-task average and over-shrink the consumer (auto-parallelism must
+    average SHUFFLE source stats only)."""
+    from tez_tpu.api.vertex_manager import TaskAttemptIdentifier
+    from tez_tpu.library.vertex_managers import ShuffleVertexManager
+    ctx = _FakeVMContext(
+        {"auto_parallel": True, "desired_task_input_size": 1000,
+         "min_fraction": 1.0, "max_fraction": 1.0},
+        {"sg": _sg_prop(), "bc": _bc_prop()},
+        {"sg": 4, "bc": 4, "consumer": 4})
+    vm = ShuffleVertexManager(ctx)
+    vm.initialize()
+    vm.on_vertex_started([])
+    for i in range(4):   # broadcast side-input: 4 x 10 bytes
+        vm.on_vertex_manager_event_received(_vm_event([10], "bc", i))
+    for i in range(4):   # shuffle source: 4 x 1000 bytes
+        vm.on_vertex_manager_event_received(_vm_event([1000], "sg", i))
+        vm.on_source_task_completed(TaskAttemptIdentifier("sg", i, 0))
+    assert vm._parallelism_determined
+    # clean average = 1000 -> expected 4000 -> desired 4 == current: no
+    # shrink.  (Polluted average 505 would wrongly shrink to 3.)
+    assert ctx.reconfigured is None
+
+
 def test_fair_shuffle_multi_source(client, tmp_path):
     """Two scatter-gather sources with different parallelism feed one fair-
     shuffle consumer: the hot partition is split with per-edge source ranges

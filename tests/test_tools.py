@@ -199,6 +199,19 @@ def test_am_web_endpoint(tmp_path):
         assert "TaskCounter" in counters
         page = urllib.request.urlopen(url).read()
         assert b"<html" in page
+        # SPA REST surface (tez-ui feature set)
+        graph = json.loads(urllib.request.urlopen(url + "graph").read())
+        assert [v["name"] for v in graph["vertices"]] == ["v"]
+        assert graph["vertices"][0]["state"] == "SUCCEEDED"
+        tasks = json.loads(urllib.request.urlopen(
+            url + "tasks?vertex=v").read())
+        assert len(tasks) == 2
+        assert all(t["attempts"][0]["state"] == "SUCCEEDED" for t in tasks)
+        dags = json.loads(urllib.request.urlopen(url + "dags").read())
+        assert any(d["state"] == "SUCCEEDED" for d in dags)
+        res = json.loads(urllib.request.urlopen(url + "analyzers").read())
+        assert {"critical_path", "dag_overview"} <= \
+            {r["analyzer"] for r in res}
     finally:
         c.stop()
 

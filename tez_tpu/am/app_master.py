@@ -94,6 +94,7 @@ class DAGAppMaster:
             max_workers=8, thread_name_prefix=f"am-exec-{app_id}")
         self.current_dag: Optional[DAGImpl] = None
         self.completed_dags: Dict[str, DAGState] = {}
+        self.completed_dag_names: Dict[str, str] = {}
         self._dag_seq = 0
         self._dag_done = threading.Condition()
         self._register_handlers()
@@ -252,6 +253,7 @@ class DAGAppMaster:
             speculator.stop()
         with self._dag_done:
             self.completed_dags[str(dag.dag_id)] = final
+            self.completed_dag_names[str(dag.dag_id)] = dag.name
             self._dag_done.notify_all()
 
     # -- DAG submission (client-facing) --------------------------------------

@@ -1,12 +1,22 @@
-"""Live AM status endpoint.
+"""Live AM web UI: JSON REST surface + single-page app.
 
 Reference parity: tez-dag/.../app/web/{AMWebController.java:69,
-WebUIService.java} — the REST surface the Tez UI polls for live DAG/vertex
-progress.  Endpoints:
-  GET /            tiny HTML progress page (auto-refresh)
-  GET /status      JSON DAG status (DAGClient schema)
-  GET /counters    JSON aggregated DAG counters
-  GET /history     JSON recent history events (in-memory logger only)
+WebUIService.java} (the live REST surface) plus the tez-ui SPA feature set
+(tez-ui/src/main/webapp/app/: DAG/vertex/task/attempt browsing, counters,
+DAG graph view, swimlane) — rebuilt as a zero-dependency single page served
+by the AM itself instead of an Ember app reading ATS.
+
+Endpoints:
+  GET /                 single-page app (tabs: overview, graph, tasks,
+                        counters, swimlane, history, analyzers)
+  GET /status           JSON DAG status (DAGClient schema)
+  GET /dags             JSON all DAGs this session (session mode)
+  GET /graph            JSON DAG structure (vertices + typed edges)
+  GET /tasks?vertex=N   JSON per-task/attempt detail for one vertex
+  GET /counters         JSON aggregated DAG counters
+  GET /history          JSON recent history events (in-memory logger only)
+  GET /analyzers        JSON analyzer suite run over live history
+  GET /swimlane.svg     container swimlane SVG
 """
 from __future__ import annotations
 
@@ -14,29 +24,182 @@ import http.server
 import json
 import logging
 import threading
-from typing import Any, Optional
+import time
+import urllib.parse
+from typing import Any, Dict, List, Optional
 
 log = logging.getLogger(__name__)
 
-_PAGE = """<!doctype html><html><head><title>tez_tpu AM</title>
-<meta http-equiv="refresh" content="2"><style>
-body{font-family:monospace;margin:2em} table{border-collapse:collapse}
-td,th{border:1px solid #999;padding:4px 10px;text-align:left}
-.bar{background:#ddd;width:240px}.fill{background:#4e79a7;height:12px}
-</style></head><body><h2 id="t"></h2><div id="c"></div>
+_PAGE = """<!doctype html><html><head><title>tez_tpu AM</title><style>
+body{font-family:monospace;margin:1.5em;background:#fafafa}
+table{border-collapse:collapse;margin-top:8px}
+td,th{border:1px solid #bbb;padding:3px 9px;text-align:left;font-size:13px}
+th{background:#eee}
+.bar{background:#ddd;width:200px;display:inline-block}
+.fill{background:#4e79a7;height:11px}
+.tabs button{font-family:monospace;padding:6px 14px;border:1px solid #999;
+ background:#eee;cursor:pointer;margin-right:4px}
+.tabs button.on{background:#4e79a7;color:#fff}
+#panel{margin-top:12px}
+.SUCCEEDED{color:#2a7d2a}.FAILED{color:#c0392b}.RUNNING{color:#2471a3}
+.KILLED{color:#8e44ad}
+svg text{font-family:monospace;font-size:12px}
+.hl{font-weight:bold}
+</style></head><body>
+<h2 id="t">tez_tpu AM</h2>
+<div class="tabs" id="tabs"></div><div id="panel"></div>
 <script>
-fetch('/status').then(r=>r.json()).then(s=>{
- document.getElementById('t').textContent =
-   s.name + ' — ' + s.state + ' (' + Math.round(s.progress*100) + '%)';
- let h = '<table><tr><th>vertex</th><th>state</th><th>tasks</th>' +
-         '<th>progress</th></tr>';
- for (const [n,v] of Object.entries(s.vertices)) {
-   h += '<tr><td>'+n+'</td><td>'+v.state+'</td><td>'+v.succeeded+'/'+
-        v.total_tasks+'</td><td><div class="bar"><div class="fill" '+
-        'style="width:'+Math.round(v.progress*240)+'px"></div></div></td></tr>';
- }
- document.getElementById('c').innerHTML = h + '</table>';
-});
+const TABS = ["overview","graph","tasks","counters","swimlane","history",
+              "analyzers"];
+let cur = "overview", selVertex = null, timer = null, gen = 0;
+const $ = id => document.getElementById(id);
+const esc = s => String(s).replace(/[&<>]/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;'}[c]));
+function tabbar() {
+  $('tabs').innerHTML = TABS.map(t =>
+    `<button class="${t===cur?'on':''}" onclick="go('${t}')">${t}</button>`
+  ).join('');
+}
+function go(t) { cur = t; tabbar(); render(); }
+async function j(path) { return (await fetch(path)).json(); }
+async function head() {
+  const s = await j('/status');
+  $('t').innerHTML = s.name ?
+    `${esc(s.name)} — <span class="${esc(s.state)}">${esc(s.state)}</span>` +
+    ` (${Math.round(s.progress*100)}%)` : 'tez_tpu AM — idle';
+  return s;
+}
+async function render() {
+  // generation counter: a tab switch mid-fetch invalidates this render so it
+  // neither clobbers the new tab's panel nor schedules a duplicate poll loop
+  const g = ++gen;
+  clearTimeout(timer);
+  const s = await head();
+  if (g !== gen) return;
+  if (cur === 'overview') {
+    let h = '<table><tr><th>vertex</th><th>state</th><th>tasks</th>' +
+            '<th>progress</th></tr>';
+    for (const [n,v] of Object.entries(s.vertices || {}))
+      h += `<tr><td>${esc(n)}</td><td class="${esc(v.state)}">${esc(v.state)}` +
+           `</td><td>${v.succeeded}/${v.total_tasks}</td>` +
+           `<td><div class="bar"><div class="fill" style="width:` +
+           `${Math.round(v.progress*200)}px"></div></div></td></tr>`;
+    h += '</table>';
+    const dags = await j('/dags');
+    if (g !== gen) return;
+    if (dags.length > 1) {
+      h += '<h3>session DAGs</h3><table><tr><th>dag</th><th>state</th></tr>';
+      for (const d of dags)
+        h += `<tr><td>${esc(d.dag_id)} (${esc(d.name)})</td>` +
+             `<td class="${esc(d.state)}">${esc(d.state)}</td></tr>`;
+      h += '</table>';
+    }
+    $('panel').innerHTML = h;
+  } else if (cur === 'graph') {
+    const gr = await j('/graph');
+    if (g !== gen) return;
+    $('panel').innerHTML = drawGraph(gr);
+  } else if (cur === 'tasks') {
+    const names = Object.keys(s.vertices || {});
+    if (!selVertex || !names.includes(selVertex)) selVertex = names[0];
+    let h = 'vertex: <select onchange="selVertex=this.value;render()">' +
+      names.map(n =>
+        `<option ${n===selVertex?'selected':''}>${esc(n)}</option>`).join('') +
+      '</select>';
+    if (selVertex) {
+      const rows = await j('/tasks?vertex=' + encodeURIComponent(selVertex));
+      if (g !== gen) return;
+      h += '<table><tr><th>task</th><th>state</th><th>attempt</th>' +
+           '<th>attempt state</th><th>node</th><th>duration</th></tr>';
+      for (const t of rows) {
+        if (!t.attempts.length)
+          h += `<tr><td>${t.index}</td><td class="${esc(t.state)}">` +
+               `${esc(t.state)}</td><td colspan=4></td></tr>`;
+        for (const a of t.attempts)
+          h += `<tr><td>${t.index}</td><td class="${esc(t.state)}">` +
+               `${esc(t.state)}</td><td>${esc(a.id)}</td>` +
+               `<td class="${esc(a.state)}">${esc(a.state)}</td>` +
+               `<td>${esc(a.node)}</td><td>${a.duration_s}s</td></tr>`;
+      }
+      h += '</table>';
+    }
+    $('panel').innerHTML = h;
+  } else if (cur === 'counters') {
+    const c = await j('/counters');
+    if (g !== gen) return;
+    let h = '';
+    for (const [g, cs] of Object.entries(c)) {
+      h += `<h3>${esc(g)}</h3><table>`;
+      for (const [k,v] of Object.entries(cs))
+        h += `<tr><td>${esc(k)}</td><td style="text-align:right">${v}</td></tr>`;
+      h += '</table>';
+    }
+    $('panel').innerHTML = h || 'no counters yet';
+  } else if (cur === 'swimlane') {
+    $('panel').innerHTML =
+      `<img src="/swimlane.svg?ts=${Date.now()}" style="max-width:100%">`;
+  } else if (cur === 'history') {
+    const evs = await j('/history');
+    if (g !== gen) return;
+    let h = '<table><tr><th>time</th><th>event</th><th>entity</th></tr>';
+    for (const e of evs.slice(-80).reverse())
+      h += `<tr><td>${new Date(e.timestamp*1000).toLocaleTimeString()}</td>` +
+           `<td>${esc(e.event_type)}</td>` +
+           `<td>${esc(e.attempt_id||e.task_id||e.vertex_id||e.dag_id||'')}` +
+           `</td></tr>`;
+    $('panel').innerHTML = h + '</table>';
+  } else if (cur === 'analyzers') {
+    const rs = await j('/analyzers');
+    if (g !== gen) return;
+    let h = '<table><tr><th>analyzer</th><th>headline</th></tr>';
+    for (const r of rs)
+      h += `<tr><td class="hl">${esc(r.analyzer)}</td>` +
+           `<td>${esc(r.headline)}</td></tr>`;
+    $('panel').innerHTML = h + '</table>';
+  }
+  if (g !== gen) return;
+  timer = setTimeout(render, cur === 'overview' || cur === 'graph' ||
+                             cur === 'tasks' ? 2000 : 10000);
+}
+function drawGraph(g) {
+  // layered layout by distance-from-root
+  const lanes = {};
+  for (const v of g.vertices)
+    (lanes[v.distance] = lanes[v.distance] || []).push(v);
+  const pos = {}, W = 190, H = 90;
+  let maxX = 0, maxY = 0;
+  for (const [d, vs] of Object.entries(lanes))
+    vs.forEach((v, i) => {
+      pos[v.name] = {x: 40 + i*W, y: 40 + d*H};
+      maxX = Math.max(maxX, 40 + i*W); maxY = Math.max(maxY, 40 + d*H);
+    });
+  let s = `<svg width="${maxX+220}" height="${maxY+90}" ` +
+          `xmlns="http://www.w3.org/2000/svg">`;
+  s += '<defs><marker id="ar" viewBox="0 0 10 10" refX="9" refY="5" ' +
+       'markerWidth="7" markerHeight="7" orient="auto-start-reverse">' +
+       '<path d="M 0 0 L 10 5 L 0 10 z" fill="#666"/></marker></defs>';
+  for (const e of g.edges) {
+    const a = pos[e.src], b = pos[e.dst];
+    if (!a || !b) continue;
+    const x1=a.x+80, y1=a.y+44, x2=b.x+80, y2=b.y;
+    s += `<line x1="${x1}" y1="${y1}" x2="${x2}" y2="${y2}" stroke="#666" ` +
+         `marker-end="url(#ar)"/>` +
+         `<text x="${(x1+x2)/2+4}" y="${(y1+y2)/2}" fill="#888">` +
+         `${esc(e.movement.toLowerCase())}</text>`;
+  }
+  const fill = {SUCCEEDED:'#d5e8d4',FAILED:'#f8cecc',RUNNING:'#dae8fc',
+                KILLED:'#e1d5e7'};
+  for (const v of g.vertices) {
+    const p = pos[v.name];
+    s += `<rect x="${p.x}" y="${p.y}" width="160" height="44" rx="6" ` +
+         `fill="${fill[v.state]||'#eee'}" stroke="#666"/>` +
+         `<text x="${p.x+10}" y="${p.y+18}">${esc(v.name)}</text>` +
+         `<text x="${p.x+10}" y="${p.y+35}" fill="#555">${esc(v.state)} ` +
+         `${v.succeeded}/${v.tasks}</text>`;
+  }
+  return s + '</svg>';
+}
+tabbar(); render();
 </script></body></html>"""
 
 
@@ -46,37 +209,130 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 — stdlib naming
         am = self.server.am  # type: ignore[attr-defined]
-        if self.path == "/":
+        parsed = urllib.parse.urlparse(self.path)
+        path, query = parsed.path, urllib.parse.parse_qs(parsed.query)
+        try:
+            self._route(am, path, query)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001 — a UI bug must not kill the AM
+            log.exception("web ui error for %s", self.path)
+            try:
+                self._send(500, json.dumps({"error": repr(e)}).encode())
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _route(self, am: Any, path: str, query: Dict[str, List[str]]) -> None:
+        if path == "/":
             self._send(200, _PAGE.encode(), "text/html")
-            return
-        if self.path == "/status":
+        elif path == "/status":
             dag = am.current_dag
             body = dag.status_dict() if dag is not None else {
                 "name": None, "state": "IDLE", "progress": 0, "vertices": {}}
             self._send(200, json.dumps(body, default=str).encode())
-            return
-        if self.path == "/counters":
+        elif path == "/dags":
+            self._send(200, json.dumps(self._dags(am)).encode())
+        elif path == "/graph":
+            self._send(200, json.dumps(self._graph(am), default=str).encode())
+        elif path == "/tasks":
+            name = (query.get("vertex") or [""])[0]
+            self._send(200, json.dumps(self._tasks(am, name),
+                                       default=str).encode())
+        elif path == "/counters":
             dag = am.current_dag
             body = dag.counters.to_dict() if dag is not None else {}
             self._send(200, json.dumps(body).encode())
-            return
-        if self.path == "/swimlane.svg":
-            events = list(getattr(am.logging_service, "events", []))
-            from tez_tpu.tools.history_parser import parse_history_events
+        elif path == "/swimlane.svg":
             from tez_tpu.tools.swimlane import render_svg
-            dags = parse_history_events(events)
-            if dags:
-                svg = render_svg(list(dags.values())[-1])
+            dag = self._parsed_dag(am)
+            if dag is not None:
+                svg = render_svg(dag)
                 self._send(200, svg.encode(), "image/svg+xml")
             else:
                 self._send(404, b'{"error": "no DAG yet"}')
-            return
-        if self.path == "/history":
+        elif path == "/history":
             events = getattr(am.logging_service, "events", [])
             body = [json.loads(e.to_json()) for e in events[-200:]]
             self._send(200, json.dumps(body).encode())
-            return
-        self._send(404, b'{"error": "not found"}')
+        elif path == "/analyzers":
+            self._send(200, json.dumps(self._analyzers(am),
+                                       default=str).encode())
+        else:
+            self._send(404, b'{"error": "not found"}')
+
+    @staticmethod
+    def _dags(am: Any) -> List[Dict[str, Any]]:
+        names = getattr(am, "completed_dag_names", {})
+        out = [{"dag_id": d, "name": names.get(d, ""), "state": s.name}
+               for d, s in am.completed_dags.items()]
+        dag = am.current_dag
+        if dag is not None and str(dag.dag_id) not in am.completed_dags:
+            out.append({"dag_id": str(dag.dag_id), "name": dag.name,
+                        "state": dag.state.name})
+        return out
+
+    @staticmethod
+    def _graph(am: Any) -> Dict[str, Any]:
+        dag = am.current_dag
+        if dag is None:
+            return {"vertices": [], "edges": []}
+        vertices = [{
+            "name": v.name, "state": v.state.name,
+            "tasks": v.num_tasks, "succeeded": v.succeeded_tasks,
+            "distance": v.distance_from_root,
+        } for v in dag.vertices.values()]
+        edges = [{
+            "src": e.source_vertex.name, "dst": e.destination_vertex.name,
+            "movement": e.edge_property.data_movement_type.name,
+        } for e in dag.edges.values()]
+        return {"vertices": vertices, "edges": edges}
+
+    @staticmethod
+    def _tasks(am: Any, vertex_name: str) -> List[Dict[str, Any]]:
+        dag = am.current_dag
+        v = dag.vertex_by_name(vertex_name) if dag is not None else None
+        if v is None:
+            return []
+        rows = []
+        for i in sorted(v.tasks):
+            t = v.tasks[i]
+            attempts = []
+            for n in sorted(t.attempts):
+                a = t.attempts[n]
+                end = a.finish_time or time.time()
+                attempts.append({
+                    "id": str(a.attempt_id), "state": a.state.name,
+                    "node": a.node_id or str(a.container_id or ""),
+                    "duration_s": round(max(0.0, end - a.launch_time), 2)
+                    if a.launch_time else 0.0,
+                })
+            rows.append({"index": i, "state": t.state.name,
+                         "attempts": attempts})
+        return rows
+
+    def _parsed_dag(self, am: Any) -> Optional[Any]:
+        """Parse the in-memory history into the latest DagInfo, cached on the
+        event count — polling tabs must not re-parse an ever-growing event
+        list on every request."""
+        events = list(getattr(am.logging_service, "events", []))
+        if not events:
+            return None
+        srv = self.server
+        cached = getattr(srv, "_parse_cache", None)
+        if cached is not None and cached[0] == len(events):
+            return cached[1]
+        from tez_tpu.tools.history_parser import parse_history_events
+        dags = parse_history_events(events)
+        dag = list(dags.values())[-1] if dags else None
+        srv._parse_cache = (len(events), dag)  # type: ignore[attr-defined]
+        return dag
+
+    def _analyzers(self, am: Any) -> List[Dict[str, Any]]:
+        dag = self._parsed_dag(am)
+        if dag is None:
+            return []
+        from tez_tpu.tools.analyzers import analyze_dag
+        return [r.to_dict() for r in analyze_dag(dag)]
 
     def _send(self, code: int, body: bytes,
               ctype: str = "application/json") -> None:

@@ -198,11 +198,24 @@ class ShuffleVertexManager(VertexManagerPlugin):
         if isinstance(payload, dict) and "output_size" in payload and \
                 event.producer_attempt is not None:
             att = event.producer_attempt
-            key = (str(att.vertex_id), att.task_id.id) \
-                if hasattr(att, "task_id") else (str(att), 0)
+            vname = event.producer_vertex_name or \
+                (str(att.vertex_id) if hasattr(att, "vertex_id") else str(att))
+            key = (vname, att.task_id.id) \
+                if hasattr(att, "task_id") else (vname, 0)
             self._output_stats[key] = payload["output_size"]
         if self._started:
             self._maybe_schedule()
+
+    def _shuffle_output_stats(self) -> Dict[tuple, int]:
+        """Stats from shuffle (SG/CUSTOM) sources only — a BROADCAST
+        side-input's tiny output reports must not drag the per-task average
+        down and over-shrink the consumer.  Falls back to all stats when
+        producer names are unattributable (older event path)."""
+        names = set(self._shuffle_source_names())
+        filtered = {k: v for k, v in self._output_stats.items()
+                    if k[0] in names}
+        return filtered if filtered or not self._output_stats \
+            else self._output_stats
 
     def on_root_vertex_initialized(self, input_name: str, descriptor: Any,
                                    events: List[Any]) -> None:
@@ -220,7 +233,8 @@ class ShuffleVertexManager(VertexManagerPlugin):
             return True
         fraction = self._completed_fraction(self._shuffle_source_names(),
                                             total_sources)
-        if not self._output_stats:
+        stats = self._shuffle_output_stats()
+        if not stats:
             if fraction >= 1.0:
                 # every source finished without reporting stats (e.g. all
                 # outputs empty): finalize with no shrink rather than
@@ -231,8 +245,7 @@ class ShuffleVertexManager(VertexManagerPlugin):
             return False
         if fraction < self.min_fraction:
             return False
-        expected_total = (sum(self._output_stats.values()) /
-                          len(self._output_stats)) * total_sources
+        expected_total = (sum(stats.values()) / len(stats)) * total_sources
         current = self.context.get_vertex_num_tasks(self.context.vertex_name)
         desired = int(math.ceil(expected_total /
                                 max(1, self.desired_task_input_size)))

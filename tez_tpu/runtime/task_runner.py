@@ -90,10 +90,12 @@ class TaskRunner:
                                     name=f"reporter-{self.spec.attempt_id}",
                                     daemon=True)
         reporter.start()
+        from tez_tpu.common import ndc
         try:
-            self._initialize()
-            self._run_processor()
-            self._close()
+            with ndc.context(str(self.spec.attempt_id)):
+                self._initialize()
+                self._run_processor()
+                self._close()
             state = "SUCCEEDED"
         except TaskKilledError:
             # fatal_error() funnels through the kill flag; report it as a

@@ -240,3 +240,47 @@ def test_shuffle_data_released_after_dag(client, tmp_path):
     assert state == "SUCCEEDED"
     count, nbytes = local_shuffle_service().stats()
     assert count == 0, f"{count} shuffle outputs leaked"
+
+
+def test_node_tracker_blacklist_and_ignore_threshold():
+    """AMNodeImpl semantics: per-node failure accumulation blacklists; when
+    too much of the fleet is blacklisted, blacklists are ignored."""
+    from tez_tpu.am.node_map import AMNodeTracker, NodeState
+    conf = C.TezConfiguration({"tez.am.maxtaskfailures.per.node": 2})
+    t = AMNodeTracker(conf)
+    for n in ("n0", "n1", "n2", "n3"):
+        t.node_seen(n)
+    t.on_attempt_failed("n0")
+    assert t.is_usable("n0")                    # below threshold
+    t.on_attempt_failed("n0")
+    assert not t.is_usable("n0")                # blacklisted (1/4 <= 33%)
+    assert t.state("n0") is NodeState.BLACKLISTED
+    t.on_attempt_failed("n1")
+    t.on_attempt_failed("n1")
+    # 2/4 = 50% > 33%: blacklisting ignored, both FORCED_ACTIVE
+    assert t.is_usable("n0") and t.is_usable("n1")
+    assert t.state("n0") is NodeState.FORCED_ACTIVE
+    assert t.snapshot()["n1"]["failures"] == 2
+
+
+def test_blacklisted_node_starved_but_single_node_survives(tmp_staging):
+    """A single-node app whose node crosses the failure threshold keeps
+    running via the ignore threshold (1/1 blacklisted > 33%) — blacklisting
+    must never deadlock the app against itself."""
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging,
+                               "tez.am.maxtaskfailures.per.node": 2,
+                               "tez.am.task.max.failed.attempts": 4,
+                               "tez.am.local.num-containers": 2})
+    am = DAGAppMaster("app_1_node", conf)
+    am.start()
+    flaky = Vertex.create("flaky", ProcessorDescriptor.create(
+        "tez_tpu.library.test_components:TestProcessor",
+        payload={"do_fail": True, "failing_task_indices": [0],
+                 "failing_upto_attempt": 2}), 1)
+    plan = DAG.create("noded").add_vertex(flaky).create_dag_plan()
+    dag_id = am.submit_dag(plan)
+    assert am.wait_for_dag(dag_id, timeout=60) is DAGState.SUCCEEDED
+    # 3 failures on the only node: it WAS blacklisted, then forced active
+    from tez_tpu.am.node_map import NodeState
+    assert am.node_tracker.state("local-0") is NodeState.FORCED_ACTIVE
+    am.stop()

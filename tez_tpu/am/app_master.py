@@ -77,6 +77,8 @@ class DAGAppMaster:
         recovery_enabled = conf.get(C.DAG_RECOVERY_ENABLED)
         self.recovery_service = RecoveryService(self, attempt) \
             if recovery_enabled else None
+        from tez_tpu.am.node_map import AMNodeTracker
+        self.node_tracker = AMNodeTracker(conf)
         from tez_tpu.am.heartbeat import HeartbeatMonitor
         self.heartbeat_monitor = HeartbeatMonitor(self)
         from tez_tpu.runtime.diagnostics import ThreadDumpHelper

@@ -134,10 +134,15 @@ class SubprocessRunnerPool:
                 env["PYTHONPATH"] = repo_root + (
                     os.pathsep + existing if existing else "")
                 cid = f"container_proc_{self.ctx.app_id}_{n:06d}"
+                # node id = HOST, not process: failure accounting must
+                # accumulate across respawns on the same machine (a multi-host
+                # deployment passes each host's own stable --node-id)
+                import socket
+                node = f"{socket.gethostname()}-{self.ctx.app_id}"
                 proc = subprocess.Popen(
                     [sys.executable, "-m", "tez_tpu.runtime.remote_runner",
                      "--am-port", str(self.ctx.umbilical_server.port),
-                     "--node-id", f"{self.ctx.app_id}-r{n}",
+                     "--node-id", node,
                      "--container-id", cid,
                      "--idle-timeout", str(self.idle_timeout)],
                     env=env)

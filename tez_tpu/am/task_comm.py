@@ -61,8 +61,16 @@ class TaskCommunicatorManager:
         self._lock = threading.Lock()
 
     # -- runner-facing API (called from runner threads) ----------------------
-    def get_task(self, container_id: ContainerId,
-                 timeout: float = 1.0) -> Optional[TaskSpec]:
+    def get_task(self, container_id: ContainerId, timeout: float = 1.0,
+                 node_id: str = "") -> Optional[TaskSpec]:
+        node = node_id or self.ctx.node_id
+        tracker = getattr(self.ctx, "node_tracker", None)
+        if tracker is not None:
+            tracker.node_seen(node)
+            if not tracker.is_usable(node):
+                # blacklisted node: starve its runner so it exits and the
+                # pool replaces it elsewhere (AMNodeImpl blacklisting)
+                return None
         spec = self.ctx.task_scheduler.get_task(container_id, timeout)
         if spec is None:
             return None
@@ -70,7 +78,7 @@ class TaskCommunicatorManager:
             self._sessions[spec.attempt_id] = _AttemptSession()
         self.ctx.dispatch(TaskAttemptEvent(
             TaskAttemptEventType.TA_STARTED_REMOTELY, spec.attempt_id,
-            container_id=container_id, node_id=self.ctx.node_id))
+            container_id=container_id, node_id=node))
         return spec
 
     def heartbeat(self, request: HeartbeatRequest) -> HeartbeatResponse:

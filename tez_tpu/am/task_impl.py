@@ -212,7 +212,8 @@ class TaskAttemptImpl:
     def _notify_scheduler_ended(self, failed: bool = False) -> None:
         self.ctx.dispatch(SchedulerEvent(SchedulerEventType.S_TA_ENDED,
                                          attempt_id=self.attempt_id,
-                                         failed=failed))
+                                         failed=failed,
+                                         node_id=self.node_id))
 
 
 def _build_attempt_factory() -> StateMachineFactory:

@@ -141,5 +141,12 @@ class TaskSchedulerManager:
             self.scheduler.schedule(event.attempt_id, event.task_spec,
                                     event.priority)
         elif event.event_type is SchedulerEventType.S_TA_ENDED:
-            self.scheduler.deallocate(event.attempt_id,
-                                      failed=getattr(event, "failed", False))
+            failed = getattr(event, "failed", False)
+            self.scheduler.deallocate(event.attempt_id, failed=failed)
+            tracker = getattr(self.ctx, "node_tracker", None)
+            node = getattr(event, "node_id", "")
+            if tracker is not None and node:
+                if failed:
+                    tracker.on_attempt_failed(node)
+                else:
+                    tracker.on_attempt_succeeded(node)

@@ -170,8 +170,9 @@ class RemoteUmbilical(FramedClient):
 
     _purpose = b"umbilical-hello"
 
-    def get_task(self, container_id: Any, timeout: float = 1.0) -> Any:
-        return self._call("get_task", container_id, timeout)
+    def get_task(self, container_id: Any, timeout: float = 1.0,
+                 node_id: str = "") -> Any:
+        return self._call("get_task", container_id, timeout, node_id)
 
     def heartbeat(self, request: Any) -> Any:
         return self._call("heartbeat", request)

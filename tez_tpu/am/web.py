@@ -74,6 +74,7 @@ async function render() {
   // neither clobbers the new tab's panel nor schedules a duplicate poll loop
   const g = ++gen;
   clearTimeout(timer);
+  try {
   const s = await head();
   if (g !== gen) return;
   if (cur === 'overview') {
@@ -156,6 +157,12 @@ async function render() {
       h += `<tr><td class="hl">${esc(r.analyzer)}</td>` +
            `<td>${esc(r.headline)}</td></tr>`;
     $('panel').innerHTML = h + '</table>';
+  }
+  } catch (e) {
+    // a transient fetch error (AM busy, DAG transition) must not kill the
+    // poll loop; show it and keep polling
+    if (g !== gen) return;
+    $('panel').innerHTML = '<i>fetch failed, retrying: ' + esc(e) + '</i>';
   }
   if (g !== gen) return;
   timer = setTimeout(render, cur === 'overview' || cur === 'graph' ||
@@ -250,6 +257,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._send(200, svg.encode(), "image/svg+xml")
             else:
                 self._send(404, b'{"error": "no DAG yet"}')
+        elif path == "/nodes":
+            tracker = getattr(am, "node_tracker", None)
+            body = tracker.snapshot() if tracker is not None else {}
+            self._send(200, json.dumps(body).encode())
         elif path == "/history":
             events = getattr(am.logging_service, "events", [])
             body = [json.loads(e.to_json()) for e in events[-200:]]
@@ -263,8 +274,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     @staticmethod
     def _dags(am: Any) -> List[Dict[str, Any]]:
         names = getattr(am, "completed_dag_names", {})
+        # list() snapshots are atomic under the GIL; these dicts are mutated
+        # by the dispatcher thread while we serve
         out = [{"dag_id": d, "name": names.get(d, ""), "state": s.name}
-               for d, s in am.completed_dags.items()]
+               for d, s in list(am.completed_dags.items())]
         dag = am.current_dag
         if dag is not None and str(dag.dag_id) not in am.completed_dags:
             out.append({"dag_id": str(dag.dag_id), "name": dag.name,
@@ -280,11 +293,11 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             "name": v.name, "state": v.state.name,
             "tasks": v.num_tasks, "succeeded": v.succeeded_tasks,
             "distance": v.distance_from_root,
-        } for v in dag.vertices.values()]
+        } for v in list(dag.vertices.values())]
         edges = [{
             "src": e.source_vertex.name, "dst": e.destination_vertex.name,
             "movement": e.edge_property.data_movement_type.name,
-        } for e in dag.edges.values()]
+        } for e in list(dag.edges.values())]
         return {"vertices": vertices, "edges": edges}
 
     @staticmethod
@@ -294,11 +307,13 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         if v is None:
             return []
         rows = []
-        for i in sorted(v.tasks):
-            t = v.tasks[i]
+        tasks = dict(v.tasks)
+        for i in sorted(tasks):
+            t = tasks[i]
             attempts = []
-            for n in sorted(t.attempts):
-                a = t.attempts[n]
+            task_attempts = dict(t.attempts)
+            for n in sorted(task_attempts):
+                a = task_attempts[n]
                 end = a.finish_time or time.time()
                 attempts.append({
                     "id": str(a.attempt_id), "state": a.state.name,
@@ -328,11 +343,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         return dag
 
     def _analyzers(self, am: Any) -> List[Dict[str, Any]]:
+        n_events = len(getattr(am.logging_service, "events", []))
+        srv = self.server
+        cached = getattr(srv, "_analyzer_cache", None)
+        if cached is not None and cached[0] == n_events:
+            return cached[1]
         dag = self._parsed_dag(am)
         if dag is None:
             return []
         from tez_tpu.tools.analyzers import analyze_dag
-        return [r.to_dict() for r in analyze_dag(dag)]
+        out = [r.to_dict() for r in analyze_dag(dag)]
+        srv._analyzer_cache = (n_events, out)  # type: ignore[attr-defined]
+        return out
 
     def _send(self, code: int, body: bytes,
               ctype: str = "application/json") -> None:

@@ -106,7 +106,12 @@ MAX_ALLOWED_OUTPUT_FAILURES_FRACTION = _key(
     "tez.am.max.allowed.output.failures.fraction", 0.1, Scope.VERTEX)
 NODE_BLACKLISTING_ENABLED = _key("tez.am.node-blacklisting.enabled", True, Scope.AM)
 NODE_BLACKLISTING_FAILURE_THRESHOLD = _key(
-    "tez.am.node-blacklisting.ignore-threshold-node-percent", 33, Scope.AM)
+    "tez.am.node-blacklisting.ignore-threshold-node-percent", 33, Scope.AM,
+    "Blacklists are ignored (nodes FORCED_ACTIVE) above this percent")
+NODE_MAX_TASK_FAILURES = _key(
+    "tez.am.maxtaskfailures.per.node", 10, Scope.AM,
+    "Task-attempt failures on one node before it is blacklisted "
+    "(reference: AMNodeImpl)")
 AM_CONTAINER_REUSE_ENABLED = _key("tez.am.container.reuse.enabled", True, Scope.AM)
 AM_SESSION_MIN_HELD_CONTAINERS = _key("tez.am.session.min.held-containers", 0, Scope.AM)
 AM_CONTAINER_IDLE_RELEASE_TIMEOUT_MIN = _key(

@@ -209,13 +209,17 @@ class ShuffleVertexManager(VertexManagerPlugin):
     def _shuffle_output_stats(self) -> Dict[tuple, int]:
         """Stats from shuffle (SG/CUSTOM) sources only — a BROADCAST
         side-input's tiny output reports must not drag the per-task average
-        down and over-shrink the consumer.  Falls back to all stats when
-        producer names are unattributable (older event path)."""
-        names = set(self._shuffle_source_names())
-        filtered = {k: v for k, v in self._output_stats.items()
-                    if k[0] in names}
-        return filtered if filtered or not self._output_stats \
-            else self._output_stats
+        down and over-shrink the consumer.  Falls back to all stats only
+        when NO key is attributable to any input edge (older event path
+        keyed by vertex id); 'only broadcast reported' yields {} so the
+        no-shrink finalization path runs instead."""
+        shuffle_names = set(self._shuffle_source_names())
+        all_edges = set(self.context.get_input_vertex_edge_properties())
+        attributable = any(k[0] in all_edges for k in self._output_stats)
+        if not attributable:
+            return dict(self._output_stats)
+        return {k: v for k, v in self._output_stats.items()
+                if k[0] in shuffle_names}
 
     def on_root_vertex_initialized(self, input_name: str, descriptor: Any,
                                    events: List[Any]) -> None:

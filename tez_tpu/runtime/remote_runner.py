@@ -50,7 +50,8 @@ def run_loop(am_host: str, am_port: int, node_id: str, token_hex: str,
     try:
         while True:
             try:
-                spec = umbilical.get_task(container_id, timeout=idle_timeout)
+                spec = umbilical.get_task(container_id, timeout=idle_timeout,
+                                          node_id=node_id)
             except ConnectionError:
                 log.info("umbilical gone; runner exiting")
                 break
@@ -83,6 +84,8 @@ def main() -> int:
         print("TEZ_TPU_JOB_TOKEN env var required", file=sys.stderr)
         return 2
     logging.basicConfig(level=os.environ.get("TEZ_TPU_LOG", "INFO"))
+    from tez_tpu.common import ndc
+    ndc.install()   # every task log line carries its attempt id (%(ndc)s)
     return run_loop(args.am_host, args.am_port, args.node_id, token,
                     idle_timeout=args.idle_timeout,
                     container_id=args.container_id,

@@ -284,3 +284,79 @@ def test_blacklisted_node_starved_but_single_node_survives(tmp_staging):
     from tez_tpu.am.node_map import NodeState
     assert am.node_tracker.state("local-0") is NodeState.FORCED_ACTIVE
     am.stop()
+
+
+def test_scheduler_preempts_lower_priority(tmp_staging):
+    """Slots full of low-priority work + a high-priority request waiting ->
+    the lowest-priority running attempt is killed (YarnTaskSchedulerService
+    preemption semantics; killed attempts respawn)."""
+    from tez_tpu.am.task_scheduler import LocalTaskSchedulerService
+    from tez_tpu.common.ids import DAGId
+
+    class _Ctx:
+        conf = C.TezConfiguration({})
+        dispatched = []
+
+        def ensure_runners(self, backlog):
+            pass
+
+        def dispatch(self, event):
+            self.dispatched.append(event)
+
+    ctx = _Ctx()
+    sched = LocalTaskSchedulerService(ctx, num_slots=2)
+    vid = DAGId("app_1_p", 1).vertex(0)
+    low_a, low_b = vid.task(0).attempt(0), vid.task(1).attempt(0)
+    high = DAGId("app_1_p", 1).vertex(1).task(0).attempt(0)
+    sched.schedule(low_a, "spec-a", priority=20)
+    sched.schedule(low_b, "spec-b", priority=20)
+    assert sched.get_task("c0", timeout=0.1) == "spec-a"
+    assert sched.get_task("c1", timeout=0.1) == "spec-b"
+    assert not ctx.dispatched        # nothing waiting: no preemption
+    sched.schedule(high, "spec-high", priority=5)
+    kills = [e for e in ctx.dispatched
+             if getattr(e, "event_type", None) is not None
+             and e.event_type.name == "TA_KILL_REQUEST"]
+    assert len(kills) == 1           # capped at 10% of 2 slots -> 1
+    assert kills[0].attempt_id in (low_a, low_b)
+    assert "preempted" in kills[0].diagnostics
+
+
+def test_preemption_breaks_priority_inversion_deadlock(tmp_staging):
+    """All slots held by consumers blocked on data a failed producer must
+    re-create: without preemption this deadlocks; with it, one consumer is
+    preempted, the producer re-runs, and the DAG completes correctly."""
+    c = TezClient.create("pre", {"tez.staging-dir": tmp_staging,
+                                 "tez.am.local.num-containers": 2}).start()
+    try:
+        producer = Vertex.create("producer", ProcessorDescriptor.create(
+            EmitProcessor), 1)
+        consumer = Vertex.create("consumer", ProcessorDescriptor.create(
+            CountProcessor), 2)
+        conf = {"tez.runtime.key.class": "bytes",
+                "tez.runtime.value.class": "long"}
+        prop = EdgeProperty.create(
+            DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+            SchedulingType.SEQUENTIAL,
+            OutputDescriptor.create(
+                "tez_tpu.library.outputs:OrderedPartitionedKVOutput",
+                payload=conf),
+            InputDescriptor.create(
+                "tez_tpu.library.test_components:FlakyFetchOrderedInput",
+                # BOTH consumers lose their fetch (so neither can finish
+                # without the producer re-running) and hold the report back
+                # until both occupy the two slots -> the producer rerun has
+                # no slot and the schedule deadlocks without preemption
+                payload={**conf, "failing_fetch_task_indices": [0, 1],
+                         "inject_delay_ms": 1500}))
+        dag = DAG.create("inversion").add_vertex(producer).add_vertex(consumer)
+        dag.add_edge(Edge.create(producer, consumer, prop))
+        status = c.submit_dag(dag).wait_for_completion(timeout=90)
+        assert status.state is DAGStatusState.SUCCEEDED
+        am = c.framework_client.am
+        d = am.dag_counters.to_dict().get("DAGCounter", {})
+        # producer + its rerun + 2 consumers + the preempted consumer's
+        # respawn (a preempted ATTEMPT respawns; the task is never KILLED)
+        assert d.get("TOTAL_LAUNCHED_TASKS", 0) >= 5
+    finally:
+        c.stop()

@@ -14,8 +14,8 @@ from tez_tpu.common.security import JobTokenSecretManager
 from tez_tpu.dag.dag import DAG, Vertex
 
 
-@pytest.fixture()
-def standalone_am(tmp_path):
+def spawn_am(tmp_path, *extra_args):
+    """Launch a standalone AM process; returns (proc, port, token)."""
     token = JobTokenSecretManager().secret.hex()
     env = dict(os.environ)
     env["TEZ_TPU_JOB_TOKEN"] = token
@@ -25,12 +25,16 @@ def standalone_am(tmp_path):
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.Popen(
         [sys.executable, "-m", "tez_tpu.am.client_server",
-         "--staging-dir", str(tmp_path / "stg"),
-         "--num-containers", "2"],
+         "--staging-dir", str(tmp_path / "stg"), *extra_args],
         env=env, stdout=subprocess.PIPE, text=True)
     line = proc.stdout.readline().strip()
     assert line.startswith("READY "), line
-    port = int(line.split()[1])
+    return proc, int(line.split()[1]), token
+
+
+@pytest.fixture()
+def standalone_am(tmp_path):
+    proc, port, token = spawn_am(tmp_path, "--num-containers", "2")
     yield port, token
     proc.terminate()
     proc.wait(timeout=10)
@@ -53,6 +57,48 @@ def test_remote_client_runs_dag_on_standalone_am(standalone_am):
         assert status.vertex_status["v"].progress.succeeded_task_count == 3
     finally:
         client.stop()
+
+
+def test_minicluster_full_stack(tmp_path):
+    """MiniTezCluster analog (SURVEY.md §4 tier 3): standalone AM process,
+    runner PROCESSES under it (socket umbilical), per-runner TCP shuffle
+    servers with HMAC auth, remote client over the DAGClientServer — a real
+    ordered-shuffle wordcount through the full multi-process stack, output
+    validated against a host golden."""
+    import collections
+    from tez_tpu.examples import ordered_wordcount
+    corpus = tmp_path / "in.txt"
+    corpus.write_text("apple banana cherry apple banana apple\n" * 120)
+    out = str(tmp_path / "out")
+
+    proc, port, token = spawn_am(tmp_path, "--runner-mode", "subprocess",
+                                 "--num-containers", "3")
+    try:
+        client = TezClient.create("mini", {
+            "tez.framework.mode": "remote",
+            "tez.am.address": f"127.0.0.1:{port}",
+            "tez.job.token": token,
+        }).start()
+        try:
+            dag = ordered_wordcount.build_dag(
+                [str(corpus)], out, tokenizer_parallelism=2,
+                summation_parallelism=2)
+            status = client.submit_dag(dag).wait_for_completion(timeout=120)
+            assert status.state is DAGStatusState.SUCCEEDED
+        finally:
+            client.stop()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+    got = {}
+    for f in sorted(os.listdir(out)):
+        if f.startswith("part-"):
+            for line in open(os.path.join(out, f), "rb"):
+                w, c = line.rstrip(b"\n").rsplit(b"\t", 1)
+                got[w.decode()] = int(c)
+    golden = collections.Counter(
+        w for l in open(corpus) for w in l.split())
+    assert got == dict(golden)
 
 
 def test_remote_client_bad_token_rejected(standalone_am):

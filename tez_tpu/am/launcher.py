@@ -109,8 +109,13 @@ class SubprocessRunnerPool:
 
     def ensure_runners(self, backlog: int) -> None:
         import os
+        import socket
         import subprocess
         import sys
+        # node id = HOST, not process: failure accounting must accumulate
+        # across respawns on the same machine (a multi-host deployment
+        # passes each host's own stable --node-id)
+        node = f"{socket.gethostname()}-{self.ctx.app_id}"
         with self._lock:
             if self._stopped:
                 return
@@ -134,11 +139,6 @@ class SubprocessRunnerPool:
                 env["PYTHONPATH"] = repo_root + (
                     os.pathsep + existing if existing else "")
                 cid = f"container_proc_{self.ctx.app_id}_{n:06d}"
-                # node id = HOST, not process: failure accounting must
-                # accumulate across respawns on the same machine (a multi-host
-                # deployment passes each host's own stable --node-id)
-                import socket
-                node = f"{socket.gethostname()}-{self.ctx.app_id}"
                 proc = subprocess.Popen(
                     [sys.executable, "-m", "tez_tpu.runtime.remote_runner",
                      "--am-port", str(self.ctx.umbilical_server.port),

@@ -44,7 +44,11 @@ class AMNodeTracker:
         if not node_id:
             return
         with self._lock:
-            self._states.setdefault(node_id, NodeState.ACTIVE)
+            if node_id not in self._states:
+                self._states[node_id] = NodeState.ACTIVE
+                # fleet grew: the blacklisted fraction changed, so a
+                # FORCED_ACTIVE node may have to revert to BLACKLISTED
+                self._recompute_ignore_locked()
 
     def on_attempt_failed(self, node_id: str) -> None:
         if not node_id or not self.enabled:

@@ -63,7 +63,9 @@ class LocalTaskSchedulerService(TaskSchedulerService):
         self._heap: List[Any] = []
         self._seq = itertools.count()
         self._queued: Set[TaskAttemptId] = set()
+        self._priorities: Dict[TaskAttemptId, int] = {}
         self._running: Dict[TaskAttemptId, ContainerId] = {}
+        self._preempting: Set[TaskAttemptId] = set()
         self._container_failures: Dict[Any, int] = {}
         self._blacklisted: Set[Any] = set()
         self._shutdown = False
@@ -74,13 +76,55 @@ class LocalTaskSchedulerService(TaskSchedulerService):
             heapq.heappush(self._heap,
                            (priority, next(self._seq), attempt_id, task_spec))
             self._queued.add(attempt_id)
+            self._priorities[attempt_id] = priority
             self._available.notify()
         self.ctx.ensure_runners(self.backlog())
+        self._maybe_preempt()
+
+    def _maybe_preempt(self) -> None:
+        """Higher-priority work waiting with every slot busy on strictly
+        lower-priority attempts: kill the lowest-priority running attempts
+        (up to tez.am.preemption.percentage of slots).  Killed attempts
+        respawn and re-queue — reference: YarnTaskSchedulerService
+        preemption (lower priority VALUE = more important, heap order)."""
+        from tez_tpu.common import config as C
+        conf = getattr(self.ctx, "conf", None)
+        pct = int(conf.get(C.AM_PREEMPTION_PERCENTAGE)) \
+            if conf is not None else 10
+        if pct <= 0:
+            return   # preemption disabled
+        limit = max(1, self.num_slots * pct // 100)
+        with self._lock:
+            queued = [(p, a) for p, _s, a, _ in self._heap
+                      if a in self._queued]
+            if not queued or len(self._running) < self.num_slots:
+                return
+            best_waiting = min(p for p, _ in queued)
+            self._preempting &= set(self._running)
+            budget = limit - len(self._preempting)
+            if budget <= 0:
+                return
+            victims = sorted(
+                ((self._priorities.get(att, 0), att)
+                 for att in self._running
+                 if self._priorities.get(att, 0) > best_waiting
+                 and att not in self._preempting),
+                key=lambda x: -x[0])[:budget]
+            self._preempting.update(att for _, att in victims)
+        for prio, att in victims:
+            log.info("preempting %s (priority %d) for waiting priority %d",
+                     att, prio, best_waiting)
+            self.ctx.dispatch(TaskAttemptEvent(
+                TaskAttemptEventType.TA_KILL_REQUEST, att,
+                diagnostics=f"preempted: priority-{best_waiting} work "
+                            "waiting for a slot"))
 
     def deallocate(self, attempt_id: TaskAttemptId,
                    failed: bool = False) -> None:
         with self._lock:
             self._queued.discard(attempt_id)
+            self._preempting.discard(attempt_id)
+            self._priorities.pop(attempt_id, None)
             container = self._running.pop(attempt_id, None)
             if failed and container is not None:
                 n = self._container_failures.get(container, 0) + 1

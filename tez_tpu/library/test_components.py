@@ -123,7 +123,9 @@ class FlakyFetchOrderedInput(LogicalInput):
     first event delivery of attempt 0 of configured tasks (reference:
     FetcherWithInjectableErrors + FetcherErrorTestingConfig).
 
-    Payload: {"failing_fetch_task_indices": [ints] (default [0])}.
+    Payload: {"failing_fetch_task_indices": [ints] (default [0]),
+    "inject_delay_ms": int (default 0) — hold the failure report back so the
+    cluster reaches a chosen state first (e.g. all slots occupied)}.
     """
 
     def __new__(cls, context, num_physical_inputs):
@@ -132,9 +134,11 @@ class FlakyFetchOrderedInput(LogicalInput):
         class _Impl(OrderedGroupedKVInput):
             def initialize(self):
                 payload = self.context.user_payload.load() or {}
+                if not isinstance(payload, dict):
+                    payload = {}
                 self._failing_tasks = payload.get(
-                    "failing_fetch_task_indices", [0]) \
-                    if isinstance(payload, dict) else [0]
+                    "failing_fetch_task_indices", [0])
+                self._inject_delay = payload.get("inject_delay_ms", 0) / 1e3
                 self._injected = False
                 return super().initialize()
 
@@ -151,6 +155,8 @@ class FlakyFetchOrderedInput(LogicalInput):
                                            (CompositeRoutedDataMovementEvent,
                                             DataMovementEvent))):
                         self._injected = True
+                        if self._inject_delay:
+                            time.sleep(self._inject_delay)
                         slot = getattr(ev, "target_index_start",
                                        getattr(ev, "target_index", 0))
                         self.context.send_events([InputReadErrorEvent(

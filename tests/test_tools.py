@@ -263,3 +263,53 @@ def test_counter_diff_cli(history_dir, capsys):
         sys.argv = old
     out = capsys.readouterr().out
     assert "wall delta" in out
+
+
+def test_log_split(tmp_path):
+    """tez-log-split analog: interleaved attempt logs carve into per-attempt
+    files, continuation lines follow their record."""
+    from tez_tpu.tools.log_split import split_log
+    a1 = "attempt_1785290000_0001_1_00_000000_0"
+    a2 = "attempt_1785290000_0001_1_00_000001_0"
+    combined = [
+        "2026-07-29 01:00:00 INFO am: dag submitted\n",
+        f"2026-07-29 01:00:01 INFO [{a1}] task: starting\n",
+        f"2026-07-29 01:00:01 ERROR [{a2}] task: boom\n",
+        "Traceback (most recent call last):\n",
+        "  File \"x.py\", line 1\n",
+        f"2026-07-29 01:00:02 INFO [{a1}] task: done\n",
+        "2026-07-29 01:00:03 INFO am: dag finished\n",
+    ]
+    out = str(tmp_path / "split")
+    counts = split_log(combined, out)
+    assert counts == {"main.log": 2, f"{a1}.log": 2, f"{a2}.log": 3}
+    body = open(os.path.join(out, f"{a2}.log")).read()
+    assert "Traceback" in body and "File" in body   # continuation followed
+
+
+def test_client_session_expiry(tmp_path):
+    """Standalone session AM shuts down when the client stops talking
+    (reference: tez.am.client.heartbeat.timeout.secs)."""
+    import time as _time
+    from tests.test_standalone_am import spawn_am
+    from tez_tpu.client.tez_client import TezClient
+    proc, port, token = spawn_am(
+        tmp_path, "--num-containers", "1",
+        "--client-heartbeat-timeout-secs", "1.5")
+    try:
+        c = TezClient.create("exp", {
+            "tez.framework.mode": "remote",
+            "tez.am.address": f"127.0.0.1:{port}",
+            "tez.job.token": token,
+            "tez.client.am.heartbeat.interval.secs": 0.5}).start()
+        _time.sleep(4)               # idle but alive: keepalive holds the
+        assert proc.poll() is None   # session open past the 1.5s timeout
+        c.stop()                     # client goes away without shutdown
+        deadline = _time.time() + 15
+        while proc.poll() is None and _time.time() < deadline:
+            _time.sleep(0.2)
+        assert proc.poll() is not None, "session AM outlived its client"
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+        proc.wait(timeout=10)

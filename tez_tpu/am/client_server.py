@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 import socketserver
 import threading
+import time
 from typing import Any, Optional
 
 from tez_tpu.am.umbilical_server import (_recv_msg, _send_msg,
@@ -35,6 +36,9 @@ class _Handler(socketserver.StreamRequestHandler):
                 return
             while True:
                 method, args, kwargs = _recv_msg(self.rfile)
+                # any authenticated request is a client liveness signal
+                # (reference: TezClient.sendAMHeartbeat / client keepalive)
+                server.last_client_contact = time.time()
                 if method not in _METHODS:
                     _send_msg(self.wfile, (False, f"no method {method}"))
                     continue
@@ -71,20 +75,48 @@ class DAGClientServer:
         self._tcp.daemon_threads = True
         self._tcp.am = am                # type: ignore[attr-defined]
         self._tcp.secrets = secrets      # type: ignore[attr-defined]
+        self._tcp.last_client_contact = time.time()  # type: ignore
         self.shutdown_event = threading.Event()
         self._tcp.shutdown_event = self.shutdown_event  # type: ignore
         self._thread = threading.Thread(target=self._tcp.serve_forever,
                                         daemon=True, name="dag-client-server")
+        self._expiry_thread: Optional[threading.Thread] = None
 
     @property
     def port(self) -> int:
         return self._tcp.server_address[1]
 
+    @property
+    def last_client_contact(self) -> float:
+        return self._tcp.last_client_contact  # type: ignore[attr-defined]
+
     def start(self) -> "DAGClientServer":
         self._thread.start()
         return self
 
+    def start_session_expiry(self, timeout_secs: float) -> None:
+        """Shut the session down when the client stops talking (reference:
+        tez.am.client.heartbeat.timeout.secs — a session AM whose client
+        died must not hold resources forever)."""
+
+        def _watch() -> None:
+            while not self.shutdown_event.wait(
+                    min(5.0, max(0.2, timeout_secs / 3))):
+                if time.time() - self.last_client_contact > timeout_secs:
+                    log.warning("no client contact for %.0fs: shutting "
+                                "session down", timeout_secs)
+                    try:
+                        self._tcp.am.stop()  # type: ignore[attr-defined]
+                    finally:
+                        self.shutdown_event.set()
+                    return
+
+        self._expiry_thread = threading.Thread(
+            target=_watch, daemon=True, name="client-session-expiry")
+        self._expiry_thread.start()
+
     def stop(self) -> None:
+        self.shutdown_event.set()
         self._tcp.shutdown()
         self._tcp.server_close()
 
@@ -109,6 +141,9 @@ def main() -> int:
     parser.add_argument("--runner-mode", default="threads")
     parser.add_argument("--num-containers", type=int, default=0)
     parser.add_argument("--staging-dir", default="/tmp/tez-tpu-staging")
+    parser.add_argument("--client-heartbeat-timeout-secs", type=float,
+                        default=-1, help="shut the session down after this "
+                        "long without any client request (-1 = never)")
     args = parser.parse_args()
     token = os.environ.get("TEZ_TPU_JOB_TOKEN", "")
     if not token:
@@ -121,11 +156,16 @@ def main() -> int:
         "tez.am.local.num-containers": args.num_containers,
         "tez.am.umbilical.bind-host": args.bind_host,
         "tez.job.token": token,
+        "tez.am.client.heartbeat.timeout.secs":
+            args.client_heartbeat_timeout_secs,
     })
     am = DAGAppMaster(new_app_id(), conf)
     am.start()
     server = DAGClientServer(am, am.secrets, host=args.bind_host,
                              port=args.port).start()
+    hb_timeout = float(conf.get(C.AM_CLIENT_HEARTBEAT_TIMEOUT_SECS))
+    if hb_timeout > 0:
+        server.start_session_expiry(hb_timeout)
     print(f"READY {server.port}", flush=True)
     try:
         server.shutdown_event.wait()   # set by shutdown_session (or Ctrl-C)

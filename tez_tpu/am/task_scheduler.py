@@ -87,6 +87,11 @@ class LocalTaskSchedulerService(TaskSchedulerService):
         (up to tez.am.preemption.percentage of slots).  Killed attempts
         respawn and re-queue — reference: YarnTaskSchedulerService
         preemption (lower priority VALUE = more important, heap order)."""
+        with self._lock:
+            # cheap common-path exit BEFORE any heap scan: a free slot (or
+            # empty queue) means nothing to preempt — schedule() stays O(1)
+            if len(self._running) < self.num_slots or not self._queued:
+                return
         from tez_tpu.common import config as C
         conf = getattr(self.ctx, "conf", None)
         pct = int(conf.get(C.AM_PREEMPTION_PERCENTAGE)) \
@@ -95,11 +100,19 @@ class LocalTaskSchedulerService(TaskSchedulerService):
             return   # preemption disabled
         limit = max(1, self.num_slots * pct // 100)
         with self._lock:
-            queued = [(p, a) for p, _s, a, _ in self._heap
-                      if a in self._queued]
-            if not queued or len(self._running) < self.num_slots:
+            if len(self._running) < self.num_slots:
                 return
-            best_waiting = min(p for p, _ in queued)
+            # best waiting priority from the heap head, lazily discarding
+            # entries cancelled while queued
+            best_waiting = None
+            while self._heap:
+                p, _s, a, _spec = self._heap[0]
+                if a in self._queued:
+                    best_waiting = p
+                    break
+                heapq.heappop(self._heap)
+            if best_waiting is None:
+                return
             self._preempting &= set(self._running)
             budget = limit - len(self._preempting)
             if budget <= 0:

@@ -7,11 +7,15 @@ address instead of a ZK registry).
 """
 from __future__ import annotations
 
+import logging
+import threading
 import time
 from typing import Any, Optional
 
 from tez_tpu.am.umbilical_server import FramedClient
 from tez_tpu.common.security import JobTokenSecretManager
+
+log = logging.getLogger(__name__)
 
 #: Server-side wait slices stay well under the socket timeout so the
 #: request/reply framing never desyncs on long DAGs.
@@ -63,6 +67,8 @@ class RemoteFrameworkClient:
     def __init__(self, conf: Any):
         self.conf = conf
         self.am: Optional[RemoteAMProxy] = None
+        self._hb_stop = threading.Event()
+        self._hb_proxy: Optional[RemoteAMProxy] = None
 
     def start(self) -> None:
         addr = self.conf.get("tez.am.address")
@@ -71,10 +77,31 @@ class RemoteFrameworkClient:
             raise ValueError("remote mode needs tez.am.address and "
                              "tez.job.token")
         host, _, port = addr.partition(":")
-        self.am = RemoteAMProxy(host, int(port),
-                                JobTokenSecretManager(bytes.fromhex(token)))
+        secrets = JobTokenSecretManager(bytes.fromhex(token))
+        self.am = RemoteAMProxy(host, int(port), secrets)
+        # Keepalive on its OWN connection (the main proxy is not safe for
+        # interleaved calls): an idle-but-alive client must not trip the
+        # AM's session expiry (reference: TezClient.sendAMHeartbeat:568).
+        interval = float(self.conf.get(
+            "tez.client.am.heartbeat.interval.secs", 5))
+        if interval > 0:
+            self._hb_proxy = RemoteAMProxy(host, int(port), secrets)
+
+            def _beat() -> None:
+                while not self._hb_stop.wait(interval):
+                    try:
+                        self._hb_proxy.web_ui_address()
+                    except Exception:  # noqa: BLE001 — AM gone; the main
+                        return         # proxy's next call surfaces the error
+
+            threading.Thread(target=_beat, daemon=True,
+                             name="client-am-heartbeat").start()
 
     def stop(self) -> None:
+        self._hb_stop.set()
+        if self._hb_proxy is not None:
+            self._hb_proxy.close()
+            self._hb_proxy = None
         if self.am is not None:
             self.am.close()
             self.am = None

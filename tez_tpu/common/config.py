@@ -137,7 +137,14 @@ AM_COMMIT_ALL_OUTPUTS_ON_SUCCESS = _key(
     "tez.am.commit-all-outputs-on-dag-success", True, Scope.DAG,
     "Reference: commit at DAG success vs per-vertex commit (DAGImpl commit modes)")
 AM_PREEMPTION_PERCENTAGE = _key("tez.am.preemption.percentage", 10, Scope.AM)
-AM_CLIENT_HEARTBEAT_TIMEOUT_SECS = _key("tez.am.client.heartbeat.timeout.secs", -1, Scope.AM)
+AM_CLIENT_HEARTBEAT_TIMEOUT_SECS = _key(
+    "tez.am.client.heartbeat.timeout.secs", -1, Scope.AM,
+    "Session AM shuts down after this long without any client request "
+    "(-1 = never); clients keep sessions alive automatically")
+CLIENT_AM_HEARTBEAT_INTERVAL_SECS = _key(
+    "tez.client.am.heartbeat.interval.secs", 5, Scope.CLIENT,
+    "Remote-client keepalive ping interval (0 disables); reference: "
+    "TezClient.sendAMHeartbeat")
 DAG_SCHEDULER_CLASS = _key("tez.am.dag.scheduler.class",
                            "tez_tpu.am.dag_scheduler:DAGSchedulerNaturalOrder", Scope.AM)
 THREAD_DUMP_INTERVAL_MS = _key("tez.thread.dump.interval.ms", 0, Scope.VERTEX)

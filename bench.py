@@ -113,27 +113,70 @@ def tpu_path(dev_inputs, num_partitions: int):
     return out
 
 
+_bench_done = None   # signalled when timing completed
+_warm_done = None    # signalled once the device finished ONE full pipeline
+
+
 def _arm_watchdog(total_mb: float) -> None:
-    """The axon relay can stall compiles indefinitely; emit a labeled
-    zero-result instead of hanging the harness (override budget via
-    TEZ_BENCH_TIMEOUT seconds)."""
+    """The axon relay can stall compiles indefinitely.  Two-stage response
+    instead of hanging the harness: after a grace period, re-run the whole
+    bench in a clean CPU subprocess (honest fallback number, labeled); if
+    even that fails, emit a labeled zero at TEZ_BENCH_TIMEOUT seconds."""
+    global _bench_done, _warm_done
     import os
     import threading
+    _bench_done = threading.Event()
+    _warm_done = threading.Event()
     budget = float(os.environ.get("TEZ_BENCH_TIMEOUT", "480"))
 
-    def _fire() -> None:
+    def _zero() -> None:
+        if _bench_done.is_set():
+            return
         print(json.dumps({
             "metric": "ordered-shuffle-sort throughput "
                       "(WATCHDOG: device stalled before completing)",
             "value": 0.0, "unit": "MB/s", "vs_baseline": 0.0}), flush=True)
         os._exit(0)
 
-    t = threading.Timer(budget, _fire)
-    t.daemon = True
-    t.start()
+    def _fallback() -> None:
+        if _bench_done.is_set() or _warm_done.is_set() or \
+                os.environ.get("TEZ_BENCH_FALLBACK") == "1":
+            # a device that completed one full pipeline is WORKING, just
+            # slow/large — never misreport it as a relay stall
+            return
+        import subprocess
+        env = dict(os.environ)
+        env["TEZ_BENCH_FALLBACK"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        # drop the axon sitecustomize: it pins the TPU platform in
+        # jax.config, which outranks JAX_PLATFORMS
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and ".axon_site" not in p)
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
+                env=env, capture_output=True, text=True,
+                timeout=max(60.0, budget - 30))
+            for line in reversed(out.stdout.strip().splitlines()):
+                if line.startswith("{"):
+                    print(line, flush=True)
+                    os._exit(0)
+        except Exception:  # noqa: BLE001 — the zero timer is still armed
+            pass
+
+    for delay, fn in ((min(150.0, budget * 0.5), _fallback), (budget, _zero)):
+        t = threading.Timer(delay, fn)
+        t.daemon = True
+        t.start()
 
 
 def main() -> int:
+    import os
+    cpu_fallback = os.environ.get("TEZ_BENCH_FALLBACK") == "1"
+    if cpu_fallback:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     num_records = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
     key_len = 12
     num_producers, num_partitions = 4, 4
@@ -144,6 +187,8 @@ def main() -> int:
     dev = prepare_device_inputs(kb, ko, vb, vo, key_len)
     # warm up (compile; persisted across runs via the jit cache)
     tpu_path(dev, num_partitions)
+    if _warm_done is not None:
+        _warm_done.set()   # device is alive: disarm the CPU fallback
 
     t0 = time.time()
     reps = 3
@@ -170,10 +215,14 @@ def main() -> int:
         assert np.array_equal(got, host_out[c]), f"partition {c} mismatch"
 
     mbps = total_mb / tpu_s
+    if _bench_done is not None:
+        _bench_done.set()
+    label = (f"ordered-shuffle-sort throughput ({num_records} recs, "
+             f"{num_partitions} partitions, HBM-resident)")
+    if cpu_fallback:
+        label += " [CPU FALLBACK: TPU relay stalled]"
     print(json.dumps({
-        "metric": "ordered-shuffle-sort throughput "
-                  f"({num_records} recs, {num_partitions} partitions, "
-                  "HBM-resident)",
+        "metric": label,
         "value": round(mbps, 2),
         "unit": "MB/s",
         "vs_baseline": round(host_s / tpu_s, 3),

@@ -92,3 +92,71 @@ def test_determinism_two_runs_byte_identical(tmp_path):
                 parts += open(os.path.join(out_dir, f), "rb").read()
         outs.append(parts)
     assert outs[0] == outs[1]
+
+
+from tez_tpu.library.processors import SimpleProcessor  # noqa: E402
+
+
+class MixedCaseEmitter(SimpleProcessor):
+    def run(self, inputs, outputs):
+        w = outputs["sum"].get_writer()
+        for word in ("Apple", "banana", "APPLE", "Banana", "apple",
+                     "cherry"):
+            w.write(word.encode(), 1)
+
+
+class GroupRecorder(SimpleProcessor):
+    def run(self, inputs, outputs):
+        payload = self.context.user_payload.load()
+        rows = [(k.decode(), sum(vs))
+                for k, vs in inputs["emit"].get_reader()]
+        with open(os.path.join(payload["out"],
+                               f"part-{self.context.task_index}"),
+                  "w") as fh:
+            for k, v in rows:
+                fh.write(f"{k}\t{v}\n")
+
+
+def test_case_insensitive_comparator_e2e(tmp_path):
+    """tez.runtime.key.comparator.class end to end: 'Foo' and 'foo' sort
+    together and the consumer groups them into ONE comparator-equal group
+    (raw-comparator grouping semantics)."""
+    from tez_tpu.client.dag_client import DAGStatusState
+    from tez_tpu.client.tez_client import TezClient
+    from tez_tpu.common.payload import (InputDescriptor, OutputDescriptor,
+                                        ProcessorDescriptor)
+    from tez_tpu.dag.dag import DAG, Edge, Vertex
+    from tez_tpu.dag.edge_property import (DataMovementType, DataSourceType,
+                                           EdgeProperty, SchedulingType)
+
+    out = str(tmp_path / "res")
+    os.makedirs(out)
+    kv = {"tez.runtime.key.class": "bytes", "tez.runtime.value.class": "long",
+          "tez.runtime.key.comparator.class":
+              "tez_tpu.library.comparators:CaseInsensitiveKeyComparator"}
+    c = TezClient.create("cmp", {"tez.staging-dir": str(tmp_path / "s"),
+                                 "tez.am.local.num-containers": 3}).start()
+    try:
+        emit = Vertex.create("emit", ProcessorDescriptor.create(
+            MixedCaseEmitter), 2)
+        summ = Vertex.create("sum", ProcessorDescriptor.create(
+            GroupRecorder, payload={"out": out}), 1)
+        prop = EdgeProperty.create(
+            DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+            SchedulingType.SEQUENTIAL,
+            OutputDescriptor.create(
+                "tez_tpu.library.outputs:OrderedPartitionedKVOutput",
+                payload=kv),
+            InputDescriptor.create(
+                "tez_tpu.library.inputs:OrderedGroupedKVInput", payload=kv))
+        dag = DAG.create("cmpdag").add_vertex(emit).add_vertex(summ)
+        dag.add_edge(Edge.create(emit, summ, prop))
+        st = c.submit_dag(dag).wait_for_completion(timeout=60)
+        assert st.state is DAGStatusState.SUCCEEDED
+    finally:
+        c.stop()
+    rows = [line.rstrip("\n").split("\t")
+            for line in open(os.path.join(out, "part-0"))]
+    # one group per case-insensitive word, counts summed across cases+tasks
+    assert [(k.lower(), int(v)) for k, v in rows] == \
+        [("apple", 6), ("banana", 4), ("cherry", 2)]

@@ -217,10 +217,13 @@ class OrderedGroupedKVInput(LogicalInput):
                                    "device")
                 factor = int(_conf_get(self.context,
                                        "tez.runtime.io.sort.factor", 64))
+                from tez_tpu.library.comparators import load_comparator
                 merged = merge_sorted_runs(runs, 1, self.key_width,
                                            counters=self.context.counters,
                                            engine=engine,
-                                           merge_factor=factor)
+                                           merge_factor=factor,
+                                           key_normalizer=load_comparator(
+                                               self.context))
                 self._merged = merged.batch
             else:
                 self._merged = KVBatch.empty()
@@ -231,8 +234,10 @@ class OrderedGroupedKVInput(LogicalInput):
         return self._merged
 
     def get_reader(self) -> "GroupedKVReader":
+        from tez_tpu.library.comparators import load_comparator
         return GroupedKVReader(self._wait_and_merge(), self.key_serde,
-                               self.val_serde, self.context)
+                               self.val_serde, self.context,
+                               key_normalizer=load_comparator(self.context))
 
     def close(self) -> List[TezAPIEvent]:
         self._merged = None
@@ -244,23 +249,30 @@ class GroupedKVReader(KeyValuesReader):
     boundary detection)."""
 
     def __init__(self, batch: KVBatch, key_serde: Serde, val_serde: Serde,
-                 context: Any):
+                 context: Any, key_normalizer: Any = None):
         self.batch = batch
         self.key_serde = key_serde
         self.val_serde = val_serde
         self.context = context
-        self._group_starts = self._compute_groups(batch)
+        self._group_starts = self._compute_groups(batch, key_normalizer)
 
     @staticmethod
-    def _compute_groups(batch: KVBatch) -> np.ndarray:
+    def _compute_groups(batch: KVBatch, key_normalizer: Any = None
+                        ) -> np.ndarray:
         n = batch.num_records
         if n == 0:
             return np.zeros(0, dtype=np.int64)
-        ko = batch.key_offsets
+        if key_normalizer is not None:
+            # comparator-equality grouping (e.g. case-insensitive): adjacent
+            # keys with equal NORMALIZED forms form one group — materialize
+            # the normalized keys once, then the same vectorized path
+            from tez_tpu.ops.sorter import normalize_batch_keys
+            kb, ko = normalize_batch_keys(batch, key_normalizer)
+        else:
+            kb, ko = batch.key_bytes, batch.key_offsets
         lengths = ko[1:] - ko[:-1]
         same = np.zeros(n, dtype=bool)
         cand = np.flatnonzero(lengths[1:] == lengths[:-1])
-        kb = batch.key_bytes
         for i in cand:
             same[i + 1] = kb[ko[i]:ko[i + 1]].tobytes() == \
                 kb[ko[i + 1]:ko[i + 2]].tobytes()

@@ -93,6 +93,7 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                                "HashPartitioner"):
             from tez_tpu.common.payload import resolve_class
             self.partition_fn = resolve_class(partitioner_cls)().get_partition
+        from tez_tpu.library.comparators import load_comparator
         self.sorter = DeviceSorter(
             num_partitions=self.num_physical_outputs,
             key_width=key_width,
@@ -103,6 +104,7 @@ class OrderedPartitionedKVOutput(LogicalOutput):
             engine=engine,
             sort_threads=sort_threads,
             merge_factor=merge_factor,
+            key_normalizer=load_comparator(ctx),
         )
         ctx.request_initial_memory(sort_mb << 20, None,
                            component_type="PARTITIONED_SORTED_OUTPUT")

@@ -29,12 +29,14 @@ from tez_tpu.ops.runformat import KVBatch, Run, gather_ragged
 log = logging.getLogger(__name__)
 
 
-def _exact_tiebreak(batch: KVBatch, partitions: np.ndarray,
-                    lanes: np.ndarray, width: int) -> Optional[np.ndarray]:
+def _exact_tiebreak(lengths: np.ndarray, partitions: np.ndarray,
+                    lanes: np.ndarray, width: int,
+                    keyfn: Callable[[int], bytes]) -> Optional[np.ndarray]:
     """Return a refinement permutation for rows whose sorted (partition,
-    prefix) group contains a key longer than `width`, or None if exact
-    already.  Host cost is proportional to colliding rows only."""
-    lengths = batch.key_offsets[1:] - batch.key_offsets[:-1]
+    prefix) group contains a SORT key longer than `width`, or None if exact
+    already.  `lengths`/`keyfn` describe the sort keys in sorted order (the
+    normalized keys when a comparator is configured).  Host cost is
+    proportional to colliding rows only."""
     if len(lengths) == 0 or lengths.max(initial=0) <= width:
         return None
     clamped = np.minimum(lengths, width + 1)
@@ -53,12 +55,41 @@ def _exact_tiebreak(batch: KVBatch, partitions: np.ndarray,
             continue
         if int(lengths[s:e].max()) <= width:
             continue  # prefix fully determined the order
-        keys = [batch.key(i) for i in range(s, e)]
+        keys = [keyfn(i) for i in range(s, e)]
         order = sorted(range(e - s), key=lambda j: keys[j])
         if order != list(range(e - s)):
             perm[s:e] = s + np.asarray(order, dtype=np.int64)
             changed = True
     return perm if changed else None
+
+
+def _sorted_key_view(sort_bytes: np.ndarray, sort_offsets: np.ndarray,
+                     perm: np.ndarray
+                     ) -> Tuple[np.ndarray, Callable[[int], bytes]]:
+    """(lengths, keyfn) over the sort keys in sorted order, slicing the
+    already-materialized ragged arrays (no re-normalization)."""
+    starts = sort_offsets[:-1][perm]
+    lengths = (sort_offsets[1:] - sort_offsets[:-1])[perm]
+
+    def keyfn(i: int) -> bytes:
+        s = int(starts[i])
+        return sort_bytes[s:s + int(lengths[i])].tobytes()
+
+    return lengths, keyfn
+
+
+def normalize_batch_keys(batch: KVBatch,
+                         normalizer: Callable[[bytes], bytes]
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Materialize normalized sort keys as ragged (bytes, offsets) arrays.
+    Per-record host cost — paid only when a custom comparator is configured
+    (the reference's RawComparator pays per-COMPARISON, which is worse)."""
+    n = batch.num_records
+    keys = [normalizer(batch.key(i)) for i in range(n)]
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(k) for k in keys], out=offsets[1:])
+    data = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    return data, offsets
 
 
 class SpanBuffer:
@@ -122,10 +153,14 @@ class DeviceSorter:
                  mem_budget_bytes: Optional[int] = None,
                  engine: str = "device",
                  sort_threads: int = 0,
-                 merge_factor: int = 64):
+                 merge_factor: int = 64,
+                 key_normalizer: Optional[Callable[[bytes], bytes]] = None):
         self.num_partitions = num_partitions
         self.key_width = max(4, key_width)
         self.engine = engine   # 'device' (TPU kernels) | 'host' (np.lexsort)
+        #: custom comparator as key normalization (library/comparators.py);
+        #: None = sort by raw key bytes (zero-cost default)
+        self.key_normalizer = key_normalizer
         self.span_budget = span_budget_bytes
         self.spill_dir = spill_dir
         self.counters = counters or TezCounters()
@@ -224,8 +259,12 @@ class DeviceSorter:
     def sort_batch(self, batch: KVBatch,
                    custom_partitions: Optional[np.ndarray] = None) -> Run:
         t0 = time.time()
-        mat, lengths = pad_to_matrix(batch.key_bytes, batch.key_offsets,
-                                     self.key_width)
+        if self.key_normalizer is not None:
+            sort_bytes, sort_offsets = normalize_batch_keys(
+                batch, self.key_normalizer)
+        else:
+            sort_bytes, sort_offsets = batch.key_bytes, batch.key_offsets
+        mat, lengths = pad_to_matrix(sort_bytes, sort_offsets, self.key_width)
         lanes = matrix_to_lanes(mat)
         if custom_partitions is not None:
             assert len(custom_partitions) == batch.num_records, \
@@ -267,8 +306,10 @@ class DeviceSorter:
                 sorted_partitions, perm = device.sort_run(partitions, lanes,
                                                           lengths)
         sorted_batch = batch.take(perm)
+        sort_lengths, keyfn = _sorted_key_view(sort_bytes, sort_offsets, perm)
         refinement = _exact_tiebreak(
-            sorted_batch, sorted_partitions, lanes[perm], self.key_width)
+            sort_lengths, sorted_partitions, lanes[perm], self.key_width,
+            keyfn)
         if refinement is not None:
             sorted_batch = sorted_batch.take(refinement)
         self.counters.find_counter(TaskCounter.DEVICE_SORT_MILLIS)\
@@ -351,7 +392,8 @@ class DeviceSorter:
             return runs[0]
         merged = merge_sorted_runs(runs, self.num_partitions, self.key_width,
                                    counters=self.counters, engine=self.engine,
-                                   merge_factor=self.merge_factor)
+                                   merge_factor=self.merge_factor,
+                                   key_normalizer=self.key_normalizer)
         if self.combiner is not None:
             merged = self.combiner(merged)
         return merged
@@ -361,7 +403,9 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
                       key_width: int,
                       counters: Optional[TezCounters] = None,
                       engine: str = "device",
-                      merge_factor: int = 0) -> Run:
+                      merge_factor: int = 0,
+                      key_normalizer: Optional[Callable[[bytes], bytes]]
+                      = None) -> Run:
     """k-way merge of partition-sorted runs (TezMerger analog): concatenate,
     stable device sort by (partition, key prefix), host tie-break.
 
@@ -380,7 +424,8 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
                 # (avoids double-counting MERGED_MAP_OUTPUTS / merge millis)
                 nxt.append(chunk[0] if len(chunk) == 1 else
                            merge_sorted_runs(chunk, num_partitions,
-                                             key_width, None, engine))
+                                             key_width, None, engine,
+                                             key_normalizer=key_normalizer))
             level = nxt
         runs = level
     t0 = time.time()
@@ -389,7 +434,11 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
         np.repeat(np.arange(r.num_partitions, dtype=np.int32),
                   np.diff(r.row_index)) for r in runs]) \
         if runs else np.zeros(0, np.int32)
-    mat, lengths = pad_to_matrix(batch.key_bytes, batch.key_offsets, key_width)
+    if key_normalizer is not None:
+        sort_bytes, sort_offsets = normalize_batch_keys(batch, key_normalizer)
+    else:
+        sort_bytes, sort_offsets = batch.key_bytes, batch.key_offsets
+    mat, lengths = pad_to_matrix(sort_bytes, sort_offsets, key_width)
     lanes = matrix_to_lanes(mat)
     if engine == "host":
         from tez_tpu.ops.host_sort import host_sort_run
@@ -397,8 +446,9 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
     else:
         sorted_partitions, perm = device.sort_run(partitions, lanes, lengths)
     sorted_batch = batch.take(perm)
-    refinement = _exact_tiebreak(sorted_batch, sorted_partitions,
-                                 lanes[perm], key_width)
+    sort_lengths, keyfn = _sorted_key_view(sort_bytes, sort_offsets, perm)
+    refinement = _exact_tiebreak(sort_lengths, sorted_partitions,
+                                 lanes[perm], key_width, keyfn)
     if refinement is not None:
         sorted_batch = sorted_batch.take(refinement)
     if counters is not None:

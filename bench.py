@@ -138,6 +138,8 @@ def _arm_watchdog(total_mb: float) -> None:
             "value": 0.0, "unit": "MB/s", "vs_baseline": 0.0}), flush=True)
         os._exit(0)
 
+    fallback_delay = min(150.0, budget * 0.5)
+
     def _fallback() -> None:
         if _bench_done.is_set() or _warm_done.is_set() or \
                 os.environ.get("TEZ_BENCH_FALLBACK") == "1":
@@ -157,7 +159,12 @@ def _arm_watchdog(total_mb: float) -> None:
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
                 env=env, capture_output=True, text=True,
-                timeout=max(60.0, budget - 30))
+                # child deadline must sit INSIDE the zero watchdog's
+                timeout=max(60.0, budget - fallback_delay - 30))
+            # the device may have woken up while the child ran: the real
+            # result wins, and two JSON lines must never print
+            if _bench_done.is_set() or _warm_done.is_set():
+                return
             for line in reversed(out.stdout.strip().splitlines()):
                 if line.startswith("{"):
                     print(line, flush=True)
@@ -165,7 +172,7 @@ def _arm_watchdog(total_mb: float) -> None:
         except Exception:  # noqa: BLE001 — the zero timer is still armed
             pass
 
-    for delay, fn in ((min(150.0, budget * 0.5), _fallback), (budget, _zero)):
+    for delay, fn in ((fallback_delay, _fallback), (budget, _zero)):
         t = threading.Timer(delay, fn)
         t.daemon = True
         t.start()

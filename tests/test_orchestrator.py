@@ -192,3 +192,24 @@ def test_concurrent_dispatcher_mode(tmp_staging):
         assert status.vertex_status["b"].progress.succeeded_task_count == 3
     finally:
         c.stop()
+
+
+def test_scale_500_tasks(tmp_staging):
+    """AM event-path scale smoke (SURVEY.md §7 event-storm concern): a
+    500-task vertex completes promptly; every transition flows through the
+    dispatcher (~6 events/task like the reference)."""
+    import time
+    c = TezClient.create("scale", {"tez.staging-dir": tmp_staging,
+                                   "tez.am.local.num-containers": 8}).start()
+    try:
+        dag = DAG.create("scale500").add_vertex(Vertex.create(
+            "v", ProcessorDescriptor.create(
+                "tez_tpu.library.processors:SleepProcessor",
+                payload={"sleep_ms": 0}), 500))
+        t0 = time.time()
+        st = c.submit_dag(dag).wait_for_completion(timeout=120)
+        assert st.state is DAGStatusState.SUCCEEDED
+        assert st.vertex_status["v"].progress.succeeded_task_count == 500
+        assert time.time() - t0 < 60   # generous: ~0.5s typical
+    finally:
+        c.stop()

@@ -360,3 +360,66 @@ def test_preemption_breaks_priority_inversion_deadlock(tmp_staging):
         assert d.get("TOTAL_LAUNCHED_TASKS", 0) >= 5
     finally:
         c.stop()
+
+
+def test_am_recovery_idempotent_across_three_attempts(tmp_staging, tmp_path):
+    """Crash -> recover -> crash AGAIN -> recover: attempt 3 still
+    short-circuits the producers because the recovered attempt re-journals
+    its TASK_FINISHED + generated events (recovery is idempotent)."""
+    gate = str(tmp_path / "gate")
+    result = str(tmp_path / "result")
+    conf_kv = {"tez.runtime.key.class": "bytes",
+               "tez.runtime.value.class": "long"}
+    producer = Vertex.create("producer", ProcessorDescriptor.create(
+        EmitProcessor), 2)
+    consumer = Vertex.create("consumer", ProcessorDescriptor.create(
+        GatedCountProcessor,
+        payload={"gate_path": gate, "result_path": result}), 1)
+    prop = EdgeProperty.create(
+        DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create(
+            "tez_tpu.library.outputs:OrderedPartitionedKVOutput",
+            payload=conf_kv),
+        InputDescriptor.create(
+            "tez_tpu.library.inputs:OrderedGroupedKVInput", payload=conf_kv))
+    dag = DAG.create("recov3").add_vertex(producer).add_vertex(consumer)
+    dag.add_edge(Edge.create(producer, consumer, prop))
+    plan = dag.create_dag_plan()
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging,
+                               "tez.am.local.num-containers": 3})
+
+    am1 = DAGAppMaster("app_1_r3", conf, attempt=1)
+    am1.start()
+    am1.submit_dag(plan)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = am1.current_dag.status_dict()
+        if st["vertices"].get("producer", {}).get("state") == "SUCCEEDED":
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("producer never finished in attempt 1")
+    am1.stop()
+
+    am2 = DAGAppMaster("app_1_r3", conf, attempt=2)
+    am2.start()
+    assert am2.recover_and_resume() is not None
+    deadline = time.time() + 30
+    while time.time() < deadline:   # wait for producers to be restored
+        st = am2.current_dag.status_dict()
+        if st["vertices"].get("producer", {}).get("state") == "SUCCEEDED":
+            break
+        time.sleep(0.1)
+    am2.stop()                       # crash again, consumer still gated
+
+    am3 = DAGAppMaster("app_1_r3", conf, attempt=3)
+    am3.start()
+    recovered = am3.recover_and_resume()
+    assert recovered is not None
+    open(gate, "w").close()
+    assert am3.wait_for_dag(recovered, timeout=60) is DAGState.SUCCEEDED
+    assert int(open(result).read()) == 100
+    d = am3.dag_counters.to_dict().get("DAGCounter", {})
+    assert d.get("TOTAL_LAUNCHED_TASKS", 0) == 1   # consumer only
+    am3.stop()

@@ -178,6 +178,9 @@ class OrderedGroupedKVInput(LogicalInput):
         ctx.request_initial_memory(0, None,
                            component_type="SORTED_MERGED_INPUT")
         self._merged: Optional[KVBatch] = None
+        from tez_tpu.library.comparators import load_comparator
+        self._key_normalizer = load_comparator(ctx)   # resolved ONCE
+        self._group_starts = None                     # cached across readers
         return []
 
     def handle_events(self, events: Sequence[TezAPIEvent]) -> None:
@@ -217,13 +220,11 @@ class OrderedGroupedKVInput(LogicalInput):
                                    "device")
                 factor = int(_conf_get(self.context,
                                        "tez.runtime.io.sort.factor", 64))
-                from tez_tpu.library.comparators import load_comparator
                 merged = merge_sorted_runs(runs, 1, self.key_width,
                                            counters=self.context.counters,
                                            engine=engine,
                                            merge_factor=factor,
-                                           key_normalizer=load_comparator(
-                                               self.context))
+                                           key_normalizer=self._key_normalizer)
                 self._merged = merged.batch
             else:
                 self._merged = KVBatch.empty()
@@ -234,13 +235,20 @@ class OrderedGroupedKVInput(LogicalInput):
         return self._merged
 
     def get_reader(self) -> "GroupedKVReader":
-        from tez_tpu.library.comparators import load_comparator
-        return GroupedKVReader(self._wait_and_merge(), self.key_serde,
+        batch = self._wait_and_merge()
+        if self._group_starts is None:
+            # one normalization pass for group detection, cached so repeat
+            # readers are free (the merge normalized pre-sort; deriving its
+            # arrays post-refinement isn't worth the plumbing)
+            self._group_starts = GroupedKVReader._compute_groups(
+                batch, self._key_normalizer)
+        return GroupedKVReader(batch, self.key_serde,
                                self.val_serde, self.context,
-                               key_normalizer=load_comparator(self.context))
+                               group_starts=self._group_starts)
 
     def close(self) -> List[TezAPIEvent]:
         self._merged = None
+        self._group_starts = None
         return []
 
 
@@ -249,12 +257,14 @@ class GroupedKVReader(KeyValuesReader):
     boundary detection)."""
 
     def __init__(self, batch: KVBatch, key_serde: Serde, val_serde: Serde,
-                 context: Any, key_normalizer: Any = None):
+                 context: Any, key_normalizer: Any = None,
+                 group_starts: Any = None):
         self.batch = batch
         self.key_serde = key_serde
         self.val_serde = val_serde
         self.context = context
-        self._group_starts = self._compute_groups(batch, key_normalizer)
+        self._group_starts = group_starts if group_starts is not None \
+            else self._compute_groups(batch, key_normalizer)
 
     @staticmethod
     def _compute_groups(batch: KVBatch, key_normalizer: Any = None

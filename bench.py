@@ -159,8 +159,10 @@ def _arm_watchdog(total_mb: float) -> None:
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
                 env=env, capture_output=True, text=True,
-                # child deadline must sit INSIDE the zero watchdog's
-                timeout=max(60.0, budget - fallback_delay - 30))
+                # child deadline must sit INSIDE the zero watchdog's:
+                # fallback_delay + timeout + margin <= budget, whatever the
+                # budget (no fixed floor that could breach it)
+                timeout=max(15.0, budget - fallback_delay - 30))
             # the device may have woken up while the child ran: the real
             # result wins, and two JSON lines must never print
             if _bench_done.is_set() or _warm_done.is_set():

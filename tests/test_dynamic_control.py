@@ -98,3 +98,139 @@ def test_speculation_rescues_straggler(client, tmp_path):
     am = client.framework_client.am
     d = am.dag_counters.to_dict().get("DAGCounter", {})
     assert d.get("NUM_SPECULATIONS", 0) >= 1
+
+
+class FailingCommitter:
+    """OutputCommitter whose commit always throws (module-level for
+    descriptor resolution)."""
+
+    def __init__(self, context):
+        self.context = context
+
+    def initialize(self):
+        pass
+
+    def setup_output(self):
+        pass
+
+    def commit_output(self):
+        raise RuntimeError("commit boom")
+
+    def abort_output(self, state):
+        pass
+
+
+def test_per_vertex_commit_mode(client, tmp_path):
+    """tez.am.commit-all-outputs-on-dag-success=False: each vertex commits
+    its own outputs at VERTEX success — the producer's _SUCCESS marker lands
+    while the gated consumer vertex is still running (reference: per-vertex
+    commit mode in DAGImpl/VertexImpl)."""
+    import time
+    from tez_tpu.examples import ordered_wordcount
+    corpus = tmp_path / "in.txt"
+    corpus.write_text("a b a c\n" * 100)
+    out = str(tmp_path / "out")
+    dag = ordered_wordcount.build_dag([str(corpus)], out,
+                                      tokenizer_parallelism=2)
+    dag.set_conf("tez.am.commit-all-outputs-on-dag-success", False)
+    dc = client.submit_dag(dag)
+    status = dc.wait_for_completion(timeout=60)
+    assert status.state is DAGStatusState.SUCCEEDED
+    assert os.path.exists(os.path.join(out, "_SUCCESS"))
+    # the journal shows the per-vertex commit record
+    events = client.framework_client.am.logging_service.events
+    kinds = [e.event_type.name for e in events]
+    assert "VERTEX_COMMIT_STARTED" in kinds
+
+
+def test_per_vertex_commit_failure_fails_vertex(client, tmp_path):
+    """A vertex whose committer throws FAILS (and the DAG with it) in
+    per-vertex commit mode."""
+    from tez_tpu.common.payload import (OutputCommitterDescriptor,
+                                        OutputDescriptor)
+    from tez_tpu.dag.dag import DataSinkDescriptor
+    v = Vertex.create("v", ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor",
+        payload={"sleep_ms": 1}), 2)
+    v.add_data_sink("sink", DataSinkDescriptor(
+        OutputDescriptor.create("tez_tpu.library.unordered:UnorderedKVOutput",
+                                payload={}),
+        OutputCommitterDescriptor.create(
+            "tests.test_dynamic_control:FailingCommitter")))
+    dag = DAG.create("commitfail").add_vertex(v)
+    dag.set_conf("tez.am.commit-all-outputs-on-dag-success", False)
+    status = client.submit_dag(dag).wait_for_completion(timeout=60)
+    assert status.state is DAGStatusState.FAILED
+    assert any("commit" in d
+               for d in status.vertex_status["v"].diagnostics), \
+        status.vertex_status["v"].diagnostics
+
+
+def test_per_vertex_commit_does_not_poison_recovery(tmp_staging, tmp_path):
+    """A vertex that committed (per-vertex mode) and FINISHED long before an
+    AM crash must not be treated as a commit-in-flight on recovery — the DAG
+    resubmits instead of failing."""
+    from tez_tpu.am.app_master import DAGAppMaster
+    from tez_tpu.am.dag_impl import DAGState
+    from tez_tpu.am.history import HistoryEvent, HistoryEventType
+    import tez_tpu.common.config as C2
+    conf = C2.TezConfiguration({"tez.staging-dir": tmp_staging})
+    am1 = DAGAppMaster("app_1_pvc", conf)
+    am1.start()
+    v = Vertex.create("v", ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor",
+        payload={"sleep_ms": 1}), 1)
+    plan = DAG.create("pvc").add_vertex(v).create_dag_plan()
+    # forge the journal shape: submitted, vertex commit started AND the
+    # vertex finished, DAG still running at crash
+    am1.history(HistoryEvent(
+        HistoryEventType.DAG_SUBMITTED, dag_id="dag_1_pvc_1",
+        data={"dag_name": plan.name, "plan": plan.serialize().hex()}))
+    am1.history(HistoryEvent(
+        HistoryEventType.VERTEX_COMMIT_STARTED, dag_id="dag_1_pvc_1",
+        vertex_id="vertex_1_pvc_1_00", data={"vertex_name": "v"}))
+    am1.history(HistoryEvent(
+        HistoryEventType.VERTEX_FINISHED, dag_id="dag_1_pvc_1",
+        vertex_id="vertex_1_pvc_1_00",
+        data={"vertex_name": "v", "state": "SUCCEEDED", "num_tasks": 1}))
+    am1.stop()
+    am2 = DAGAppMaster("app_1_pvc", conf, attempt=2)
+    am2.start()
+    recovered = am2.recover_and_resume()
+    assert recovered is not None
+    # resubmitted, NOT failed-for-commit-in-flight
+    assert am2.completed_dags.get("dag_1_pvc_1") is not DAGState.FAILED
+    assert am2.wait_for_dag(recovered, timeout=30) is DAGState.SUCCEEDED
+    am2.stop()
+
+
+def test_per_vertex_commit_rejects_group_shared_sink(client, tmp_path):
+    """Group-shared sinks are incompatible with commit-on-vertex-success
+    (first member would commit an output siblings still write)."""
+    from tez_tpu.common.payload import OutputDescriptor
+    from tez_tpu.dag.dag import DataSinkDescriptor
+    a = Vertex.create("a", ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor", payload={}), 1)
+    b = Vertex.create("b", ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor", payload={}), 1)
+    dag = DAG.create("groupsink").add_vertex(a).add_vertex(b)
+    from tez_tpu.dag.dag import Edge
+    from tez_tpu.dag.edge_property import (DataMovementType, DataSourceType,
+                                           EdgeProperty, SchedulingType)
+    kv = {"tez.runtime.key.class": "bytes", "tez.runtime.value.class": "bytes"}
+    dag.add_edge(Edge.create(a, b, EdgeProperty.create(
+        DataMovementType.ONE_TO_ONE, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create(
+            "tez_tpu.library.unordered:UnorderedKVOutput", payload=kv),
+        InputDescriptor.create(
+            "tez_tpu.library.unordered:UnorderedKVInput", payload=kv))))
+    g = dag.create_vertex_group("g", [a, b])
+    g.add_data_sink("shared", DataSinkDescriptor(
+        OutputDescriptor.create(
+            "tez_tpu.library.unordered:UnorderedKVOutput", payload={})))
+    dag.set_conf("tez.am.commit-all-outputs-on-dag-success", False)
+    status = client.submit_dag(dag).wait_for_completion(timeout=30)
+    assert status.state.name in ("ERROR", "FAILED")
+    assert any("group-shared sinks" in d for d in status.diagnostics), \
+        status.diagnostics

@@ -74,3 +74,50 @@ def test_failing_task_retries_across_processes(proc_client):
     status = proc_client.submit_dag(
         DAG.create("retry").add_vertex(v)).wait_for_completion(timeout=120)
     assert status.state is DAGStatusState.SUCCEEDED
+
+
+def test_runner_process_killed_midtask_recovers(tmp_staging):
+    """SIGKILL a runner process while its task runs: the heartbeat monitor
+    times the attempt out, the pool respawns a runner, the task retries and
+    the DAG completes (container-loss recovery, reference:
+    ContainerHeartbeatHandler + container reallocation)."""
+    import signal
+    import time
+    from tez_tpu.common.payload import ProcessorDescriptor
+    from tez_tpu.dag.dag import DAG, Vertex
+    c = TezClient.create("killer", {
+        "tez.staging-dir": tmp_staging,
+        "tez.runner.mode": "subprocess",
+        "tez.am.local.num-containers": 2,
+        "tez.task.heartbeat.timeout-ms": 1000,
+        "tez.am.runner.env": {"JAX_PLATFORMS": "cpu",
+                              "PALLAS_AXON_POOL_IPS": ""},
+    }).start()
+    try:
+        am = c.framework_client.am
+        am.heartbeat_monitor.check_interval = 0.2
+        dag = DAG.create("killdag").add_vertex(Vertex.create(
+            "v", ProcessorDescriptor.create(
+                "tez_tpu.library.processors:SleepProcessor",
+                payload={"sleep_ms": 4000}), 2))
+        dc = c.submit_dag(dag)
+        deadline = time.time() + 20
+        victim = None
+        while time.time() < deadline and victim is None:
+            with am.runner_pool._lock:
+                procs = [p for p, _cid in am.runner_pool._procs.values()]
+            for p in procs:
+                if p.poll() is None:
+                    victim = p
+                    break
+            time.sleep(0.1)
+        assert victim is not None, "no runner process appeared"
+        time.sleep(1.0)       # let it pick a task up
+        os.kill(victim.pid, signal.SIGKILL)
+        status = dc.wait_for_completion(timeout=60)
+        assert status.state is DAGStatusState.SUCCEEDED
+        d = am.dag_counters.to_dict().get("DAGCounter", {})
+        # 2 original tasks + at least one retry after the kill
+        assert d.get("TOTAL_LAUNCHED_TASKS", 0) >= 3
+    finally:
+        c.stop()

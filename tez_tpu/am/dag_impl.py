@@ -94,6 +94,20 @@ class DAGImpl:
     # -- construction (DAG_INIT) ---------------------------------------------
     def _on_init(self, event: DAGEvent) -> None:
         from tez_tpu.am.dag_scheduler import assign_natural_order_priorities
+        # Per-vertex commit mode cannot drive a vertex-group SHARED sink:
+        # the first member to finish would commit an output its siblings are
+        # still writing (the reference rejects this combination too).
+        if not self.conf.get("tez.am.commit-all-outputs-on-dag-success", True):
+            by_name = {v.name: v for v in self.plan.vertices}
+            for g in self.plan.vertex_groups:
+                sinks = [{s.name for s in by_name[m].leaf_outputs}
+                         for m in g.members if m in by_name]
+                shared = set.intersection(*sinks) if sinks else set()
+                if shared:
+                    raise ValueError(
+                        f"vertex group '{g.name}' shares output(s) "
+                        f"{sorted(shared)}: commit-on-vertex-success is "
+                        "incompatible with group-shared sinks")
         for i, vplan in enumerate(self.plan.vertices):
             vid = self.dag_id.vertex(i)
             v = VertexImpl(vid, vplan, self)
@@ -209,6 +223,8 @@ class DAGImpl:
         return DAGState.COMMITTING
 
     def _collect_committers(self) -> List[Any]:
+        if not self.conf.get("tez.am.commit-all-outputs-on-dag-success", True):
+            return []   # per-vertex mode: each vertex committed on success
         out = []
         for v in self.vertices.values():
             for name, committer in getattr(v, "committers", {}).items():
@@ -347,6 +363,12 @@ def _build_dag_factory() -> StateMachineFactory:
     f.add(S.NEW, S.INITED, E.DAG_INIT, DAGImpl._on_init)
     f.add(S.INITED, S.RUNNING, E.DAG_START, DAGImpl._on_start)
     f.add_multi(S.INITED, (S.RUNNING, S.KILLED), E.DAG_KILL, DAGImpl._on_kill)
+    # init/start-time failures (e.g. an invalid plan rejected in _on_init)
+    # must terminate the DAG, not strand it in NEW forever
+    f.add_multi(S.NEW, (S.ERROR,), E.INTERNAL_ERROR,
+                DAGImpl._on_internal_error)
+    f.add_multi(S.INITED, (S.ERROR,), E.INTERNAL_ERROR,
+                DAGImpl._on_internal_error)
     f.add_multi(S.RUNNING,
                 (S.RUNNING, S.COMMITTING, S.SUCCEEDED, S.FAILED, S.KILLED),
                 E.DAG_VERTEX_COMPLETED, DAGImpl._on_vertex_completed)

@@ -47,6 +47,7 @@ class VertexEventType(enum.Enum):
     V_MANAGER_USER_CODE_ERROR = enum.auto()
     V_TERMINATE = enum.auto()
     V_COMPLETED = enum.auto()            # internal bookkeeping check
+    V_COMMIT_COMPLETED = enum.auto()     # per-vertex commit mode result
     V_RECONFIGURE_DONE = enum.auto()
 
 

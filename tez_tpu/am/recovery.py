@@ -189,6 +189,11 @@ class RecoveryParser:
         dag_events = [e for e in events if e.dag_id == last_dag_id]
         dag_state = None
         commit_started = False
+        # per-vertex commits are in flight only until that vertex's
+        # VERTEX_FINISHED lands — a long-finished vertex commit must not
+        # poison recovery of a DAG that crashed hours later
+        pending_vertex_commits: Set[str] = set()
+        pending_group_commits: Set[str] = set()
         completed_vertices: Dict[str, Dict[str, Any]] = {}
         attempt_records: Dict[str, Dict[str, Any]] = {}  # attempt id -> data
         task_last: Dict[str, Dict[str, Any]] = {}        # task id -> last finish
@@ -197,13 +202,18 @@ class RecoveryParser:
             t = ev.event_type
             if t is HistoryEventType.DAG_FINISHED:
                 dag_state = ev.data.get("state")
-            elif t in (HistoryEventType.DAG_COMMIT_STARTED,
-                       HistoryEventType.VERTEX_COMMIT_STARTED,
-                       HistoryEventType.VERTEX_GROUP_COMMIT_STARTED):
+            elif t is HistoryEventType.DAG_COMMIT_STARTED:
                 commit_started = True
-            elif t is HistoryEventType.VERTEX_FINISHED and \
-                    ev.data.get("state") == "SUCCEEDED":
-                completed_vertices[ev.data.get("vertex_name")] = ev.data
+            elif t is HistoryEventType.VERTEX_COMMIT_STARTED:
+                pending_vertex_commits.add(ev.vertex_id)
+            elif t is HistoryEventType.VERTEX_GROUP_COMMIT_STARTED:
+                pending_group_commits.add(ev.data.get("group", ""))
+            elif t is HistoryEventType.VERTEX_GROUP_COMMIT_FINISHED:
+                pending_group_commits.discard(ev.data.get("group", ""))
+            elif t is HistoryEventType.VERTEX_FINISHED:
+                pending_vertex_commits.discard(ev.vertex_id)
+                if ev.data.get("state") == "SUCCEEDED":
+                    completed_vertices[ev.data.get("vertex_name")] = ev.data
             elif t in (HistoryEventType.VERTEX_INITIALIZED,
                        HistoryEventType.VERTEX_CONFIGURE_DONE):
                 name = ev.data.get("vertex_name")
@@ -233,7 +243,9 @@ class RecoveryParser:
             }
         return DAGRecoveryData(
             dag_id=last_dag_id, plan=plan, dag_state=dag_state,
-            commit_in_flight=commit_started and dag_state is None,
+            commit_in_flight=(commit_started or bool(pending_vertex_commits)
+                              or bool(pending_group_commits))
+            and dag_state is None,
             completed_vertices=completed_vertices,
             succeeded_tasks=succeeded_tasks, events=dag_events,
             task_data=task_data, vertex_num_tasks=vertex_num_tasks)

@@ -92,6 +92,8 @@ class VertexImpl:
         self.vm_tasks_scheduled = False
         self.start_requested = False
         self._recovered_tasks: Dict[int, Any] = {}  # task index -> journal data
+        import threading
+        self._commit_lock = threading.Lock()  # commit vs abort serialization
         self.started_sources: Set[str] = set()
         self.completed_source_attempts: Set[TaskAttemptId] = set()
         self.sm = self._factory.make(self)
@@ -393,30 +395,99 @@ class VertexImpl:
     def _check_complete(self) -> Optional[VertexState]:
         if self.completed_tasks >= len(self.tasks) and \
                 self.succeeded_tasks == len(self.tasks):
-            self.finish_time = time.time()
-            self.counters = TezCounters()  # fresh roll-up (vertex may rerun)
-            for t in self.tasks.values():
-                att = t.successful_attempt_impl()
-                if att is not None:
-                    self.counters.aggregate(att.counters)
-            self.ctx.history(HistoryEvent(
-                HistoryEventType.VERTEX_FINISHED,
-                dag_id=str(self.vertex_id.dag_id),
-                vertex_id=str(self.vertex_id),
-                data={"vertex_name": self.name, "state": "SUCCEEDED",
-                      "num_tasks": self.num_tasks,
-                      "time_taken": self.finish_time - (self.start_time or
-                                                        self.finish_time),
-                      "counters": self.counters.to_dict()}))
-            self.dag.on_vertex_completed(self, VertexState.SUCCEEDED)
-            return VertexState.SUCCEEDED
+            # per-vertex commit mode (reference: VertexImpl commit when
+            # tez.am.commit-all-outputs-on-dag-success is false): commit this
+            # vertex's outputs NOW, off the dispatcher; completion arrives
+            # back as V_COMMIT_COMPLETED
+            if self._committing:
+                return None     # commit already in flight: its completion
+                # event decides the outcome; a re-entrant completion (e.g.
+                # after an output-loss rerun) must not bypass it
+            if not self.conf.get("tez.am.commit-all-outputs-on-dag-success",
+                                 True) and getattr(self, "committers", None) \
+                    and not self._committed:
+                self._committing = True
+                self.ctx.history(HistoryEvent(
+                    HistoryEventType.VERTEX_COMMIT_STARTED,
+                    dag_id=str(self.vertex_id.dag_id),
+                    vertex_id=str(self.vertex_id),
+                    data={"vertex_name": self.name}))
+
+                def _commit() -> None:
+                    try:
+                        with self._commit_lock:   # serialize vs abort
+                            for committer in self.committers.values():
+                                committer.commit_output()
+                            # set INSIDE the lock: a racing abort must see
+                            # the commit landed and leave the output alone
+                            self._committed = True
+                        ok, diag = True, ""
+                    except BaseException as e:  # noqa: BLE001
+                        log.exception("vertex %s: commit failed", self.name)
+                        ok, diag = False, repr(e)
+                    self.ctx.dispatch(VertexEvent(
+                        VertexEventType.V_COMMIT_COMPLETED, self.vertex_id,
+                        succeeded=ok, diagnostics=diag))
+
+                self.ctx.submit_to_executor(_commit)
+                return None     # stay RUNNING until the commit lands
+            return self._finish_succeeded()
         if self.completed_tasks >= len(self.tasks) and self.killed_tasks > 0:
             self._abort("KILLED")
             return VertexState.KILLED
         return None
 
+    _committing = False
+    _committed = False
+
+    def _finish_succeeded(self) -> VertexState:
+        self.finish_time = time.time()
+        self.counters = TezCounters()  # fresh roll-up (vertex may rerun)
+        for t in self.tasks.values():
+            att = t.successful_attempt_impl()
+            if att is not None:
+                self.counters.aggregate(att.counters)
+        self.ctx.history(HistoryEvent(
+            HistoryEventType.VERTEX_FINISHED,
+            dag_id=str(self.vertex_id.dag_id),
+            vertex_id=str(self.vertex_id),
+            data={"vertex_name": self.name, "state": "SUCCEEDED",
+                  "num_tasks": self.num_tasks,
+                  "time_taken": self.finish_time - (self.start_time or
+                                                    self.finish_time),
+                  "counters": self.counters.to_dict()}))
+        self.dag.on_vertex_completed(self, VertexState.SUCCEEDED)
+        return VertexState.SUCCEEDED
+
+    def _on_commit_completed(self, event: VertexEvent) -> VertexState:
+        """Per-vertex commit finished (reference: commit failure fails the
+        vertex, not just the DAG)."""
+        self._committing = False
+        if getattr(event, "succeeded", False):
+            self._committed = True
+            return self._finish_succeeded()
+        self.diagnostics.append(
+            f"output commit failed: {getattr(event, 'diagnostics', '')}")
+        self._abort("FAILED")
+        return VertexState.FAILED
+
     def _abort(self, final: str, terminate_tasks: bool = False) -> None:
         self.finish_time = time.time()
+        # per-vertex commit mode: this vertex's outputs never committed —
+        # abort them (committed vertices stay committed; reference does not
+        # roll back per-vertex commits on later DAG failure).  The commit
+        # lock serializes against an in-flight commit_output on the executor.
+        if not self.conf.get("tez.am.commit-all-outputs-on-dag-success",
+                             True) and not self._committed:
+            with self._commit_lock:
+                if not self._committed:
+                    for name, committer in getattr(self, "committers",
+                                                   {}).items():
+                        try:
+                            committer.abort_output(final)
+                        except BaseException:  # noqa: BLE001
+                            log.exception("abort of %s:%s failed",
+                                          self.name, name)
         if terminate_tasks:
             for t in self.tasks.values():
                 if t.state not in TERMINAL_TASK_STATES:
@@ -634,6 +705,8 @@ def _build_vertex_factory() -> StateMachineFactory:
                 VertexImpl._on_terminate)
     f.add_multi(S.RUNNING, (S.FAILED,), E.V_MANAGER_USER_CODE_ERROR,
                 VertexImpl._on_manager_error)
+    f.add_multi(S.RUNNING, (S.SUCCEEDED, S.FAILED), E.V_COMMIT_COMPLETED,
+                VertexImpl._on_commit_completed)
     # SUCCEEDED vertices can still route events (late consumers) and see
     # task reschedules — handled via handle() terminal-state guard override:
     return f

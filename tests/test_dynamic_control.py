@@ -234,3 +234,50 @@ def test_per_vertex_commit_rejects_group_shared_sink(client, tmp_path):
     assert status.state.name in ("ERROR", "FAILED")
     assert any("group-shared sinks" in d for d in status.diagnostics), \
         status.diagnostics
+
+
+def test_recovery_restores_committed_vertex_state(tmp_staging):
+    """A vertex whose per-vertex commit landed pre-crash must NOT re-run
+    commit_output() after recovery — proven with a committer that would
+    throw if invoked again."""
+    from tez_tpu.am.app_master import DAGAppMaster
+    from tez_tpu.am.dag_impl import DAGState
+    from tez_tpu.am.history import HistoryEvent, HistoryEventType
+    from tez_tpu.common.payload import (OutputCommitterDescriptor,
+                                        OutputDescriptor)
+    from tez_tpu.dag.dag import DataSinkDescriptor
+    import tez_tpu.common.config as C2
+    conf = C2.TezConfiguration({"tez.staging-dir": tmp_staging})
+    v = Vertex.create("v", ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor",
+        payload={"sleep_ms": 1}), 1)
+    v.add_data_sink("sink", DataSinkDescriptor(
+        OutputDescriptor.create("tez_tpu.library.unordered:UnorderedKVOutput",
+                                payload={}),
+        OutputCommitterDescriptor.create(
+            "tests.test_dynamic_control:FailingCommitter")))
+    dag = DAG.create("pvc2").add_vertex(v)
+    dag.set_conf("tez.am.commit-all-outputs-on-dag-success", False)
+    plan = dag.create_dag_plan()
+    am1 = DAGAppMaster("app_1_pvc2", conf)
+    am1.start()
+    vid = "vertex_1_pvc2_1_00"
+    am1.history(HistoryEvent(
+        HistoryEventType.DAG_SUBMITTED, dag_id="dag_1_pvc2_1",
+        data={"dag_name": plan.name, "plan": plan.serialize().hex()}))
+    am1.history(HistoryEvent(
+        HistoryEventType.VERTEX_COMMIT_STARTED, dag_id="dag_1_pvc2_1",
+        vertex_id=vid, data={"vertex_name": "v"}))
+    am1.history(HistoryEvent(
+        HistoryEventType.VERTEX_FINISHED, dag_id="dag_1_pvc2_1",
+        vertex_id=vid,
+        data={"vertex_name": "v", "state": "SUCCEEDED", "num_tasks": 1}))
+    am1.stop()
+    am2 = DAGAppMaster("app_1_pvc2", conf, attempt=2)
+    am2.start()
+    recovered = am2.recover_and_resume()
+    assert recovered is not None
+    # tasks re-run (no task journal), but the commit is NOT re-invoked —
+    # FailingCommitter.commit_output would fail the DAG if it were
+    assert am2.wait_for_dag(recovered, timeout=30) is DAGState.SUCCEEDED
+    am2.stop()

@@ -109,3 +109,62 @@ def test_mrr_three_stage(tmp_path, tmp_staging):
                 prev = int(total)
     assert got == {k: len(v) for k, v in rows.items()}
     assert order_ok
+
+
+class ExplodingScheduler(LocalTaskSchedulerService):
+    """schedule() throws — the *WithErrors service-plugin tier (reference:
+    TestExternalTezServicesErrors): plugin errors must fail the DAG, not
+    crash the AM process."""
+
+    def schedule(self, attempt_id, task_spec, priority):
+        raise RuntimeError("scheduler plugin exploded")
+
+
+def test_scheduler_plugin_error_contained(tmp_staging):
+    from tez_tpu.am.app_master import DAGAppMaster
+    from tez_tpu.am.dag_impl import DAGState
+    from tez_tpu.common import config as C
+    from tez_tpu.common.payload import ProcessorDescriptor
+    from tez_tpu.dag.dag import DAG, Vertex
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging,
+                               "tez.am.local.num-containers": 2})
+    am = DAGAppMaster("app_1_boom", conf)
+    am.task_scheduler = ExplodingScheduler(am, 2)
+    am.scheduler_manager.scheduler = am.task_scheduler
+    am.start()
+    v = Vertex.create("v", ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor", payload={}), 1)
+    dag_id = am.submit_dag(DAG.create("boom").add_vertex(v).create_dag_plan())
+    final = am.wait_for_dag(dag_id, timeout=30)
+    assert final in (DAGState.ERROR, DAGState.FAILED)
+    # AM survives: a follow-up healthy submission would still be accepted
+    assert am.dispatcher is not None
+    am.stop()
+
+
+def test_session_min_held_containers(tmp_staging):
+    """Session mode holds warm runners across DAGs (reference:
+    tez.am.session.min.held-containers)."""
+    import time
+    from tez_tpu.client.tez_client import TezClient
+    from tez_tpu.client.dag_client import DAGStatusState
+    from tez_tpu.common.payload import ProcessorDescriptor
+    from tez_tpu.dag.dag import DAG, Vertex
+    c = TezClient.create("held", {
+        "tez.staging-dir": tmp_staging,
+        "tez.am.local.num-containers": 3,
+        "tez.am.session.min.held-containers": 2,
+        "tez.am.container.idle.release-timeout-min.millis": 200}).start()
+    try:
+        am = c.framework_client.am
+        dag = DAG.create("d1").add_vertex(Vertex.create(
+            "v", ProcessorDescriptor.create(
+                "tez_tpu.library.processors:SleepProcessor",
+                payload={"sleep_ms": 1}), 3))
+        assert c.submit_dag(dag).wait_for_completion(
+            timeout=30).state is DAGStatusState.SUCCEEDED
+        time.sleep(1.2)          # several idle timeouts pass
+        held = am.runner_pool.live_count()
+        assert held == 2, f"expected 2 held runners, found {held}"
+    finally:
+        c.stop()

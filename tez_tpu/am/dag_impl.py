@@ -98,15 +98,11 @@ class DAGImpl:
         # the first member to finish would commit an output its siblings are
         # still writing (the reference rejects this combination too).
         if not self.conf.get("tez.am.commit-all-outputs-on-dag-success", True):
-            by_name = {v.name: v for v in self.plan.vertices}
             for g in self.plan.vertex_groups:
-                sinks = [{s.name for s in by_name[m].leaf_outputs}
-                         for m in g.members if m in by_name]
-                shared = set.intersection(*sinks) if sinks else set()
-                if shared:
+                if g.outputs:   # the plan records ACTUAL shared sinks
                     raise ValueError(
                         f"vertex group '{g.name}' shares output(s) "
-                        f"{sorted(shared)}: commit-on-vertex-success is "
+                        f"{sorted(g.outputs)}: commit-on-vertex-success is "
                         "incompatible with group-shared sinks")
         for i, vplan in enumerate(self.plan.vertices):
             vid = self.dag_id.vertex(i)

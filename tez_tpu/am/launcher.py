@@ -25,10 +25,19 @@ log = logging.getLogger(__name__)
 
 class RunnerPool:
     def __init__(self, ctx: Any, max_runners: int,
-                 idle_timeout: float = 5.0):
+                 idle_timeout: Optional[float] = None):
         self.ctx = ctx
         self.max_runners = max_runners
+        conf = getattr(ctx, "conf", None)
+        if idle_timeout is None:
+            ms = conf.get("tez.am.container.idle.release-timeout-min.millis") \
+                if conf is not None else None
+            idle_timeout = (5000 if ms is None else ms) / 1000.0
         self.idle_timeout = idle_timeout
+        #: session mode holds this many runners even when idle (reference:
+        #: tez.am.session.min.held-containers)
+        self.min_held = int(conf.get("tez.am.session.min.held-containers")
+                            or 0) if conf is not None else 0
         self._runners: Dict[ContainerId, threading.Thread] = {}
         self._seq = itertools.count()
         self._lock = threading.Lock()
@@ -60,7 +69,19 @@ class RunnerPool:
                 spec = self.ctx.task_comm.get_task(container_id,
                                                    timeout=self.idle_timeout)
                 if spec is None:
-                    break
+                    tracker = getattr(self.ctx, "node_tracker", None)
+                    if tracker is not None and \
+                            not tracker.is_usable(self.ctx.node_id):
+                        break   # blacklisted node must not hold-and-spin
+                    # idle release — but session mode keeps min.held runners
+                    # warm (container reuse across DAGs; kernel caches
+                    # live).  Decision and table removal are ATOMIC so
+                    # several simultaneously-idle runners can't all leave.
+                    with self._lock:
+                        if len(self._runners) > self.min_held:
+                            self._runners.pop(container_id, None)
+                            break
+                    continue
                 if tasks_run > 0:
                     self.ctx.dag_counters.increment(
                         DAGCounter.TOTAL_CONTAINER_REUSE_COUNT)

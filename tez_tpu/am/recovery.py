@@ -68,6 +68,10 @@ class DAGRecoveryData:
     # vertex name -> num_tasks at crash time (last INITIALIZED/CONFIGURE_DONE);
     # a vertex is only short-circuitable when its new parallelism matches.
     vertex_num_tasks: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # vertex names whose per-vertex commit finished before the crash
+    # (VERTEX_COMMIT_STARTED followed by that vertex's VERTEX_FINISHED) —
+    # recovery must not commit them a second time.
+    committed_vertices: Set[str] = dataclasses.field(default_factory=set)
 
 
 # ---------------------------------------------------------------------------
@@ -194,6 +198,7 @@ class RecoveryParser:
         # poison recovery of a DAG that crashed hours later
         pending_vertex_commits: Set[str] = set()
         pending_group_commits: Set[str] = set()
+        committed_vertices: Set[str] = set()
         completed_vertices: Dict[str, Dict[str, Any]] = {}
         attempt_records: Dict[str, Dict[str, Any]] = {}  # attempt id -> data
         task_last: Dict[str, Dict[str, Any]] = {}        # task id -> last finish
@@ -211,6 +216,9 @@ class RecoveryParser:
             elif t is HistoryEventType.VERTEX_GROUP_COMMIT_FINISHED:
                 pending_group_commits.discard(ev.data.get("group", ""))
             elif t is HistoryEventType.VERTEX_FINISHED:
+                if ev.vertex_id in pending_vertex_commits and \
+                        ev.data.get("state") == "SUCCEEDED":
+                    committed_vertices.add(ev.data.get("vertex_name"))
                 pending_vertex_commits.discard(ev.vertex_id)
                 if ev.data.get("state") == "SUCCEEDED":
                     completed_vertices[ev.data.get("vertex_name")] = ev.data
@@ -248,4 +256,5 @@ class RecoveryParser:
             and dag_state is None,
             completed_vertices=completed_vertices,
             succeeded_tasks=succeeded_tasks, events=dag_events,
-            task_data=task_data, vertex_num_tasks=vertex_num_tasks)
+            task_data=task_data, vertex_num_tasks=vertex_num_tasks,
+            committed_vertices=committed_vertices)

@@ -266,7 +266,13 @@ class VertexImpl:
         journal recorded — a vertex whose auto-parallelism decision could
         differ this run re-executes from scratch (safe default)."""
         rec = getattr(self.dag, "recovery_data", None)
-        if rec is None or not rec.task_data:
+        if rec is None:
+            return
+        if self.name in getattr(rec, "committed_vertices", ()):
+            # this vertex's per-vertex commit landed before the crash —
+            # never run commit_output() a second time
+            self._committed = True
+        if not rec.task_data:
             return
         if rec.vertex_num_tasks.get(self.name) != self.num_tasks:
             return
@@ -416,12 +422,19 @@ class VertexImpl:
                 def _commit() -> None:
                     try:
                         with self._commit_lock:   # serialize vs abort
-                            for committer in self.committers.values():
-                                committer.commit_output()
-                            # set INSIDE the lock: a racing abort must see
-                            # the commit landed and leave the output alone
-                            self._committed = True
-                        ok, diag = True, ""
+                            if self._aborted:
+                                # the vertex was killed/failed first and its
+                                # outputs aborted — committing now would
+                                # publish a dead vertex's output
+                                ok, diag = False, "vertex aborted before " \
+                                    "commit ran"
+                            else:
+                                for committer in self.committers.values():
+                                    committer.commit_output()
+                                # set INSIDE the lock: a racing abort must
+                                # see the commit landed and leave it alone
+                                self._committed = True
+                                ok, diag = True, ""
                     except BaseException as e:  # noqa: BLE001
                         log.exception("vertex %s: commit failed", self.name)
                         ok, diag = False, repr(e)
@@ -439,6 +452,7 @@ class VertexImpl:
 
     _committing = False
     _committed = False
+    _aborted = False
 
     def _finish_succeeded(self) -> VertexState:
         self.finish_time = time.time()
@@ -465,7 +479,14 @@ class VertexImpl:
         self._committing = False
         if getattr(event, "succeeded", False):
             self._committed = True
-            return self._finish_succeeded()
+            # an output-loss reschedule may have landed while the commit was
+            # in flight: only finish if every task is still complete (the
+            # rerun's completion re-enters _check_complete, which sees
+            # _committed and finishes without re-committing)
+            if self.completed_tasks >= len(self.tasks) and \
+                    self.succeeded_tasks == len(self.tasks):
+                return self._finish_succeeded()
+            return VertexState.RUNNING
         self.diagnostics.append(
             f"output commit failed: {getattr(event, 'diagnostics', '')}")
         self._abort("FAILED")
@@ -480,6 +501,7 @@ class VertexImpl:
         if not self.conf.get("tez.am.commit-all-outputs-on-dag-success",
                              True) and not self._committed:
             with self._commit_lock:
+                self._aborted = True   # a queued commit must not run later
                 if not self._committed:
                     for name, committer in getattr(self, "committers",
                                                    {}).items():

@@ -281,3 +281,115 @@ def test_recovery_restores_committed_vertex_state(tmp_staging):
     # FailingCommitter.commit_output would fail the DAG if it were
     assert am2.wait_for_dag(recovered, timeout=30) is DAGState.SUCCEEDED
     am2.stop()
+
+
+def test_controlled_dag_scheduler_holds_downstream(client, tmp_path):
+    """DAGSchedulerNaturalOrderControlled: a downstream vertex with an
+    eager (ImmediateStart) manager is HELD until its source has scheduled
+    every task — under the default scheduler it would schedule at DAG start
+    (reference: DAGSchedulerNaturalOrderControlled)."""
+    from tez_tpu.am.history import HistoryEventType
+    kv = {"tez.runtime.key.class": "bytes", "tez.runtime.value.class": "long"}
+
+    def build(scheduler):
+        a = Vertex.create("a", ProcessorDescriptor.create(
+            "tez_tpu.library.processors:SleepProcessor",
+            payload={"sleep_ms": 400}), 2)
+        b = Vertex.create("b", ProcessorDescriptor.create(
+            SkewedEmitterForSched), 2)
+        c2 = Vertex.create("c", ProcessorDescriptor.create(
+            "tez_tpu.library.processors:SimpleProcessor"), 1)
+        from tez_tpu.dag.edge_property import (DataMovementType,
+                                               DataSourceType, EdgeProperty,
+                                               SchedulingType)
+        sg = lambda s, d, out_name: Edge.create(s, d, EdgeProperty.create(  # noqa: E731
+            DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+            SchedulingType.SEQUENTIAL,
+            OutputDescriptor.create(
+                "tez_tpu.library.unordered:UnorderedPartitionedKVOutput",
+                payload=kv),
+            InputDescriptor.create(
+                "tez_tpu.library.unordered:UnorderedKVInput",
+                payload=kv)))
+        dag = DAG.create("ctrl").add_vertex(a).add_vertex(b).add_vertex(c2)
+        # b slow-starts on a's completion; c is EAGER
+        dag.add_edge(sg(a, b, "b")).add_edge(sg(b, c2, "c"))
+        b.set_vertex_manager_plugin(VertexManagerPluginDescriptor.create(
+            "tez_tpu.library.vertex_managers:ShuffleVertexManager",
+            payload={"min_fraction": 1.0, "max_fraction": 1.0}))
+        c2.set_vertex_manager_plugin(VertexManagerPluginDescriptor.create(
+            "tez_tpu.library.vertex_managers:ImmediateStartVertexManager"))
+        dag.set_conf("tez.am.dag.scheduler.class", scheduler)
+        return dag
+
+    dag = build("tez_tpu.am.dag_scheduler:DAGSchedulerNaturalOrderControlled")
+    status = client.submit_dag(dag).wait_for_completion(timeout=60)
+    assert status.state is DAGStatusState.SUCCEEDED
+    events = client.framework_client.am.logging_service.events
+    started = {}
+    for e in events:
+        if e.event_type is HistoryEventType.TASK_STARTED:
+            started.setdefault(e.data.get("vertex_name"), e.timestamp)
+    # eager c was held until b scheduled (b itself waits for a's completion,
+    # ~400ms) — with the uncontrolled scheduler c starts at t=0
+    assert started["c"] >= started["b"], started
+    assert started["c"] - started["a"] > 0.3, started
+
+
+class SkewedEmitterForSched(SimpleProcessor):
+    def run(self, inputs, outputs):
+        w = outputs["c"].get_writer()
+        w.write(b"k", 1)
+
+
+class EmptyInitializer:
+    """Initializer resolving a root vertex to ZERO tasks (an empty data
+    source — module-level for descriptor resolution)."""
+
+    def __init__(self, context=None):
+        self.context = context
+
+    def initialize(self):
+        from tez_tpu.api.initializer import InputConfigureVertexTasksEvent
+        return [InputConfigureVertexTasksEvent(num_tasks=0)]
+
+    def handle_input_initializer_event(self, events):
+        pass
+
+
+@pytest.mark.parametrize("sched", [
+    "tez_tpu.am.dag_scheduler:DAGSchedulerNaturalOrder",
+    "tez_tpu.am.dag_scheduler:DAGSchedulerNaturalOrderControlled"])
+def test_runtime_empty_source_vertex(client, sched):
+    """A root vertex whose initializer resolves to 0 tasks completes
+    immediately and must not wedge its consumer under either DAG scheduler
+    (regression: 0-task SUCCEEDED transition missing from the vertex state
+    table; controlled gate waiting forever on a source that never
+    schedules)."""
+    from tez_tpu.common.payload import InputInitializerDescriptor
+    from tez_tpu.dag.dag import DataSourceDescriptor
+    from tez_tpu.dag.edge_property import (DataMovementType, DataSourceType,
+                                           EdgeProperty, SchedulingType)
+    kv = {"tez.runtime.key.class": "bytes", "tez.runtime.value.class": "long"}
+    empty = Vertex.create("empty", ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor", payload={}), -1)
+    empty.add_data_source("src", DataSourceDescriptor.create(
+        InputDescriptor.create(
+            "tez_tpu.library.unordered:UnorderedKVInput", payload=kv),
+        initializer=InputInitializerDescriptor.create(
+            "tests.test_dynamic_control:EmptyInitializer")))
+    down = Vertex.create("down", ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SimpleProcessor"), 2)
+    prop = EdgeProperty.create(
+        DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create(
+            "tez_tpu.library.unordered:UnorderedPartitionedKVOutput",
+            payload=kv),
+        InputDescriptor.create(
+            "tez_tpu.library.unordered:UnorderedKVInput", payload=kv))
+    dag = DAG.create("emptysrc").add_vertex(empty).add_vertex(down)
+    dag.add_edge(Edge.create(empty, down, prop))
+    dag.set_conf("tez.am.dag.scheduler.class", sched)
+    st = client.submit_dag(dag).wait_for_completion(timeout=45)
+    assert st.state is DAGStatusState.SUCCEEDED, st.diagnostics

@@ -93,7 +93,7 @@ class DAGImpl:
 
     # -- construction (DAG_INIT) ---------------------------------------------
     def _on_init(self, event: DAGEvent) -> None:
-        from tez_tpu.am.dag_scheduler import assign_natural_order_priorities
+        from tez_tpu.am.dag_scheduler import apply_dag_scheduler
         # Per-vertex commit mode cannot drive a vertex-group SHARED sink:
         # the first member to finish would commit an output its siblings are
         # still writing (the reference rejects this combination too).
@@ -124,7 +124,7 @@ class DAGImpl:
                 gplan.group_name,
                 tuple(self._group_members(gplan.group_name)),
                 gplan.merged_input))
-        assign_natural_order_priorities(self)
+        apply_dag_scheduler(self)
         for edge in self.edges.values():
             edge.initialize()
         self.ctx.history(HistoryEvent(

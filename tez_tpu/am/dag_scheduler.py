@@ -1,9 +1,12 @@
-"""DAG-level priority assignment.
+"""DAG-level priority assignment + scheduling gate variants.
 
 Reference parity: tez-dag/.../dag/impl/DAGSchedulerNaturalOrder.java:75 —
 priority = topological depth (deeper vertices run at lower priority so
-upstream work drains first); the "controlled" variant gates scheduling on
-vertex readiness, which our vertex managers already do.
+upstream work drains first) — and DAGSchedulerNaturalOrderControlled.java:54,
+which additionally HOLDS BACK a vertex's task scheduling until every
+SEQUENTIAL source vertex has scheduled all of its own tasks (downstream
+tasks must not grab slots before their sources are even queued).
+Selected via tez.am.dag.scheduler.class.
 """
 from __future__ import annotations
 
@@ -13,7 +16,7 @@ if TYPE_CHECKING:
     from tez_tpu.am.dag_impl import DAGImpl
 
 
-def assign_natural_order_priorities(dag: "DAGImpl") -> None:
+def _assign_depth_priorities(dag: "DAGImpl") -> None:
     """Longest-path-from-root depth, priority = (depth+1)*3 with the +/-1
     band reserved for retries/speculation (reference multiplies by 3 to give
     each vertex a priority band)."""
@@ -37,3 +40,30 @@ def assign_natural_order_priorities(dag: "DAGImpl") -> None:
     for name, v in dag.vertices.items():
         v.distance_from_root = depth.get(name, 0)
         v.priority = (depth.get(name, 0) + 1) * 3
+
+
+class DAGSchedulerNaturalOrder:
+    """Priorities only; vertex managers decide when tasks schedule."""
+
+    controlled = False
+
+    def apply(self, dag: "DAGImpl") -> None:
+        _assign_depth_priorities(dag)
+        for v in dag.vertices.values():
+            v.controlled_scheduling = self.controlled
+
+
+class DAGSchedulerNaturalOrderControlled(DAGSchedulerNaturalOrder):
+    """Same priorities + the sources-fully-scheduled gate
+    (VertexImpl.schedule_tasks defers until every SEQUENTIAL source vertex
+    has scheduled all of its tasks)."""
+
+    controlled = True
+
+
+def apply_dag_scheduler(dag: "DAGImpl") -> None:
+    from tez_tpu.common import config as C
+    from tez_tpu.common.payload import resolve_class
+    name = dag.conf.get(C.DAG_SCHEDULER_CLASS) or \
+        "tez_tpu.am.dag_scheduler:DAGSchedulerNaturalOrder"
+    resolve_class(name)().apply(dag)

@@ -48,6 +48,7 @@ class VertexEventType(enum.Enum):
     V_TERMINATE = enum.auto()
     V_COMPLETED = enum.auto()            # internal bookkeeping check
     V_COMMIT_COMPLETED = enum.auto()     # per-vertex commit mode result
+    V_SOURCE_SCHEDULED = enum.auto()     # controlled-mode holdback release
     V_RECONFIGURE_DONE = enum.auto()
 
 

@@ -92,6 +92,7 @@ class VertexImpl:
         self.vm_tasks_scheduled = False
         self.start_requested = False
         self._recovered_tasks: Dict[int, Any] = {}  # task index -> journal data
+        self._deferred_schedule: List[int] = []   # controlled-mode holdback
         import threading
         self._commit_lock = threading.Lock()  # commit vs abort serialization
         self.started_sources: Set[str] = set()
@@ -329,15 +330,48 @@ class VertexImpl:
     def _on_source_vertex_started(self, event: VertexEvent) -> None:
         self.started_sources.add(event.source_vertex_name)
 
+    def _on_source_scheduled(self, event: VertexEvent) -> None:
+        self._drain_deferred_schedule()
+
     # ---------------------------------------------------------- scheduling
+    def _sources_fully_scheduled(self) -> bool:
+        """Controlled-scheduling gate (DAGSchedulerNaturalOrderControlled):
+        every SEQUENTIAL source vertex must have scheduled ALL its tasks."""
+        for e in self.in_edges.values():
+            if e.edge_property.scheduling_type is not SchedulingType.SEQUENTIAL:
+                continue
+            src = e.source_vertex
+            if src.num_tasks == 0:
+                continue   # an empty source is trivially fully scheduled
+            if src.num_tasks < 0 or \
+                    len(src.scheduled_task_indices) < src.num_tasks:
+                return False
+        return True
+
+    def _drain_deferred_schedule(self) -> None:
+        if self._deferred_schedule and self._sources_fully_scheduled():
+            pending, self._deferred_schedule = self._deferred_schedule, []
+            log.info("vertex %s: sources fully scheduled, releasing %d "
+                     "held tasks", self.name, len(pending))
+            self.schedule_tasks(pending)
+
     def schedule_tasks(self, task_indices: Sequence[int]) -> None:
         """Called by the vertex manager host (reference:
         VertexImpl.scheduleTasks:1775)."""
         self.vm_tasks_scheduled = True
+        if getattr(self, "controlled_scheduling", False) and \
+                self.in_edges and not self._sources_fully_scheduled():
+            seen = set(self._deferred_schedule)
+            self._deferred_schedule.extend(
+                i for i in task_indices
+                if i not in self.scheduled_task_indices and i not in seen)
+            return
+        newly_scheduled = False
         for i in task_indices:
             if i in self.scheduled_task_indices:
                 continue
             self.scheduled_task_indices.add(i)
+            newly_scheduled = True
             recovered = self._recovered_tasks.get(i)
             if recovered is not None:
                 self.ctx.dispatch(TaskEvent(TaskEventType.T_RECOVER,
@@ -346,6 +380,14 @@ class VertexImpl:
             else:
                 self.ctx.dispatch(TaskEvent(TaskEventType.T_SCHEDULE,
                                             self.vertex_id.task(i)))
+        if newly_scheduled:
+            # controlled downstream vertices may have been waiting on us
+            for e in self.out_edges.values():
+                dst = e.destination_vertex
+                if getattr(dst, "controlled_scheduling", False):
+                    self.ctx.dispatch(VertexEvent(
+                        VertexEventType.V_SOURCE_SCHEDULED,
+                        dst.vertex_id, source_vertex_name=self.name))
 
     # ------------------------------------------------- completion tracking
     def _on_task_completed(self, event: VertexEvent) -> VertexState:
@@ -470,6 +512,15 @@ class VertexImpl:
                   "time_taken": self.finish_time - (self.start_time or
                                                     self.finish_time),
                   "counters": self.counters.to_dict()}))
+        # a finished source is definitionally fully scheduled: release any
+        # controlled downstream holdback (covers 0-task sources, which never
+        # emit the schedule-time signal)
+        for e in self.out_edges.values():
+            dst = e.destination_vertex
+            if getattr(dst, "controlled_scheduling", False):
+                self.ctx.dispatch(VertexEvent(
+                    VertexEventType.V_SOURCE_SCHEDULED, dst.vertex_id,
+                    source_vertex_name=self.name))
         self.dag.on_vertex_completed(self, VertexState.SUCCEEDED)
         return VertexState.SUCCEEDED
 
@@ -687,14 +738,18 @@ class VertexImpl:
 def _build_vertex_factory() -> StateMachineFactory:
     S, E = VertexState, VertexEventType
     f = StateMachineFactory(S.NEW)
-    f.add_multi(S.NEW, (S.INITIALIZING, S.INITED, S.FAILED, S.RUNNING),
+    # SUCCEEDED is reachable directly when a (possibly initializer-provided)
+    # 0-task vertex starts: _do_start -> _check_complete finishes it
+    f.add_multi(S.NEW, (S.INITIALIZING, S.INITED, S.FAILED, S.RUNNING,
+                        S.SUCCEEDED),
                 E.V_INIT, VertexImpl._on_init)
     f.add_multi(S.NEW, (S.NEW,), E.V_START, VertexImpl._on_start)
     f.add(S.NEW, S.NEW, E.V_SOURCE_VERTEX_STARTED,
           VertexImpl._on_source_vertex_started)
     f.add(S.NEW, S.KILLED, E.V_TERMINATE, VertexImpl._on_terminate)
 
-    f.add_multi(S.INITIALIZING, (S.INITIALIZING, S.INITED, S.FAILED, S.RUNNING),
+    f.add_multi(S.INITIALIZING, (S.INITIALIZING, S.INITED, S.FAILED,
+                                 S.RUNNING, S.SUCCEEDED),
                 E.V_ROOT_INPUT_INITIALIZED, VertexImpl._on_root_input_initialized)
     f.add_multi(S.INITIALIZING, (S.FAILED,), E.V_ROOT_INPUT_FAILED,
                 VertexImpl._on_root_input_failed)
@@ -704,7 +759,8 @@ def _build_vertex_factory() -> StateMachineFactory:
           VertexImpl._on_source_vertex_started)
     f.add(S.INITIALIZING, S.KILLED, E.V_TERMINATE, VertexImpl._on_terminate)
 
-    f.add_multi(S.INITED, (S.RUNNING,), E.V_START, VertexImpl._on_start)
+    f.add_multi(S.INITED, (S.RUNNING, S.SUCCEEDED), E.V_START,
+                VertexImpl._on_start)
     f.add(S.INITED, S.INITED, E.V_SOURCE_VERTEX_STARTED,
           VertexImpl._on_source_vertex_started)
     f.add(S.INITED, S.INITED, E.V_SOURCE_TASK_ATTEMPT_COMPLETED,
@@ -723,6 +779,10 @@ def _build_vertex_factory() -> StateMachineFactory:
           VertexImpl._on_source_task_attempt_completed)
     f.add(S.RUNNING, S.RUNNING, E.V_SOURCE_VERTEX_STARTED,
           VertexImpl._on_source_vertex_started)
+    f.add(S.RUNNING, S.RUNNING, E.V_SOURCE_SCHEDULED,
+          VertexImpl._on_source_scheduled)
+    f.add(S.INITED, S.INITED, E.V_SOURCE_SCHEDULED,
+          VertexImpl._on_source_scheduled)
     f.add_multi(S.RUNNING, (S.RUNNING, S.KILLED), E.V_TERMINATE,
                 VertexImpl._on_terminate)
     f.add_multi(S.RUNNING, (S.FAILED,), E.V_MANAGER_USER_CODE_ERROR,

@@ -64,6 +64,4 @@ class DAGSchedulerNaturalOrderControlled(DAGSchedulerNaturalOrder):
 def apply_dag_scheduler(dag: "DAGImpl") -> None:
     from tez_tpu.common import config as C
     from tez_tpu.common.payload import resolve_class
-    name = dag.conf.get(C.DAG_SCHEDULER_CLASS) or \
-        "tez_tpu.am.dag_scheduler:DAGSchedulerNaturalOrder"
-    resolve_class(name)().apply(dag)
+    resolve_class(dag.conf.get(C.DAG_SCHEDULER_CLASS))().apply(dag)

@@ -287,8 +287,9 @@ class VertexImpl:
 
     def _recreate_tasks(self, new_parallelism: int) -> None:
         """Auto-parallelism reconfiguration before any task scheduled."""
-        assert not self.scheduled_task_indices, \
-            "cannot reconfigure after tasks scheduled"
+        assert not self.scheduled_task_indices and \
+            not self._deferred_schedule, \
+            "cannot reconfigure after tasks scheduled (incl. held back)"
         self.num_tasks = new_parallelism
         self.tasks.clear()
         self._recovered_tasks.clear()   # indices no longer meaningful
@@ -380,8 +381,10 @@ class VertexImpl:
             else:
                 self.ctx.dispatch(TaskEvent(TaskEventType.T_SCHEDULE,
                                             self.vertex_id.task(i)))
-        if newly_scheduled:
-            # controlled downstream vertices may have been waiting on us
+        if newly_scheduled and self.num_tasks > 0 and \
+                len(self.scheduled_task_indices) >= self.num_tasks:
+            # we just became FULLY scheduled: release controlled downstream
+            # holdbacks (one signal, not one per schedule_tasks call)
             for e in self.out_edges.values():
                 dst = e.destination_vertex
                 if getattr(dst, "controlled_scheduling", False):

@@ -148,6 +148,10 @@ CLIENT_AM_HEARTBEAT_INTERVAL_SECS = _key(
 DAG_SCHEDULER_CLASS = _key("tez.am.dag.scheduler.class",
                            "tez_tpu.am.dag_scheduler:DAGSchedulerNaturalOrder", Scope.AM)
 THREAD_DUMP_INTERVAL_MS = _key("tez.thread.dump.interval.ms", 0, Scope.VERTEX)
+TASK_JAX_PROFILE_DIR = _key(
+    "tez.task.jax-profile.dir", "", Scope.VERTEX,
+    "Write a per-task-attempt XLA profiler trace (TensorBoard/Perfetto) "
+    "under this dir; '' disables (the TPU-native per-kernel tracing story)")
 AM_WEB_ENABLED = _key("tez.am.web.enabled", False, Scope.AM,
                       "Serve the live status endpoint (AMWebController analog)")
 AM_WEB_PORT = _key("tez.am.web.port", 0, Scope.AM, "0 = ephemeral")

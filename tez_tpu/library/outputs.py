@@ -94,6 +94,14 @@ class OrderedPartitionedKVOutput(LogicalOutput):
             from tez_tpu.common.payload import resolve_class
             self.partition_fn = resolve_class(partitioner_cls)().get_partition
         from tez_tpu.library.comparators import load_comparator
+        spill_codec = None
+        if _conf_get(ctx, "tez.runtime.compress", False):
+            spill_codec = _conf_get(ctx, "tez.runtime.compress.codec", "zlib")
+            if spill_codec != "zlib":
+                # silently-off compression is worse than a loud error
+                raise ValueError(
+                    f"unsupported tez.runtime.compress.codec {spill_codec!r}"
+                    " (supported: zlib)")
         self.sorter = DeviceSorter(
             num_partitions=self.num_physical_outputs,
             key_width=key_width,
@@ -105,6 +113,7 @@ class OrderedPartitionedKVOutput(LogicalOutput):
             sort_threads=sort_threads,
             merge_factor=merge_factor,
             key_normalizer=load_comparator(ctx),
+            spill_codec=spill_codec,
         )
         ctx.request_initial_memory(sort_mb << 20, None,
                            component_type="PARTITIONED_SORTED_OUTPUT")

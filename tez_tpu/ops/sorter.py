@@ -154,13 +154,16 @@ class DeviceSorter:
                  engine: str = "device",
                  sort_threads: int = 0,
                  merge_factor: int = 64,
-                 key_normalizer: Optional[Callable[[bytes], bytes]] = None):
+                 key_normalizer: Optional[Callable[[bytes], bytes]] = None,
+                 spill_codec: Optional[str] = None):
         self.num_partitions = num_partitions
         self.key_width = max(4, key_width)
         self.engine = engine   # 'device' (TPU kernels) | 'host' (np.lexsort)
         #: custom comparator as key normalization (library/comparators.py);
         #: None = sort by raw key bytes (zero-cost default)
         self.key_normalizer = key_normalizer
+        #: host-spill compression (reference: tez.runtime.compress on IFile)
+        self.spill_codec = spill_codec
         self.span_budget = span_budget_bytes
         self.spill_dir = spill_dir
         self.counters = counters or TezCounters()
@@ -324,11 +327,14 @@ class DeviceSorter:
                 self._runs_nbytes + run.nbytes > self.mem_budget:
             path = os.path.join(self.spill_dir,
                                 f"spill_{uuid.uuid4().hex}.run")
-            run.save(path)
+            run.save(path, codec=self.spill_codec)
+            # count bytes actually written: with compression on, disk I/O
+            # is what these counters exist to report
+            written = os.path.getsize(path)
             self.counters.increment(TaskCounter.ADDITIONAL_SPILLS_BYTES_WRITTEN,
-                                    run.nbytes)
+                                    written)
             self.counters.increment(TaskCounter.ADDITIONAL_SPILL_COUNT)
-            self.counters.increment(TaskCounter.HOST_SPILL_BYTES, run.nbytes)
+            self.counters.increment(TaskCounter.HOST_SPILL_BYTES, written)
             self._runs.append(path)
         else:
             self._runs.append(run)
@@ -358,9 +364,10 @@ class DeviceSorter:
         out = []
         for r in self._runs:
             if isinstance(r, str):
+                read = os.path.getsize(r)
                 run = Run.load(r)
                 self.counters.increment(
-                    TaskCounter.ADDITIONAL_SPILLS_BYTES_READ, run.nbytes)
+                    TaskCounter.ADDITIONAL_SPILLS_BYTES_READ, read)
                 out.append(run)
             else:
                 out.append(r)

@@ -172,6 +172,11 @@ class Run:
 
     # -- host-spill serialization (checksummed; IFileOutputStream analog) ----
     def to_bytes(self, codec: Optional[str] = None) -> bytes:
+        if codec not in (None, "zlib"):
+            # an unknown codec silently writing uncompressed is worse than
+            # a loud error at the layer that interprets the value
+            raise ValueError(f"unsupported run codec {codec!r} "
+                             "(supported: zlib)")
         buf = io.BytesIO()
         arrays = (self.batch.key_bytes, self.batch.key_offsets,
                   self.batch.val_bytes, self.batch.val_offsets,

@@ -423,3 +423,30 @@ def test_am_recovery_idempotent_across_three_attempts(tmp_staging, tmp_path):
     d = am3.dag_counters.to_dict().get("DAGCounter", {})
     assert d.get("TOTAL_LAUNCHED_TASKS", 0) == 1   # consumer only
     am3.stop()
+
+
+def test_recovery_journal_pickle_gate():
+    """Pickle-encoded journal payloads are rejected during replay unless
+    tez.dag.recovery.trusted-staging opts in (the journal lives in a shared
+    staging dir; unpickling it is code execution)."""
+    import pytest as _pytest
+    from tez_tpu.am.recovery import (UntrustedJournalPayload, event_from_wire,
+                                     event_to_wire)
+
+    wire = event_to_wire(_CarrierEvent())
+    assert wire["t"] == "pickle"
+    with _pytest.raises(UntrustedJournalPayload):
+        event_from_wire(wire)
+    assert isinstance(event_from_wire(wire, allow_pickle=True),
+                      _CarrierEvent)
+
+    from tez_tpu.api.events import DataMovementEvent
+    dme_wire = event_to_wire(DataMovementEvent(source_index=1,
+                                               user_payload=b"x", version=0))
+    ev = event_from_wire(dme_wire)     # typed kinds replay without opt-in
+    assert ev.source_index == 1 and ev.user_payload == b"x"
+
+
+class _CarrierEvent:
+    """Not a DME/CDME: forces the pickle wire kind (module-level so the
+    allow_pickle=True leg can actually unpickle it)."""

@@ -98,7 +98,7 @@ def _payload_to_wire(p: Any) -> Any:
     return {"__kind__": "pickle", "hex": pickle.dumps(p).hex()}
 
 
-def _payload_from_wire(w: Any) -> Any:
+def _payload_from_wire(w: Any, allow_pickle: bool = False) -> Any:
     from tez_tpu.api.events import ShufflePayload
     if w is None:
         return None
@@ -112,6 +112,14 @@ def _payload_from_wire(w: Any) -> Any:
         return bytes.fromhex(w["hex"])
     if kind == "json":
         return w["value"]
+    if not allow_pickle:
+        # the journal lives in the shared staging dir: unpickling it hands
+        # code execution to anyone with write access there.  The framework's
+        # own events all use the typed kinds above; arbitrary payloads only
+        # replay under the explicit tez.dag.recovery.trusted-staging opt-in.
+        raise UntrustedJournalPayload(
+            f"journal payload kind {kind!r} requires pickle; set "
+            f"tez.dag.recovery.trusted-staging=true to replay it")
     import pickle
     return pickle.loads(bytes.fromhex(w["hex"]))
 
@@ -131,19 +139,29 @@ def event_to_wire(ev: Any) -> Dict[str, Any]:
     return {"t": "pickle", "hex": pickle.dumps(ev).hex()}
 
 
-def event_from_wire(w: Dict[str, Any]) -> Any:
+class UntrustedJournalPayload(RuntimeError):
+    """A journaled event/payload needs pickle to decode but the staging dir
+    is not trusted (tez.dag.recovery.trusted-staging unset)."""
+
+
+def event_from_wire(w: Dict[str, Any], allow_pickle: bool = False) -> Any:
     from tez_tpu.api.events import (CompositeDataMovementEvent,
                                     DataMovementEvent)
     t = w["t"]
     if t == "DME":
-        return DataMovementEvent(source_index=w["source_index"],
-                                 user_payload=_payload_from_wire(w["payload"]),
-                                 version=w["version"])
+        return DataMovementEvent(
+            source_index=w["source_index"],
+            user_payload=_payload_from_wire(w["payload"], allow_pickle),
+            version=w["version"])
     if t == "CDME":
         return CompositeDataMovementEvent(
             source_index_start=w["source_index_start"], count=w["count"],
-            user_payload=_payload_from_wire(w["payload"]),
+            user_payload=_payload_from_wire(w["payload"], allow_pickle),
             version=w["version"])
+    if not allow_pickle:
+        raise UntrustedJournalPayload(
+            f"journal event kind {t!r} requires pickle; set "
+            f"tez.dag.recovery.trusted-staging=true to replay it")
     import pickle
     return pickle.loads(bytes.fromhex(w["hex"]))
 

@@ -346,9 +346,10 @@ class TaskImpl:
         self.attempts[n] = att
         self.successful_attempt = att.attempt_id
         self.scheduled_time = self.finish_time = now
-        from tez_tpu.am.recovery import event_from_wire
-        for edge_name, wire in rec.get("generated_events", []):
-            ev = event_from_wire(wire)
+        # events were decoded (and the pickle trust gate enforced) by
+        # VertexImpl._load_recovered_tasks — a task reaches T_RECOVER only
+        # when every journaled event replayed cleanly
+        for edge_name, ev in rec["decoded_events"]:
             edge = self.vertex.out_edges.get(edge_name)
             if edge is None:
                 continue

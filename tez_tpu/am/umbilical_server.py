@@ -7,13 +7,16 @@ orchestrator process and out-of-process runners (TezChild JVMs there, runner
 processes here; a multi-host deployment points runners at the AM host over
 DCN).
 
-Wire format: a RAW 32-byte HMAC handshake (no deserialization of untrusted
-bytes before authentication), then length-prefixed pickled (method, args)
-requests / (ok, payload) responses.  Pickle is acceptable on the
-post-handshake channel because both ends are the framework's own trusted
-processes inside one job holding the job token (the reference's Writable
-RPC makes the same assumption); unauthenticated peers never reach the
-unpickler.
+Wire format: a RAW challenge-response handshake (no deserialization of
+untrusted bytes before authentication) — the server sends a random 16-byte
+nonce first and the client replies the 32-byte HMAC(secret, purpose||nonce),
+so an observed handshake cannot be replayed (reference: the umbilical's
+SASL/DIGEST job-token challenge-response auth) — then length-prefixed
+pickled (method, args) requests / (ok, payload) responses.  Pickle is
+acceptable on the post-handshake channel because both ends are the
+framework's own trusted processes inside one job holding the job token (the
+reference's Writable RPC makes the same assumption); unauthenticated peers
+never reach the unpickler.
 """
 from __future__ import annotations
 
@@ -52,11 +55,19 @@ def _recv_msg(rfile: Any) -> Any:
 
 def authenticate_stream(rfile, wfile, secrets: JobTokenSecretManager,
                         purpose: bytes) -> bool:
-    """Server side of the raw handshake: read EXACTLY 32 bytes (the HMAC of
-    `purpose`), compare, reply b"OK"/b"NO".  Nothing is unpickled before
-    this succeeds."""
+    """Server side of the challenge-response handshake: send a fresh 16-byte
+    nonce, read EXACTLY 32 bytes (the HMAC of purpose||nonce), compare,
+    reply b"OK"/b"NO".  The nonce makes an observed handshake worthless to a
+    replaying peer; nothing is unpickled before this succeeds."""
+    import os as _os
+    nonce = _os.urandom(16)
+    try:
+        wfile.write(nonce)
+        wfile.flush()
+    except OSError:
+        return False
     sig = rfile.read(32)
-    if len(sig) != 32 or not secrets.verify_hash(sig, purpose):
+    if len(sig) != 32 or not secrets.verify_hash(sig, purpose + nonce):
         try:
             wfile.write(b"NO")
             wfile.flush()
@@ -70,7 +81,10 @@ def authenticate_stream(rfile, wfile, secrets: JobTokenSecretManager,
 
 def client_handshake(rfile, wfile, secrets: JobTokenSecretManager,
                      purpose: bytes) -> None:
-    wfile.write(secrets.compute_hash(purpose))
+    nonce = rfile.read(16)
+    if len(nonce) != 16:
+        raise ConnectionError("handshake: server closed before challenge")
+    wfile.write(secrets.compute_hash(purpose + nonce))
     wfile.flush()
     reply = rfile.read(2)
     if reply != b"OK":

@@ -27,6 +27,7 @@ from tez_tpu.am.events import (TaskAttemptEvent, TaskAttemptEventType,
 from tez_tpu.am.history import HistoryEvent, HistoryEventType
 from tez_tpu.am.task_impl import (TaskAttemptState, TaskImpl, TaskState,
                                   TERMINAL_TASK_STATES)
+from tez_tpu.common import config as C
 from tez_tpu.common.counters import TezCounters
 from tez_tpu.common.ids import TaskAttemptId, VertexId
 from tez_tpu.common.statemachine import StateMachineFactory
@@ -277,10 +278,36 @@ class VertexImpl:
             return
         if rec.vertex_num_tasks.get(self.name) != self.num_tasks:
             return
+        from tez_tpu.am.recovery import (UntrustedJournalPayload,
+                                          event_from_wire)
+        allow_pickle = bool(self.conf.get(C.RECOVERY_TRUSTED_STAGING))
         for i in range(self.num_tasks):
             td = rec.task_data.get(str(self.vertex_id.task(i)))
-            if td is not None:
-                self._recovered_tasks[i] = td
+            if td is None:
+                continue
+            # Decode the journaled output events NOW: a task whose events
+            # cannot be replayed (pickle-encoded journal without the
+            # trusted-staging opt-in) is not restorable — short-circuiting
+            # it while dropping events would leave consumers waiting on a
+            # DME that never comes, so it re-runs normally instead.
+            try:
+                td = dict(td)
+                td["decoded_events"] = [
+                    (edge_name, event_from_wire(w, allow_pickle=allow_pickle))
+                    for edge_name, w in td.get("generated_events", [])]
+            except UntrustedJournalPayload as e:
+                log.warning("vertex %s task %d: not restoring from journal "
+                            "(%s); task will re-run", self.name, i, e)
+                continue
+            except Exception as e:  # noqa: BLE001 — a journal entry that
+                # fails to decode for ANY reason (stale pickled class from an
+                # older build, truncated/corrupt wire fields) must degrade to
+                # re-running that task, never fail the whole DAG's recovery
+                log.warning("vertex %s task %d: journal entry undecodable "
+                            "(%s: %s); task will re-run", self.name, i,
+                            type(e).__name__, e)
+                continue
+            self._recovered_tasks[i] = td
         if self._recovered_tasks:
             log.info("vertex %s: %d/%d tasks restorable from recovery journal",
                      self.name, len(self._recovered_tasks), self.num_tasks)

@@ -125,6 +125,10 @@ SPECULATION_SLOWTASK_THRESHOLD = _key(
 SPECULATION_ESTIMATOR = _key("tez.am.legacy.speculative.estimator.class",
                              "simple_exponential", Scope.VERTEX)
 DAG_RECOVERY_ENABLED = _key("tez.dag.recovery.enabled", True, Scope.AM)
+RECOVERY_TRUSTED_STAGING = _key(
+    "tez.dag.recovery.trusted-staging", False, Scope.AM,
+    "allow pickle-encoded journal payloads during recovery replay (only "
+    "safe when the staging dir is writable solely by the framework)")
 DAG_RECOVERY_FLUSH_INTERVAL_SECS = _key("tez.dag.recovery.flush.interval.secs", 30, Scope.AM)
 HISTORY_LOGGING_SERVICE_CLASS = _key(
     "tez.history.logging.service.class",

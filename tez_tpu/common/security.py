@@ -27,12 +27,22 @@ class JobTokenSecretManager:
         return hmac.compare_digest(digest, self.compute_hash(msg))
 
 
+def shuffle_request_msg(path: str, spill_id: int, partition_lo: int,
+                        partition_hi: int, nonce: bytes) -> bytes:
+    """Canonical fetch-request bytes (SecureShuffleUtils.hashFromString
+    analog): covers EVERY request field plus the server's per-connection
+    nonce, so a captured request neither authorizes different partitions
+    nor replays on a new connection."""
+    return (f"{path}|{spill_id}|{partition_lo}|{partition_hi}|"
+            f"{nonce.hex()}".encode())
+
+
 def hash_from_request(secret: JobTokenSecretManager, path: str,
-                      spill_id: int, partition: int) -> bytes:
-    """Canonical request signature (SecureShuffleUtils.hashFromString
-    analog)."""
-    msg = f"{path}|{spill_id}|{partition}".encode()
-    return secret.compute_hash(msg)
+                      spill_id: int, partition_lo: int, partition_hi: int,
+                      nonce: bytes) -> bytes:
+    return secret.compute_hash(
+        shuffle_request_msg(path, spill_id, partition_lo, partition_hi,
+                            nonce))
 
 
 class DAGAccessControls:

@@ -101,6 +101,9 @@ class ShuffleFetchTable:
                     (payload.spill_id >= 0 and payload.spill_id in s.spills_seen):
                 return  # duplicate delivery (e.g. after slot reset race)
             s.version = version
+            stamp = s   # identity captured: if on_input_failed resets the
+            # slot while the (un-locked) fetch below runs, this stale
+            # producer version's batch must not land in the fresh slot
         try:
             if payload.is_empty(partition):
                 batch = None
@@ -121,6 +124,8 @@ class ShuffleFetchTable:
             return
         with self.lock:
             s = self.slots[slot]
+            if s is not stamp or s.version != version:
+                return   # slot was reset mid-fetch: drop the stale batch
             if batch is not None:
                 s.batches.append(batch)
             if payload.spill_id >= 0:

@@ -8,7 +8,12 @@ tez_tpu.shuffle.service; this socket path carries inter-host (DCN) fetches
 and AM-recovery reads.
 
 Wire format (length-prefixed):
+  greeting: 16-byte random per-connection nonce (server -> client)
   request : u32 len | JSON {path, spill, partition_lo, partition_hi, hmac-hex}
+            where hmac = HMAC(token, path|spill|lo|hi|nonce) — covers the
+            full canonical request and is bound to this connection, so a
+            captured request cannot be replayed (SecureShuffleUtils MACs
+            the entire request URL; the nonce adds replay resistance)
   response: u32 len | JSON {status, sizes:[...]} | concatenated Run blobs
 Each requested partition ships as one checksummed single-partition Run blob
 (ops.runformat serialization), so corruption is detected end-to-end.
@@ -18,6 +23,7 @@ from __future__ import annotations
 import io
 import json
 import logging
+import os
 import socket
 import socketserver
 import struct
@@ -28,7 +34,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from tez_tpu.common.security import (JobTokenSecretManager,
-                                     hash_from_request)
+                                     hash_from_request, shuffle_request_msg)
 from tez_tpu.ops.runformat import KVBatch, Run
 from tez_tpu.shuffle.service import (ShuffleDataNotFound, ShuffleService,
                                      local_shuffle_service)
@@ -50,24 +56,28 @@ class _Handler(socketserver.StreamRequestHandler):
     def handle(self) -> None:
         server: "ShuffleServer" = self.server  # type: ignore[assignment]
         try:
+            nonce = os.urandom(16)
+            self.wfile.write(nonce)
+            self.wfile.flush()
             while True:  # keep-alive: serve multiple fetches per connection
                 raw_len = self.rfile.read(4)
                 if len(raw_len) < 4:
                     return
                 (req_len,) = struct.unpack("<I", raw_len)
                 req = json.loads(self.rfile.read(req_len))
-                self._serve_one(server, req)
+                self._serve_one(server, req, nonce)
         except (ConnectionError, json.JSONDecodeError, struct.error):
             return
 
-    def _serve_one(self, server: "ShuffleServer", req: dict) -> None:
+    def _serve_one(self, server: "ShuffleServer", req: dict,
+                   nonce: bytes) -> None:
         path = req.get("path", "")
         spill = int(req.get("spill", -1))
         lo = int(req.get("partition_lo", 0))
         hi = int(req.get("partition_hi", lo + 1))
         sig = bytes.fromhex(req.get("hmac", ""))
         if not server.secrets.verify_hash(
-                sig, f"{path}|{spill}|{lo}".encode()):
+                sig, shuffle_request_msg(path, spill, lo, hi, nonce)):
             server.auth_failures += 1   # count BEFORE replying (clients may
             self._reply({"status": "forbidden"}, [])  # observe immediately)
             return
@@ -166,15 +176,19 @@ class ShuffleFetcher:
 
     def _fetch_once(self, host: str, port: int, path: str, spill: int,
                     lo: int, hi: int) -> List[KVBatch]:
-        req = json.dumps({
-            "path": path, "spill": spill,
-            "partition_lo": lo, "partition_hi": hi,
-            "hmac": hash_from_request(self.secrets, path, spill, lo).hex(),
-        }).encode()
         with socket.create_connection((host, port),
                                       timeout=self.connect_timeout) as sk:
-            sk.sendall(struct.pack("<I", len(req)) + req)
             fh = sk.makefile("rb")
+            nonce = fh.read(16)
+            if len(nonce) != 16:
+                raise ConnectionError("shuffle server closed before nonce")
+            req = json.dumps({
+                "path": path, "spill": spill,
+                "partition_lo": lo, "partition_hi": hi,
+                "hmac": hash_from_request(self.secrets, path, spill, lo, hi,
+                                          nonce).hex(),
+            }).encode()
+            sk.sendall(struct.pack("<I", len(req)) + req)
             (hdr_len,) = struct.unpack("<I", fh.read(4))
             header = json.loads(fh.read(hdr_len))
             status = header.get("status")

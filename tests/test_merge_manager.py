@@ -1,0 +1,207 @@
+"""Bounded-memory consumer merge (MergeManager.java:83 analog) tests:
+admission + stall, mem->disk trigger, disk cascade, streaming final merge,
+poisoning on post-merge slot reset, and an E2E run with budget << data."""
+import os
+
+import numpy as np
+import pytest
+
+from tez_tpu.common.counters import TaskCounter, TezCounters
+from tez_tpu.library.merge_manager import ShuffleMergeManager
+from tez_tpu.ops.runformat import KVBatch
+
+
+def sorted_batch(seed: int, n: int, vlen: int = 32) -> KVBatch:
+    rng = np.random.default_rng(seed)
+    keys = sorted(f"k{rng.integers(0, 50_000):08d}".encode()
+                  for _ in range(n))
+    vals = [rng.integers(0, 256, vlen, dtype=np.uint8).tobytes()
+            for _ in range(n)]
+    return KVBatch.from_pairs(list(zip(keys, vals)))
+
+
+def reference_merge(batches):
+    """Golden: global stable sort by key over (slot-ordered) concatenation."""
+    pairs = []
+    for b in batches:
+        pairs.extend(b.iter_pairs())
+    pairs.sort(key=lambda kv: kv[0])
+    return pairs
+
+
+def drain(mm):
+    result = mm.finish()
+    if result.is_streaming:
+        return [(k, v) for _, k, v in result.stream.iter_records()]
+    return list(result.batch.iter_pairs())
+
+
+def test_unbounded_budget_passthrough(tmp_path):
+    counters = TezCounters()
+    mm = ShuffleMergeManager(counters, 0, str(tmp_path), engine="host")
+    batches = [sorted_batch(i, 500) for i in range(4)]
+    for slot, b in enumerate(batches):
+        mm.commit(slot, b)
+    assert drain(mm) == reference_merge(batches)
+    assert mm._mem_to_disk == 0
+
+
+def test_budget_forces_disk_merges_and_bounds_memory(tmp_path):
+    counters = TezCounters()
+    batches = [sorted_batch(i, 2000) for i in range(8)]
+    total = sum(b.nbytes for b in batches)
+    budget = total // 5
+    mm = ShuffleMergeManager(counters, budget, str(tmp_path), engine="host",
+                             merge_threshold=0.5, max_single_fraction=2.0,
+                             block_records=256)
+    for slot, b in enumerate(batches):
+        mm.commit(slot, b)
+    got = drain(mm)
+    assert got == reference_merge(batches)
+    assert mm.peak_mem_bytes <= budget
+    assert mm._mem_to_disk >= 1
+    assert counters.find_counter(TaskCounter.NUM_MEM_TO_DISK_MERGES)\
+        .value >= 1
+    assert counters.find_counter(TaskCounter.SHUFFLE_BYTES_TO_MEM).value > 0
+
+
+def test_oversized_batch_goes_straight_to_disk(tmp_path):
+    counters = TezCounters()
+    big = sorted_batch(1, 4000)
+    mm = ShuffleMergeManager(counters, big.nbytes * 2, str(tmp_path),
+                             engine="host", max_single_fraction=0.25,
+                             block_records=512)
+    mm.commit(0, big)
+    assert counters.find_counter(TaskCounter.SHUFFLE_BYTES_TO_DISK)\
+        .value == big.nbytes
+    assert drain(mm) == reference_merge([big])
+
+
+def test_disk_to_disk_cascade(tmp_path):
+    counters = TezCounters()
+    batches = [sorted_batch(i, 800) for i in range(6)]
+    mm = ShuffleMergeManager(counters, 10 * 1024 * 1024, str(tmp_path),
+                             engine="host", merge_factor=2,
+                             max_single_fraction=0.0001,  # everything DISK
+                             block_records=128)
+    for slot, b in enumerate(batches):
+        mm.commit(slot, b)
+    import time
+    deadline = time.time() + 20
+    while mm._disk_to_disk == 0 and time.time() < deadline:
+        time.sleep(0.05)
+    assert mm._disk_to_disk >= 1
+    assert counters.find_counter(TaskCounter.NUM_DISK_TO_DISK_MERGES)\
+        .value >= 1
+    assert drain(mm) == reference_merge(batches)
+
+
+def test_slot_reset_in_memory_discards(tmp_path):
+    counters = TezCounters()
+    keep = sorted_batch(0, 300)
+    drop = sorted_batch(1, 300)
+    mm = ShuffleMergeManager(counters, 0, str(tmp_path), engine="host")
+    mm.commit(0, keep)
+    mm.commit(1, drop)
+    dropped = mm.on_slot_reset(1)
+    assert dropped and dropped[0] is drop
+    assert drain(mm) == reference_merge([keep])
+
+
+def test_slot_reset_after_disk_merge_poisons(tmp_path):
+    counters = TezCounters()
+    big = sorted_batch(0, 2000)
+    mm = ShuffleMergeManager(counters, big.nbytes * 2, str(tmp_path),
+                             engine="host", max_single_fraction=0.1)
+    mm.commit(3, big)          # oversized -> disk
+    mm.on_slot_reset(3)        # data already on disk: unrecoverable
+    with pytest.raises(RuntimeError, match="merge state lost"):
+        mm.commit(0, sorted_batch(1, 10))
+    mm.cleanup()
+
+
+def test_streaming_plan_is_reiterable(tmp_path):
+    counters = TezCounters()
+    batches = [sorted_batch(i, 1000) for i in range(4)]
+    mm = ShuffleMergeManager(counters, 10 * 1024 * 1024, str(tmp_path),
+                             engine="host", max_single_fraction=0.0001,
+                             block_records=128)
+    for slot, b in enumerate(batches):
+        mm.commit(slot, b)
+    result = mm.finish()
+    assert result.is_streaming
+    first = [(k, v) for _, k, v in result.stream.iter_records()]
+    second = [(k, v) for _, k, v in result.stream.iter_records()]
+    assert first == second == reference_merge(batches)
+
+
+def test_e2e_wordcount_with_tiny_merge_budget(tmp_path):
+    """Framework-level: OrderedWordCount with a consumer merge budget far
+    below the shuffled data size must spill, stream, and still produce
+    output identical to the unbounded run."""
+    import collections
+    import random
+    from tez_tpu.examples import ordered_wordcount
+
+    rng = random.Random(11)
+    words = [f"w{rng.randrange(300):05d}" for _ in range(250_000)]
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text(" ".join(words))
+    golden = collections.Counter(words)
+
+    outs = {}
+    for label, budget_mb in (("unbounded", 0), ("tiny", 1)):
+        out_dir = str(tmp_path / f"out_{label}")
+        conf = {"tez.staging-dir": str(tmp_path / f"stg_{label}"),
+                "tez.runtime.io.sort.mb": 1}
+        if budget_mb:
+            conf["tez.runtime.shuffle.merge.budget.mb"] = budget_mb
+            conf["tez.runtime.shuffle.merge.percent"] = 0.4
+        state = ordered_wordcount.run(
+            [str(corpus)], out_dir,
+            conf=conf, tokenizer_parallelism=3, summation_parallelism=2,
+            sorter_parallelism=1)
+        assert state == "SUCCEEDED"
+        lines = []
+        for name in sorted(os.listdir(out_dir)):
+            with open(os.path.join(out_dir, name)) as fh:
+                lines.extend(fh.read().splitlines())
+        outs[label] = lines
+        counts = dict(line.rsplit(None, 1) for line in lines if line.strip())
+        assert {k: int(v) for k, v in counts.items()} == dict(golden)
+    assert outs["unbounded"] == outs["tiny"]
+
+
+def test_commit_below_threshold_does_not_deadlock(tmp_path):
+    """A batch that doesn't fit the remaining budget while committed memory
+    sits BELOW the merge threshold must not stall forever: a stalled
+    fetcher forces the merger to free memory early."""
+    counters = TezCounters()
+    b0 = sorted_batch(0, 900)
+    budget = int(b0.nbytes * 1.25)
+    mm = ShuffleMergeManager(counters, budget, str(tmp_path), engine="host",
+                             merge_threshold=0.9, max_single_fraction=0.5,
+                             block_records=128)
+    mm.commit(0, b0)                       # ~80% of budget: below threshold
+    big2 = sorted_batch(1, 500)            # doesn't fit; < max_single
+    import threading
+    done = threading.Event()
+    t = threading.Thread(target=lambda: (mm.commit(1, big2), done.set()),
+                         daemon=True)
+    t.start()
+    assert done.wait(20), "commit deadlocked below merge threshold"
+    assert drain(mm) == reference_merge([b0, big2])
+
+
+def test_stale_generation_commit_dropped(tmp_path):
+    """A fetch that started before a slot reset must not displace (or join)
+    the new attempt's data, even when it commits after the reset."""
+    counters = TezCounters()
+    mm = ShuffleMergeManager(counters, 0, str(tmp_path), engine="host")
+    stale = sorted_batch(0, 200)
+    fresh = sorted_batch(1, 200)
+    gen = mm.slot_generation(2)
+    mm.on_slot_reset(2)                      # producer re-ran mid-fetch
+    assert mm.commit(2, fresh, mm.slot_generation(2)) is True
+    assert mm.commit(2, stale, gen) is False   # late stale commit dropped
+    assert drain(mm) == reference_merge([fresh])

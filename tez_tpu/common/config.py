@@ -201,6 +201,10 @@ SHUFFLE_PARALLEL_COPIES = _key("tez.runtime.shuffle.parallel.copies", 8, Scope.V
 SHUFFLE_BUFFER_FRACTION = _key("tez.runtime.shuffle.fetch.buffer.percent", 0.9, Scope.VERTEX)
 SHUFFLE_MEMORY_LIMIT_PERCENT = _key("tez.runtime.shuffle.memory.limit.percent", 0.25, Scope.VERTEX)
 SHUFFLE_MERGE_PERCENT = _key("tez.runtime.shuffle.merge.percent", 0.9, Scope.VERTEX)
+SHUFFLE_MERGE_BUDGET_MB = _key(
+    "tez.runtime.shuffle.merge.budget.mb", 0, Scope.VERTEX,
+    "consumer-side fetch/merge memory budget; 0 = use the MemoryDistributor "
+    "grant (fetch.buffer.percent x io.sort.mb request)")
 SHUFFLE_FAILED_CHECK_SINCE_LAST_COMPLETION = _key(
     "tez.runtime.shuffle.failed.check.since-last.completion", True, Scope.VERTEX)
 SHUFFLE_FETCH_MAX_TASK_OUTPUT_AT_ONCE = _key(

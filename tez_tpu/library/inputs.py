@@ -10,6 +10,7 @@ final merge is the device k-way merge kernel.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -22,9 +23,8 @@ from tez_tpu.api.events import (CompositeRoutedDataMovementEvent,
 from tez_tpu.api.runtime import (KeyValueReader, KeyValuesReader,
                                  LogicalInput, MergedLogicalInput, Reader)
 from tez_tpu.common.counters import TaskCounter
-from tez_tpu.ops.runformat import KVBatch, Run
+from tez_tpu.ops.runformat import KVBatch
 from tez_tpu.ops.serde import Serde, get_serde
-from tez_tpu.ops.sorter import merge_sorted_runs
 from tez_tpu.shuffle.service import (ShuffleDataNotFound,
                                      local_shuffle_service)
 
@@ -50,9 +50,12 @@ class ShuffleFetchTable:
     heartbeat thread, the reader blocks on the processor thread).
 
     This is the ShuffleScheduler+MergeManager seam: local fetches are
-    immediate; a DCN fetcher would enqueue here instead."""
+    immediate; a DCN fetcher would enqueue here instead.  With a merge
+    manager attached, fetched batches go through bounded-memory admission
+    (MergeManager.reserve semantics) instead of accumulating per-slot."""
 
-    def __init__(self, context: Any, num_slots: int, my_partition: int):
+    def __init__(self, context: Any, num_slots: int, my_partition: int,
+                 merge_manager: Optional[Any] = None):
         self.context = context
         self.num_slots = num_slots
         self.my_partition = my_partition
@@ -62,6 +65,7 @@ class ShuffleFetchTable:
         self.service = local_shuffle_service()
         self.failed = False
         self.diagnostics = ""
+        self.merge_manager = merge_manager
         meta = context.get_service_provider_metadata("shuffle") or {}
         self.local_host = meta.get("host", "local")
         self.local_port = meta.get("port", 0)
@@ -95,6 +99,7 @@ class ShuffleFetchTable:
 
     def on_payload(self, slot: int, partition: int, payload: ShufflePayload,
                    version: int = 0) -> None:
+        mm = self.merge_manager
         with self.lock:
             s = self.slots[slot]
             if s.complete or \
@@ -104,6 +109,7 @@ class ShuffleFetchTable:
             stamp = s   # identity captured: if on_input_failed resets the
             # slot while the (un-locked) fetch below runs, this stale
             # producer version's batch must not land in the fresh slot
+        generation = mm.slot_generation(slot) if mm is not None else 0
         try:
             if payload.is_empty(partition):
                 batch = None
@@ -111,8 +117,11 @@ class ShuffleFetchTable:
                 batch = self._fetch(payload, partition)
                 self.context.counters.increment(
                     TaskCounter.SHUFFLE_BYTES, batch.nbytes)
-                self.context.counters.increment(
-                    TaskCounter.SHUFFLE_BYTES_TO_MEM, batch.nbytes)
+                if mm is None:
+                    # with a merge manager the TO_MEM/TO_DISK split is its
+                    # admission decision, counted there exactly once
+                    self.context.counters.increment(
+                        TaskCounter.SHUFFLE_BYTES_TO_MEM, batch.nbytes)
                 self.context.counters.increment(TaskCounter.NUM_SHUFFLED_INPUTS)
         except (ShuffleDataNotFound, ConnectionError, PermissionError) as e:
             log.warning("fetch failed for slot %d: %s", slot, e)
@@ -122,11 +131,26 @@ class ShuffleFetchTable:
             self.context.counters.increment(
                 TaskCounter.NUM_FAILED_SHUFFLE_INPUTS)
             return
+        if mm is not None and batch is not None:
+            # bounded-memory admission; may stall while the background
+            # merger frees memory (MergeManager.reserve():404 semantics).
+            # The captured generation makes a stale commit (slot reset
+            # mid-fetch) a silent no-op inside the manager — it can never
+            # displace the new attempt's data.
+            try:
+                if not mm.commit(slot, batch, generation):
+                    return
+            except RuntimeError as e:
+                with self.lock:
+                    self.failed = True
+                    self.diagnostics = str(e)
+                    self.lock.notify_all()
+                return
         with self.lock:
             s = self.slots[slot]
             if s is not stamp or s.version != version:
-                return   # slot was reset mid-fetch: drop the stale batch
-            if batch is not None:
+                return   # slot was reset mid-fetch: drop the stale delivery
+            if mm is None and batch is not None:
                 s.batches.append(batch)
             if payload.spill_id >= 0:
                 s.spills_seen.add(payload.spill_id)
@@ -145,6 +169,8 @@ class ShuffleFetchTable:
                 self.completed -= 1
             self.slots[slot] = _SlotState()
             self.lock.notify_all()
+        if self.merge_manager is not None:
+            self.merge_manager.on_slot_reset(slot)
 
     def wait_all(self, timeout: Optional[float] = None) -> List[KVBatch]:
         import time
@@ -178,15 +204,64 @@ class OrderedGroupedKVInput(LogicalInput):
                                              "bytes"))
         self.key_width = int(_conf_get(ctx, "tez.runtime.tpu.key.width.bytes",
                                        16))
-        self.table = ShuffleFetchTable(ctx, self.num_physical_inputs,
-                                       my_partition=ctx.task_index)
-        ctx.request_initial_memory(0, None,
-                           component_type="SORTED_MERGED_INPUT")
         self._merged: Optional[KVBatch] = None
+        self._stream_plan = None
         from tez_tpu.library.comparators import load_comparator
         self._key_normalizer = load_comparator(ctx)   # resolved ONCE
         self._group_starts = None                     # cached across readers
+
+        # Bounded-memory merge (MergeManager.java:83 analog).  The budget
+        # comes from an explicit key, or else from the MemoryDistributor
+        # grant for buffer.percent x io.sort.mb (reference: shuffle buffer
+        # = fetch.buffer.percent of task memory).  0 explicit + 0 grant =
+        # unbounded accumulation (grant callback delivers before start()).
+        budget_mb = int(_conf_get(ctx, "tez.runtime.shuffle.merge.budget.mb",
+                                  0))
+        sort_mb = int(_conf_get(ctx, "tez.runtime.io.sort.mb", 256))
+        frac = float(_conf_get(ctx,
+                               "tez.runtime.shuffle.fetch.buffer.percent",
+                               0.9))
+        spill_dir = _conf_get(ctx, "tez.runtime.tpu.host.spill.dir", "") or \
+            os.path.join(ctx.work_dirs[0], "spill")
+        codec = None
+        if _conf_get(ctx, "tez.runtime.compress", False):
+            codec = _conf_get(ctx, "tez.runtime.compress.codec", "zlib")
+        engine = _conf_get(ctx, "tez.runtime.sorter.class", "device")
+        factor = int(_conf_get(ctx, "tez.runtime.io.sort.factor", 64))
+
+        self._mm_budget = budget_mb << 20
+        self._mm_kwargs = dict(
+            key_width=self.key_width, engine=engine, merge_factor=factor,
+            merge_threshold=float(_conf_get(
+                ctx, "tez.runtime.shuffle.merge.percent", 0.9)),
+            max_single_fraction=float(_conf_get(
+                ctx, "tez.runtime.shuffle.memory.limit.percent", 0.25)),
+            key_normalizer=self._key_normalizer, codec=codec)
+        self._spill_dir = spill_dir
+
+        from tez_tpu.api.runtime import MemoryUpdateCallback
+
+        class _Granted(MemoryUpdateCallback):
+            def memory_assigned(cb_self, assigned_size: int) -> None:
+                if self._mm_budget <= 0:
+                    self._mm_budget = int(assigned_size)
+
+        ctx.request_initial_memory(int(frac * (sort_mb << 20)), _Granted(),
+                           component_type="SORTED_MERGED_INPUT")
+        self.merge_manager = None     # created in start(): grant lands first
+        self.table = ShuffleFetchTable(ctx, self.num_physical_inputs,
+                                       my_partition=ctx.task_index)
         return []
+
+    def start(self) -> None:
+        """Memory grants are delivered between initialize() and start():
+        build the merge manager with the final budget and attach it before
+        any event can deliver a fetch (events replay after start)."""
+        from tez_tpu.library.merge_manager import ShuffleMergeManager
+        self.merge_manager = ShuffleMergeManager(
+            self.context.counters, self._mm_budget, self._spill_dir,
+            **self._mm_kwargs)
+        self.table.merge_manager = self.merge_manager
 
     def handle_events(self, events: Sequence[TezAPIEvent]) -> None:
         for ev in events:
@@ -210,37 +285,33 @@ class OrderedGroupedKVInput(LogicalInput):
             else:
                 log.warning("OrderedGroupedKVInput: unexpected event %r", ev)
 
-    def _wait_and_merge(self) -> KVBatch:
-        if self._merged is None:
+    def _wait_and_merge(self) -> None:
+        if self._merged is None and self._stream_plan is None:
             import time
             t0 = time.time()
-            batches = self.table.wait_all()
+            self.table.wait_all()
             self.context.counters.find_counter(TaskCounter.SHUFFLE_PHASE_TIME)\
                 .increment(int((time.time() - t0) * 1000))
             t1 = time.time()
-            runs = [Run(b, np.array([0, b.num_records], dtype=np.int64))
-                    for b in batches if b.num_records > 0]
-            if runs:
-                engine = _conf_get(self.context, "tez.runtime.sorter.class",
-                                   "device")
-                factor = int(_conf_get(self.context,
-                                       "tez.runtime.io.sort.factor", 64))
-                merged = merge_sorted_runs(runs, 1, self.key_width,
-                                           counters=self.context.counters,
-                                           engine=engine,
-                                           merge_factor=factor,
-                                           key_normalizer=self._key_normalizer)
-                self._merged = merged.batch
+            result = self.merge_manager.finish()
+            if result.is_streaming:
+                # partition exceeds the memory budget: records stream from
+                # chunked disk runs with bounded resident memory
+                self._stream_plan = result.stream
             else:
-                self._merged = KVBatch.empty()
+                self._merged = result.batch
+                self.context.counters.increment(
+                    TaskCounter.REDUCE_INPUT_RECORDS,
+                    self._merged.num_records)
             self.context.counters.find_counter(TaskCounter.MERGE_PHASE_TIME)\
                 .increment(int((time.time() - t1) * 1000))
-            self.context.counters.increment(
-                TaskCounter.REDUCE_INPUT_RECORDS, self._merged.num_records)
-        return self._merged
 
-    def get_reader(self) -> "GroupedKVReader":
-        batch = self._wait_and_merge()
+    def get_reader(self):
+        self._wait_and_merge()
+        if self._stream_plan is not None:
+            return StreamingGroupedKVReader(self._stream_plan, self.key_serde,
+                                            self.val_serde, self.context)
+        batch = self._merged
         if self._group_starts is None:
             # one normalization pass for group detection, cached so repeat
             # readers are free (the merge normalized pre-sort; deriving its
@@ -254,6 +325,9 @@ class OrderedGroupedKVInput(LogicalInput):
     def close(self) -> List[TezAPIEvent]:
         self._merged = None
         self._group_starts = None
+        self._stream_plan = None
+        if self.merge_manager is not None:
+            self.merge_manager.cleanup()
         return []
 
 
@@ -307,6 +381,46 @@ class GroupedKVReader(KeyValuesReader):
             yield key, values
         self.context.counters.increment(TaskCounter.REDUCE_INPUT_GROUPS,
                                         groups)
+
+
+class StreamingGroupedKVReader(KeyValuesReader):
+    """Grouped reader over a streaming merge plan (bounded memory): records
+    arrive sorted from the disk-run heap merge; adjacent equal SORT keys
+    (normalized form when a comparator is configured) form one group.
+    Re-iterable — each iteration re-reads the chunked disk runs."""
+
+    def __init__(self, plan: Any, key_serde: Serde, val_serde: Serde,
+                 context: Any):
+        self.plan = plan
+        self.key_serde = key_serde
+        self.val_serde = val_serde
+        self.context = context
+
+    def __iter__(self) -> Iterator[Tuple[Any, Iterator[Any]]]:
+        import itertools
+        counters = self.context.counters
+        groups = 0
+        records = 0
+
+        for _, group in itertools.groupby(self.plan.iter_records(),
+                                          key=lambda r: r[0]):
+            first = next(group)
+            key = self.key_serde.from_bytes(first[1])
+
+            def _values(first=first, group=group):
+                nonlocal records
+                records += 1
+                yield self.val_serde.from_bytes(first[2])
+                for rec in group:
+                    records += 1
+                    yield self.val_serde.from_bytes(rec[2])
+
+            groups += 1
+            if (groups & 0x3FF) == 0:
+                self.context.notify_progress()
+            yield key, _values()
+        counters.increment(TaskCounter.REDUCE_INPUT_GROUPS, groups)
+        counters.increment(TaskCounter.REDUCE_INPUT_RECORDS, records)
 
 
 class UnorderedKVReaderAdapter(KeyValueReader):

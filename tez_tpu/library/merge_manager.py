@@ -1,0 +1,412 @@
+"""Consumer-side bounded-memory shuffle merge (the MergeManager analog).
+
+Reference parity: tez-runtime-library/.../common/shuffle/orderedgrouped/
+MergeManager.java:83 — `reserve()` admission with stall (:404), the
+commitMemory >= mergeThreshold mem->disk merge trigger (:387), the on-disk
+merge cascade, and a final merge over leftover memory + disk segments —
+re-thought for this framework's vectorized data plane:
+
+- Fetched batches are already partition-sorted runs (the producer ships
+  sorted slices), so a "mem->disk merge" is one vectorized k-way merge of
+  the committed batches written out as a block-chunked sorted file
+  (ops.runformat.ChunkedRunWriter), and the DISK admission target just
+  streams the oversized batch to its own chunked file — no record-at-a-time
+  byte crunching anywhere.
+- The final merge is vectorized + in-RAM when everything fits the budget
+  (the common case, byte-for-byte the old fast path), and otherwise a
+  streaming heap-merge over block-buffered disk runs whose resident set is
+  one block per run — a partition far larger than host RAM reduces with
+  peak memory ~ budget + num_runs * block_bytes.
+
+Equal keys across different source runs emerge in run-arrival order (the
+reference's MergeQueue makes the same arrival-dependent choice; within one
+source the producer's sorted order is preserved exactly).
+"""
+from __future__ import annotations
+
+import heapq
+import logging
+import os
+import threading
+import uuid
+from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tez_tpu.common.counters import TaskCounter, TezCounters
+from tez_tpu.ops.runformat import (ChunkedRunWriter, KVBatch, Run,
+                                   iter_chunked_run)
+from tez_tpu.ops.sorter import merge_sorted_runs, normalize_batch_keys
+
+log = logging.getLogger(__name__)
+
+
+def _as_run(batch: KVBatch) -> Run:
+    return Run(batch, np.array([0, batch.num_records], dtype=np.int64))
+
+
+class ShuffleMergeManager:
+    """Admission + background mem->disk merging for one consumer input.
+
+    Thread model: fetch threads call `commit()` (which may stall on the
+    memory budget); one background merger thread frees memory by merging
+    committed batches to disk; `finish()` joins the merger and hands back
+    either a fully-merged in-RAM batch or a streaming plan.
+    """
+
+    def __init__(self, counters: TezCounters, budget_bytes: int,
+                 spill_dir: str,
+                 key_width: int = 16,
+                 engine: str = "device",
+                 merge_factor: int = 64,
+                 merge_threshold: float = 0.9,
+                 max_single_fraction: float = 0.25,
+                 key_normalizer: Optional[Callable[[bytes], bytes]] = None,
+                 codec: Optional[str] = None,
+                 block_records: int = 65536):
+        self.counters = counters
+        self.budget = int(budget_bytes)
+        self.spill_dir = spill_dir
+        self.key_width = key_width
+        self.engine = engine
+        self.merge_factor = max(2, merge_factor)
+        self.merge_threshold = merge_threshold
+        self.max_single = int(self.budget * max_single_fraction) \
+            if self.budget > 0 else 0
+        self.key_normalizer = key_normalizer
+        self.codec = codec
+        self.block_records = block_records
+
+        self.lock = threading.Condition()
+        # committed in-memory batches: (slot, seq, batch) — slot-major
+        # order keeps the no-spill final merge byte-identical to the
+        # historical slot-ordered merge; seq is global arrival order
+        self._mem: List[Tuple[int, int, KVBatch]] = []
+        self._mem_bytes = 0
+        self._seq = 0
+        self._disk_runs: List[str] = []          # chunked run paths, by age
+        self._disk_slots: set = set()            # slots with data on disk
+        self._merging: List[Tuple[int, int, KVBatch]] = []  # claimed by merger
+        self._stalled = 0                        # fetchers waiting in commit
+        self._slot_gen: dict = {}                # slot -> reset generation
+        self._mem_to_disk = 0
+        self._disk_to_disk = 0
+        self.peak_mem_bytes = 0
+        self._poisoned: Optional[str] = None
+        self._closed = False
+        self._error: Optional[BaseException] = None
+        self._merger: Optional[threading.Thread] = None
+        if self.budget > 0:
+            self._merger = threading.Thread(target=self._merge_loop,
+                                            daemon=True,
+                                            name="shuffle-merger")
+            self._merger.start()
+
+    # ------------------------------------------------------------- admission
+    def slot_generation(self, slot: int) -> int:
+        """Current reset-generation of a slot.  Fetchers capture this BEFORE
+        fetching and pass it to commit(): a commit whose generation is stale
+        (the slot reset mid-fetch) is dropped instead of stored, so a new
+        producer attempt's data can never be discarded by the old attempt's
+        late-arriving fetch."""
+        with self.lock:
+            return self._slot_gen.get(slot, 0)
+
+    def commit(self, slot: int, batch: KVBatch, generation: int = 0) -> bool:
+        """Account a fetched (sorted) batch.  MEM target when it fits the
+        budget — stalling while the merger frees memory (reserve():404
+        semantics) — DISK target for oversized batches (maxSingleShuffleLimit
+        analog): streamed straight to its own chunked run.  Returns False if
+        the batch was dropped as stale (slot reset since `generation`)."""
+        if self.budget <= 0:
+            with self.lock:
+                if self._slot_gen.get(slot, 0) != generation:
+                    return False
+                self._mem.append((slot, self._seq, batch))
+                self._seq += 1
+                self._mem_bytes += batch.nbytes
+                self.peak_mem_bytes = max(self.peak_mem_bytes, self._mem_bytes)
+            self.counters.increment(TaskCounter.SHUFFLE_BYTES_TO_MEM,
+                                    batch.nbytes)
+            return True
+        if batch.nbytes > self.max_single:
+            path = self._write_chunked([_as_run(batch)])
+            with self.lock:
+                if self._slot_gen.get(slot, 0) != generation:
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+                    return False
+                self._disk_runs.append(path)
+                self._disk_slots.add(slot)
+            self.counters.increment(TaskCounter.SHUFFLE_BYTES_TO_DISK,
+                                    batch.nbytes)
+            return True
+        with self.lock:
+            while self._mem_bytes + batch.nbytes > self.budget and \
+                    self._error is None and self._poisoned is None:
+                if not self._mem and not self._merging:
+                    # nothing the merger could free: the batch itself is
+                    # what's over budget (many stalled fetchers, tiny
+                    # budget).  Fall through and admit anyway — peak memory
+                    # then exceeds the budget by at most one sub-max_single
+                    # batch, which beats deadlocking the fetch forever.
+                    break
+                self._stalled += 1           # merger merges on our behalf
+                self.lock.notify_all()
+                try:
+                    self.lock.wait(0.1)
+                finally:
+                    self._stalled -= 1
+            self._raise_if_broken()
+            if self._slot_gen.get(slot, 0) != generation:
+                return False
+            self._mem.append((slot, self._seq, batch))
+            self._seq += 1
+            self._mem_bytes += batch.nbytes
+            self.peak_mem_bytes = max(self.peak_mem_bytes, self._mem_bytes)
+            if self._mem_bytes >= self.budget * self.merge_threshold:
+                self.lock.notify_all()
+        self.counters.increment(TaskCounter.SHUFFLE_BYTES_TO_MEM, batch.nbytes)
+        return True
+
+    def on_slot_reset(self, slot: int) -> List[KVBatch]:
+        """A producer is re-running.  The slot's generation bumps (so
+        in-flight fetches of the old attempt drop at commit), its in-memory
+        batches are discarded (and returned for accounting); if the slot's
+        data already merged to disk — or is mid-merge right now — the state
+        is unrecoverable in place: poison, so the consumer attempt fails
+        loudly and re-runs with fresh fetches (the reference's
+        too-many-failures consumer-kill escape hatch)."""
+        with self.lock:
+            self._slot_gen[slot] = self._slot_gen.get(slot, 0) + 1
+            if slot in self._disk_slots or \
+                    any(s == slot for s, _, _ in self._merging):
+                self._poisoned = (
+                    f"slot {slot} re-ran after its data merged to disk; "
+                    f"consumer must re-fetch from scratch")
+                self.lock.notify_all()
+                return []
+            dropped = [b for s, _, b in self._mem if s == slot]
+            self._mem = [(s, q, b) for s, q, b in self._mem if s != slot]
+            self._mem_bytes -= sum(b.nbytes for b in dropped)
+            self.lock.notify_all()
+            return dropped
+
+    def _raise_if_broken(self) -> None:
+        if self._error is not None:
+            raise RuntimeError("shuffle merger failed") from self._error
+        if self._poisoned is not None:
+            raise RuntimeError(f"shuffle merge state lost: {self._poisoned}")
+
+    # ------------------------------------------------------- background merge
+    def _mem_merge_due(self) -> bool:
+        """Under lock: committed memory crossed the merge threshold, OR a
+        fetcher is stalled on admission and there is anything at all to
+        free (without the second clause a batch that doesn't fit the
+        remaining budget while memory sits below the threshold would stall
+        its fetcher forever)."""
+        if not self._mem:
+            return False
+        return self._mem_bytes >= self.budget * self.merge_threshold or \
+            self._stalled > 0
+
+    def _merge_loop(self) -> None:
+        while True:
+            with self.lock:
+                while not self._closed and self._poisoned is None and \
+                        not self._mem_merge_due() and \
+                        len(self._disk_runs) < self.merge_factor:
+                    self.lock.wait(0.2)
+                if self._closed or self._poisoned is not None:
+                    return
+                work = None
+                if self._mem_merge_due():
+                    # CLAIM the batches: they leave _mem (so a concurrent
+                    # slot reset can't silently mutate the working set) but
+                    # stay accounted in _mem_bytes until the write lands
+                    work = ("mem", list(self._mem))
+                    self._merging = list(self._mem)
+                    self._mem = []
+                elif len(self._disk_runs) >= self.merge_factor:
+                    work = ("disk", self._disk_runs[:self.merge_factor])
+            try:
+                if work[0] == "mem":
+                    self._do_mem_to_disk(work[1])
+                else:
+                    self._do_disk_to_disk(work[1])
+            except BaseException as e:  # noqa: BLE001 — surface to callers
+                with self.lock:
+                    self._error = e
+                    self.lock.notify_all()
+                return
+
+    def _do_mem_to_disk(self, items: List[Tuple[int, int, KVBatch]]) -> None:
+        items = sorted(items)               # slot-major, then arrival
+        runs = [_as_run(b) for _, _, b in items if b.num_records > 0]
+        merged = merge_sorted_runs(runs, 1, self.key_width,
+                                   engine=self.engine,
+                                   merge_factor=self.merge_factor,
+                                   key_normalizer=self.key_normalizer) \
+            if runs else _as_run(KVBatch.empty())
+        path = self._write_chunked([merged])
+        freed = sum(b.nbytes for _, _, b in items)
+        with self.lock:
+            self._merging = []
+            if self._poisoned is not None:
+                # a claimed slot reset mid-merge: the written file contains
+                # stale data — discard it; the consumer attempt re-runs
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                self.lock.notify_all()
+                return
+            self._disk_slots.update(s for s, _, _ in items)
+            self._mem_bytes -= freed
+            self._disk_runs.append(path)
+            self._mem_to_disk += 1
+            self.lock.notify_all()
+        self.counters.increment(TaskCounter.NUM_MEM_TO_DISK_MERGES)
+
+    def _do_disk_to_disk(self, paths: List[str]) -> None:
+        out = self._stream_merge_to_disk(paths)
+        with self.lock:
+            # replace the merged inputs with the result, keeping age order
+            i = self._disk_runs.index(paths[0])
+            self._disk_runs = [p for p in self._disk_runs if p not in paths]
+            self._disk_runs.insert(i, out)
+            self._disk_to_disk += 1
+            self.lock.notify_all()
+        for p in paths:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        self.counters.increment(TaskCounter.NUM_DISK_TO_DISK_MERGES)
+
+    # ------------------------------------------------------------ disk I/O
+    def _write_chunked(self, runs: Sequence[Run]) -> str:
+        path = os.path.join(self.spill_dir,
+                            f"mmerge_{uuid.uuid4().hex}.crun")
+        w = ChunkedRunWriter(path, codec=self.codec,
+                             block_records=self.block_records)
+        for r in runs:
+            w.append(r.batch)
+        w.close()
+        self.counters.increment(TaskCounter.ADDITIONAL_SPILLS_BYTES_WRITTEN,
+                                w.bytes_written)
+        return path
+
+    def _record_iter(self, source) -> Iterator[Tuple[bytes, bytes, bytes]]:
+        """(sort_key, key, value) stream from a chunked run path or KVBatch;
+        resident memory is one block at a time for paths."""
+        blocks = iter_chunked_run(source) if isinstance(source, str) \
+            else iter([source])
+        norm = self.key_normalizer
+        for batch in blocks:
+            if norm is not None:
+                nb, no = normalize_batch_keys(batch, norm)
+                for i in range(batch.num_records):
+                    yield (nb[no[i]:no[i + 1]].tobytes(), batch.key(i),
+                           batch.value(i))
+            else:
+                for i in range(batch.num_records):
+                    k = batch.key(i)
+                    yield (k, k, batch.value(i))
+
+    def _stream_merge_to_disk(self, paths: List[str]) -> str:
+        out_path = os.path.join(self.spill_dir,
+                                f"mmerge_{uuid.uuid4().hex}.crun")
+        w = ChunkedRunWriter(out_path, codec=self.codec,
+                             block_records=self.block_records)
+        keys: List[bytes] = []
+        vals: List[bytes] = []
+        for _, k, v in heapq.merge(*[self._record_iter(p) for p in paths],
+                                   key=lambda r: r[0]):
+            keys.append(k)
+            vals.append(v)
+            if len(keys) >= self.block_records:
+                w.append(KVBatch.from_pairs(list(zip(keys, vals))))
+                keys, vals = [], []
+        if keys:
+            w.append(KVBatch.from_pairs(list(zip(keys, vals))))
+        w.close()
+        self.counters.increment(TaskCounter.ADDITIONAL_SPILLS_BYTES_WRITTEN,
+                                w.bytes_written)
+        return out_path
+
+    # ------------------------------------------------------------- finish
+    def finish(self) -> "MergedResult":
+        """Join the merger; decide in-RAM vs streaming final merge."""
+        with self.lock:
+            self._closed = True
+            self.lock.notify_all()
+        if self._merger is not None:
+            self._merger.join(timeout=300)
+        with self.lock:
+            self._raise_if_broken()
+            mem = sorted(self._mem)
+            disk = list(self._disk_runs)
+        if not disk:
+            runs = [_as_run(b) for _, _, b in mem if b.num_records > 0]
+            if not runs:
+                return MergedResult(batch=KVBatch.empty())
+            merged = runs[0] if len(runs) == 1 else merge_sorted_runs(
+                runs, 1, self.key_width, counters=self.counters,
+                engine=self.engine, merge_factor=self.merge_factor,
+                key_normalizer=self.key_normalizer)
+            return MergedResult(batch=merged.batch)
+        # leftover memory becomes one more (bounded) sorted segment
+        mem_runs = [_as_run(b) for _, _, b in mem if b.num_records > 0]
+        mem_seg: Optional[KVBatch] = None
+        if mem_runs:
+            mem_seg = merge_sorted_runs(
+                mem_runs, 1, self.key_width, counters=self.counters,
+                engine=self.engine, merge_factor=self.merge_factor,
+                key_normalizer=self.key_normalizer).batch
+        return MergedResult(stream=_StreamPlan(self, disk, mem_seg))
+
+    def cleanup(self) -> None:
+        with self.lock:
+            self._closed = True
+            self.lock.notify_all()
+            paths = list(self._disk_runs)
+            self._disk_runs = []
+        for p in paths:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+
+class _StreamPlan:
+    """Re-iterable streaming merge over disk runs + the leftover mem segment
+    (disk blocks re-read on every iteration; memory stays bounded)."""
+
+    def __init__(self, mm: ShuffleMergeManager, disk: List[str],
+                 mem_seg: Optional[KVBatch]):
+        self.mm = mm
+        self.disk = disk
+        self.mem_seg = mem_seg
+
+    def iter_records(self) -> Iterator[Tuple[bytes, bytes, bytes]]:
+        sources: List[Any] = list(self.disk)
+        if self.mem_seg is not None:
+            sources.append(self.mem_seg)
+        return heapq.merge(*[self.mm._record_iter(s) for s in sources],
+                           key=lambda r: r[0])
+
+
+class MergedResult:
+    """Either a fully-merged in-RAM batch or a streaming merge plan."""
+
+    def __init__(self, batch: Optional[KVBatch] = None,
+                 stream: Optional[_StreamPlan] = None):
+        self.batch = batch
+        self.stream = stream
+
+    @property
+    def is_streaming(self) -> bool:
+        return self.stream is not None

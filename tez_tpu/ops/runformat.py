@@ -236,3 +236,56 @@ class Run:
         row_index = np.zeros(num_partitions + 1, dtype=np.int64)
         np.cumsum(counts, out=row_index[1:])
         return Run(batch, row_index)
+
+
+class ChunkedRunWriter:
+    """Append-only on-disk run of globally-sorted record blocks.
+
+    The consumer-side spill format (MergeManager mem->disk merge target,
+    reference MergeManager.java:387 InMemoryMerger writing an IFile): a
+    sequence of length-prefixed single-partition Run blobs, each internally
+    sorted and globally ordered across blocks, so a reader can stream the
+    run block-at-a-time with bounded memory.
+    """
+
+    def __init__(self, path: str, codec: Optional[str] = None,
+                 block_records: int = 65536):
+        self.path = path
+        self.codec = codec
+        self.block_records = block_records
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = open(path + ".tmp", "wb")
+        self.blocks = 0
+        self.records = 0
+        self.bytes_written = 0
+
+    def append(self, batch: KVBatch) -> None:
+        """Append a sorted batch, splitting into bounded blocks."""
+        for s in range(0, batch.num_records, self.block_records):
+            piece = batch.slice_rows(s, min(s + self.block_records,
+                                            batch.num_records))
+            blob = Run(piece,
+                       np.array([0, piece.num_records], dtype=np.int64)
+                       ).to_bytes(self.codec)
+            self._fh.write(struct.pack("<Q", len(blob)))
+            self._fh.write(blob)
+            self.blocks += 1
+            self.records += piece.num_records
+            self.bytes_written += len(blob) + 8
+
+    def close(self) -> str:
+        self._fh.close()
+        os.replace(self.path + ".tmp", self.path)
+        return self.path
+
+
+def iter_chunked_run(path: str):
+    """Stream the sorted blocks of a ChunkedRunWriter file (bounded memory:
+    one block resident at a time)."""
+    with open(path, "rb") as fh:
+        while True:
+            raw = fh.read(8)
+            if len(raw) < 8:
+                return
+            (n,) = struct.unpack("<Q", raw)
+            yield Run.from_bytes(fh.read(n), where=path).batch

@@ -16,49 +16,104 @@ def mesh8():
     return make_mesh(8)
 
 
-def test_distributed_shuffle_matches_host_golden(mesh8):
-    W, N, L, CAP = 8, 64, 2, 64 * 8
-    rng = np.random.default_rng(0)
+def _inputs(W, N, L, V, seed=0, valid_frac=0.9, key_max_len=None):
+    rng = np.random.default_rng(seed)
     lanes = rng.integers(0, 1 << 20, (W * N, L)).astype(np.uint32)
-    values = np.arange(W * N, dtype=np.uint32)
-    valid = rng.random(W * N) < 0.9
+    key_max = key_max_len if key_max_len is not None else L * 4
+    lengths = rng.integers(1, key_max + 1, W * N).astype(np.uint32)
+    # zero the bytes beyond each key's length so lanes are canonical
+    for i in range(L * 4):
+        word, shift = divmod(i, 4)
+        mask = ~(np.uint32(0xFF) << np.uint32(24 - 8 * (i % 4)))
+        dead = lengths <= i
+        lanes[dead, word] &= mask
+    values = rng.integers(0, 1 << 30, (W * N, V)).astype(np.uint32)
+    valid = rng.random(W * N) < valid_frac
+    return lanes, lengths, values, valid
 
-    fn = build_distributed_shuffle(mesh8, L, N, CAP)
-    out_lanes, out_vals, out_valid, dropped = jax.device_get(
-        fn(lanes, values, valid.astype(bool)))
-    assert int(dropped.sum()) == 0
 
-    golden = distributed_shuffle_reference(lanes, values, valid, W)
+def _got(out_lanes, out_lens, out_vals, out_valid, W):
     per = out_lanes.shape[0] // W
-    for w in range(8):
-        ol = out_lanes[w * per:(w + 1) * per]
-        ov = out_vals[w * per:(w + 1) * per]
-        om = out_valid[w * per:(w + 1) * per]
-        got = [(tuple(ol[i].tolist()), int(ov[i]))
-               for i in range(per) if om[i]]
-        assert got == golden[w], f"worker {w}"
+    out = []
+    for w in range(W):
+        sl = slice(w * per, (w + 1) * per)
+        ol, oln, ov, om = out_lanes[sl], out_lens[sl], out_vals[sl], \
+            out_valid[sl]
+        out.append([(tuple(ol[i].tolist()), int(oln[i]),
+                     tuple(np.atleast_1d(ov[i]).tolist()))
+                    for i in range(per) if om[i]])
+    return out
+
+
+def test_distributed_shuffle_matches_host_golden(mesh8):
+    W, N, L, V, CAP = 8, 64, 2, 3, 64 * 8
+    lanes, lengths, values, valid = _inputs(W, N, L, V)
+    fn = build_distributed_shuffle(mesh8, L, N, CAP, value_words=V)
+    out_lanes, out_lens, out_vals, out_valid, dropped = jax.device_get(
+        fn(lanes, lengths, values, valid.astype(bool)))
+    assert int(dropped.sum()) == 0
+    golden = distributed_shuffle_reference(lanes, lengths, values, valid, W)
+    got = _got(out_lanes, out_lens, out_vals, out_valid, W)
+    for w in range(W):
+        assert got[w] == golden[w], f"worker {w}"
+
+
+def test_short_key_sorts_before_zero_padded_longer_key(mesh8):
+    """Exactness of the length tie-break: key b"ad" must order before
+    b"ad\\x00" even though their zero-padded lanes are identical.  The pair
+    is chosen so BOTH keys hash to the same worker (FNV(b"ad") % 8 ==
+    FNV(b"ad\\x00") % 8 == 0) — the tie-break is exercised by one worker's
+    merge sort, not masked by worker routing."""
+    from tez_tpu.parallel.exchange import fnv_bytes_host
+    assert fnv_bytes_host(b"ad") % 8 == fnv_bytes_host(b"ad\x00") % 8
+
+    W, N, L = 8, 8, 1
+    fn = build_distributed_shuffle(mesh8, L, N, N * W, value_words=1)
+    ad = int.from_bytes(b"ad\x00\x00", "big")
+    lanes = np.zeros((W * N, L), np.uint32)
+    lengths = np.zeros(W * N, np.uint32)
+    values = np.zeros((W * N, 1), np.uint32)
+    valid = np.zeros(W * N, bool)
+    # two rows: same lanes, lengths 3 and 2 (deliberately reversed order)
+    lanes[0, 0] = ad
+    lengths[0] = 3
+    values[0, 0] = 333
+    lanes[1, 0] = ad
+    lengths[1] = 2
+    values[1, 0] = 222
+    valid[:2] = True
+    out_lanes, out_lens, out_vals, out_valid, dropped = jax.device_get(
+        fn(lanes, lengths, values, valid))
+    assert int(dropped.sum()) == 0
+    rows = [(int(out_lens[i]), int(out_vals[i, 0]))
+            for i in range(len(out_valid)) if out_valid[i]]
+    assert rows == [(2, 222), (3, 333)]
 
 
 def test_distributed_shuffle_all_invalid(mesh8):
-    W, N, L, CAP = 8, 16, 2, 16
-    fn = build_distributed_shuffle(mesh8, L, N, CAP)
+    W, N, L = 8, 16, 2
+    fn = build_distributed_shuffle(mesh8, L, N, 16, value_words=1)
     lanes = np.zeros((W * N, L), dtype=np.uint32)
-    values = np.zeros(W * N, dtype=np.uint32)
+    lengths = np.zeros(W * N, dtype=np.uint32)
+    values = np.zeros((W * N, 1), dtype=np.uint32)
     valid = np.zeros(W * N, dtype=bool)
-    _, _, out_valid, dropped = jax.device_get(fn(lanes, values, valid))
+    _, _, _, out_valid, dropped = jax.device_get(
+        fn(lanes, lengths, values, valid))
     assert not out_valid.any()
     assert int(dropped.sum()) == 0
 
 
 def test_distributed_shuffle_overflow_is_reported(mesh8):
     """Rows beyond the per-pair capacity must be counted, never silently
-    lost (the skew-handling layer re-runs with a bigger cap)."""
+    lost (the coordinator sizes CAP exactly; this guards the kernel)."""
     W, N, L, CAP = 8, 16, 2, 4
-    fn = build_distributed_shuffle(mesh8, L, N, CAP)
+    fn = build_distributed_shuffle(mesh8, L, N, CAP, value_words=1)
     lanes = np.zeros((W * N, L), dtype=np.uint32)   # all hash to one worker
-    values = np.arange(W * N, dtype=np.uint32)
+    lengths = np.full(W * N, 4, dtype=np.uint32)
+    values = np.arange(W * N, dtype=np.uint32).reshape(-1, 1)
     valid = np.ones(W * N, dtype=bool)
-    _, _, out_valid, dropped = jax.device_get(fn(lanes, values, valid))
+    _, _, _, out_valid, dropped = jax.device_get(
+        fn(lanes, lengths, values, valid))
     assert int(out_valid.sum()) + int(dropped.sum()) == W * N
     assert int(dropped.sum()) > 0
 
@@ -66,24 +121,20 @@ def test_distributed_shuffle_overflow_is_reported(mesh8):
 def test_ragged_exchange_matches_golden_or_skips(mesh8):
     """The ragged (zero-padding-on-wire) exchange; XLA:CPU lacks the
     ragged-all-to-all thunk, so this compiles+runs only on TPU."""
-    W, N, L = 8, 32, 2
-    fn = build_distributed_shuffle(mesh8, L, N, N, ragged=True)
-    rng = np.random.default_rng(3)
-    lanes = rng.integers(0, 1 << 18, (W * N, L)).astype(np.uint32)
-    values = np.arange(W * N, dtype=np.uint32)
-    valid = np.ones(W * N, dtype=bool)
+    W, N, L, V = 8, 32, 2, 2
+    fn = build_distributed_shuffle(mesh8, L, N, N, value_words=V,
+                                   ragged=True)
+    lanes, lengths, values, valid = _inputs(W, N, L, V, seed=3,
+                                            valid_frac=1.0)
     try:
-        out_lanes, out_vals, out_valid, dropped = jax.device_get(
-            fn(lanes, values, valid))
+        out_lanes, out_lens, out_vals, out_valid, dropped = jax.device_get(
+            fn(lanes, lengths, values, valid))
     except Exception as e:  # noqa: BLE001
         if "UNIMPLEMENTED" in str(e) or isinstance(e, NotImplementedError):
             pytest.skip(f"backend lacks ragged-all-to-all: {type(e).__name__}")
         raise
     assert int(dropped.sum()) == 0
-    golden = distributed_shuffle_reference(lanes, values, valid, W)
-    per = out_lanes.shape[0] // W
+    golden = distributed_shuffle_reference(lanes, lengths, values, valid, W)
+    got = _got(out_lanes, out_lens, out_vals, out_valid, W)
     for w in range(W):
-        got = sorted((tuple(out_lanes[w * per + i].tolist()),
-                      int(out_vals[w * per + i]))
-                     for i in range(per) if out_valid[w * per + i])
-        assert got == sorted(golden[w]), f"worker {w}"
+        assert sorted(got[w]) == sorted(golden[w]), f"worker {w}"

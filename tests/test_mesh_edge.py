@@ -1,0 +1,150 @@
+"""The ICI exchange as a real DAG edge: SCATTER_GATHER through
+parallel/exchange.py inside framework execution (VERDICT round-1 item 2).
+
+OrderedWordCount runs with its tokenizer->summation edge on the mesh
+(MeshOrderedPartitionedKVEdgeConfig) over the virtual 8-device CPU mesh and
+must produce byte-identical output to the host-shuffle run."""
+import collections
+import os
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from tez_tpu.ops.runformat import KVBatch
+from tez_tpu.parallel.coordinator import (MeshCapacityError,
+                                          MeshExchangeCoordinator,
+                                          mesh_coordinator,
+                                          reset_coordinator)
+
+
+@pytest.fixture(autouse=True)
+def fresh_coordinator():
+    reset_coordinator()
+    yield
+    reset_coordinator()
+
+
+def make_batch(pairs):
+    return KVBatch.from_pairs([(k.encode(), v.encode()) for k, v in pairs])
+
+
+def reference_route(pairs, num_workers):
+    from tez_tpu.parallel.exchange import fnv_bytes_host
+    out = [[] for _ in range(num_workers)]
+    for k, v in pairs:
+        out[fnv_bytes_host(k.encode()) % num_workers].append(
+            (k.encode(), v.encode()))
+    for part in out:
+        part.sort(key=lambda kv: kv[0])
+    return out
+
+
+def test_coordinator_exchange_matches_host_routing():
+    coord = MeshExchangeCoordinator()
+    rng = random.Random(5)
+    pairs = [(f"key{rng.randrange(500):05d}", f"val{i:06d}")
+             for i in range(3000)]
+    thirds = [pairs[0::3], pairs[1::3], pairs[2::3]]
+    for idx, chunk in enumerate(thirds):
+        coord.register_producer("e1", idx, 3, 4, make_batch(chunk),
+                                key_width=16, value_width=12)
+    golden = reference_route(pairs, 4)
+    for w in range(4):
+        got = coord.wait_consumer("e1", w, 3, 4, timeout=30)
+        got_pairs = list(got.iter_pairs())
+        assert [k for k, _ in got_pairs] == [k for k, _ in golden[w]]
+        # every (k, v) multiset must survive exactly
+        assert sorted(got_pairs) == sorted(golden[w])
+    assert coord.exchanges_run == 1
+    assert coord.rows_exchanged == 3000
+
+
+def test_coordinator_multi_round_on_skew():
+    """A hot key bigger than the per-round budget forces a multi-round
+    exchange; output must still be complete and sorted."""
+    coord = MeshExchangeCoordinator(max_rows_per_round=256)
+    hot = [("hotkey", f"v{i:07d}") for i in range(900)]
+    cold = [(f"cold{i:04d}", "x") for i in range(300)]
+    coord.register_producer("e2", 0, 2, 3, make_batch(hot),
+                            key_width=12, value_width=8)
+    coord.register_producer("e2", 1, 2, 3, make_batch(cold),
+                            key_width=12, value_width=8)
+    golden = reference_route(hot + cold, 3)
+    total_got = 0
+    for w in range(3):
+        got = list(coord.wait_consumer("e2", w, 2, 3, timeout=60).iter_pairs())
+        total_got += len(got)
+        assert [k for k, _ in got] == [k for k, _ in golden[w]]
+        assert sorted(got) == sorted(golden[w])
+    assert total_got == 1200
+    assert coord.exchanges_run == 1
+
+
+def test_oversized_key_rejected_loudly():
+    coord = MeshExchangeCoordinator()
+    with pytest.raises(MeshCapacityError, match="key.width"):
+        coord.register_producer(
+            "e3", 0, 1, 2, make_batch([("x" * 99, "v")]),
+            key_width=16, value_width=8)
+
+
+def test_mesh_edge_wordcount_byte_identical(tmp_path):
+    """The flagship: OrderedWordCount through the mesh exchange inside a
+    real DAG, byte-identical to the host-shuffle run."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple virtual devices")
+    from tez_tpu.examples import ordered_wordcount
+
+    rng = random.Random(17)
+    words = [f"word{rng.randrange(400):04d}" for _ in range(30_000)]
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text(" ".join(words))
+    golden = collections.Counter(words)
+
+    outs = {}
+    for exchange in ("host", "mesh"):
+        out_dir = str(tmp_path / f"out_{exchange}")
+        state = ordered_wordcount.run(
+            [str(corpus)], out_dir,
+            conf={"tez.staging-dir": str(tmp_path / f"stg_{exchange}")},
+            tokenizer_parallelism=3, summation_parallelism=2,
+            sorter_parallelism=1, exchange=exchange)
+        assert state == "SUCCEEDED", exchange
+        lines = []
+        for name in sorted(os.listdir(out_dir)):
+            with open(os.path.join(out_dir, name)) as fh:
+                lines.extend(fh.read().splitlines())
+        counts = dict(line.rsplit(None, 1) for line in lines if line.strip())
+        assert {k: int(v) for k, v in counts.items()} == dict(golden), \
+            exchange
+        outs[exchange] = lines
+    assert outs["host"] == outs["mesh"]
+    assert mesh_coordinator().exchanges_run >= 1
+
+
+def test_producer_reregistration_reruns_exchange():
+    """A producer re-running after the exchange (output loss recovery) must
+    invalidate and re-run the exchange with the replacement span — not
+    permanently fail the edge."""
+    coord = MeshExchangeCoordinator()
+    a = make_batch([("k1", "old")])
+    b = make_batch([("k2", "vb")])
+    coord.register_producer("er", 0, 2, 2, a, key_width=8, value_width=8)
+    coord.register_producer("er", 1, 2, 2, b, key_width=8, value_width=8)
+    first = {w: list(coord.wait_consumer("er", w, 2, 2,
+                                         timeout=30).iter_pairs())
+             for w in range(2)}
+    assert sorted(sum(first.values(), [])) == \
+        sorted([(b"k1", b"old"), (b"k2", b"vb")])
+    # producer 0 re-runs with different data
+    coord.register_producer("er", 0, 2, 2, make_batch([("k1", "new")]),
+                            key_width=8, value_width=8)
+    second = {w: list(coord.wait_consumer("er", w, 2, 2,
+                                          timeout=30).iter_pairs())
+              for w in range(2)}
+    assert sorted(sum(second.values(), [])) == \
+        sorted([(b"k1", b"new"), (b"k2", b"vb")])
+    assert coord.exchanges_run == 2

@@ -250,6 +250,10 @@ class DAGAppMaster:
         n = local_shuffle_service().unregister_prefix(str(dag.dag_id))
         if n:
             log.info("dag %s: released %d shuffle outputs", dag.dag_id, n)
+        from tez_tpu.parallel.coordinator import mesh_coordinator
+        m = mesh_coordinator().cleanup_dag(str(dag.dag_id))
+        if m:
+            log.info("dag %s: released %d mesh exchange edges", dag.dag_id, m)
         speculator = getattr(dag, "speculator", None)
         if speculator is not None:
             speculator.stop()

@@ -61,7 +61,11 @@ class NoOpSorterProcessor(SimpleProcessor):
 
 def build_dag(input_paths, output_path: str, tokenizer_parallelism: int = -1,
               summation_parallelism: int = 2, sorter_parallelism: int = 1,
-              combine: bool = True, pipelined: bool = False) -> DAG:
+              combine: bool = True, pipelined: bool = False,
+              exchange: str = "host") -> DAG:
+    """exchange="mesh" moves the tokenizer->summation shuffle onto the ICI
+    mesh exchange (one SPMD all-to-all program instead of spill+fetch;
+    needs one device per summation task)."""
     tokenizer = Vertex.create("tokenizer", ProcessorDescriptor.create(
         TokenProcessor), tokenizer_parallelism)
     tokenizer.add_data_source("input", DataSourceDescriptor.create(
@@ -84,12 +88,20 @@ def build_dag(input_paths, output_path: str, tokenizer_parallelism: int = -1,
             "tez_tpu.io.file_output:FileOutputCommitter",
             payload={"path": output_path})))
 
-    e1_builder = OrderedPartitionedKVEdgeConfig.new_builder("bytes", "long")
-    if combine:
-        e1_builder.set_combiner("sum_long")
-    if pipelined:
-        e1_builder.set_pipelined(True)
-    e1 = e1_builder.build()
+    if exchange == "mesh":
+        from tez_tpu.library.conf import MeshOrderedPartitionedKVEdgeConfig
+        # the mesh edge has no separate combine phase: the exchange's merge
+        # epilogue lands every equal key on one worker already
+        e1 = MeshOrderedPartitionedKVEdgeConfig.new_builder("bytes", "long")\
+            .set_value_width(8).build()
+    else:
+        e1_builder = OrderedPartitionedKVEdgeConfig.new_builder("bytes",
+                                                                "long")
+        if combine:
+            e1_builder.set_combiner("sum_long")
+        if pipelined:
+            e1_builder.set_pipelined(True)
+        e1 = e1_builder.build()
     e2 = OrderedPartitionedKVEdgeConfig.new_builder("long", "bytes").build()
 
     dag = DAG.create("OrderedWordCount")

@@ -132,3 +132,29 @@ class UnorderedPartitionedKVEdgeConfig(_BaseEdgeConfigBuilder):
     def set_buffer_mb(self, mb: int) -> "UnorderedPartitionedKVEdgeConfig":
         self.conf["tez.runtime.unordered.output.buffer.size-mb"] = mb
         return self
+
+
+class MeshOrderedPartitionedKVEdgeConfig(_BaseEdgeConfigBuilder):
+    """Sorted scatter-gather edge over the ICI mesh exchange: producer sort,
+    all-to-all transport and consumer merge are ONE SPMD program
+    (library/mesh_io.py; reference roles: PipelinedSorter + ShuffleHandler +
+    Fetcher + MergeManager).  Consumer parallelism must not exceed the mesh
+    device count; keys/values are bounded by the configured widths."""
+    _output_class = "tez_tpu.library.mesh_io:MeshOrderedPartitionedKVOutput"
+    _input_class = "tez_tpu.library.mesh_io:MeshOrderedGroupedKVInput"
+    _movement = DataMovementType.SCATTER_GATHER
+
+    @staticmethod
+    def new_builder(key_serde: str = "bytes", value_serde: str = "bytes"
+                    ) -> "MeshOrderedPartitionedKVEdgeConfig":
+        return MeshOrderedPartitionedKVEdgeConfig(key_serde, value_serde)
+
+    def set_key_width(self, width: int
+                      ) -> "MeshOrderedPartitionedKVEdgeConfig":
+        self.conf["tez.runtime.tpu.key.width.bytes"] = width
+        return self
+
+    def set_value_width(self, width: int
+                        ) -> "MeshOrderedPartitionedKVEdgeConfig":
+        self.conf["tez.runtime.tpu.mesh.value.width.bytes"] = width
+        return self

@@ -1,0 +1,212 @@
+"""Mesh-backed ordered scatter-gather IO: the ICI data plane as a DAG edge.
+
+Reference parity: these classes stand in the exact seam of
+OrderedPartitionedKVOutput / OrderedGroupedKVInput (tez-runtime-library
+library/{output,input}/), but the edge's data movement is the SPMD
+all-to-all exchange (parallel/exchange.py via parallel/coordinator.py)
+instead of per-task spill files + N^2 fetches: the producer-side sort, the
+shuffle transport, and the consumer-side merge are ONE jitted program over
+the device mesh.  Event flow is unchanged — producers still emit
+DataMovementEvents through the AM (so vertex managers, recovery and
+counters all see a normal SCATTER_GATHER edge); the payload's
+`host="(mesh)"` marks that the bytes move through the exchange, not the
+shuffle servers.
+
+Contract: keys up to tez.runtime.tpu.key.width.bytes and values up to
+tez.runtime.tpu.mesh.value.width.bytes travel on-device (loud
+MeshCapacityError otherwise); consumer parallelism must not exceed the
+mesh's device count (one partition per worker).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional, Sequence
+
+from tez_tpu.api.events import (CompositeDataMovementEvent,
+                                CompositeRoutedDataMovementEvent,
+                                DataMovementEvent, InputFailedEvent,
+                                ShufflePayload, TezAPIEvent,
+                                VertexManagerEvent)
+from tez_tpu.api.runtime import (KeyValuesWriter, LogicalInput, LogicalOutput,
+                                 Writer)
+from tez_tpu.common.counters import TaskCounter
+from tez_tpu.library.inputs import GroupedKVReader
+from tez_tpu.library.util import conf_get as _conf_get
+from tez_tpu.ops.runformat import KVBatch
+from tez_tpu.ops.serde import get_serde
+
+log = logging.getLogger(__name__)
+
+MESH_HOST = "(mesh)"
+
+
+def _edge_id(dag_id: Any, src_vertex: str, dst_vertex: str) -> str:
+    return f"{dag_id}/{src_vertex}->{dst_vertex}"
+
+
+class MeshOrderedPartitionedKVOutput(LogicalOutput):
+    """Producer half of a mesh SCATTER_GATHER edge: collects this task's
+    records and registers them with the exchange coordinator; the last
+    producer to close triggers the SPMD exchange (the gang barrier)."""
+
+    def initialize(self) -> List[TezAPIEvent]:
+        ctx = self.context
+        self.key_serde = get_serde(_conf_get(ctx, "tez.runtime.key.class",
+                                             "bytes"))
+        self.val_serde = get_serde(_conf_get(ctx, "tez.runtime.value.class",
+                                             "bytes"))
+        self.key_width = int(_conf_get(ctx, "tez.runtime.tpu.key.width.bytes",
+                                       16))
+        self.value_width = int(_conf_get(
+            ctx, "tez.runtime.tpu.mesh.value.width.bytes", 16))
+        if _conf_get(ctx, "tez.runtime.key.comparator.class", ""):
+            raise ValueError(
+                "mesh edges sort by raw key bytes on device; custom "
+                "comparators need the host shuffle edge "
+                "(OrderedPartitionedKVEdgeConfig)")
+        self._pairs: List = []
+        ctx.request_initial_memory(0, None,
+                                   component_type="PARTITIONED_SORTED_OUTPUT")
+        return []
+
+    def get_writer(self) -> Writer:
+        output = self
+
+        class _W(KeyValuesWriter):
+            def write(self, key, value) -> None:
+                k = output.key_serde.to_bytes(key)
+                v = output.val_serde.to_bytes(value)
+                output._pairs.append((k, v))
+                output.context.counters.increment(TaskCounter.OUTPUT_RECORDS)
+                output.context.counters.increment(
+                    TaskCounter.OUTPUT_BYTES, len(k) + len(v))
+                if (len(output._pairs) & 0x3FFF) == 0:
+                    output.context.notify_progress()
+
+        return _W()
+
+    def handle_events(self, events: Sequence[TezAPIEvent]) -> None:
+        pass
+
+    def close(self) -> List[TezAPIEvent]:
+        from tez_tpu.parallel.coordinator import mesh_coordinator
+        ctx = self.context
+        batch = KVBatch.from_pairs(self._pairs) if self._pairs \
+            else KVBatch.empty()
+        self._pairs = []
+        edge = _edge_id(ctx.task_attempt_id.dag_id, ctx.vertex_name,
+                        ctx.destination_vertex_name)
+        mesh_coordinator().register_producer(
+            edge, ctx.task_index,
+            num_producers=ctx.vertex_parallelism,
+            num_consumers=self.num_physical_outputs,
+            batch=batch, key_width=self.key_width,
+            value_width=self.value_width)
+        ctx.counters.increment(TaskCounter.SHUFFLE_BYTES, batch.nbytes)
+        payload = ShufflePayload(host=MESH_HOST, port=0,
+                                 path_component=edge, last_event=True)
+        return [
+            CompositeDataMovementEvent(0, self.num_physical_outputs, payload),
+            VertexManagerEvent(
+                target_vertex_name=ctx.destination_vertex_name,
+                user_payload={"output_size": batch.nbytes,
+                              "partition_sizes": None}),
+        ]
+
+
+class MeshOrderedGroupedKVInput(LogicalInput):
+    """Consumer half: waits for every producer's mesh DME, then reads its
+    worker's sorted partition straight off the exchange (already merged —
+    there is no consumer-side fetch or merge phase at all)."""
+
+    def initialize(self) -> List[TezAPIEvent]:
+        ctx = self.context
+        self.key_serde = get_serde(_conf_get(ctx, "tez.runtime.key.class",
+                                             "bytes"))
+        self.val_serde = get_serde(_conf_get(ctx, "tez.runtime.value.class",
+                                             "bytes"))
+        import threading
+        self._lock = threading.Condition()
+        self._complete = set()
+        self._failed: Optional[str] = None
+        self._batch: Optional[KVBatch] = None
+        self._group_starts = None
+        ctx.request_initial_memory(0, None,
+                                   component_type="SORTED_MERGED_INPUT")
+        return []
+
+    def handle_events(self, events: Sequence[TezAPIEvent]) -> None:
+        with self._lock:
+            for ev in events:
+                if isinstance(ev, (CompositeRoutedDataMovementEvent,
+                                   DataMovementEvent)):
+                    slot = ev.target_index_start if isinstance(
+                        ev, CompositeRoutedDataMovementEvent) \
+                        else ev.target_index
+                    payload = ev.user_payload
+                    assert isinstance(payload, ShufflePayload), payload
+                    if payload.host != MESH_HOST:
+                        self._failed = (
+                            f"mesh input received non-mesh payload from "
+                            f"slot {slot} (host {payload.host!r}): the "
+                            f"edge's output class must be the mesh output")
+                    self._complete.add(slot)
+                elif isinstance(ev, InputFailedEvent):
+                    if self._batch is not None:
+                        # this attempt already materialized the (now stale)
+                        # merged result: fail loudly; the retry waits for
+                        # the coordinator's re-exchange below
+                        self._failed = (f"producer slot {ev.target_index} "
+                                        f"re-ran after this attempt read "
+                                        f"the mesh exchange")
+                    else:
+                        # producer re-running before we read anything: the
+                        # coordinator invalidates and re-runs the exchange
+                        # when the replacement span registers — just wait
+                        # for the fresh DME
+                        self._complete.discard(ev.target_index)
+                else:
+                    log.warning("MeshOrderedGroupedKVInput: unexpected "
+                                "event %r", ev)
+            self._lock.notify_all()
+
+    def _wait_complete(self) -> None:
+        import time
+        with self._lock:
+            while len(self._complete) < self.num_physical_inputs:
+                if self._failed:
+                    raise RuntimeError(self._failed)
+                self._lock.wait(0.2)
+                self.context.notify_progress()
+            if self._failed:
+                raise RuntimeError(self._failed)
+
+    def get_reader(self) -> GroupedKVReader:
+        if self._batch is None:
+            import time
+            ctx = self.context
+            t0 = time.time()
+            self._wait_complete()
+            from tez_tpu.parallel.coordinator import mesh_coordinator
+            edge = _edge_id(ctx.task_attempt_id.dag_id,
+                            ctx.source_vertex_name, ctx.vertex_name)
+            self._batch = mesh_coordinator().wait_consumer(
+                edge, ctx.task_index,
+                num_producers=self.num_physical_inputs,
+                num_consumers=ctx.vertex_parallelism,
+                progress=ctx.notify_progress)
+            ctx.counters.find_counter(TaskCounter.SHUFFLE_PHASE_TIME)\
+                .increment(int((time.time() - t0) * 1000))
+            ctx.counters.increment(TaskCounter.REDUCE_INPUT_RECORDS,
+                                   self._batch.num_records)
+            ctx.counters.increment(TaskCounter.NUM_SHUFFLED_INPUTS,
+                                   self.num_physical_inputs)
+        if self._group_starts is None:
+            self._group_starts = GroupedKVReader._compute_groups(self._batch)
+        return GroupedKVReader(self._batch, self.key_serde, self.val_serde,
+                               self.context, group_starts=self._group_starts)
+
+    def close(self) -> List[TezAPIEvent]:
+        self._batch = None
+        self._group_starts = None
+        return []

@@ -58,3 +58,12 @@ def encode_keys(key_bytes: np.ndarray, offsets: np.ndarray,
     """Ragged keys -> (uint32 lanes [N, ceil(width/4)], lengths[N])."""
     mat, lengths = pad_to_matrix(key_bytes, offsets, width)
     return matrix_to_lanes(mat), lengths
+
+
+def lanes_to_matrix(lanes: np.ndarray) -> np.ndarray:
+    """Inverse of matrix_to_lanes: big-endian uint32[N, L] -> uint8[N, L*4]."""
+    n, num_lanes = lanes.shape
+    mat = np.zeros((n, num_lanes * 4), dtype=np.uint8)
+    for i in range(4):
+        mat[:, i::4] = ((lanes >> (24 - 8 * i)) & 0xFF).astype(np.uint8)
+    return mat

@@ -1,0 +1,350 @@
+"""Mesh exchange coordinator: SCATTER_GATHER edges over ICI collectives.
+
+This is the framework seam that turns a DAG edge into ONE SPMD program
+(reference roles replaced: ShuffleHandler.java:159 server + Fetcher.java:79
+clients + MergeManager's final merge all collapse into the jitted
+all-to-all exchange of parallel/exchange.py).  Producer tasks register
+their encoded spans; when the last producer lands, the coordinator sizes
+the exchange from EXACT per-partition counts (so the padded kernel can
+never overflow), runs it over the device mesh — multi-round when one round
+would exceed the per-device row budget (SURVEY.md §5.7 multi-pass analog)
+— and consumer tasks block on their sorted partition.
+
+Single-controller topology: every runner in this process shares one
+coordinator (the analog of local_shuffle_service); a multi-host deployment
+runs one coordinator per host participating in a global jax mesh, with the
+same register/wait surface.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tez_tpu.ops.keycodec import matrix_to_lanes, pad_to_matrix
+from tez_tpu.ops.runformat import KVBatch
+
+log = logging.getLogger(__name__)
+
+
+class MeshCapacityError(RuntimeError):
+    """A single partition exceeds what the mesh exchange can carry even
+    multi-round; callers fall back to the fair-shuffle split path."""
+
+
+def _encode_values(batch: KVBatch, value_width: int) -> np.ndarray:
+    """Values -> u32[N, 1 + value_width/4]: word 0 is the true byte length,
+    the rest the zero-padded value bytes as big-endian words."""
+    vmat, vlens = pad_to_matrix(batch.val_bytes, batch.val_offsets,
+                                value_width)
+    words = matrix_to_lanes(vmat)
+    return np.concatenate([vlens.astype(np.uint32)[:, None],
+                           words.astype(np.uint32)], axis=1)
+
+
+def _decode_rows(lanes: np.ndarray, lengths: np.ndarray, values: np.ndarray,
+                 valid: np.ndarray) -> KVBatch:
+    """Exchange output -> KVBatch (vectorized byte reconstruction)."""
+    from tez_tpu.ops.keycodec import lanes_to_matrix
+    sel = np.flatnonzero(valid)
+    if sel.size == 0:
+        return KVBatch.empty()
+    lanes = lanes[sel]
+    klens = lengths[sel].astype(np.int64)
+    vwords = values[sel]
+    n, L = lanes.shape
+    kmat = lanes_to_matrix(lanes)
+    kmask = np.arange(L * 4)[None, :] < klens[:, None]
+    key_bytes = kmat[kmask]
+    key_offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(klens, out=key_offsets[1:])
+
+    vlens = vwords[:, 0].astype(np.int64)
+    vmat = lanes_to_matrix(np.ascontiguousarray(vwords[:, 1:]))
+    vmask = np.arange(vmat.shape[1])[None, :] < vlens[:, None]
+    val_bytes = vmat[vmask]
+    val_offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(vlens, out=val_offsets[1:])
+    return KVBatch(key_bytes, key_offsets, val_bytes, val_offsets)
+
+
+class _EdgeState:
+    def __init__(self, num_producers: int, num_consumers: int):
+        self.num_producers = num_producers
+        self.num_consumers = num_consumers
+        self.spans: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+        self.results: Optional[List[KVBatch]] = None
+        self.error: Optional[BaseException] = None
+        self.executing = False     # an _execute is in flight on some thread
+        self.dirty = False         # spans changed while executing: re-run
+
+
+class MeshExchangeCoordinator:
+    """Per-process exchange coordinator (one per runner host)."""
+
+    def __init__(self, mesh=None, max_rows_per_round: int = 1 << 20):
+        self._mesh = mesh
+        self.max_rows_per_round = max_rows_per_round
+        self.lock = threading.Condition()
+        self.edges: Dict[str, _EdgeState] = {}
+        # compiled exchange programs keyed by (devices, shape...) — meshes
+        # are cached per size below so these keys are stable across edges
+        self._compiled: Dict[Tuple[int, int, int, int], object] = {}
+        self._meshes: Dict[int, object] = {}
+        self.exchanges_run = 0
+        self.rows_exchanged = 0
+
+    # ------------------------------------------------------------------ mesh
+    def mesh_for(self, num_consumers: int):
+        from tez_tpu.parallel.mesh import make_mesh
+        import jax
+        if self._mesh is not None and \
+                self._mesh.devices.size == num_consumers:
+            return self._mesh
+        cached = self._meshes.get(num_consumers)
+        if cached is not None:
+            return cached
+        if len(jax.devices()) < num_consumers:
+            raise MeshCapacityError(
+                f"mesh edge needs {num_consumers} devices (one per consumer "
+                f"partition), have {len(jax.devices())}; lower consumer "
+                f"parallelism or use the host shuffle edge")
+        mesh = make_mesh(n_devices=num_consumers)
+        self._meshes[num_consumers] = mesh
+        return mesh
+
+    # ------------------------------------------------------------- producers
+    def register_producer(self, edge_id: str, task_index: int,
+                          num_producers: int, num_consumers: int,
+                          batch: KVBatch, key_width: int,
+                          value_width: int) -> None:
+        """Record one producer span (encoded).  The LAST registration runs
+        the exchange inline on that producer's thread — the gang barrier:
+        by then every producer's data is resident, which is exactly the
+        gang-scheduling condition CONCURRENT edges declare."""
+        if len(batch.key_offsets) > 1:
+            max_key = int(np.max(np.diff(batch.key_offsets)))
+            if max_key > key_width:
+                raise MeshCapacityError(
+                    f"mesh edge carries keys up to "
+                    f"tez.runtime.tpu.key.width.bytes={key_width}B, "
+                    f"found {max_key}B; raise the width")
+            max_val = int(np.max(np.diff(batch.val_offsets)))
+            if max_val > value_width:
+                raise MeshCapacityError(
+                    f"mesh edge carries values up to "
+                    f"tez.runtime.tpu.mesh.value.width.bytes={value_width}B,"
+                    f" found {max_val}B; raise the width")
+        kmat, klens = pad_to_matrix(batch.key_bytes, batch.key_offsets,
+                                    key_width)
+        lanes = matrix_to_lanes(kmat)
+        vwords = _encode_values(batch, value_width)
+        with self.lock:
+            st = self.edges.setdefault(
+                edge_id, _EdgeState(num_producers, num_consumers))
+            st.spans[task_index] = (lanes,
+                                    klens.astype(np.uint32),
+                                    vwords)
+            if st.results is not None:
+                # a producer RE-RAN after the exchange: invalidate and
+                # re-exchange with the replacement span (consumers that
+                # already read the old result fail on their
+                # InputFailedEvent and re-run against the fresh one)
+                log.warning("mesh edge %s: producer %d re-registered after "
+                            "the exchange; re-running it", edge_id,
+                            task_index)
+                st.results = None
+            if st.executing:
+                st.dirty = True    # the in-flight run is stale; rerun after
+                return
+            ready = len(st.spans) >= st.num_producers
+            if ready:
+                st.executing = True
+        if not ready:
+            return
+        while True:
+            try:
+                results = self._execute(st)
+            except BaseException as e:  # noqa: BLE001 — consumers must wake
+                with self.lock:
+                    st.error = e
+                    st.executing = False
+                    self.lock.notify_all()
+                raise
+            with self.lock:
+                if st.dirty:
+                    st.dirty = False
+                    continue           # spans changed mid-run: go again
+                st.results = results
+                st.error = None
+                st.executing = False
+                self.lock.notify_all()
+                return
+
+    # ------------------------------------------------------------- consumers
+    def wait_consumer(self, edge_id: str, consumer_index: int,
+                      num_producers: int, num_consumers: int,
+                      timeout: Optional[float] = None,
+                      progress=None) -> KVBatch:
+        import time
+        deadline = None if timeout is None else time.time() + timeout
+        with self.lock:
+            st = self.edges.setdefault(
+                edge_id, _EdgeState(num_producers, num_consumers))
+            while st.results is None and st.error is None:
+                if deadline is not None and time.time() > deadline:
+                    raise TimeoutError(
+                        f"mesh exchange {edge_id}: "
+                        f"{len(st.spans)}/{st.num_producers} producers")
+                self.lock.wait(0.2)
+                if progress is not None:
+                    progress()
+            if st.error is not None:
+                raise RuntimeError(
+                    f"mesh exchange {edge_id} failed") from st.error
+            return st.results[consumer_index]
+
+    def cleanup_edge(self, edge_id: str) -> None:
+        with self.lock:
+            self.edges.pop(edge_id, None)
+
+    def cleanup_dag(self, dag_id_prefix: str) -> int:
+        """Deletion tracking (reference: DeletionTracker/DagDeleteRunnable):
+        drop every edge of a finished DAG — spans and materialized results."""
+        with self.lock:
+            doomed = [e for e in self.edges if e.startswith(dag_id_prefix)]
+            for e in doomed:
+                del self.edges[e]
+            return len(doomed)
+
+    # -------------------------------------------------------------- exchange
+    def _compiled_fn(self, mesh, num_lanes: int, rows_per_worker: int,
+                     cap: int, value_words: int):
+        from tez_tpu.parallel.exchange import build_distributed_shuffle
+        key = (mesh.devices.size, num_lanes, rows_per_worker, cap,
+               value_words)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = build_distributed_shuffle(mesh, num_lanes, rows_per_worker,
+                                           cap, value_words=value_words)
+            self._compiled[key] = fn
+        return fn
+
+    def _execute(self, st: _EdgeState) -> List[KVBatch]:
+        """Run the SPMD exchange for a complete edge.  CAP comes from exact
+        host-side partition counts (fnv_rows_host == the kernel's
+        partitioner), so the padded all-to-all cannot overflow; when the
+        biggest partition exceeds max_rows_per_round the exchange runs in
+        rank-sliced rounds and each consumer's rounds merge at the end."""
+        from tez_tpu.ops.host_sort import fnv_rows_host
+        from tez_tpu.ops.sorter import merge_sorted_runs
+        from tez_tpu.ops.runformat import Run
+
+        W = st.num_consumers
+        mesh = self.mesh_for(W)
+        with self.lock:
+            spans = [st.spans[i] for i in sorted(st.spans)]
+        lanes = np.concatenate([s[0] for s in spans]) \
+            if spans else np.zeros((0, 1), np.uint32)
+        klens = np.concatenate([s[1] for s in spans]) \
+            if spans else np.zeros((0,), np.uint32)
+        vwords = np.concatenate([s[2] for s in spans]) \
+            if spans else np.zeros((0, 1), np.uint32)
+        total = lanes.shape[0]
+        num_lanes = lanes.shape[1]
+        value_words = vwords.shape[1]
+        if total == 0:
+            return [KVBatch.empty() for _ in range(W)]
+
+        # exact routing on host: byte-masked FNV over the padded key matrix
+        # (reconstruct the byte matrix from lanes — cheap, vectorized)
+        kmat = np.zeros((total, num_lanes * 4), dtype=np.uint8)
+        for i in range(4):
+            kmat[:, i::4] = ((lanes >> (24 - 8 * i)) & 0xFF).astype(np.uint8)
+        part = (fnv_rows_host(kmat, klens.astype(np.int64)) %
+                np.uint32(W)).astype(np.int64)
+        counts = np.bincount(part, minlength=W)
+        max_part = int(counts.max())
+        rounds = max(1, -(-max_part // self.max_rows_per_round))
+        cap = min(max_part, self.max_rows_per_round)
+
+        # rank of each row within its partition (stable arrival order)
+        order = np.argsort(part, kind="stable")
+        ranks = np.empty(total, dtype=np.int64)
+        starts = np.zeros(W + 1, dtype=np.int64)
+        np.cumsum(counts, out=starts[1:])
+        ranks[order] = np.arange(total, dtype=np.int64) - \
+            np.repeat(starts[:-1], counts)
+
+        per_round_results: List[List[KVBatch]] = []
+        for r in range(rounds):
+            lo, hi = r * cap, (r + 1) * cap
+            sel = np.flatnonzero((ranks >= lo) & (ranks < hi))
+            n_round = sel.size
+            if n_round == 0:
+                continue
+            N = -(-n_round // W)          # rows per worker, padded
+            pad = W * N - n_round
+            r_lanes = np.concatenate(
+                [lanes[sel],
+                 np.zeros((pad, num_lanes), np.uint32)])
+            r_klens = np.concatenate([klens[sel],
+                                      np.zeros(pad, np.uint32)])
+            r_vwords = np.concatenate(
+                [vwords[sel], np.zeros((pad, value_words), np.uint32)])
+            r_valid = np.concatenate([np.ones(n_round, bool),
+                                      np.zeros(pad, bool)])
+            fn = self._compiled_fn(mesh, num_lanes, N, cap, value_words)
+            out_lanes, out_klens, out_vwords, out_valid, dropped = \
+                fn(r_lanes, r_klens, r_vwords, r_valid)
+            dropped_total = int(np.asarray(dropped).sum())
+            if dropped_total:
+                raise MeshCapacityError(
+                    f"mesh exchange overflow: {dropped_total} rows dropped "
+                    f"(cap {cap}, round {r}) — capacity accounting bug")
+            out_lanes = np.asarray(out_lanes).reshape(W, -1, num_lanes)
+            out_klens = np.asarray(out_klens).reshape(W, -1)
+            out_vwords = np.asarray(out_vwords).reshape(W, -1, value_words)
+            out_valid = np.asarray(out_valid).reshape(W, -1)
+            per_round_results.append([
+                _decode_rows(out_lanes[w], out_klens[w], out_vwords[w],
+                             out_valid[w]) for w in range(W)])
+            self.rows_exchanged += n_round
+        self.exchanges_run += 1
+
+        if len(per_round_results) == 1:
+            return per_round_results[0]
+        merged: List[KVBatch] = []
+        for w in range(W):
+            runs = [Run(res[w],
+                        np.array([0, res[w].num_records], dtype=np.int64))
+                    for res in per_round_results if res[w].num_records > 0]
+            if not runs:
+                merged.append(KVBatch.empty())
+            elif len(runs) == 1:
+                merged.append(runs[0].batch)
+            else:
+                merged.append(merge_sorted_runs(
+                    runs, 1, num_lanes * 4, engine="host").batch)
+        return merged
+
+
+_coordinator: Optional[MeshExchangeCoordinator] = None
+_coordinator_lock = threading.Lock()
+
+
+def mesh_coordinator() -> MeshExchangeCoordinator:
+    global _coordinator
+    with _coordinator_lock:
+        if _coordinator is None:
+            _coordinator = MeshExchangeCoordinator()
+        return _coordinator
+
+
+def reset_coordinator() -> None:
+    """Test hook: drop all edge state (fresh process semantics)."""
+    global _coordinator
+    with _coordinator_lock:
+        _coordinator = None

@@ -8,12 +8,20 @@ whole exchange is ONE jitted SPMD program over a device mesh —
     all-to-all over ICI          (partition p's rows land on worker p) ->
     local k-way merge (stable sort of concatenation)
 
+Row payload is fully general KV: `lanes` carry the key bytes as big-endian
+u32 words (keycodec packing, so lane order == byte order), `lengths` the
+true key length (the tie-break that makes zero-padded short keys sort
+exactly like raw bytes: "ab" < "ab\\x00"), and `values` V u32 words per row
+(fixed-width value slots; the mesh edge layer enforces the width).
+
 Everything is static-shape: each worker holds up to N rows (padding rows
 carry partition = P_MAX so they sort to the tail and exchange as slack), and
 the all-to-all moves a fixed [W, CAP] send buffer per worker — the padded
 formulation of a ragged all-to-all.  Skew beyond CAP is handled above this
-kernel by the fair-shuffle vertex manager splitting oversized partitions
-(SURVEY.md §5.7).
+kernel: the mesh exchange coordinator sizes CAP from exact partition counts
+and falls back to a multi-round exchange when one round would exceed the
+device budget (SURVEY.md §5.7), with fair-shuffle splitting for persistent
+skew.
 """
 from __future__ import annotations
 
@@ -30,12 +38,22 @@ from tez_tpu.parallel.mesh import WORKER_AXIS
 INVALID = jnp.uint32(0xFFFFFFFF)
 
 
-def _fnv_lanes(lanes: jnp.ndarray) -> jnp.ndarray:
-    """FNV-1a over each row's lanes (u32 words); the distributed kernel's
-    partitioner (device-side analog of HashPartitioner over encoded keys)."""
-    h = jnp.full((lanes.shape[0],), 2166136261, dtype=jnp.uint32)
-    for i in range(lanes.shape[1]):
-        h = ((h ^ lanes[:, i]) * jnp.uint32(16777619)).astype(jnp.uint32)
+def _fnv_lanes(lanes: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """FNV-1a over each row's key BYTES (big-endian expansion of the lanes,
+    truncated to the true length) — the distributed kernel's partitioner,
+    byte-for-byte the same hash a host HashPartitioner computes over the
+    raw key, so mesh and host shuffles route identically."""
+    n, num_lanes = lanes.shape
+    h = jnp.full((n,), 2166136261, dtype=jnp.uint32)
+    for i in range(num_lanes):
+        word = lanes[:, i]
+        for shift in (24, 16, 8, 0):
+            byte_index = i * 4 + (3 - shift // 8)
+            byte = (word >> shift) & jnp.uint32(0xFF)
+            live = byte_index < lengths
+            h = jnp.where(
+                live, ((h ^ byte) * jnp.uint32(16777619)).astype(jnp.uint32),
+                h)
     return h
 
 
@@ -52,49 +70,53 @@ def _stable_sort_rows(keys_cols, payload_cols):
     return [c[perm] for c in keys_cols], [p[perm] for p in payload_cols], perm
 
 
-
-def _partition_sort(lanes, values, valid, num_workers):
+def _partition_sort(lanes, lengths, values, valid, num_workers):
     """Shared prologue: hash-partition + stable local sort by
-    (partition, key lanes); invalid rows carry partition == num_workers so
-    they sort to the tail.  Returns (spart, slanes, svalues, svalid)."""
+    (partition, key lanes, key length); invalid rows carry partition ==
+    num_workers so they sort to the tail."""
     n, num_lanes = lanes.shape
-    part = jnp.where(valid, _fnv_lanes(lanes) % num_workers,
+    part = jnp.where(valid, _fnv_lanes(lanes, lengths) % num_workers,
                      jnp.uint32(num_workers))
     key_cols = [part.astype(jnp.uint32)] + \
-        [lanes[:, i] for i in range(num_lanes)]
+        [lanes[:, i] for i in range(num_lanes)] + [lengths.astype(jnp.uint32)]
     sorted_keys, sorted_payload, _ = _stable_sort_rows(
         key_cols, [values, valid.astype(jnp.uint32)])
     spart = sorted_keys[0]
-    slanes = jnp.stack(sorted_keys[1:], axis=1) if num_lanes else \
-        jnp.zeros((n, 0), jnp.uint32)
+    slanes = jnp.stack(sorted_keys[1:1 + num_lanes], axis=1) if num_lanes \
+        else jnp.zeros((n, 0), jnp.uint32)
+    slengths = sorted_keys[-1]
     svalues, svalid = sorted_payload
-    return spart, slanes, svalues, svalid
+    return spart, slanes, slengths, svalues, svalid
 
 
-def _merge_received(rlanes, rvals, rvalid):
-    """Shared epilogue: stable sort of the received concatenation by key
-    lanes, validity-major (invalid rows to the tail)."""
+def _merge_received(rlanes, rlengths, rvals, rvalid):
+    """Shared epilogue: stable sort of the received concatenation by
+    (key lanes, key length), validity-major (invalid rows to the tail)."""
     num_lanes = rlanes.shape[1]
     key_cols = [jnp.where(rvalid > 0, jnp.uint32(0), jnp.uint32(1))] + \
-        [rlanes[:, i] for i in range(num_lanes)]
+        [rlanes[:, i] for i in range(num_lanes)] + \
+        [rlengths.astype(jnp.uint32)]
     sorted_keys, sorted_payload, _ = _stable_sort_rows(
         key_cols, [rvals, rvalid])
-    out_lanes = jnp.stack(sorted_keys[1:], axis=1) if num_lanes else rlanes
+    out_lanes = jnp.stack(sorted_keys[1:1 + num_lanes], axis=1) \
+        if num_lanes else rlanes
+    out_lengths = sorted_keys[-1]
     out_vals, out_valid = sorted_payload
-    return out_lanes, out_vals, out_valid
+    return out_lanes, out_lengths, out_vals, out_valid
 
 
-def _shuffle_step_local(lanes: jnp.ndarray, values: jnp.ndarray,
-                        valid: jnp.ndarray, num_workers: int,
-                        cap: int) -> Tuple[jnp.ndarray, jnp.ndarray,
-                                           jnp.ndarray]:
-    """Per-worker body run under shard_map.  lanes: u32[N, L]; values:
-    u32[N]; valid: bool[N].  Returns (lanes', values', valid', dropped)
-    holding this worker's partition, key-sorted, padded to [W*cap], plus a
-    per-worker count of rows lost to capacity overflow (must be zero)."""
+def _shuffle_step_local(lanes: jnp.ndarray, lengths: jnp.ndarray,
+                        values: jnp.ndarray, valid: jnp.ndarray,
+                        num_workers: int, cap: int) -> Tuple[jnp.ndarray, ...]:
+    """Per-worker body run under shard_map.  lanes: u32[N, L]; lengths:
+    u32[N]; values: u32[N, V]; valid: bool[N].  Returns (lanes', lengths',
+    values', valid', dropped) holding this worker's partition, key-sorted,
+    padded to [W*cap], plus a per-worker count of rows lost to capacity
+    overflow (must be zero)."""
     n, num_lanes = lanes.shape
-    spart, slanes, svalues, svalid = _partition_sort(lanes, values, valid,
-                                                     num_workers)
+    num_vwords = values.shape[1]
+    spart, slanes, slengths, svalues, svalid = _partition_sort(
+        lanes, lengths, values, valid, num_workers)
 
     # scatter rows into the fixed [W, cap] send buffer: row i of partition p
     # goes to slot (p, rank_within_partition(i))
@@ -107,39 +129,47 @@ def _shuffle_step_local(lanes: jnp.ndarray, values: jnp.ndarray,
     flat_slot = jnp.where(in_range, spart.astype(jnp.int32) * cap + ranks,
                           dump)
 
-    send_lanes = jnp.full((num_workers * cap + 1, num_lanes), INVALID,
-                          dtype=jnp.uint32)
-    send_vals = jnp.zeros((num_workers * cap + 1,), dtype=jnp.uint32)
-    send_valid = jnp.zeros((num_workers * cap + 1,), dtype=jnp.uint32)
+    send_lanes = jnp.full((dump + 1, num_lanes), INVALID, dtype=jnp.uint32)
+    send_lengths = jnp.zeros((dump + 1,), dtype=jnp.uint32)
+    send_vals = jnp.zeros((dump + 1, num_vwords), dtype=jnp.uint32)
+    send_valid = jnp.zeros((dump + 1,), dtype=jnp.uint32)
     send_lanes = send_lanes.at[flat_slot].set(slanes)
+    send_lengths = send_lengths.at[flat_slot].set(slengths)
     send_vals = send_vals.at[flat_slot].set(svalues)
     send_valid = send_valid.at[flat_slot].set(jnp.uint32(1))
 
     # ICI all-to-all: block w of my send buffer -> worker w
-    send_lanes = send_lanes[:dump].reshape(num_workers, cap, num_lanes)
-    send_vals = send_vals[:dump].reshape(num_workers, cap)
-    send_valid = send_valid[:dump].reshape(num_workers, cap)
-    recv_lanes = jax.lax.all_to_all(send_lanes, WORKER_AXIS, 0, 0, tiled=False)
-    recv_vals = jax.lax.all_to_all(send_vals, WORKER_AXIS, 0, 0, tiled=False)
-    recv_valid = jax.lax.all_to_all(send_valid, WORKER_AXIS, 0, 0,
-                                    tiled=False)
+    recv_lanes = jax.lax.all_to_all(
+        send_lanes[:dump].reshape(num_workers, cap, num_lanes),
+        WORKER_AXIS, 0, 0, tiled=False)
+    recv_lengths = jax.lax.all_to_all(
+        send_lengths[:dump].reshape(num_workers, cap),
+        WORKER_AXIS, 0, 0, tiled=False)
+    recv_vals = jax.lax.all_to_all(
+        send_vals[:dump].reshape(num_workers, cap, num_vwords),
+        WORKER_AXIS, 0, 0, tiled=False)
+    recv_valid = jax.lax.all_to_all(
+        send_valid[:dump].reshape(num_workers, cap),
+        WORKER_AXIS, 0, 0, tiled=False)
 
     # local merge: stable sort of the received concatenation by key lanes
     # (invalid rows carry INVALID lanes -> tail)
     m = num_workers * cap
-    out_lanes, out_vals, out_valid = _merge_received(
-        recv_lanes.reshape(m, num_lanes), recv_vals.reshape(m),
-        recv_valid.reshape(m))
+    out_lanes, out_lengths, out_vals, out_valid = _merge_received(
+        recv_lanes.reshape(m, num_lanes), recv_lengths.reshape(m),
+        recv_vals.reshape(m, num_vwords), recv_valid.reshape(m))
     # overflow signal: valid rows this worker could NOT send (rank >= cap).
     # Zero in correct operation; the caller MUST check it — capacity
-    # overflow otherwise means silent data loss (skew handling above this
-    # kernel re-runs with a bigger cap or splits the partition).
+    # overflow otherwise means silent data loss (the coordinator re-runs
+    # with more rounds or splits the partition).
     dropped = jnp.sum((svalid > 0) & ~in_range).astype(jnp.int32)
-    return out_lanes, out_vals, out_valid.astype(jnp.bool_), dropped[None]
+    return out_lanes, out_lengths, out_vals, out_valid.astype(jnp.bool_), \
+        dropped[None]
 
 
-def _shuffle_step_local_ragged(lanes: jnp.ndarray, values: jnp.ndarray,
-                               valid: jnp.ndarray, num_workers: int,
+def _shuffle_step_local_ragged(lanes: jnp.ndarray, lengths: jnp.ndarray,
+                               values: jnp.ndarray, valid: jnp.ndarray,
+                               num_workers: int,
                                out_cap: int) -> Tuple[jnp.ndarray, ...]:
     """Ragged variant: only real rows cross ICI (jax.lax.ragged_all_to_all).
 
@@ -151,8 +181,9 @@ def _shuffle_step_local_ragged(lanes: jnp.ndarray, values: jnp.ndarray,
     default.
     """
     n, num_lanes = lanes.shape
-    spart, slanes, svalues, _ = _partition_sort(lanes, values, valid,
-                                                num_workers)
+    num_vwords = values.shape[1]
+    spart, slanes, slengths, svalues, _ = _partition_sort(
+        lanes, lengths, values, valid, num_workers)
 
     raw_sizes = jnp.bincount(
         jnp.minimum(spart, num_workers).astype(jnp.int32),
@@ -179,9 +210,13 @@ def _shuffle_step_local_ragged(lanes: jnp.ndarray, values: jnp.ndarray,
     ).reshape(num_workers).astype(jnp.int32)
 
     out_lanes = jnp.full((out_cap, num_lanes), INVALID, dtype=jnp.uint32)
-    out_vals = jnp.zeros((out_cap,), dtype=jnp.uint32)
+    out_lengths = jnp.zeros((out_cap,), dtype=jnp.uint32)
+    out_vals = jnp.zeros((out_cap, num_vwords), dtype=jnp.uint32)
     out_lanes = jax.lax.ragged_all_to_all(
         slanes, out_lanes, input_offsets, send_sizes, output_offsets,
+        recv_sizes, axis_name=WORKER_AXIS)
+    out_lengths = jax.lax.ragged_all_to_all(
+        slengths, out_lengths, input_offsets, send_sizes, output_offsets,
         recv_sizes, axis_name=WORKER_AXIS)
     out_vals = jax.lax.ragged_all_to_all(
         svalues, out_vals, input_offsets, send_sizes, output_offsets,
@@ -189,19 +224,21 @@ def _shuffle_step_local_ragged(lanes: jnp.ndarray, values: jnp.ndarray,
     n_recv = jnp.sum(recv_sizes)
     rvalid = (jnp.arange(out_cap) < n_recv).astype(jnp.uint32)
 
-    final_lanes, final_vals, final_valid = _merge_received(
-        out_lanes, out_vals, rvalid)
+    final_lanes, final_lengths, final_vals, final_valid = _merge_received(
+        out_lanes, out_lengths, out_vals, rvalid)
     # overflow signal: rows this worker could not SEND (receiver cap hit)
     dropped = jnp.sum(raw_sizes - send_sizes).astype(jnp.int32)
-    return final_lanes, final_vals, final_valid.astype(jnp.bool_), \
-        dropped[None]
+    return final_lanes, final_lengths, final_vals, \
+        final_valid.astype(jnp.bool_), dropped[None]
 
 
 def build_distributed_shuffle(mesh, num_lanes: int, rows_per_worker: int,
-                              cap_per_pair: int, ragged: bool = False):
+                              cap_per_pair: int, value_words: int = 1,
+                              ragged: bool = False):
     """Compile the SPMD shuffle step for a mesh.  Returns a jitted function
-    f(lanes u32[W*N, L], values u32[W*N], valid bool[W*N]) -> per-worker
-    sorted partitions, sharded over the mesh."""
+    f(lanes u32[W*N, L], lengths u32[W*N], values u32[W*N, V],
+      valid bool[W*N]) -> per-worker sorted partitions, sharded over the
+    mesh."""
     try:
         from jax import shard_map          # jax >= 0.8
     except ImportError:                    # pragma: no cover — older jax
@@ -221,29 +258,39 @@ def build_distributed_shuffle(mesh, num_lanes: int, rows_per_worker: int,
         inspect.signature(shard_map).parameters else "check_rep"
     smapped = shard_map(
         body, mesh=mesh,
-        in_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
+        in_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS),
+                  P(WORKER_AXIS)),
         out_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS),
-                   P(WORKER_AXIS)),
+                   P(WORKER_AXIS), P(WORKER_AXIS)),
         **{check_kw: False})
     return jax.jit(smapped)
 
 
-def distributed_shuffle_reference(lanes: np.ndarray, values: np.ndarray,
-                                  valid: np.ndarray,
+def fnv_bytes_host(key: bytes) -> int:
+    """Host reference of the kernel's byte-wise FNV-1a partitioner."""
+    h = 2166136261
+    for b in key:
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def distributed_shuffle_reference(lanes: np.ndarray, lengths: np.ndarray,
+                                  values: np.ndarray, valid: np.ndarray,
                                   num_workers: int) -> list:
     """Host golden: what each worker should hold after the exchange."""
-    rows = [(tuple(lanes[i].tolist()), int(values[i]))
-            for i in range(len(values)) if valid[i]]
 
-    def fnv(ls):
-        h = 2166136261
-        for w in ls:
-            h = ((h ^ w) * 16777619) & 0xFFFFFFFF
-        return h
+    def row_key_bytes(i: int) -> bytes:
+        raw = b"".join(int(w).to_bytes(4, "big") for w in lanes[i])
+        return raw[: int(lengths[i])]
 
     out = [[] for _ in range(num_workers)]
-    for ls, v in rows:
-        out[fnv(ls) % num_workers].append((ls, v))
+    for i in range(len(valid)):
+        if not valid[i]:
+            continue
+        kb = row_key_bytes(i)
+        w = fnv_bytes_host(kb) % num_workers
+        out[w].append((tuple(lanes[i].tolist()), int(lengths[i]),
+                       tuple(np.atleast_1d(values[i]).tolist())))
     for part in out:
-        part.sort(key=lambda t: t[0])
+        part.sort(key=lambda t: (t[0], t[1]))
     return out

@@ -160,3 +160,34 @@ def test_case_insensitive_comparator_e2e(tmp_path):
     # one group per case-insensitive word, counts summed across cases+tasks
     assert [(k.lower(), int(v)) for k, v in rows] == \
         [("apple", 6), ("banana", 4), ("cherry", 2)]
+
+
+def test_vector_tokenizer_matches_simple(tmp_path):
+    """Batch-first tokenizer (iter_chunks + write_batch) must produce
+    identical output to the per-record path, across both exchanges."""
+    import collections
+    import random
+    from tez_tpu.examples import ordered_wordcount
+
+    rng = random.Random(23)
+    corpus = tmp_path / "c.txt"
+    with open(corpus, "w") as fh:
+        for _ in range(5000):
+            fh.write(f"v{rng.randrange(200):03d}")
+            fh.write(rng.choice([" ", " ", "  ", "\n", "\r\n", "\t", "\x0b", "\x0c"]))
+    outs = {}
+    for mode in ("simple", "vector"):
+        out_dir = str(tmp_path / f"out_{mode}")
+        state = ordered_wordcount.run(
+            [str(corpus)], out_dir,
+            conf={"tez.staging-dir": str(tmp_path / f"stg_{mode}")},
+            tokenizer_parallelism=3, summation_parallelism=2,
+            sorter_parallelism=1, tokenizer_mode=mode)
+        assert state == "SUCCEEDED", mode
+        lines = []
+        for name in sorted(os.listdir(out_dir)):
+            with open(os.path.join(out_dir, name)) as fh:
+                lines.extend(fh.read().splitlines())
+        outs[mode] = lines
+    assert outs["simple"] == outs["vector"]
+    assert len(outs["simple"]) == 200

@@ -35,6 +35,41 @@ class TokenProcessor(SimpleProcessor):
                 writer.write(word, 1)
 
 
+class VectorTokenProcessor(SimpleProcessor):
+    """Batch-first tokenizer: numpy whitespace split over large line-aligned
+    chunks, records shipped as pre-serialized KVBatches (write_batch) — no
+    per-record Python on the hot path.  This is the TPU-native shape of the
+    reference's per-record map loop (the bench path)."""
+
+    def run(self, inputs: Dict[str, LogicalInput],
+            outputs: Dict[str, LogicalOutput]) -> None:
+        import numpy as np
+        from tez_tpu.ops.runformat import KVBatch
+        from tez_tpu.ops.serde import VarLongSerde
+
+        one = VarLongSerde().to_bytes(1)
+        reader = inputs["input"].get_reader()
+        writer = outputs["summation"].get_writer()
+        for chunk in reader.iter_chunks():
+            data = np.frombuffer(chunk, dtype=np.uint8)
+            # full bytes.split() whitespace set: space \t \n \v \f \r
+            ws = (data == 32) | ((data >= 9) & (data <= 13))
+            sel = ~ws
+            if not sel.any():
+                continue
+            key_bytes = data[sel].copy()
+            starts_mask = sel & np.concatenate(([True], ws[:-1]))
+            run_id = np.cumsum(starts_mask)[sel]        # 1-based word id
+            lengths = np.bincount(run_id - 1)
+            n = len(lengths)
+            key_offsets = np.zeros(n + 1, np.int64)
+            np.cumsum(lengths, out=key_offsets[1:])
+            val_bytes = np.frombuffer(one * n, dtype=np.uint8).copy()
+            val_offsets = np.arange(n + 1, dtype=np.int64) * len(one)
+            writer.write_batch(KVBatch(key_bytes, key_offsets,
+                                       val_bytes, val_offsets))
+
+
 class SumProcessor(SimpleProcessor):
     """Sum counts per word, emit (count, word) toward the sorter."""
 
@@ -62,12 +97,15 @@ class NoOpSorterProcessor(SimpleProcessor):
 def build_dag(input_paths, output_path: str, tokenizer_parallelism: int = -1,
               summation_parallelism: int = 2, sorter_parallelism: int = 1,
               combine: bool = True, pipelined: bool = False,
-              exchange: str = "host") -> DAG:
+              exchange: str = "host", tokenizer_mode: str = "simple") -> DAG:
     """exchange="mesh" moves the tokenizer->summation shuffle onto the ICI
     mesh exchange (one SPMD all-to-all program instead of spill+fetch;
-    needs one device per summation task)."""
+    needs one device per summation task).  tokenizer_mode="vector" uses the
+    batch-first numpy tokenizer (the bench path)."""
+    tok_cls = VectorTokenProcessor if tokenizer_mode == "vector" \
+        else TokenProcessor
     tokenizer = Vertex.create("tokenizer", ProcessorDescriptor.create(
-        TokenProcessor), tokenizer_parallelism)
+        tok_cls), tokenizer_parallelism)
     tokenizer.add_data_source("input", DataSourceDescriptor.create(
         InputDescriptor.create("tez_tpu.io.text:TextInput"),
         InputInitializerDescriptor.create(

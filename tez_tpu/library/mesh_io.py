@@ -65,6 +65,7 @@ class MeshOrderedPartitionedKVOutput(LogicalOutput):
                 "comparators need the host shuffle edge "
                 "(OrderedPartitionedKVEdgeConfig)")
         self._pairs: List = []
+        self._batches: List[KVBatch] = []
         ctx.request_initial_memory(0, None,
                                    component_type="PARTITIONED_SORTED_OUTPUT")
         return []
@@ -83,6 +84,15 @@ class MeshOrderedPartitionedKVOutput(LogicalOutput):
                 if (len(output._pairs) & 0x3FFF) == 0:
                     output.context.notify_progress()
 
+            def write_batch(self, batch: KVBatch) -> None:
+                """Batch-first path: pre-serialized records."""
+                output._batches.append(batch)
+                output.context.counters.increment(
+                    TaskCounter.OUTPUT_RECORDS, batch.num_records)
+                output.context.counters.increment(
+                    TaskCounter.OUTPUT_BYTES, batch.nbytes)
+                output.context.notify_progress()
+
         return _W()
 
     def handle_events(self, events: Sequence[TezAPIEvent]) -> None:
@@ -91,9 +101,12 @@ class MeshOrderedPartitionedKVOutput(LogicalOutput):
     def close(self) -> List[TezAPIEvent]:
         from tez_tpu.parallel.coordinator import mesh_coordinator
         ctx = self.context
-        batch = KVBatch.from_pairs(self._pairs) if self._pairs \
-            else KVBatch.empty()
+        parts = list(self._batches)
+        if self._pairs:
+            parts.append(KVBatch.from_pairs(self._pairs))
+        batch = KVBatch.concat(parts) if parts else KVBatch.empty()
         self._pairs = []
+        self._batches = []
         edge = _edge_id(ctx.task_attempt_id.dag_id, ctx.vertex_name,
                         ctx.destination_vertex_name)
         mesh_coordinator().register_producer(
@@ -182,6 +195,9 @@ class MeshOrderedGroupedKVInput(LogicalInput):
                 raise RuntimeError(self._failed)
 
     def get_reader(self) -> GroupedKVReader:
+        with self._lock:
+            if self._failed:
+                raise RuntimeError(self._failed)
         if self._batch is None:
             import time
             ctx = self.context
@@ -209,4 +225,10 @@ class MeshOrderedGroupedKVInput(LogicalInput):
     def close(self) -> List[TezAPIEvent]:
         self._batch = None
         self._group_starts = None
+        with self._lock:
+            if self._failed:
+                # the attempt consumed a generation that a producer re-ran
+                # out from under it: it must not complete successfully —
+                # the retry reads the re-exchanged data
+                raise RuntimeError(self._failed)
         return []

@@ -63,7 +63,21 @@ class _SorterWriter(KeyValuesWriter):
                                         len(k) + len(v))
         self._n += 1
         if (self._n & 0x3FFF) == 0:
-            self.context.notify_progress()
+            self.context.notify_progress()   # liveness + kill check
+
+    def write_batch(self, batch: Any) -> None:
+        """Batch-first write path: a KVBatch of PRE-SERIALIZED records goes
+        straight to the sorter (no per-record Python).  Only valid with the
+        stock hash partitioner — a custom Partitioner sees logical records
+        and must use write()."""
+        if self.partition_fn is not None:
+            raise ValueError("write_batch requires the stock hash "
+                             "partitioner (custom Partitioner sees logical "
+                             "records)")
+        self.sorter.write_batch(batch)
+        self.context.counters.increment(TaskCounter.OUTPUT_BYTES,
+                                        batch.nbytes)
+        self.context.notify_progress()
 
 
 class OrderedPartitionedKVOutput(LogicalOutput):

@@ -260,15 +260,19 @@ class MeshExchangeCoordinator:
 
         # exact routing on host: byte-masked FNV over the padded key matrix
         # (reconstruct the byte matrix from lanes — cheap, vectorized)
-        kmat = np.zeros((total, num_lanes * 4), dtype=np.uint8)
-        for i in range(4):
-            kmat[:, i::4] = ((lanes >> (24 - 8 * i)) & 0xFF).astype(np.uint8)
+        from tez_tpu.ops.device import _bucket
+        from tez_tpu.ops.keycodec import lanes_to_matrix
+        kmat = lanes_to_matrix(lanes)
         part = (fnv_rows_host(kmat, klens.astype(np.int64)) %
                 np.uint32(W)).astype(np.int64)
         counts = np.bincount(part, minlength=W)
         max_part = int(counts.max())
         rounds = max(1, -(-max_part // self.max_rows_per_round))
-        cap = min(max_part, self.max_rows_per_round)
+        # power-of-two bucketing keeps the compiled-program cache keys
+        # stable across runs with slightly different cardinalities (the
+        # kernel tolerates extra capacity as padding)
+        cap = min(_bucket(min(max_part, self.max_rows_per_round)),
+                  self.max_rows_per_round)
 
         # rank of each row within its partition (stable arrival order)
         order = np.argsort(part, kind="stable")
@@ -285,7 +289,8 @@ class MeshExchangeCoordinator:
             n_round = sel.size
             if n_round == 0:
                 continue
-            N = -(-n_round // W)          # rows per worker, padded
+            # rows per worker, padded AND bucketed (stable compile keys)
+            N = _bucket(-(-n_round // W))
             pad = W * N - n_round
             r_lanes = np.concatenate(
                 [lanes[sel],
@@ -311,8 +316,10 @@ class MeshExchangeCoordinator:
             per_round_results.append([
                 _decode_rows(out_lanes[w], out_klens[w], out_vwords[w],
                              out_valid[w]) for w in range(W)])
-            self.rows_exchanged += n_round
-        self.exchanges_run += 1
+            with self.lock:
+                self.rows_exchanged += n_round
+        with self.lock:
+            self.exchanges_run += 1
 
         if len(per_round_results) == 1:
             return per_round_results[0]

@@ -1,0 +1,141 @@
+"""Estimator SPI unit tests (reference: SimpleExponentialTaskRuntimeEstimator
+vs LegacyTaskRuntimeEstimator — the two must disagree exactly where the
+exponential smoothing is the point)."""
+from __future__ import annotations
+
+import math
+
+import pytest
+
+from tez_tpu.am.estimators import (
+    DataStatistics,
+    LegacyRuntimeEstimator,
+    SimpleExponentialRuntimeEstimator,
+    create_estimator,
+)
+from tez_tpu.common import config as C
+
+
+def _conf(**over):
+    base = {
+        "tez.am.legacy.speculative.exponential.smooth.lambda-millis": 2_000,
+        "tez.am.legacy.speculative.exponential.stagnated.millis": 5_000,
+        "tez.am.legacy.speculative.exponential.skip.initials": 3,
+    }
+    base.update(over)
+    return C.TezConfiguration(base)
+
+
+def test_data_statistics():
+    s = DataStatistics()
+    for x in (2.0, 4.0, 6.0):
+        s.add(x)
+    assert s.mean() == pytest.approx(4.0)
+    assert s.std() == pytest.approx(math.sqrt(8 / 3))
+    assert s.outlier(1.0) == pytest.approx(4.0 + math.sqrt(8 / 3))
+
+
+def _feed(est, attempt, points):
+    """points: (timestamp, progress) pairs."""
+    est.enroll(attempt, points[0][0])
+    for t, p in points:
+        est.update_attempt(attempt, p, t)
+
+
+def test_exponential_forgives_slow_start():
+    """A task that crawled early but is moving fast NOW: the legacy
+    lifetime-average estimator condemns it; the smoothed estimator sees the
+    recent rate and predicts a short remaining time (the reason
+    SimpleExponentialTaskRuntimeEstimator exists)."""
+    # 0..20s: progress crawls to 0.1; 20..30s: sprints to 0.8
+    points = [(float(t), 0.005 * t) for t in range(0, 21)]
+    points += [(20.0 + t, 0.1 + 0.07 * t) for t in range(1, 11)]
+    now = 30.0
+
+    legacy = LegacyRuntimeEstimator()
+    legacy.contextualize(_conf(), "v")
+    legacy.attempt_succeeded(10.0)
+    _feed(legacy, "a0", points)
+    legacy_total = legacy.estimated_runtime("a0", now)
+
+    exp = SimpleExponentialRuntimeEstimator()
+    exp.contextualize(_conf(), "v")
+    exp.attempt_succeeded(10.0)
+    _feed(exp, "a0", points)
+    exp_total = exp.estimated_runtime("a0", now)
+
+    # legacy: 30s elapsed / 0.8 progress = 37.5s total -> straggler vs the
+    # 10s mean.  exponential: recent rate ~0.07/s -> ~33s total... still
+    # above mean, but the *relative* judgment flips at the decision gate:
+    assert legacy_total == pytest.approx(37.5, rel=0.01)
+    assert exp_total < legacy_total  # smoothing credits the recent sprint
+    # with a harsher slow start the gap is decisive
+    points2 = [(float(t), 0.001 * t) for t in range(0, 21)]
+    points2 += [(20.0 + t, 0.02 + 0.095 * t) for t in range(1, 11)]
+    legacy2 = LegacyRuntimeEstimator()
+    legacy2.contextualize(_conf(), "v")
+    _feed(legacy2, "a1", points2)
+    exp2 = SimpleExponentialRuntimeEstimator()
+    exp2.contextualize(_conf(), "v")
+    _feed(exp2, "a1", points2)
+    l2 = legacy2.estimated_runtime("a1", now)
+    e2 = exp2.estimated_runtime("a1", now)
+    # mean 10s, threshold 1.0 -> gate at 20s: legacy says ~31s (speculate),
+    # exponential says ~30.3s elapsed+remaining/0.095 ~ 30.3 < ... both
+    # above 20 in absolute terms, but exp2 must be well below l2 and close
+    # to the true finish (progress 0.97 at t=30, ~0.3s left).
+    assert e2 < 31.0 < l2 - 0.001 or e2 < l2 * 0.99
+    assert e2 == pytest.approx(30.0 + (1 - 0.97) / 0.095, rel=0.2)
+
+
+def test_exponential_catches_stagnation_legacy_does_not():
+    """A task that reached 0.9 quickly then froze: lifetime average says
+    'nearly done, fast' (legacy estimate ~ elapsed/0.9 — no speculation);
+    the smoothed estimator detects stagnation and returns infinity."""
+    points = [(float(t), 0.09 * t) for t in range(0, 11)]   # 0.9 @ t=10
+    points += [(10.0 + 2 * t, 0.9) for t in range(1, 6)]    # frozen to t=20
+    now = 20.0
+
+    legacy = LegacyRuntimeEstimator()
+    legacy.contextualize(_conf(), "v")
+    _feed(legacy, "a0", points)
+    assert legacy.estimated_runtime("a0", now) == pytest.approx(20 / 0.9,
+                                                                rel=0.01)
+    exp = SimpleExponentialRuntimeEstimator()
+    exp.contextualize(_conf(), "v")   # stagnation window 5s
+    _feed(exp, "a0", points)
+    assert exp.has_stagnated("a0", now)
+    assert exp.estimated_runtime("a0", now) == math.inf
+
+
+def test_skip_initials_withholds_estimate():
+    exp = SimpleExponentialRuntimeEstimator()
+    exp.contextualize(_conf(), "v")   # skip.initials = 3
+    exp.enroll("a0", 0.0)
+    exp.update_attempt("a0", 0.1, 0.0)
+    exp.update_attempt("a0", 0.2, 1.0)    # 1 rate sample
+    assert exp.estimated_runtime("a0", 2.0) is None
+    exp.update_attempt("a0", 0.3, 2.0)
+    exp.update_attempt("a0", 0.4, 3.0)    # 3 samples -> estimate appears
+    est = exp.estimated_runtime("a0", 3.0)
+    assert est == pytest.approx(3.0 + 0.6 / 0.1, rel=0.05)
+
+
+def test_registry_and_custom_class():
+    conf = _conf(**{"tez.am.legacy.speculative.estimator.class": "legacy"})
+    assert isinstance(create_estimator(conf, "v"), LegacyRuntimeEstimator)
+    conf2 = _conf(**{
+        "tez.am.legacy.speculative.estimator.class":
+            "tez_tpu.am.estimators:SimpleExponentialRuntimeEstimator"})
+    assert isinstance(create_estimator(conf2, "v"),
+                      SimpleExponentialRuntimeEstimator)
+
+
+def test_new_attempt_runtime_is_mean_of_completions():
+    exp = SimpleExponentialRuntimeEstimator()
+    exp.contextualize(_conf(), "v")
+    assert exp.estimated_new_attempt_runtime() is None
+    exp.attempt_succeeded(4.0)
+    exp.attempt_succeeded(6.0)
+    assert exp.estimated_new_attempt_runtime() == pytest.approx(5.0)
+    assert exp.threshold_runtime(1.0) == pytest.approx(6.0)

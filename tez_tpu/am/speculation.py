@@ -1,19 +1,24 @@
 """Speculative execution of straggler attempts.
 
-Reference parity: tez-dag/.../dag/speculation/legacy/LegacySpeculator.java:63
-with the SimpleExponentialTaskRuntimeEstimator idea collapsed to a
-progress-rate estimator: per-vertex mean runtime of completed tasks; a
-running attempt whose estimated completion (from its progress rate) exceeds
-the mean by the slowtask threshold gets a speculative sibling, at most one
-per task, and only while spare capacity exists.
+Reference parity: tez-dag/.../dag/speculation/legacy/LegacySpeculator.java:63.
+The runtime estimate comes from a pluggable ``TaskRuntimeEstimator``
+(``tez.am.legacy.speculative.estimator.class`` — see am/estimators.py):
+"simple_exponential" (default, smoothed recent progress rate + stagnation
+detection) or "legacy" (whole-lifetime progress rate).  A running attempt is
+speculated when its estimated completion is later than the estimated
+completion of a fresh replacement AND its estimated total runtime clears the
+slowtask threshold over the vertex mean; at most one new speculation per
+vertex per scan (the best candidate), one speculative sibling per task.
 """
 from __future__ import annotations
 
 import logging
+import math
 import threading
 import time
-from typing import Any, Dict
+from typing import Any, Dict, Set
 
+from tez_tpu.am.estimators import TaskRuntimeEstimator, create_estimator
 from tez_tpu.am.events import TaskEvent, TaskEventType
 from tez_tpu.am.task_impl import TaskAttemptState, TaskState
 from tez_tpu.common import config as C
@@ -34,6 +39,11 @@ class Speculator:
         self.dag = dag
         self.ctx = dag.ctx
         self.threshold = dag.conf.get(C.SPECULATION_SLOWTASK_THRESHOLD)
+        # fail fast on a bad estimator class name — a typo must surface at
+        # DAG submit, not as a logged exception every scan forever
+        create_estimator(dag.conf, "<probe>")
+        self.estimators: Dict[str, TaskRuntimeEstimator] = {}
+        self._fed_durations: Set[str] = set()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"speculator-{dag.dag_id}")
@@ -51,6 +61,13 @@ class Speculator:
             except BaseException:  # noqa: BLE001
                 log.exception("speculator scan failed")
 
+    def _estimator(self, vertex: Any) -> TaskRuntimeEstimator:
+        est = self.estimators.get(vertex.name)
+        if est is None:
+            est = create_estimator(self.dag.conf, vertex.name)
+            self.estimators[vertex.name] = est
+        return est
+
     def _scan(self) -> None:
         from tez_tpu.am.dag_impl import TERMINAL_DAG_STATES
         if self.dag.state in TERMINAL_DAG_STATES:
@@ -58,14 +75,20 @@ class Speculator:
             return
         now = time.time()
         for vertex in self.dag.vertices.values():
-            completed: list = []
+            est = self._estimator(vertex)
+            # feed newly completed durations into the vertex statistics
             for task in vertex.tasks.values():
                 att = task.successful_attempt_impl()
-                if att is not None and att.launch_time:
-                    completed.append(att.finish_time - att.launch_time)
-            if not completed:
-                continue
-            mean_runtime = sum(completed) / len(completed)
+                if att is not None and att.launch_time and \
+                        att.attempt_id not in self._fed_durations:
+                    self._fed_durations.add(att.attempt_id)
+                    est.attempt_succeeded(att.finish_time - att.launch_time)
+                    est.forget(att.attempt_id)  # prune per-attempt state
+            new_runtime = est.estimated_new_attempt_runtime()
+            if new_runtime is None:
+                continue   # nothing completed yet: no replacement estimate
+            best_task = None
+            best_value = 0.0
             for task in vertex.tasks.values():
                 if task.state is not TaskState.RUNNING:
                     continue
@@ -76,18 +99,33 @@ class Speculator:
                 if att.state is not TaskAttemptState.RUNNING or \
                         not att.launch_time:
                     continue
+                est.enroll(att.attempt_id, att.launch_time)
+                est.update_attempt(att.attempt_id, att.progress, now)
                 runtime = now - att.launch_time
                 if runtime < max(MIN_RUNTIME_BEFORE_SPECULATION,
-                                 mean_runtime * (1 + self.threshold)):
+                                 new_runtime * (1 + self.threshold)):
                     continue
-                # estimate completion from progress rate; no progress means
-                # estimate = infinity
-                progress = max(att.progress, 1e-6)
-                estimated_total = runtime / progress
-                if estimated_total <= mean_runtime * (1 + self.threshold):
+                estimated_total = est.estimated_runtime(att.attempt_id, now)
+                if estimated_total is None:
+                    continue   # estimator not confident yet
+                if estimated_total <= new_runtime * (1 + self.threshold):
                     continue
-                log.info("speculating %s (runtime %.2fs, mean %.2fs, "
-                         "progress %.2f)", att.attempt_id, runtime,
-                         mean_runtime, att.progress)
+                # speculation value: how much earlier a replacement would end
+                estimated_end = att.launch_time + estimated_total
+                replacement_end = now + new_runtime
+                value = estimated_end - replacement_end
+                if value <= 0:
+                    continue
+                if best_task is None or value > best_value:
+                    best_task, best_value = task, value
+                    best_info = (att, runtime, estimated_total, new_runtime)
+            if best_task is not None:
+                att, runtime, estimated_total, new_runtime = best_info
+                log.info("speculating %s (runtime %.2fs, estimate %s, "
+                         "new-attempt %.2fs, progress %.2f)",
+                         att.attempt_id, runtime,
+                         "inf" if math.isinf(estimated_total)
+                         else f"{estimated_total:.2f}s",
+                         new_runtime, att.progress)
                 self.ctx.dispatch(TaskEvent(
-                    TaskEventType.T_ADD_SPEC_ATTEMPT, task.task_id))
+                    TaskEventType.T_ADD_SPEC_ATTEMPT, best_task.task_id))

@@ -124,6 +124,18 @@ SPECULATION_SLOWTASK_THRESHOLD = _key(
     "tez.am.legacy.speculative.slowtask.threshold", 1.0, Scope.VERTEX)
 SPECULATION_ESTIMATOR = _key("tez.am.legacy.speculative.estimator.class",
                              "simple_exponential", Scope.VERTEX)
+SPECULATION_SMOOTH_LAMBDA_MS = _key(
+    "tez.am.legacy.speculative.exponential.smooth.lambda-millis", 30_000,
+    Scope.VERTEX,
+    "time constant of the exponentially-smoothed progress rate")
+SPECULATION_STAGNATED_MS = _key(
+    "tez.am.legacy.speculative.exponential.stagnated.millis", 90_000,
+    Scope.VERTEX,
+    "no progress change for this long marks the attempt stagnated "
+    "(estimate becomes infinite)")
+SPECULATION_SKIP_INITIALS = _key(
+    "tez.am.legacy.speculative.exponential.skip.initials", 8, Scope.VERTEX,
+    "progress samples to observe before trusting the smoothed estimate")
 DAG_RECOVERY_ENABLED = _key("tez.dag.recovery.enabled", True, Scope.AM)
 RECOVERY_TRUSTED_STAGING = _key(
     "tez.dag.recovery.trusted-staging", False, Scope.AM,

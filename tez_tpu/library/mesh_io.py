@@ -143,6 +143,7 @@ class MeshOrderedGroupedKVInput(LogicalInput):
         self._complete = set()
         self._failed: Optional[str] = None
         self._batch: Optional[KVBatch] = None
+        self._reading = False
         self._group_starts = None
         ctx.request_initial_memory(0, None,
                                    component_type="SORTED_MERGED_INPUT")
@@ -165,7 +166,7 @@ class MeshOrderedGroupedKVInput(LogicalInput):
                             f"edge's output class must be the mesh output")
                     self._complete.add(slot)
                 elif isinstance(ev, InputFailedEvent):
-                    if self._batch is not None:
+                    if self._batch is not None or self._reading:
                         # this attempt already materialized the (now stale)
                         # merged result: fail loudly; the retry waits for
                         # the coordinator's re-exchange below
@@ -184,7 +185,6 @@ class MeshOrderedGroupedKVInput(LogicalInput):
             self._lock.notify_all()
 
     def _wait_complete(self) -> None:
-        import time
         with self._lock:
             while len(self._complete) < self.num_physical_inputs:
                 if self._failed:
@@ -193,6 +193,11 @@ class MeshOrderedGroupedKVInput(LogicalInput):
                 self.context.notify_progress()
             if self._failed:
                 raise RuntimeError(self._failed)
+            # atomically with the final completeness check: any producer
+            # InputFailedEvent from here on marks this attempt failed —
+            # no window where a failure lands between this check and the
+            # batch read/assignment in get_reader
+            self._reading = True
 
     def get_reader(self) -> GroupedKVReader:
         with self._lock:
@@ -206,11 +211,15 @@ class MeshOrderedGroupedKVInput(LogicalInput):
             from tez_tpu.parallel.coordinator import mesh_coordinator
             edge = _edge_id(ctx.task_attempt_id.dag_id,
                             ctx.source_vertex_name, ctx.vertex_name)
-            self._batch = mesh_coordinator().wait_consumer(
+            batch = mesh_coordinator().wait_consumer(
                 edge, ctx.task_index,
                 num_producers=self.num_physical_inputs,
                 num_consumers=ctx.vertex_parallelism,
                 progress=ctx.notify_progress)
+            with self._lock:
+                if self._failed:
+                    raise RuntimeError(self._failed)
+                self._batch = batch
             ctx.counters.find_counter(TaskCounter.SHUFFLE_PHASE_TIME)\
                 .increment(int((time.time() - t0) * 1000))
             ctx.counters.increment(TaskCounter.REDUCE_INPUT_RECORDS,

@@ -222,6 +222,23 @@ SHUFFLE_FAILED_CHECK_SINCE_LAST_COMPLETION = _key(
 SHUFFLE_FETCH_MAX_TASK_OUTPUT_AT_ONCE = _key(
     "tez.runtime.shuffle.fetch.max.task.output.at.once", 20, Scope.VERTEX)
 SHUFFLE_NOTIFY_READERROR = _key("tez.runtime.shuffle.notify.readerror", True, Scope.VERTEX)
+SHUFFLE_HOST_PENALTY_BASE_MS = _key(
+    "tez.runtime.shuffle.host.penalty.base-ms", 250, Scope.VERTEX,
+    "initial penalty-box hold for a failing shuffle host; doubles per "
+    "consecutive failure (ShuffleScheduler Penalty/Referee analog)")
+SHUFFLE_HOST_PENALTY_CAP_MS = _key(
+    "tez.runtime.shuffle.host.penalty.cap-ms", 10_000, Scope.VERTEX)
+SHUFFLE_FETCH_ATTEMPTS = _key(
+    "tez.runtime.shuffle.fetch.attempts", 4, Scope.VERTEX,
+    "connection-level retries per fetch before InputReadErrorEvent")
+SHUFFLE_SPECULATIVE_FETCH_WAIT_MS = _key(
+    "tez.runtime.shuffle.speculative.fetch.wait-ms", 15_000, Scope.VERTEX,
+    "an in-flight fetch older than this gets a duplicate on a fresh "
+    "connection; first delivery wins")
+SHUFFLE_FETCHER_CLASS = _key(
+    "tez.runtime.shuffle.fetcher.class", "", Scope.VERTEX,
+    "injectable fetch-session factory (tests: FetcherWithInjectableErrors "
+    "analog); empty = TCP keep-alive session")
 SHUFFLE_CONNECT_TIMEOUT_MS = _key("tez.runtime.shuffle.connect.timeout", 12_000, Scope.VERTEX)
 SHUFFLE_READ_TIMEOUT_MS = _key("tez.runtime.shuffle.read.timeout", 30_000, Scope.VERTEX)
 COMPRESS = _key("tez.runtime.compress", False, Scope.VERTEX)

@@ -70,32 +70,88 @@ class ShuffleFetchTable:
         self.local_host = meta.get("host", "local")
         self.local_port = meta.get("port", 0)
         self._secret = meta.get("secret")
+        self._scheduler = None   # created on first remote payload
+        self._closing = False
+        # counters document a single-writer rule; fetch-pool deliveries come
+        # from many threads, so the table serializes ITS counter writes
+        self._deliver_lock = threading.Lock()
 
-    def _fetch(self, payload: ShufflePayload, partition: int) -> KVBatch:
-        """Local short-circuit or DCN socket fetch (Fetcher.java:288 local
-        short-circuit vs HTTP fetch split)."""
-        if payload.port == 0 or (payload.host, payload.port) == \
-                (self.local_host, self.local_port):
-            batch = self.service.fetch_partition(
-                payload.path_component, payload.spill_id, partition)
-            self.context.counters.increment(
-                TaskCounter.LOCAL_SHUFFLED_INPUTS)
-            return batch
-        from tez_tpu.shuffle.server import ShuffleFetcher
-        if self._secret is None:
-            # config gap on THIS consumer, not producer data loss: must not
-            # masquerade as a local fetch failure (which force-reruns the
-            # healthy producer)
-            raise PermissionError(
-                f"no shuffle secret for remote fetch from "
-                f"{payload.host}:{payload.port}")
-        fetcher = ShuffleFetcher(self._secret)
-        batch = fetcher.fetch(payload.host, payload.port,
-                              payload.path_component, payload.spill_id,
-                              partition)[0]
-        self.context.counters.increment(TaskCounter.SHUFFLE_BYTES_DISK_DIRECT,
-                                        batch.nbytes)
+    def _is_local(self, payload: ShufflePayload) -> bool:
+        return payload.port == 0 or (payload.host, payload.port) == \
+            (self.local_host, self.local_port)
+
+    def _scheduler_for_remote(self):
+        """DCN fetches go through the bounded fetcher pool with per-host
+        queues, coalescing, penalty box and speculative refetch
+        (ShuffleScheduler.java:91,179,295 analog; see shuffle/scheduler.py).
+        Created lazily: purely-local shuffles never pay for the threads."""
+        if self._scheduler is None:
+            from tez_tpu.common.payload import resolve_class
+            from tez_tpu.shuffle.scheduler import (FetchScheduler,
+                                                   TcpFetchSession)
+            from tez_tpu.common import config as C
+            ctx = self.context
+
+            def _k(key):   # one source of truth: the registered ConfKey
+                return _conf_get(ctx, key.name, key.default)
+
+            session_cls = _k(C.SHUFFLE_FETCHER_CLASS)
+            if session_cls:
+                factory = resolve_class(session_cls)
+            else:
+                factory = lambda h, p: TcpFetchSession(self._secret, h, p)  # noqa: E731
+            self._scheduler = FetchScheduler(
+                deliver=self._remote_done,
+                session_factory=factory,
+                num_fetchers=int(_k(C.SHUFFLE_PARALLEL_COPIES)),
+                max_per_fetch=int(_k(C.SHUFFLE_FETCH_MAX_TASK_OUTPUT_AT_ONCE)),
+                penalty_base=float(_k(C.SHUFFLE_HOST_PENALTY_BASE_MS)) / 1e3,
+                penalty_cap=float(_k(C.SHUFFLE_HOST_PENALTY_CAP_MS)) / 1e3,
+                max_attempts=int(_k(C.SHUFFLE_FETCH_ATTEMPTS)),
+                stall_timeout=float(
+                    _k(C.SHUFFLE_SPECULATIVE_FETCH_WAIT_MS)) / 1e3)
+        return self._scheduler
+
+    def shutdown(self) -> None:
+        self._closing = True
+        if self._scheduler is not None:
+            self._scheduler.stop()
+
+    def _fetch_local(self, payload: ShufflePayload,
+                     partition: int) -> KVBatch:
+        """Same-host short-circuit (Fetcher.java:288 local-disk fetch)."""
+        batch = self.service.fetch_partition(
+            payload.path_component, payload.spill_id, partition)
+        self.context.counters.increment(TaskCounter.LOCAL_SHUFFLED_INPUTS)
         return batch
+
+    def _fetch_error(self, slot: int, version: int, e: Exception) -> None:
+        log.warning("fetch failed for slot %d: %s", slot, e)
+        self.context.send_events([InputReadErrorEvent(
+            diagnostics=str(e), index=slot, version=version,
+            is_local_fetch=isinstance(e, ShuffleDataNotFound))])
+        with self._deliver_lock:
+            self.context.counters.increment(
+                TaskCounter.NUM_FAILED_SHUFFLE_INPUTS)
+
+    def _remote_done(self, req, batch, error) -> None:
+        """Fetch-pool delivery: runs on a fetcher thread."""
+        if self._closing:
+            return   # input closed mid-fetch: nothing to deliver into
+        slot, partition, payload, version, stamp, generation = req.cookie
+        if error is not None:
+            self._fetch_error(slot, version, error)
+            return
+        with self._deliver_lock:
+            self.context.counters.increment(TaskCounter.SHUFFLE_BYTES,
+                                            batch.nbytes)
+            self.context.counters.increment(
+                TaskCounter.SHUFFLE_BYTES_DISK_DIRECT, batch.nbytes)
+            if self.merge_manager is None:
+                self.context.counters.increment(
+                    TaskCounter.SHUFFLE_BYTES_TO_MEM, batch.nbytes)
+            self.context.counters.increment(TaskCounter.NUM_SHUFFLED_INPUTS)
+        self._commit_fetch(slot, payload, version, stamp, generation, batch)
 
     def on_payload(self, slot: int, partition: int, payload: ShufflePayload,
                    version: int = 0) -> None:
@@ -110,27 +166,46 @@ class ShuffleFetchTable:
             # slot while the (un-locked) fetch below runs, this stale
             # producer version's batch must not land in the fresh slot
         generation = mm.slot_generation(slot) if mm is not None else 0
+        if not payload.is_empty(partition) and not self._is_local(payload):
+            if self._secret is None:
+                # config gap on THIS consumer, not producer data loss: must
+                # not masquerade as a local fetch failure (which force-reruns
+                # the healthy producer)
+                self._fetch_error(slot, version, PermissionError(
+                    f"no shuffle secret for remote fetch from "
+                    f"{payload.host}:{payload.port}"))
+                return
+            from tez_tpu.shuffle.scheduler import FetchRequest
+            self._scheduler_for_remote().enqueue(FetchRequest(
+                payload.host, payload.port, payload.path_component,
+                payload.spill_id, partition,
+                cookie=(slot, partition, payload, version, stamp,
+                        generation)))
+            return
         try:
             if payload.is_empty(partition):
                 batch = None
             else:
-                batch = self._fetch(payload, partition)
-                self.context.counters.increment(
-                    TaskCounter.SHUFFLE_BYTES, batch.nbytes)
-                if mm is None:
-                    # with a merge manager the TO_MEM/TO_DISK split is its
-                    # admission decision, counted there exactly once
+                batch = self._fetch_local(payload, partition)
+                with self._deliver_lock:
                     self.context.counters.increment(
-                        TaskCounter.SHUFFLE_BYTES_TO_MEM, batch.nbytes)
-                self.context.counters.increment(TaskCounter.NUM_SHUFFLED_INPUTS)
+                        TaskCounter.SHUFFLE_BYTES, batch.nbytes)
+                    if mm is None:
+                        # with a merge manager the TO_MEM/TO_DISK split is
+                        # its admission decision, counted there exactly once
+                        self.context.counters.increment(
+                            TaskCounter.SHUFFLE_BYTES_TO_MEM, batch.nbytes)
+                    self.context.counters.increment(
+                        TaskCounter.NUM_SHUFFLED_INPUTS)
         except (ShuffleDataNotFound, ConnectionError, PermissionError) as e:
-            log.warning("fetch failed for slot %d: %s", slot, e)
-            self.context.send_events([InputReadErrorEvent(
-                diagnostics=str(e), index=slot, version=version,
-                is_local_fetch=isinstance(e, ShuffleDataNotFound))])
-            self.context.counters.increment(
-                TaskCounter.NUM_FAILED_SHUFFLE_INPUTS)
+            self._fetch_error(slot, version, e)
             return
+        self._commit_fetch(slot, payload, version, stamp, generation, batch)
+
+    def _commit_fetch(self, slot: int, payload: ShufflePayload, version: int,
+                      stamp: "_SlotState", generation: int,
+                      batch: Optional[KVBatch]) -> None:
+        mm = self.merge_manager
         if mm is not None and batch is not None:
             # bounded-memory admission; may stall while the background
             # merger frees memory (MergeManager.reserve():404 semantics).
@@ -326,6 +401,7 @@ class OrderedGroupedKVInput(LogicalInput):
         self._merged = None
         self._group_starts = None
         self._stream_plan = None
+        self.table.shutdown()
         if self.merge_manager is not None:
             self.merge_manager.cleanup()
         return []

@@ -169,3 +169,42 @@ class FlakyFetchOrderedInput(LogicalInput):
                     super().handle_events(passthrough)
 
         return _Impl(context, num_physical_inputs)
+
+
+class ScriptedFetchSession:
+    """Injectable fetch-session factory for tez.runtime.shuffle.fetcher.class
+    (reference: FetcherWithInjectableErrors — scripted fetch failures behind
+    the real seam).  Serves from the in-process shuffle service regardless of
+    host so no TCP server is needed; class-level script controls failures.
+
+    Script (class attributes, reset per test):
+      fail_remaining — first N fetches raise ConnectionError
+      sessions / fetch_log — observability for coalescing assertions
+    """
+
+    fail_remaining = 0
+    sessions: list = []
+    fetch_log: list = []
+
+    @classmethod
+    def reset(cls, fail_remaining: int = 0) -> None:
+        cls.fail_remaining = fail_remaining
+        cls.sessions = []
+        cls.fetch_log = []
+
+    def __init__(self, host: str, port: int):
+        type(self).sessions.append(self)
+        self.host, self.port = host, port
+
+    def fetch(self, path: str, spill: int, partition: int):
+        cls = type(self)
+        cls.fetch_log.append((self.host, path, spill, partition))
+        if cls.fail_remaining > 0:
+            cls.fail_remaining -= 1
+            raise ConnectionError("scripted fetch failure")
+        from tez_tpu.shuffle.service import local_shuffle_service
+        return local_shuffle_service().fetch_partition(path, spill,
+                                                       partition)
+
+    def close(self) -> None:
+        pass

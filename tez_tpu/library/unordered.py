@@ -298,4 +298,5 @@ class UnorderedKVInput(LogicalInput):
                                  self.context)
 
     def close(self) -> List[TezAPIEvent]:
+        self.table.shutdown()
         return []

@@ -1,0 +1,322 @@
+"""Fetch scheduling for the DCN shuffle path.
+
+Reference parity: tez-runtime-library/.../shuffle/orderedgrouped/
+ShuffleScheduler.java:91 — per-host queues (MapHost), a bounded fetcher
+pool (:295), multi-output coalescing per connection (keep-alive batching),
+a penalty DelayQueue with backoff Referee (:179-180), per-input retry
+accounting, and speculative refetch of stalled connections.
+
+TPU-first deltas: this scheduler only runs for inter-host (DCN) fetches —
+same-host handoffs short-circuit through tez_tpu.shuffle.service and
+intra-slice scatter-gather rides the ICI mesh exchange instead
+(parallel/coordinator.py), so the pool is sized for cross-slice stragglers,
+not the common path.
+"""
+from __future__ import annotations
+
+import heapq
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from tez_tpu.shuffle.service import ShuffleDataNotFound
+
+log = logging.getLogger(__name__)
+
+HostKey = Tuple[str, int]
+
+
+@dataclass
+class FetchRequest:
+    """One (source output, partition) to pull from one host."""
+    host: str
+    port: int
+    path: str
+    spill: int
+    partition: int
+    #: opaque caller cookie handed back on delivery
+    cookie: Any = None
+    attempts: int = 0
+    speculative: bool = False
+
+    @property
+    def key(self) -> Tuple[str, int, int]:
+        return (self.path, self.spill, self.partition)
+
+
+class _Host:
+    """MapHost analog: pending queue + penalty/busy state.  ``active`` is a
+    count, not a flag: a speculative refetch legitimately opens a second
+    concurrent connection, and serialization must resume only when BOTH are
+    done."""
+    __slots__ = ("key", "pending", "active", "penalized", "failures")
+
+    def __init__(self, key: HostKey) -> None:
+        self.key = key
+        self.pending: deque = deque()
+        self.active = 0
+        self.penalized = False
+        self.failures = 0
+
+
+class _Inflight:
+    __slots__ = ("host_key", "requests", "started")
+
+    def __init__(self, host_key: HostKey, requests: List[FetchRequest]):
+        self.host_key = host_key
+        self.requests = requests
+        self.started = time.time()
+
+
+class TcpFetchSession:
+    """One keep-alive connection serving many fetches (the server already
+    speaks multi-request keep-alive — shuffle/server.py _Handler.handle)."""
+
+    def __init__(self, secrets: Any, host: str, port: int,
+                 connect_timeout: float = 5.0):
+        from tez_tpu.shuffle.server import ShuffleFetcher
+        self._fetcher = ShuffleFetcher(secrets, retries=1,
+                                       connect_timeout=connect_timeout)
+        self.host = host
+        self.port = port
+
+    def fetch(self, path: str, spill: int, partition: int):
+        return self._fetcher.fetch(self.host, self.port, path, spill,
+                                   partition)[0]
+
+    def close(self) -> None:
+        pass
+
+
+class FetchScheduler:
+    """Bounded fetcher pool over per-host queues with penalty-box backoff.
+
+    ``deliver(request, batch, error)`` is invoked exactly once per enqueued
+    request key — batch on success (or ``None`` for a speculative duplicate
+    that lost the race... those are swallowed, not delivered), error after
+    the retry budget or on a definitive miss.
+    """
+
+    def __init__(self, deliver: Callable[[FetchRequest, Any, Optional[Exception]], None],
+                 session_factory: Callable[[str, int], Any],
+                 num_fetchers: int = 8,
+                 max_per_fetch: int = 20,
+                 penalty_base: float = 0.25,
+                 penalty_cap: float = 10.0,
+                 max_attempts: int = 4,
+                 stall_timeout: float = 15.0,
+                 name: str = "shuffle"):
+        self.deliver = deliver
+        self.session_factory = session_factory
+        self.num_fetchers = max(1, num_fetchers)
+        self.max_per_fetch = max(1, max_per_fetch)
+        self.penalty_base = penalty_base
+        self.penalty_cap = penalty_cap
+        self.max_attempts = max_attempts
+        self.stall_timeout = stall_timeout
+
+        self.lock = threading.Condition()
+        self.hosts: Dict[HostKey, _Host] = {}
+        self.ready: deque = deque()            # host keys with runnable work
+        self.penalties: List[Tuple[float, HostKey]] = []   # heap
+        self.inflight: Dict[int, _Inflight] = {}           # worker id -> batch
+        self.done_keys: Set[Tuple[str, int, int]] = set()  # delivered once
+        self.speculated: Set[Tuple[str, int, int]] = set()
+        self._stopped = False
+        self._workers = [
+            threading.Thread(target=self._worker, args=(i,), daemon=True,
+                             name=f"{name}-fetcher-{i}")
+            for i in range(self.num_fetchers)]
+        self._referee = threading.Thread(target=self._referee_loop,
+                                         daemon=True, name=f"{name}-referee")
+        for t in self._workers:
+            t.start()
+        self._referee.start()
+
+    # ------------------------------------------------------------------ API
+    def enqueue(self, req: FetchRequest) -> None:
+        key = (req.host, req.port)
+        with self.lock:
+            if self._stopped or req.key in self.done_keys:
+                return
+            host = self.hosts.get(key)
+            if host is None:
+                host = self.hosts[key] = _Host(key)
+            host.pending.append(req)
+            self._make_ready(host)
+            self.lock.notify()
+
+    def stop(self) -> None:
+        with self.lock:
+            self._stopped = True
+            self.lock.notify_all()
+
+    # ------------------------------------------------------------ internals
+    def _make_ready(self, host: _Host) -> None:
+        """Caller holds the lock."""
+        if host.active == 0 and not host.penalized and host.pending and \
+                host.key not in self.ready:
+            self.ready.append(host.key)
+
+    def _worker(self, worker_id: int) -> None:
+        while True:
+            with self.lock:
+                while not self.ready and not self._stopped:
+                    self.lock.wait(0.5)
+                if self._stopped:
+                    return
+                host = self.hosts[self.ready.popleft()]
+                batch_reqs: List[FetchRequest] = []
+                while host.pending and len(batch_reqs) < self.max_per_fetch:
+                    r = host.pending.popleft()
+                    if r.key in self.done_keys:
+                        continue
+                    batch_reqs.append(r)
+                if not batch_reqs:
+                    self._make_ready(host)
+                    continue
+                host.active += 1
+                self.inflight[worker_id] = _Inflight(host.key, batch_reqs)
+                self.lock.notify_all()   # referee recomputes its deadline
+            self._fetch_batch(worker_id, host, batch_reqs)
+
+    def _fetch_batch(self, worker_id: int, host: _Host,
+                     reqs: List[FetchRequest]) -> None:
+        """Open ONE session; fetch every request over it (coalescing)."""
+        session = None
+        completed = 0
+        failed_conn: Optional[Exception] = None
+        try:
+            session = self.session_factory(*host.key)
+            for i, req in enumerate(reqs):
+                try:
+                    batch = session.fetch(req.path, req.spill, req.partition)
+                except (ShuffleDataNotFound, PermissionError) as e:
+                    # definitive per-input miss: deliver, connection is fine
+                    self._deliver_once(req, None, e)
+                    completed = i + 1
+                    continue
+                except BaseException as e:  # noqa: BLE001 — conn-level fault
+                    failed_conn = e
+                    completed = i
+                    break
+                self._deliver_once(req, batch, None)
+                completed = i + 1
+        except BaseException as e:  # noqa: BLE001 — session open failed
+            failed_conn = e
+        finally:
+            if session is not None:
+                try:
+                    session.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        failed_out: List[Tuple[FetchRequest, Exception]] = []
+        with self.lock:
+            self.inflight.pop(worker_id, None)
+            host.active -= 1
+            if failed_conn is not None:
+                failed_out = self._host_failed(host, reqs[completed:],
+                                               failed_conn)
+            else:
+                host.failures = 0
+            self._make_ready(host)
+            self.lock.notify()
+        # outside the scheduler lock: delivery takes the caller's lock and
+        # the caller's threads take ours via enqueue — never hold both
+        for req, err in failed_out:
+            self._deliver_once(req, None, err)
+
+    def _deliver_once(self, req: FetchRequest, batch: Any,
+                      error: Optional[Exception]) -> None:
+        with self.lock:
+            if req.key in self.done_keys:
+                return      # speculative duplicate lost the race
+            self.done_keys.add(req.key)
+        try:
+            self.deliver(req, batch, error)
+        except BaseException:  # noqa: BLE001 — a callback fault must not
+            log.exception("fetch delivery failed for %s", req.key)
+
+    def _host_failed(self, host: _Host, rest: List[FetchRequest],
+                     error: Exception
+                     ) -> List[Tuple[FetchRequest, Exception]]:
+        """Caller holds the lock.  Penalize the host with exponential
+        backoff; requeue the unfetched requests; return the ones whose
+        retry budget is exhausted (caller delivers them lock-free)."""
+        host.failures += 1
+        penalty = min(self.penalty_cap,
+                      self.penalty_base * (2 ** (host.failures - 1)))
+        failed_out: List[Tuple[FetchRequest, Exception]] = []
+        for req in rest:
+            req.attempts += 1
+            if req.attempts >= self.max_attempts:
+                failed_out.append((req, ConnectionError(
+                    f"fetch {req.key} from {host.key[0]}:{host.key[1]} "
+                    f"failed after {req.attempts} attempts: {error!r}")))
+            else:
+                # speculative dups requeue too: the original may be stalled
+                # forever, so dropping the dup could mean NOTHING delivers
+                # this key (done_keys still dedups if both complete)
+                host.pending.appendleft(req)
+        if host.pending:
+            host.penalized = True
+            heapq.heappush(self.penalties,
+                           (time.time() + penalty, host.key))
+            log.info("penalty box: %s:%s for %.2fs (%d failures)",
+                     host.key[0], host.key[1], penalty, host.failures)
+        return failed_out
+
+    def _referee_loop(self) -> None:
+        """Releases penalized hosts when their penalty expires and issues
+        speculative duplicates for stalled in-flight fetches.  Sleeps until
+        the earliest deadline (penalty expiry or stall) rather than polling."""
+        with self.lock:
+            while not self._stopped:
+                now = time.time()
+                while self.penalties and self.penalties[0][0] <= now:
+                    _, key = heapq.heappop(self.penalties)
+                    host = self.hosts.get(key)
+                    if host is not None:
+                        host.penalized = False
+                        self._make_ready(host)
+                        self.lock.notify()
+                # speculative refetch: an in-flight batch older than the
+                # stall timeout gets duplicate requests on a NEW connection
+                # (the stuck one may be a dead socket, not a dead host);
+                # first completed delivery wins via done_keys
+                for infl in list(self.inflight.values()):
+                    if now - infl.started < self.stall_timeout:
+                        continue
+                    host = self.hosts.get(infl.host_key)
+                    if host is None:
+                        continue
+                    for req in infl.requests:
+                        if req.key in self.done_keys or \
+                                req.key in self.speculated:
+                            continue
+                        self.speculated.add(req.key)
+                        dup = FetchRequest(req.host, req.port, req.path,
+                                           req.spill, req.partition,
+                                           cookie=req.cookie,
+                                           attempts=req.attempts,
+                                           speculative=True)
+                        host.pending.append(dup)
+                        log.info("speculative refetch of %s from %s:%s",
+                                 req.key, req.host, req.port)
+                    # the stalled connection still counts in host.active;
+                    # allow one concurrent speculative connection
+                    if host.pending and not host.penalized and \
+                            host.key not in self.ready:
+                        self.ready.append(host.key)
+                        self.lock.notify()
+                deadline = self.penalties[0][0] if self.penalties else None
+                for infl in self.inflight.values():
+                    stall_at = infl.started + self.stall_timeout
+                    if deadline is None or stall_at < deadline:
+                        deadline = stall_at
+                wait = 5.0 if deadline is None else \
+                    max(0.01, deadline - time.time())
+                self.lock.wait(wait)

@@ -195,6 +195,9 @@ class _FakeVMContext:
     def vertex_reconfiguration_planned(self):
         pass
 
+    def vertex_reconfiguration_restored(self):
+        return False
+
     def done_reconfiguring_vertex(self):
         pass
 

@@ -450,3 +450,81 @@ def test_recovery_journal_pickle_gate():
 class _CarrierEvent:
     """Not a DME/CDME: forces the pickle wire kind (module-level so the
     allow_pickle=True leg can actually unpickle it)."""
+
+
+def test_am_recovery_restores_reconfigured_vertex(tmp_staging, tmp_path):
+    """A consumer shrunk by auto-parallelism before the crash keeps its
+    shrunk parallelism after recovery (the journaled reconfiguration is
+    re-applied, the manager does not re-decide) and the producer's completed
+    tasks are restored, not re-run (reference: RecoveryParser.java:658
+    restoring VertexConfigurationDoneEvent)."""
+    from tez_tpu.common.payload import VertexManagerPluginDescriptor
+    gate = str(tmp_path / "gate")
+    result = str(tmp_path / "result")
+    conf_kv = {"tez.runtime.key.class": "bytes",
+               "tez.runtime.value.class": "long"}
+    producer = Vertex.create("producer", ProcessorDescriptor.create(
+        EmitProcessor), 2)
+    consumer = Vertex.create("consumer", ProcessorDescriptor.create(
+        GatedCountProcessor,
+        payload={"gate_path": gate, "result_path": result}), 6)
+    consumer.set_vertex_manager_plugin(VertexManagerPluginDescriptor.create(
+        "tez_tpu.library.vertex_managers:ShuffleVertexManager",
+        payload={"auto_parallel": True,
+                 "desired_task_input_size": 1 << 30,
+                 "min_task_parallelism": 1,
+                 "min_fraction": 1.0, "max_fraction": 1.0}))
+    prop = EdgeProperty.create(
+        DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create(
+            "tez_tpu.library.outputs:OrderedPartitionedKVOutput",
+            payload=conf_kv),
+        InputDescriptor.create(
+            "tez_tpu.library.inputs:OrderedGroupedKVInput", payload=conf_kv))
+    dag = DAG.create("recov_reconf").add_vertex(producer).add_vertex(consumer)
+    dag.add_edge(Edge.create(producer, consumer, prop))
+    plan = dag.create_dag_plan()
+
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging,
+                               "tez.am.local.num-containers": 3})
+    am1 = DAGAppMaster("app_1_reconf", conf, attempt=1)
+    am1.start()
+    am1.submit_dag(plan)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = am1.current_dag.status_dict()
+        cons = st["vertices"].get("consumer", {})
+        if st["vertices"].get("producer", {}).get("state") == "SUCCEEDED" \
+                and cons.get("total_tasks") == 1:
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("producer never finished / consumer never shrank: "
+                    f"{am1.current_dag.status_dict()}")
+    am1.stop()            # crash: consumer reconfigured 6->1, gated
+
+    am2 = DAGAppMaster("app_1_reconf", conf, attempt=2)
+    am2.start()
+    recovered = am2.recover_and_resume()
+    assert recovered is not None
+    # the reconfiguration was RESTORED, not re-decided: 1 task as soon as
+    # the vertex exists (restore happens inside vertex init, before any
+    # source-completion stats could drive a fresh decision)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = am2.current_dag.status_dict()
+        cons = st["vertices"].get("consumer")
+        if cons is not None and cons.get("state") not in ("NEW",):
+            break
+        time.sleep(0.05)
+    assert st["vertices"]["consumer"]["total_tasks"] == 1, st["vertices"]
+    open(gate, "w").close()
+    assert am2.wait_for_dag(recovered, timeout=60) is DAGState.SUCCEEDED
+    with open(result) as fh:
+        assert int(fh.read()) == 100  # 2 producers x 50 records x value 1
+    d = am2.dag_counters.to_dict().get("DAGCounter", {})
+    # producers restored from the journal; only the consumer launched
+    assert d.get("TOTAL_LAUNCHED_TASKS", 0) == 1
+    assert d.get("NUM_SUCCEEDED_TASKS", 0) == 3
+    am2.stop()

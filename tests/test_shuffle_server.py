@@ -205,3 +205,25 @@ def _pipe_pair():
 
     ch = _Chan()
     return ch, ch
+
+
+def test_fetch_session_many_fetches_one_connection(served_run):
+    """FetchSession: one TCP connect + one nonce handshake serves many
+    requests (the coalescing transport the fetch scheduler batches onto);
+    a definitive miss leaves the connection usable."""
+    from tez_tpu.shuffle.server import FetchSession
+    from tez_tpu.shuffle.service import ShuffleDataNotFound
+    server, secrets, run = served_run
+    s = FetchSession(secrets, "127.0.0.1", server.port)
+    try:
+        for p in range(3):
+            got = s.fetch("dagX/attempt_1/cons", -1, p)
+            assert list(got.iter_pairs()) == \
+                list(run.partition(p).iter_pairs())
+        with pytest.raises(ShuffleDataNotFound):
+            s.fetch("no/such/output", -1, 0)
+        # connection still serves after the miss
+        got = s.fetch("dagX/attempt_1/cons", -1, 1)
+        assert got.num_records == run.partition(1).num_records
+    finally:
+        s.close()

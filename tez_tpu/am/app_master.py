@@ -208,12 +208,16 @@ class DAGAppMaster:
         self.history_handler.handle(event)
 
     def history_vertex_configured(self, vertex: Any) -> None:
+        data = {"vertex_name": vertex.name, "num_tasks": vertex.num_tasks}
+        reconfig = getattr(vertex, "_reconfig_journal", None)
+        if reconfig is not None:
+            # enough to REPLAY the manager's decision on AM restart
+            data["reconfig"] = reconfig
         self.history(HistoryEvent(
             HistoryEventType.VERTEX_CONFIGURE_DONE,
             dag_id=str(vertex.vertex_id.dag_id),
             vertex_id=str(vertex.vertex_id),
-            data={"vertex_name": vertex.name,
-                  "num_tasks": vertex.num_tasks}))
+            data=data))
 
     def submit_to_executor(self, fn: Any) -> None:
         self.executor.submit(fn)

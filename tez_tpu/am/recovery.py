@@ -72,6 +72,12 @@ class DAGRecoveryData:
     # (VERTEX_COMMIT_STARTED followed by that vertex's VERTEX_FINISHED) —
     # recovery must not commit them a second time.
     committed_vertices: Set[str] = dataclasses.field(default_factory=set)
+    # vertex name -> journaled reconfiguration ({"parallelism": n, "edges":
+    # {src: {"class_name", "payload"}}}) from the last VERTEX_CONFIGURE_DONE
+    # that carried one; the recovering AM re-applies it so the vertex's
+    # completed tasks stay restorable (RecoveryParser.java:658 semantics).
+    vertex_reconfig: Dict[str, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
 
 
 # ---------------------------------------------------------------------------
@@ -217,6 +223,7 @@ class RecoveryParser:
         pending_vertex_commits: Set[str] = set()
         pending_group_commits: Set[str] = set()
         committed_vertices: Set[str] = set()
+        vertex_reconfig: Dict[str, Dict[str, Any]] = {}
         completed_vertices: Dict[str, Dict[str, Any]] = {}
         attempt_records: Dict[str, Dict[str, Any]] = {}  # attempt id -> data
         task_last: Dict[str, Dict[str, Any]] = {}        # task id -> last finish
@@ -246,6 +253,8 @@ class RecoveryParser:
                 n = ev.data.get("num_tasks")
                 if name is not None and n is not None:
                     vertex_num_tasks[name] = n
+                if name is not None and ev.data.get("reconfig") is not None:
+                    vertex_reconfig[name] = ev.data["reconfig"]
             elif t is HistoryEventType.TASK_ATTEMPT_FINISHED and \
                     ev.data.get("state") == "SUCCEEDED":
                 attempt_records[ev.attempt_id] = ev.data
@@ -275,4 +284,5 @@ class RecoveryParser:
             completed_vertices=completed_vertices,
             succeeded_tasks=succeeded_tasks, events=dag_events,
             task_data=task_data, vertex_num_tasks=vertex_num_tasks,
-            committed_vertices=committed_vertices)
+            committed_vertices=committed_vertices,
+            vertex_reconfig=vertex_reconfig)

@@ -212,6 +212,7 @@ class VertexImpl:
             self._abort("FAILED")
             return VertexState.FAILED
         self._create_tasks()
+        self._maybe_restore_reconfiguration()
         self._load_recovered_tasks()
         self._create_committers()
         self._create_vertex_manager()
@@ -261,6 +262,60 @@ class VertexImpl:
             committer.initialize()
             committer.setup_output()
             self.committers[sink.name] = committer
+
+    def _maybe_restore_reconfiguration(self) -> None:
+        """Re-apply a journaled auto-parallelism reconfiguration BEFORE task
+        recovery, so the vertex's completed tasks remain restorable and the
+        vertex manager does not re-decide (reference: recovered
+        VertexConfigurationDoneEvent, RecoveryParser.java:658).  Any decode
+        failure degrades to re-running the vertex from scratch."""
+        rec = getattr(self.dag, "recovery_data", None)
+        if rec is None:
+            return
+        rc = getattr(rec, "vertex_reconfig", {}).get(self.name)
+        if rc is None:
+            return
+        from tez_tpu.am.recovery import (UntrustedJournalPayload,
+                                          _payload_from_wire)
+        from tez_tpu.common.payload import EdgeManagerPluginDescriptor
+        from tez_tpu.dag.edge_property import EdgeProperty
+        allow_pickle = bool(self.conf.get(C.RECOVERY_TRUSTED_STAGING))
+        try:
+            decoded = {}
+            for src_name, ed in (rc.get("edges") or {}).items():
+                decoded[src_name] = EdgeManagerPluginDescriptor.create(
+                    ed["class_name"],
+                    payload=_payload_from_wire(ed["payload"],
+                                               allow_pickle=allow_pickle))
+        except UntrustedJournalPayload as e:
+            log.warning("vertex %s: journaled reconfiguration not restored "
+                        "(%s); vertex re-runs and re-decides", self.name, e)
+            return
+        except Exception as e:  # noqa: BLE001 — corrupt journal entry must
+            # degrade to a clean re-run, never fail the recovery
+            log.warning("vertex %s: reconfiguration journal undecodable "
+                        "(%s: %s); vertex re-runs", self.name,
+                        type(e).__name__, e)
+            return
+        parallelism = rc.get("parallelism")
+        if parallelism is not None and parallelism != self.num_tasks:
+            self._recreate_tasks(parallelism)
+        for src_name, desc in decoded.items():
+            edge = self.in_edges.get(src_name)
+            if edge is None:
+                continue
+            prop = edge.edge_property
+            edge.edge_property = EdgeProperty.create_custom(
+                desc, prop.data_source_type, prop.edge_source,
+                prop.edge_destination, prop.scheduling_type)
+            edge.set_edge_manager(desc)
+        self._reconfig_restored = True
+        self._reconfig_journal = rc   # re-journal on this attempt's
+        # CONFIGURE_DONE so a THIRD AM attempt can restore it again
+        log.info("vertex %s: restored journaled reconfiguration "
+                 "(parallelism=%s, %d edges)", self.name, parallelism,
+                 len(decoded))
+        self.ctx.history_vertex_configured(self)
 
     def _load_recovered_tasks(self) -> None:
         """AM recovery: map journaled SUCCEEDED tasks onto this vertex's task

@@ -89,7 +89,9 @@ class _VMContext(VertexManagerPluginContext):
         v = self.vertex
         if parallelism >= 0 and parallelism != v.num_tasks:
             v._recreate_tasks(parallelism)
+        edge_journal = {}
         if source_edge_properties:
+            from tez_tpu.am.recovery import _payload_to_wire
             for src_name, prop in source_edge_properties.items():
                 edge = v.in_edges.get(src_name)
                 if edge is None:
@@ -97,9 +99,22 @@ class _VMContext(VertexManagerPluginContext):
                 edge.edge_property = prop
                 if prop.edge_manager_descriptor is not None:
                     edge.set_edge_manager(prop.edge_manager_descriptor)
+                    desc = prop.edge_manager_descriptor
+                    edge_journal[src_name] = {
+                        "class_name": desc.class_name,
+                        "payload": _payload_to_wire(desc.payload.load()),
+                    }
+        # journaled via VERTEX_CONFIGURE_DONE so a recovering AM can RESTORE
+        # this decision instead of re-running the vertex from scratch
+        # (reference: VertexConfigurationDoneEvent in RecoveryParser.java:658)
+        v._reconfig_journal = {"parallelism": v.num_tasks,
+                               "edges": edge_journal}
 
     def vertex_reconfiguration_planned(self) -> None:
         self._reconfig_planned = True
+
+    def vertex_reconfiguration_restored(self) -> bool:
+        return getattr(self.vertex, "_reconfig_restored", False)
 
     def done_reconfiguring_vertex(self) -> None:
         self._reconfig_planned = False

@@ -69,6 +69,12 @@ class VertexManagerPluginContext(abc.ABC):
     @abc.abstractmethod
     def done_reconfiguring_vertex(self) -> None: ...
 
+    def vertex_reconfiguration_restored(self) -> bool:
+        """True when a recovering AM already re-applied a journaled
+        reconfiguration of this vertex — the manager must NOT re-decide
+        parallelism (reference: recovered VertexConfigurationDoneEvent)."""
+        return False
+
     @abc.abstractmethod
     def send_event_to_processor(self, events: Sequence[Any],
                                 task_indices: Sequence[int]) -> None: ...

@@ -173,6 +173,11 @@ class FairShuffleVertexManager(ShuffleVertexManager):
     def _try_determine_parallelism(self) -> bool:
         if self._parallelism_determined:
             return True
+        if self.context.vertex_reconfiguration_restored():
+            # recovery already re-applied the journaled slicing; a fresh
+            # decision could slice differently and orphan restored tasks
+            self._parallelism_determined = True
+            return True
         sg_sources = self._sg_source_names()
         if not sg_sources:
             self._parallelism_determined = True

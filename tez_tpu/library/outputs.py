@@ -111,11 +111,9 @@ class OrderedPartitionedKVOutput(LogicalOutput):
         spill_codec = None
         if _conf_get(ctx, "tez.runtime.compress", False):
             spill_codec = _conf_get(ctx, "tez.runtime.compress.codec", "zlib")
-            if spill_codec != "zlib":
-                # silently-off compression is worse than a loud error
-                raise ValueError(
-                    f"unsupported tez.runtime.compress.codec {spill_codec!r}"
-                    " (supported: zlib)")
+            from tez_tpu.ops.runformat import resolve_codec
+            resolve_codec(spill_codec)   # loud error on unknown/unavailable
+            # codecs at initialize() — silently-off compression is worse
         self.sorter = DeviceSorter(
             num_partitions=self.num_physical_outputs,
             key_width=key_width,

@@ -231,6 +231,12 @@ class ShuffleVertexManager(VertexManagerPlugin):
     def _try_determine_parallelism(self) -> bool:
         if self._parallelism_determined:
             return True
+        if self.context.vertex_reconfiguration_restored():
+            # a recovering AM already re-applied the journaled decision;
+            # re-deciding here could shrink differently and orphan the
+            # restored tasks (reference: recovered VertexConfigurationDone)
+            self._parallelism_determined = True
+            return True
         total_sources = self._total_source_tasks()
         if total_sources == 0:
             self._parallelism_determined = True

@@ -23,6 +23,55 @@ import numpy as np
 MAGIC = b"TPRUN1"
 
 
+def _zstd_codec():
+    import zstandard   # baked into the image; gate loudly if ever absent
+    comp = zstandard.ZstdCompressor(level=1)
+    dec = zstandard.ZstdDecompressor()
+    return comp.compress, dec.decompress
+
+
+def _lz4_codec():
+    try:
+        import lz4.frame
+    except ImportError:
+        raise ValueError(
+            "run codec 'lz4' requires the lz4 module, which is not "
+            "available in this environment (supported here: zlib, zstd)"
+        ) from None
+    return lz4.frame.compress, lz4.frame.decompress
+
+
+#: codec name -> (wire flag, lazy (compress, decompress) factory).  The flag
+#: is stored in the run header, so blobs stay self-describing across codec
+#: config changes (reference: per-stream codec in IFile.java:67).
+_CODECS = {
+    None: (0, lambda: (lambda b: b, lambda b: b)),
+    "zlib": (1, lambda: (lambda b: zlib.compress(b, 1), zlib.decompress)),
+    "zstd": (2, _zstd_codec),
+    "lz4": (3, _lz4_codec),
+}
+_FLAG_TO_NAME = {flag: name for name, (flag, _) in _CODECS.items()}
+
+
+def resolve_codec(codec: Optional[str]):
+    """-> (wire flag, compress, decompress); loud error on unknown names —
+    an unknown codec silently writing uncompressed is worse."""
+    entry = _CODECS.get(codec)
+    if entry is None:
+        raise ValueError(f"unsupported run codec {codec!r} "
+                         f"(supported: zlib, zstd, lz4)")
+    flag, factory = entry
+    compress, decompress = factory()
+    return flag, compress, decompress
+
+
+def resolve_codec_flag(flag: int):
+    if flag not in _FLAG_TO_NAME:
+        raise ValueError(f"unknown run codec flag {flag}")
+    name = _FLAG_TO_NAME[flag]
+    return (name,) + resolve_codec(name)[1:]
+
+
 def _ranges(lengths: np.ndarray) -> np.ndarray:
     """[3,1,2] -> [0,1,2, 0, 0,1] (per-segment aranges)."""
     total = int(lengths.sum())
@@ -172,25 +221,18 @@ class Run:
 
     # -- host-spill serialization (checksummed; IFileOutputStream analog) ----
     def to_bytes(self, codec: Optional[str] = None) -> bytes:
-        if codec not in (None, "zlib"):
-            # an unknown codec silently writing uncompressed is worse than
-            # a loud error at the layer that interprets the value
-            raise ValueError(f"unsupported run codec {codec!r} "
-                             "(supported: zlib)")
+        flag, compress, _ = resolve_codec(codec)
         buf = io.BytesIO()
         arrays = (self.batch.key_bytes, self.batch.key_offsets,
                   self.batch.val_bytes, self.batch.val_offsets,
                   self.row_index)
         for a in arrays:
-            raw = np.ascontiguousarray(a).tobytes()
-            if codec == "zlib":
-                raw = zlib.compress(raw, 1)
+            raw = compress(np.ascontiguousarray(a).tobytes())
             buf.write(struct.pack("<cQ", a.dtype.char.encode(), len(raw)))
             buf.write(raw)
         payload = buf.getvalue()
         header = MAGIC + struct.pack(
-            "<BIQ", 1 if codec == "zlib" else 0,
-            zlib.crc32(payload), len(payload))
+            "<BIQ", flag, zlib.crc32(payload), len(payload))
         return header + payload
 
     @staticmethod
@@ -198,18 +240,20 @@ class Run:
         if data[:len(MAGIC)] != MAGIC:
             raise IOError(f"bad run magic in {where}")
         off = len(MAGIC)
-        compressed, crc, size = struct.unpack_from("<BIQ", data, off)
+        flag, crc, size = struct.unpack_from("<BIQ", data, off)
         off += 1 + 4 + 8
         payload = data[off:off + size]
         if zlib.crc32(payload) != crc:
             raise IOError(f"checksum mismatch in {where}")
+        try:
+            _, _, decompress = resolve_codec_flag(flag)
+        except ValueError as e:
+            raise IOError(f"{e} in {where}") from None
         buf = io.BytesIO(payload)
         arrays = []
         for _ in range(5):
             dtype_c, length = struct.unpack("<cQ", buf.read(9))
-            raw = buf.read(length)
-            if compressed:
-                raw = zlib.decompress(raw)
+            raw = decompress(buf.read(length))
             arrays.append(np.frombuffer(raw, dtype=np.dtype(
                 dtype_c.decode())).copy())
         kb, ko, vb, vo, ri = arrays

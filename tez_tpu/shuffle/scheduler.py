@@ -71,24 +71,13 @@ class _Inflight:
         self.started = time.time()
 
 
-class TcpFetchSession:
-    """One keep-alive connection serving many fetches (the server already
-    speaks multi-request keep-alive — shuffle/server.py _Handler.handle)."""
-
-    def __init__(self, secrets: Any, host: str, port: int,
-                 connect_timeout: float = 5.0):
-        from tez_tpu.shuffle.server import ShuffleFetcher
-        self._fetcher = ShuffleFetcher(secrets, retries=1,
-                                       connect_timeout=connect_timeout)
-        self.host = host
-        self.port = port
-
-    def fetch(self, path: str, spill: int, partition: int):
-        return self._fetcher.fetch(self.host, self.port, path, spill,
-                                   partition)[0]
-
-    def close(self) -> None:
-        pass
+def TcpFetchSession(secrets: Any, host: str, port: int,
+                    connect_timeout: float = 5.0):
+    """Real transport session: ONE TCP connect + nonce handshake, many
+    fetches (shuffle/server.py FetchSession — the server's handler loops
+    per connection)."""
+    from tez_tpu.shuffle.server import FetchSession
+    return FetchSession(secrets, host, port, connect_timeout)
 
 
 class FetchScheduler:
@@ -293,6 +282,7 @@ class FetchScheduler:
                     host = self.hosts.get(infl.host_key)
                     if host is None:
                         continue
+                    added = 0
                     for req in infl.requests:
                         if req.key in self.done_keys or \
                                 req.key in self.speculated:
@@ -304,16 +294,23 @@ class FetchScheduler:
                                            attempts=req.attempts,
                                            speculative=True)
                         host.pending.append(dup)
+                        added += 1
                         log.info("speculative refetch of %s from %s:%s",
                                  req.key, req.host, req.port)
                     # the stalled connection still counts in host.active;
-                    # allow one concurrent speculative connection
-                    if host.pending and not host.penalized and \
+                    # allow ONE concurrent speculative connection — only
+                    # when this pass actually issued new duplicates
+                    if added and not host.penalized and \
                             host.key not in self.ready:
                         self.ready.append(host.key)
                         self.lock.notify()
                 deadline = self.penalties[0][0] if self.penalties else None
                 for infl in self.inflight.values():
+                    if all(r.key in self.speculated or r.key in self.done_keys
+                           for r in infl.requests):
+                        continue   # fully handled: its stall deadline is
+                        # moot — never a reason to wake (avoids a 100Hz spin
+                        # while a slow-but-alive batch drains)
                     stall_at = infl.started + self.stall_timeout
                     if deadline is None or stall_at < deadline:
                         deadline = stall_at

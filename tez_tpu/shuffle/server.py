@@ -140,6 +140,58 @@ class ShuffleServer:
         self._tcp.server_close()
 
 
+class FetchSession:
+    """One keep-alive connection serving many fetches — the server's handler
+    loops per connection, so N map outputs coalesce onto one TCP connect +
+    one nonce handshake (ShuffleHandler keep-alive batching;
+    Fetcher.java's multi-output-per-connection fetch).
+
+    Per-request misses (not_found/forbidden) leave the connection usable;
+    OSError/struct.error mean the connection is dead — the caller discards
+    the session."""
+
+    def __init__(self, secrets: JobTokenSecretManager, host: str, port: int,
+                 connect_timeout: float = 5.0):
+        self.secrets = secrets
+        self.host, self.port = host, port
+        self._sk = socket.create_connection((host, port),
+                                            timeout=connect_timeout)
+        self._fh = self._sk.makefile("rb")
+        self._nonce = self._fh.read(16)
+        if len(self._nonce) != 16:
+            self.close()
+            raise ConnectionError("shuffle server closed before nonce")
+
+    def fetch_range(self, path: str, spill: int, lo: int,
+                    hi: int) -> List[KVBatch]:
+        req = json.dumps({
+            "path": path, "spill": spill,
+            "partition_lo": lo, "partition_hi": hi,
+            "hmac": hash_from_request(self.secrets, path, spill, lo, hi,
+                                      self._nonce).hex(),
+        }).encode()
+        self._sk.sendall(struct.pack("<I", len(req)) + req)
+        (hdr_len,) = struct.unpack("<I", self._fh.read(4))
+        header = json.loads(self._fh.read(hdr_len))
+        status = header.get("status")
+        if status == "not_found":
+            raise ShuffleDataNotFound(f"{path}/{spill}")
+        if status != "ok":
+            raise PermissionError(f"shuffle fetch {status}: {path}")
+        return [_blob_to_batch(self._fh.read(size))
+                for size in header["sizes"]]
+
+    def fetch(self, path: str, spill: int, partition: int) -> KVBatch:
+        return self.fetch_range(path, spill, partition, partition + 1)[0]
+
+    def close(self) -> None:
+        for closer in (self._fh.close, self._sk.close):
+            try:
+                closer()
+            except OSError:
+                pass
+
+
 class ShuffleFetcher:
     """Client side: fetch with retry/backoff (Fetcher.java penalty-box lite).
 
@@ -160,8 +212,13 @@ class ShuffleFetcher:
         last: Optional[Exception] = None
         for attempt in range(self.retries):
             try:
-                return self._fetch_once(host, port, path, spill,
-                                        partition_lo, partition_hi)
+                session = FetchSession(self.secrets, host, port,
+                                       self.connect_timeout)
+                try:
+                    return session.fetch_range(path, spill, partition_lo,
+                                               partition_hi)
+                finally:
+                    session.close()
             except (ShuffleDataNotFound, PermissionError):
                 raise   # definitive: retrying cannot help
             except (OSError, ValueError, struct.error) as e:
@@ -173,28 +230,3 @@ class ShuffleFetcher:
         raise ConnectionError(
             f"fetch {host}:{port}/{path} failed after "
             f"{self.retries} tries: {last!r}")
-
-    def _fetch_once(self, host: str, port: int, path: str, spill: int,
-                    lo: int, hi: int) -> List[KVBatch]:
-        with socket.create_connection((host, port),
-                                      timeout=self.connect_timeout) as sk:
-            fh = sk.makefile("rb")
-            nonce = fh.read(16)
-            if len(nonce) != 16:
-                raise ConnectionError("shuffle server closed before nonce")
-            req = json.dumps({
-                "path": path, "spill": spill,
-                "partition_lo": lo, "partition_hi": hi,
-                "hmac": hash_from_request(self.secrets, path, spill, lo, hi,
-                                          nonce).hex(),
-            }).encode()
-            sk.sendall(struct.pack("<I", len(req)) + req)
-            (hdr_len,) = struct.unpack("<I", fh.read(4))
-            header = json.loads(fh.read(hdr_len))
-            status = header.get("status")
-            if status == "not_found":
-                raise ShuffleDataNotFound(f"{path}/{spill}")
-            if status != "ok":
-                raise PermissionError(f"shuffle fetch {status}: {path}")
-            return [
-                _blob_to_batch(fh.read(size)) for size in header["sizes"]]

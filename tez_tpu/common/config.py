@@ -239,6 +239,11 @@ SHUFFLE_FETCHER_CLASS = _key(
     "tez.runtime.shuffle.fetcher.class", "", Scope.VERTEX,
     "injectable fetch-session factory (tests: FetcherWithInjectableErrors "
     "analog); empty = TCP keep-alive session")
+TPU_RESIDENT_KEYS = _key(
+    "tez.runtime.tpu.resident.keys", True, Scope.VERTEX,
+    "keep sorted key lanes in HBM for downstream device merges "
+    "(~(key width + 4) B/row pinned per registered output until DAG "
+    "deletion; outside the host memory budgets)")
 SHUFFLE_CONNECT_TIMEOUT_MS = _key("tez.runtime.shuffle.connect.timeout", 12_000, Scope.VERTEX)
 SHUFFLE_READ_TIMEOUT_MS = _key("tez.runtime.shuffle.read.timeout", 30_000, Scope.VERTEX)
 COMPRESS = _key("tez.runtime.compress", False, Scope.VERTEX)

@@ -126,6 +126,8 @@ class OrderedPartitionedKVOutput(LogicalOutput):
             merge_factor=merge_factor,
             key_normalizer=load_comparator(ctx),
             spill_codec=spill_codec,
+            resident_keys=bool(_conf_get(
+                ctx, "tez.runtime.tpu.resident.keys", True)),
         )
         ctx.request_initial_memory(sort_mb << 20, None,
                            component_type="PARTITIONED_SORTED_OUTPUT")

@@ -143,6 +143,136 @@ def _lsd_passes(partitions: jnp.ndarray, lanes: jnp.ndarray,
     return sorted_parts.astype(jnp.int32), perm
 
 
+# ---------------------------------------------------------------------------
+# device-resident span sort + merge (VERDICT r1 item 4: the framework's hot
+# path keeps key material in HBM across sort -> shuffle -> merge; the host
+# only sees permutations and does the leaf ragged gathers).  Only valid when
+# every key fits the lane width — then lanes+lengths ARE the full key, the
+# FNV hash can be derived on device (no separate hash-matrix upload) and
+# prefix order IS exact byte order (no tie-break pass).
+# ---------------------------------------------------------------------------
+def _fnv_rows_from_lanes(lanes: jnp.ndarray,
+                         lengths: jnp.ndarray) -> jnp.ndarray:
+    """FNV-1a over each row's first `lengths[i]` bytes, reconstructed from
+    the big-endian u32 lanes (keycodec.matrix_to_lanes packing).  Exact
+    parity with _fnv_rows/HashPartitioner when true length <= lane bytes."""
+    h = jnp.full((lanes.shape[0],), FNV_OFFSET, dtype=jnp.uint32)
+    for j in range(lanes.shape[1] * 4):     # static unroll, W is small
+        byte = (lanes[:, j // 4] >> (24 - 8 * (j % 4))) & jnp.uint32(0xFF)
+        nh = ((h ^ byte) * FNV_PRIME).astype(jnp.uint32)
+        h = jnp.where(j < lengths, nh, h)
+    return h
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_partitions", "skip_length_pass"))
+def _fused_resident_hash_sort(lanes: jnp.ndarray, lengths: jnp.ndarray,
+                              num_partitions: int,
+                              skip_length_pass: bool = False
+                              ) -> Tuple[jnp.ndarray, ...]:
+    """hash-from-lanes + LSD sort; ALSO returns the sorted key columns as
+    device arrays so downstream merges never re-upload them.  Sentinel rows
+    (length < 0) take partition MAX and sort to the tail."""
+    h = _fnv_rows_from_lanes(lanes, lengths)
+    partitions = jnp.where(
+        lengths < 0, jnp.int32(np.iinfo(np.int32).max),
+        (h % jnp.uint32(num_partitions)).astype(jnp.int32))
+    sort_lens = jnp.where(lengths < 0, jnp.uint32(0xFFFFFFFF),
+                          lengths.astype(jnp.uint32))
+    sp, perm = _lsd_passes(partitions, lanes, sort_lens, skip_length_pass)
+    return sp, perm, lanes[perm], lengths[perm]
+
+
+def hash_sort_span_resident(lanes: np.ndarray, lengths: np.ndarray,
+                            num_partitions: int):
+    """Fused span kernel, resident flavor: upload = lanes + lengths ONLY
+    (~20B/row vs ~36B for the matrix path); returns host (sorted partitions,
+    permutation) plus device (sorted lanes, sorted lengths, bucketed) whose
+    rows >= n are tail sentinels.  Caller guarantees max true length <=
+    lane bytes."""
+    n = lanes.shape[0]
+    if n == 0:
+        return (np.zeros(0, np.int32), np.zeros(0, np.int32), None)
+    uniform, _pad = uniform_clamped_lengths(lengths, lanes.shape[1] * 4 + 1)
+    nb = _bucket(n)
+    lengths = lengths.astype(np.int32)
+    if nb != n:
+        lanes = np.pad(lanes, ((0, nb - n), (0, 0)),
+                       constant_values=np.uint32(0xFFFFFFFF))
+        lengths = np.pad(lengths, (0, nb - n), constant_values=-1)
+    # uniform real lengths make the length pass an identity reorder even
+    # with tail sentinels present: sentinel order is fully decided by the
+    # final partition pass (partition MAX)
+    sp, perm, out_lanes, out_lens = _fused_resident_hash_sort(
+        jnp.asarray(lanes), jnp.asarray(lengths), num_partitions,
+        skip_length_pass=uniform)
+    sp = np.asarray(sp)[:n]
+    perm = np.asarray(perm)[:n]
+    return sp, perm, (out_lanes, out_lens, 0, n)
+
+
+@functools.partial(jax.jit, static_argnames=("out_rows",))
+def _slice_to_bucket(lanes: jnp.ndarray, lengths: jnp.ndarray,
+                     lo, count, out_rows: int):
+    """Dynamic [lo, lo+count) slice padded to a STATIC out_rows bucket with
+    tail sentinels — dynamic offsets keep the compile count bounded by
+    (input bucket, output bucket) pairs, not by data-dependent slice sizes."""
+    idx = lo + jnp.arange(out_rows)
+    safe = jnp.minimum(idx, lanes.shape[0] - 1)
+    sl = jnp.take(lanes, safe, axis=0)
+    ln = jnp.take(lengths, safe, axis=0)
+    mask = jnp.arange(out_rows) < count
+    sl = jnp.where(mask[:, None], sl, jnp.uint32(0xFFFFFFFF))
+    ln = jnp.where(mask, ln, -1)
+    return sl, ln
+
+
+@jax.jit
+def _fused_resident_merge(lanes_list, lens_list):
+    """Single-partition k-way merge of device-resident sorted key columns:
+    stable sort of the concatenation (TezMerger semantics — equal keys keep
+    run order).  Sentinel rows (length < 0) sort to the tail."""
+    lanes = jnp.concatenate(lanes_list, axis=0)
+    lens = jnp.concatenate(lens_list, axis=0)
+    parts = jnp.where(lens < 0, jnp.int32(np.iinfo(np.int32).max),
+                      jnp.int32(0))
+    sort_lens = jnp.where(lens < 0, jnp.uint32(0xFFFFFFFF),
+                          lens.astype(jnp.uint32))
+    _, perm = _lsd_passes(parts, lanes, sort_lens)
+    return perm
+
+
+def merge_resident_slices(slices) -> np.ndarray:
+    """k-way merge over device-resident key views.
+
+    slices: list of (lanes_dev, lens_dev, lo, hi) with identical lane
+    counts.  Returns the merge permutation into the HOST concatenation of
+    the real rows (run order preserved for equal keys).  No key bytes move
+    host->device; only the permutation comes back."""
+    counts = [hi - lo for (_l, _n, lo, hi) in slices]
+    # ONE common bucket for every slice: the merge program's compile key is
+    # then (k, B, L) instead of the full ordered tuple of per-run sizes —
+    # bounded compile variety at the cost of sorting k*B instead of
+    # sum(bucket_i) rows (sentinels are cheap; compiles are not)
+    common = _bucket(max(counts))
+    buckets = [common] * len(slices)
+    lanes_list, lens_list = [], []
+    for (lanes, lens, lo, hi) in slices:
+        sl, ln = _slice_to_bucket(lanes, lens, lo, hi - lo, common)
+        lanes_list.append(sl)
+        lens_list.append(ln)
+    perm = np.asarray(_fused_resident_merge(lanes_list, lens_list))
+    # map bucketed-concat indices back to real host rows
+    bounds = np.zeros(len(buckets) + 1, dtype=np.int64)
+    np.cumsum(buckets, out=bounds[1:])
+    host_offsets = np.zeros(len(counts), dtype=np.int64)
+    np.cumsum(counts[:-1], out=host_offsets[1:])
+    run_id = np.searchsorted(bounds[1:], perm, side="right")
+    within = perm - bounds[run_id]
+    real = within < np.asarray(counts)[run_id]
+    return (host_offsets[run_id] + within)[real].astype(np.int64)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("num_partitions", "skip_length_pass"))
 def _fused_hash_sort(key_mat: jnp.ndarray, hash_lengths: jnp.ndarray,

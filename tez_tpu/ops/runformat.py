@@ -121,11 +121,29 @@ def concat_ragged(parts: Sequence[Tuple[np.ndarray, np.ndarray]]
 
 @dataclasses.dataclass
 class KVBatch:
-    """Columnar record batch: ragged keys + ragged values."""
+    """Columnar record batch: ragged keys + ragged values.
+
+    dev_keys optionally carries a DEVICE-resident view of the sort keys —
+    (lanes u32[NB, L], lengths i32[NB], lo, hi) where rows [lo, hi) of the
+    bucketed arrays align with this batch's rows and tail rows are
+    sentinels.  It lets a same-process consumer merge fetched partitions
+    without re-uploading key bytes (SURVEY.md §2.5 "spans = device
+    buffers"); it is dropped by serialization, pickling, take() and
+    concat() (order changes invalidate the row alignment)."""
     key_bytes: np.ndarray     # uint8[..]
     key_offsets: np.ndarray   # int64[N+1]
     val_bytes: np.ndarray
     val_offsets: np.ndarray
+    dev_keys: Optional[tuple] = dataclasses.field(
+        default=None, compare=False, repr=False)
+
+    def __getstate__(self) -> dict:
+        state = self.__dict__.copy()
+        state["dev_keys"] = None   # device handles never cross processes
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
 
     @property
     def num_records(self) -> int:
@@ -152,9 +170,14 @@ class KVBatch:
     def slice_rows(self, start: int, stop: int) -> "KVBatch":
         ko = self.key_offsets[start:stop + 1]
         vo = self.val_offsets[start:stop + 1]
+        dev = None
+        if self.dev_keys is not None:
+            lanes, lens, lo, _hi = self.dev_keys
+            dev = (lanes, lens, lo + start, lo + stop)   # view, no copy
         return KVBatch(
             self.key_bytes[ko[0]:ko[-1]], (ko - ko[0]).astype(np.int64),
-            self.val_bytes[vo[0]:vo[-1]], (vo - vo[0]).astype(np.int64))
+            self.val_bytes[vo[0]:vo[-1]], (vo - vo[0]).astype(np.int64),
+            dev_keys=dev)
 
     @staticmethod
     def empty() -> "KVBatch":

@@ -155,10 +155,17 @@ class DeviceSorter:
                  sort_threads: int = 0,
                  merge_factor: int = 64,
                  key_normalizer: Optional[Callable[[bytes], bytes]] = None,
-                 spill_codec: Optional[str] = None):
+                 spill_codec: Optional[str] = None,
+                 resident_keys: bool = True):
         self.num_partitions = num_partitions
         self.key_width = max(4, key_width)
         self.engine = engine   # 'device' (TPU kernels) | 'host' (np.lexsort)
+        #: keep sorted key lanes in HBM for downstream device merges.  The
+        #: pinned HBM (~(key width + 4) B/row per registered output, freed
+        #: at DAG deletion) is OUTSIDE the host memory budgets — operators
+        #: of long many-output DAGs can turn it off
+        #: (tez.runtime.tpu.resident.keys).
+        self.resident_keys = resident_keys
         #: custom comparator as key normalization (library/comparators.py);
         #: None = sort by raw key bytes (zero-cost default)
         self.key_normalizer = key_normalizer
@@ -262,6 +269,31 @@ class DeviceSorter:
     def sort_batch(self, batch: KVBatch,
                    custom_partitions: Optional[np.ndarray] = None) -> Run:
         t0 = time.time()
+        if custom_partitions is None and self.partitioner == "hash" and \
+                self.engine != "host" and self.key_normalizer is None and \
+                self.resident_keys:
+            klens = batch.key_offsets[1:] - batch.key_offsets[:-1]
+            wmax = int(klens.max(initial=1))
+            if wmax <= self.key_width:
+                # device-resident fast path: lanes sized to the ACTUAL max
+                # key length (fewer upload bytes), full keys fit them, so
+                # the FNV hash derives from lanes ON DEVICE (no hash-matrix
+                # upload), prefix order IS exact byte order (no tie-break),
+                # and the sorted key columns stay in HBM for the consumer
+                # merge (VERDICT r1 item 4)
+                eff = ((max(wmax, 1) + 3) // 4) * 4
+                mat, lengths = pad_to_matrix(batch.key_bytes,
+                                             batch.key_offsets, eff)
+                lanes = matrix_to_lanes(mat)
+                sorted_partitions, perm, dev = \
+                    device.hash_sort_span_resident(lanes, lengths,
+                                                   self.num_partitions)
+                sorted_batch = batch.take(perm)
+                sorted_batch.dev_keys = dev
+                self.counters.find_counter(TaskCounter.DEVICE_SORT_MILLIS)\
+                    .increment(int((time.time() - t0) * 1000))
+                return Run.from_sorted_batch(sorted_batch, sorted_partitions,
+                                             self.num_partitions)
         if self.key_normalizer is not None:
             sort_bytes, sort_offsets = normalize_batch_keys(
                 batch, self.key_normalizer)
@@ -436,6 +468,23 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
             level = nxt
         runs = level
     t0 = time.time()
+    if engine != "host" and key_normalizer is None and num_partitions == 1:
+        views = [r.batch.dev_keys for r in runs if r.batch.num_records > 0]
+        if views and all(v is not None for v in views) and \
+                len({v[0].shape[1] for v in views}) == 1:
+            # device-resident merge: key columns are already in HBM from
+            # the producers' span sorts — only the permutation comes back
+            # (VERDICT r1 item 4; TezMerger semantics preserved)
+            perm = device.merge_resident_slices(views)
+            batch = KVBatch.concat(
+                [r.batch for r in runs if r.batch.num_records > 0])
+            sorted_batch = batch.take(perm)
+            if counters is not None:
+                counters.find_counter(TaskCounter.DEVICE_MERGE_MILLIS)\
+                    .increment(int((time.time() - t0) * 1000))
+                counters.increment(TaskCounter.MERGED_MAP_OUTPUTS, len(runs))
+            return Run(sorted_batch,
+                       np.array([0, sorted_batch.num_records], np.int64))
     batch = KVBatch.concat([r.batch for r in runs])
     partitions = np.concatenate([
         np.repeat(np.arange(r.num_partitions, dtype=np.int32),

@@ -270,7 +270,7 @@ def bench_framework(cpu_fallback: bool) -> dict:
         # BASELINE.md protocol: 3 runs per engine, median wall-clock (the
         # first device run additionally pays trace/compile warmup; the
         # median reports steady state for BOTH engines identically)
-        reps = int(os.environ.get("TEZ_BENCH_E2E_REPS", "3"))
+        reps = max(1, int(os.environ.get("TEZ_BENCH_E2E_REPS", "3")))
         runs = {}
         for engine in ("device", "host"):
             walls = []

@@ -504,3 +504,29 @@ def test_long_keys_fall_back_to_exact_path():
     assert run.batch.dev_keys is None   # not resident-eligible
     got = [k for k, _ in run.batch.iter_pairs()]
     assert got == sorted(keys)
+
+
+def test_resident_merge_mixed_lane_widths():
+    """Producers whose spans saw different max key lengths produce device
+    views with different lane counts; the merge widens narrow views with
+    zero lanes on device and stays byte-exact."""
+    import numpy as np
+    from tez_tpu.ops.runformat import Run
+    from tez_tpu.ops.sorter import DeviceSorter, merge_sorted_runs
+    runs = []
+    all_keys = []
+    for prod, klen in enumerate((4, 12)):      # 1 lane vs 3 lanes
+        s = DeviceSorter(num_partitions=1, key_width=16)
+        for i in range(120):
+            k = f"{i % 37:0{klen}d}".encode()
+            all_keys.append((k, prod, i))
+            s.write(k, f"v{prod}".encode())
+        run = s.flush()
+        assert run.batch.dev_keys is not None
+        runs.append(run)
+    assert runs[0].batch.dev_keys[0].shape[1] != \
+        runs[1].batch.dev_keys[0].shape[1]
+    merged = merge_sorted_runs(runs, 1, 16, engine="device")
+    got = [k for k, _ in merged.batch.iter_pairs()]
+    assert got == sorted(got) and len(got) == 240
+    assert sorted(got) == sorted(k for k, _, _ in all_keys)

@@ -63,13 +63,21 @@ class DAGAppMaster:
         self.secrets = JobTokenSecretManager(
             bytes.fromhex(token_hex) if token_hex else None)
         self.umbilical_server = None
-        if conf.get(C.RUNNER_MODE) == "subprocess":
-            from tez_tpu.am.launcher import SubprocessRunnerPool
+        runner_mode = conf.get(C.RUNNER_MODE)
+        if runner_mode in ("subprocess", "pods"):
             from tez_tpu.am.umbilical_server import UmbilicalServer
             self.umbilical_server = UmbilicalServer(
                 self.task_comm, self.secrets,
                 host=conf.get(C.UMBILICAL_BIND_HOST))
-            self.runner_pool = SubprocessRunnerPool(self, num_slots)
+            if runner_mode == "subprocess":
+                from tez_tpu.am.launcher import SubprocessRunnerPool
+                self.runner_pool = SubprocessRunnerPool(self, num_slots)
+            else:
+                # external cluster binding: the AM acquires runner pods
+                # from a cluster driver (YarnTaskSchedulerService/NMClient
+                # analog — am/cluster_binding.py)
+                from tez_tpu.am.cluster_binding import create_pod_pool
+                self.runner_pool = create_pod_pool(self, num_slots)
         else:
             self.runner_pool = RunnerPool(self, num_slots)
         logging_service = HistoryEventHandler.create_logging_service(conf)

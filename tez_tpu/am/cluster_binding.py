@@ -1,0 +1,272 @@
+"""External cluster-manager binding: the AM ACQUIRES executor pods.
+
+Reference parity: tez-dag YarnTaskSchedulerService.java:87 (AMRMClient
+allocate loop: request containers against backlog, hold while useful,
+release when idle) and TezContainerLauncherImpl.java:81 (NMClient launching
+the container on its node).  There is no YARN here by design; the cluster
+manager is abstracted as a *pod driver* — the same seam a GKE/Kubernetes
+deployment binds to.  Each pod is one runner on one (logical) host: it
+gets a stable node id (the unit of failure accumulation/blacklisting),
+hosts its own shuffle server, and dials back into the AM's umbilical over
+TCP exactly like a hand-started multi-host worker (docs/multihost.md).
+
+Drivers:
+- ProcessPodDriver — pods are local runner processes, one per simulated
+  host.  This is the process-per-"host" harness with the REAL plugin seam
+  (the MiniCluster analog): everything above the driver (scaling, reaping,
+  node ids, umbilical, TCP shuffle) is identical in production.
+- KubernetesPodDriver — builds pod specs and drives the Kubernetes API;
+  gated loudly on the `kubernetes` client being importable (not baked into
+  this image).
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import subprocess
+import sys
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from tez_tpu.am.history import HistoryEvent, HistoryEventType
+
+log = logging.getLogger(__name__)
+
+
+class PodDriver:
+    """Cluster-manager seam (the AMRMClient/NMClient analog)."""
+
+    def launch(self, pod_name: str, node_id: str, env: Dict[str, str],
+               am_host: str, am_port: int, idle_timeout: float) -> Any:
+        """Start one runner pod; returns an opaque handle."""
+        raise NotImplementedError
+
+    def poll(self, handle: Any) -> Optional[int]:
+        """None while running, else an exit status."""
+        raise NotImplementedError
+
+    def stop(self, handle: Any) -> None:
+        raise NotImplementedError
+
+
+class ProcessPodDriver(PodDriver):
+    """Runner processes as pods, each with its own stable node id."""
+
+    def launch(self, pod_name: str, node_id: str, env: Dict[str, str],
+               am_host: str, am_port: int, idle_timeout: float) -> Any:
+        penv = dict(os.environ)
+        for k, v in env.items():
+            if v == "":          # same contract as tez.am.runner.env in
+                penv.pop(k, None)   # subprocess mode: empty value = unset
+            else:
+                penv[k] = v
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        existing = penv.get("PYTHONPATH", "")
+        penv["PYTHONPATH"] = repo_root + (
+            os.pathsep + existing if existing else "")
+        return subprocess.Popen(
+            [sys.executable, "-m", "tez_tpu.runtime.remote_runner",
+             "--am-host", am_host, "--am-port", str(am_port),
+             "--node-id", node_id,
+             "--container-id", pod_name,
+             "--idle-timeout", str(idle_timeout)],
+            env=penv)
+
+    def poll(self, handle: Any) -> Optional[int]:
+        return handle.poll()
+
+    def stop(self, handle: Any) -> None:
+        handle.terminate()
+        try:
+            handle.wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            handle.kill()
+
+
+class KubernetesPodDriver(PodDriver):
+    """Kubernetes binding: one runner pod per TPU host.
+
+    Requires the `kubernetes` Python client and in-cluster (or kubeconfig)
+    credentials; both are absent from this image, so construction fails
+    loudly rather than pretending to schedule."""
+
+    def __init__(self, namespace: str = "default",
+                 image: str = "tez-tpu-runner:latest",
+                 pod_template: Optional[Dict[str, Any]] = None):
+        try:
+            import kubernetes  # noqa: F401
+        except ImportError:
+            raise RuntimeError(
+                "KubernetesPodDriver needs the `kubernetes` client, which "
+                "is not installed in this environment; use the "
+                "ProcessPodDriver (process-per-host) or install the client "
+                "in your deployment image") from None
+        from kubernetes import client, config
+        try:
+            config.load_incluster_config()
+        except Exception:  # noqa: BLE001
+            config.load_kube_config()
+        self._core = client.CoreV1Api()
+        self.namespace = namespace
+        self.image = image
+        self.pod_template = pod_template or {}
+
+    def _pod_manifest(self, pod_name: str, node_id: str,
+                      env: Dict[str, str], am_host: str, am_port: int,
+                      idle_timeout: float) -> Dict[str, Any]:
+        manifest = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": pod_name,
+                         "labels": {"app": "tez-tpu-runner"}},
+            "spec": {
+                "restartPolicy": "Never",
+                "containers": [{
+                    "name": "runner",
+                    "image": self.image,
+                    "command": ["python", "-m",
+                                "tez_tpu.runtime.remote_runner",
+                                "--am-host", am_host,
+                                "--am-port", str(am_port),
+                                "--node-id", node_id,
+                                "--container-id", pod_name,
+                                "--advertise-host",
+                                "$(POD_IP)",
+                                "--idle-timeout", str(idle_timeout)],
+                    "env": [{"name": k, "value": str(v)}
+                            for k, v in env.items() if v != ""] +
+                           [{"name": "POD_IP", "valueFrom": {"fieldRef": {
+                               "fieldPath": "status.podIP"}}}],
+                }],
+            },
+        }
+        # deployment-supplied template wins on conflicts (resources,
+        # nodeSelector for TPU node pools, tolerations, ...)
+        spec = manifest["spec"]
+        for k, v in self.pod_template.items():
+            spec[k] = v
+        return manifest
+
+    def launch(self, pod_name: str, node_id: str, env: Dict[str, str],
+               am_host: str, am_port: int, idle_timeout: float) -> Any:
+        self._core.create_namespaced_pod(
+            self.namespace,
+            self._pod_manifest(pod_name, node_id, env, am_host, am_port,
+                               idle_timeout))
+        return pod_name
+
+    def poll(self, handle: Any) -> Optional[int]:
+        from kubernetes.client.rest import ApiException
+        try:
+            pod = self._core.read_namespaced_pod(handle, self.namespace)
+        except ApiException as e:
+            if e.status == 404:
+                return 1   # deleted/evicted outside the pool: reap it
+            log.warning("pod %s poll failed (%s); keeping it", handle, e)
+            return None    # transient API fault must not kill the fleet
+        phase = pod.status.phase
+        if phase in ("Succeeded", "Failed"):
+            return 0 if phase == "Succeeded" else 1
+        return None
+
+    def stop(self, handle: Any) -> None:
+        try:
+            self._core.delete_namespaced_pod(handle, self.namespace)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class PodPoolRunnerPool:
+    """RunnerPool-shaped facade over a PodDriver: grows the pod fleet
+    against scheduler backlog, reaps exited pods (the scheduler's next
+    ensure_runners respawns them while work remains), stops the fleet at
+    shutdown.  Pod index -> stable node id, so a respawned pod on the same
+    slot keeps accumulating node failures (blacklisting semantics)."""
+
+    def __init__(self, ctx: Any, max_pods: int, driver: PodDriver,
+                 idle_timeout: float = 5.0):
+        self.ctx = ctx
+        self.max_pods = max_pods
+        self.driver = driver
+        self.idle_timeout = idle_timeout
+        self._pods: Dict[int, Tuple[Any, str]] = {}   # slot -> (handle, name)
+        self._launched = itertools.count()
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    def _env(self) -> Dict[str, str]:
+        env = {}
+        for k, v in (self.ctx.conf.get("tez.am.runner.env") or {}).items():
+            env[k] = str(v)
+        env["TEZ_TPU_JOB_TOKEN"] = self.ctx.secrets.secret.hex()
+        return env
+
+    def ensure_runners(self, backlog: int) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            self._reap()
+            want = min(self.max_pods, len(self._pods) + max(0, backlog))
+            free_slots = (s for s in itertools.count()
+                          if s not in self._pods)
+            while len(self._pods) < want:
+                slot = next(free_slots)
+                n = next(self._launched)
+                node_id = f"pod-{slot}"
+                name = f"tez-pod-{self.ctx.app_id}-{slot}-{n}".lower()\
+                    .replace("_", "-")
+                handle = self.driver.launch(
+                    name, node_id, self._env(),
+                    am_host=self.ctx.conf.get(
+                        "tez.am.pod-pool.advertise-host", "127.0.0.1"),
+                    am_port=self.ctx.umbilical_server.port,
+                    idle_timeout=self.idle_timeout)
+                self._pods[slot] = (handle, name)
+                self.ctx.history(HistoryEvent(
+                    HistoryEventType.CONTAINER_LAUNCHED,
+                    container_id=name,
+                    data={"node_id": node_id, "driver":
+                          type(self.driver).__name__}))
+
+    def _reap(self) -> None:
+        for slot, (handle, name) in list(self._pods.items()):
+            status = self.driver.poll(handle)
+            if status is not None:
+                del self._pods[slot]
+                self.ctx.history(HistoryEvent(
+                    HistoryEventType.CONTAINER_STOPPED,
+                    container_id=name, data={"returncode": status}))
+
+    def live_count(self) -> int:
+        with self._lock:
+            self._reap()
+            return len(self._pods)
+
+    def shutdown(self, wait: bool = True) -> None:
+        with self._lock:
+            self._stopped = True
+            pods = list(self._pods.values())
+            self._pods.clear()
+        for handle, _name in pods:
+            self.driver.stop(handle)
+
+
+def create_pod_pool(ctx: Any, num_slots: int) -> PodPoolRunnerPool:
+    """Build the pool from conf: tez.am.pod-pool.driver.class (registry
+    shorthand 'process'/'kubernetes' or a module:Class path)."""
+    name = ctx.conf.get("tez.am.pod-pool.driver.class") or "process"
+    if name == "process":
+        driver: PodDriver = ProcessPodDriver()
+    elif name == "kubernetes":
+        driver = KubernetesPodDriver(
+            namespace=ctx.conf.get("tez.am.pod-pool.k8s.namespace")
+            or "default",
+            image=ctx.conf.get("tez.am.pod-pool.k8s.image")
+            or "tez-tpu-runner:latest",
+            pod_template=ctx.conf.get("tez.am.pod-pool.k8s.pod-template"))
+    else:
+        from tez_tpu.common.payload import resolve_class
+        driver = resolve_class(name)()
+    max_pods = int(ctx.conf.get("tez.am.pod-pool.max-pods") or num_slots)
+    return PodPoolRunnerPool(ctx, max_pods, driver)

@@ -180,9 +180,25 @@ AM_CONCURRENT_DISPATCHER_SHARDS = _key(
     "0 = single dispatcher thread (reference default); N>1 = hash-sharded "
     "concurrent dispatcher for event storms (AsyncDispatcherConcurrent)")
 RUNNER_MODE = _key("tez.runner.mode", "threads", Scope.AM,
-                   "'threads' (in-process, reference local mode) or "
+                   "'threads' (in-process, reference local mode), "
                    "'subprocess' (out-of-process runners over the socket "
-                   "umbilical — the TezChild-per-container model)")
+                   "umbilical — the TezChild-per-container model), or "
+                   "'pods' (external cluster binding: the AM acquires "
+                   "runner pods via tez.am.pod-pool.driver.class)")
+POD_POOL_DRIVER = _key(
+    "tez.am.pod-pool.driver.class", "process", Scope.AM,
+    "'process' (process-per-host simulation with the real plugin seam), "
+    "'kubernetes' (GKE/k8s pods; needs the kubernetes client), or a "
+    "module:Class PodDriver path")
+POD_POOL_MAX_PODS = _key("tez.am.pod-pool.max-pods", 0, Scope.AM,
+                         "0 = tez.am.local.num-containers")
+POD_POOL_ADVERTISE_HOST = _key(
+    "tez.am.pod-pool.advertise-host", "127.0.0.1", Scope.AM,
+    "AM address handed to launched pods for the umbilical dial-back")
+POD_POOL_K8S_NAMESPACE = _key("tez.am.pod-pool.k8s.namespace", "default",
+                              Scope.AM)
+POD_POOL_K8S_IMAGE = _key("tez.am.pod-pool.k8s.image",
+                          "tez-tpu-runner:latest", Scope.AM)
 
 # --------------------------------------------------------------------------
 # Runtime (per-edge / per-IO) keys (TezRuntimeConfiguration.java analog)

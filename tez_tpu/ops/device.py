@@ -211,16 +211,20 @@ def hash_sort_span_resident(lanes: np.ndarray, lengths: np.ndarray,
     return sp, perm, (out_lanes, out_lens, 0, n)
 
 
-@functools.partial(jax.jit, static_argnames=("out_rows",))
+@functools.partial(jax.jit, static_argnames=("out_rows", "out_lanes"))
 def _slice_to_bucket(lanes: jnp.ndarray, lengths: jnp.ndarray,
-                     lo, count, out_rows: int):
+                     lo, count, out_rows: int, out_lanes: int):
     """Dynamic [lo, lo+count) slice padded to a STATIC out_rows bucket with
     tail sentinels — dynamic offsets keep the compile count bounded by
-    (input bucket, output bucket) pairs, not by data-dependent slice sizes."""
+    (input bucket, output bucket) pairs, not by data-dependent slice sizes.
+    Narrower views widen to out_lanes with ZERO lanes: bytes beyond a key's
+    length are zero in the lane encoding, so widening preserves order."""
     idx = lo + jnp.arange(out_rows)
     safe = jnp.minimum(idx, lanes.shape[0] - 1)
     sl = jnp.take(lanes, safe, axis=0)
     ln = jnp.take(lengths, safe, axis=0)
+    if lanes.shape[1] < out_lanes:
+        sl = jnp.pad(sl, ((0, 0), (0, out_lanes - lanes.shape[1])))
     mask = jnp.arange(out_rows) < count
     sl = jnp.where(mask[:, None], sl, jnp.uint32(0xFFFFFFFF))
     ln = jnp.where(mask, ln, -1)
@@ -256,9 +260,10 @@ def merge_resident_slices(slices) -> np.ndarray:
     # sum(bucket_i) rows (sentinels are cheap; compiles are not)
     common = _bucket(max(counts))
     buckets = [common] * len(slices)
+    width = max(l.shape[1] for (l, _n, _lo, _hi) in slices)
     lanes_list, lens_list = [], []
     for (lanes, lens, lo, hi) in slices:
-        sl, ln = _slice_to_bucket(lanes, lens, lo, hi - lo, common)
+        sl, ln = _slice_to_bucket(lanes, lens, lo, hi - lo, common, width)
         lanes_list.append(sl)
         lens_list.append(ln)
     perm = np.asarray(_fused_resident_merge(lanes_list, lens_list))

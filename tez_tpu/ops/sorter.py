@@ -470,8 +470,9 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
     t0 = time.time()
     if engine != "host" and key_normalizer is None and num_partitions == 1:
         views = [r.batch.dev_keys for r in runs if r.batch.num_records > 0]
-        if views and all(v is not None for v in views) and \
-                len({v[0].shape[1] for v in views}) == 1:
+        if views and all(v is not None for v in views):
+            # mixed lane widths are fine: narrower views widen with zero
+            # lanes on device (zero = absent bytes in the lane encoding)
             # device-resident merge: key columns are already in HBM from
             # the producers' span sorts — only the permutation comes back
             # (VERDICT r1 item 4; TezMerger semantics preserved)

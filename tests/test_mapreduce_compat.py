@@ -48,3 +48,108 @@ def test_simple_mr_wordcount(tmp_path):
                 k, v = line.split("\t")
                 got[k] = int(v)
     assert got == {"x": 300, "y": 200, "z": 100}
+
+
+def fixed_map(key, value):
+    """key: 8B id, value: 8B big-endian count — emit (bucket, count)."""
+    import struct
+    (count,) = struct.unpack(">q", value)
+    yield key[:2], str(count).encode()
+
+
+def fixed_reduce(bucket, values):
+    yield bucket, str(sum(int(v) for v in values)).encode()
+
+
+def test_fixed_width_binary_format_e2e(tmp_path):
+    """Second stock InputFormat (VERDICT r1 item 9): fixed-width binary KV
+    records through simple_mr_dag, record-aligned splits, exact sums."""
+    import struct
+    data = tmp_path / "in.bin"
+    golden = collections.Counter()
+    with open(data, "wb") as fh:
+        for i in range(5000):
+            key = f"b{i % 7}_{i:04d}".encode()[:8].ljust(8, b"\x00")
+            count = i % 13
+            golden[key[:2]] += count
+            fh.write(key + struct.pack(">q", count))
+    out = str(tmp_path / "out")
+    dag = simple_mr_dag(
+        "mr-fixed", [str(data)], out,
+        map_fn="tests.test_mapreduce_compat:fixed_map",
+        reduce_fn="tests.test_mapreduce_compat:fixed_reduce",
+        num_mappers=3, num_reducers=2,
+        key_serde="text", value_serde="text",
+        input_format="fixed",
+        format_params={"key_bytes": 8, "value_bytes": 8})
+    with TezClient.create("mrf", {"tez.staging-dir":
+                                  str(tmp_path / "s")}) as c:
+        status = c.submit_dag(dag).wait_for_completion(timeout=60)
+    assert status.state is DAGStatusState.SUCCEEDED
+    got = {}
+    for f in os.listdir(out):
+        if f.startswith("part-"):
+            for line in open(os.path.join(out, f), "rb"):
+                k, v = line.rstrip(b"\n").split(b"\t")
+                got[k] = int(v)
+    assert got == {k: v for k, v in golden.items()}
+
+
+def test_fixed_width_splits_are_record_aligned(tmp_path):
+    from tez_tpu.io.formats import FixedWidthKVFormat
+    data = tmp_path / "a.bin"
+    rec = 12
+    data.write_bytes(b"x" * (rec * 1000 + 5))   # trailing partial record
+    fmt = FixedWidthKVFormat({"key_bytes": 4, "value_bytes": 8})
+    splits = fmt.compute_splits([str(data)], 4, min_split_bytes=64)
+    assert splits, "no splits"
+    covered = 0
+    for s in splits:
+        assert s.start % rec == 0 and s.length % rec == 0, s
+        covered += s.length
+    assert covered == rec * 1000    # partial tail record dropped
+    # splits are disjoint and ordered
+    ends = 0
+    for s in sorted(splits, key=lambda s: s.start):
+        assert s.start == ends
+        ends = s.start + s.length
+
+
+def test_multi_mr_input_one_reader_per_split(tmp_path):
+    """MultiMRInput analog: get_key_value_readers() exposes split
+    boundaries (reference: MultiMRInput.java)."""
+    from tez_tpu.io.formats import MultiMRInput
+    from tez_tpu.io.text import FileSplit
+    from tez_tpu.common.counters import TezCounters
+
+    f1 = tmp_path / "a.txt"
+    f1.write_text("a1\na2\n")
+    f2 = tmp_path / "b.txt"
+    f2.write_text("b1\n")
+
+    class _Payload:
+        def load(self):
+            return {"format": "text",
+                    "static_splits": [
+                        FileSplit(str(f1), 0, f1.stat().st_size),
+                        FileSplit(str(f2), 0, f2.stat().st_size)]}
+
+    class _Ctx:
+        user_payload = _Payload()
+        counters = TezCounters()
+
+        def notify_progress(self):
+            pass
+
+    inp = MultiMRInput.__new__(MultiMRInput)
+    inp.context = _Ctx()
+    inp.initialize()
+    readers = inp.get_key_value_readers()
+    assert len(readers) == 2
+    assert [line for _, line in readers[0]] == [b"a1", b"a2"]
+    assert [line for _, line in readers[1]] == [b"b1"]
+    # the fused reader chains them in split order
+    inp2 = MultiMRInput.__new__(MultiMRInput)
+    inp2.context = _Ctx()
+    inp2.initialize()
+    assert [line for _, line in inp2.get_reader()] == [b"a1", b"a2", b"b1"]

@@ -87,19 +87,30 @@ def simple_mr_dag(name: str, input_paths, output_path: str,
                   num_mappers: int = -1, num_reducers: int = 2,
                   key_serde: str = "bytes", value_serde: str = "bytes",
                   intermediate_serdes: Tuple[str, str] = ("bytes", "bytes"),
-                  combiner: str = "") -> DAG:
-    """The YARNRunner-analog translation: one map vertex over text splits,
-    one reduce vertex over a sorted shuffle, file-committed output.
-    map_fn/reduce_fn are "module:callable" strings (must be importable in
-    runner processes)."""
+                  combiner: str = "",
+                  input_format: str = "text",
+                  format_params: Optional[dict] = None,
+                  multi_input: bool = False) -> DAG:
+    """The YARNRunner-analog translation: one map vertex over format-driven
+    splits (io/formats.py SPI — "text", "fixed", or a module:Class path;
+    reference: MRInput.java:87 arbitrary InputFormats), one reduce vertex
+    over a sorted shuffle, file-committed output.  multi_input swaps in the
+    MultiMRInput analog (one reader per split).  map_fn/reduce_fn are
+    "module:callable" strings (must be importable in runner processes)."""
+    input_cls = "tez_tpu.io.formats:MultiMRInput" if multi_input \
+        else "tez_tpu.io.formats:MRInput"
     mapper = Vertex.create("map", ProcessorDescriptor.create(
         MapProcessor, payload={"map_fn": map_fn}), num_mappers)
     mapper.add_data_source("input", DataSourceDescriptor.create(
-        InputDescriptor.create("tez_tpu.io.text:TextInput"),
+        InputDescriptor.create(input_cls,
+                               payload={"format": input_format,
+                                        "format_params": format_params}),
         InputInitializerDescriptor.create(
-            "tez_tpu.io.text:TextSplitGenerator",
+            "tez_tpu.io.formats:MRSplitGenerator",
             payload={"paths": list(input_paths),
-                     "desired_splits": num_mappers})))
+                     "desired_splits": num_mappers,
+                     "format": input_format,
+                     "format_params": format_params})))
     reducer = Vertex.create("reduce", ProcessorDescriptor.create(
         ReduceProcessor, payload={"reduce_fn": reduce_fn}), num_reducers)
     reducer.add_data_sink("output", DataSinkDescriptor.create(

@@ -1,230 +1,25 @@
-"""Root input for text files: splits + line records.
+"""Text root input: the stock line-record instance of the format SPI.
 
-Reference parity: tez-mapreduce MRInput.java:87 (HDFS splits -> records) +
-MRInputAMSplitGenerator.java:61 (AM-side split computation -> events +
-parallelism) + TezSplitGrouper.java:43 (group splits to a target wave count).
-Local filesystem instead of HDFS; splits are newline-aligned byte ranges.
+Reference parity: tez-mapreduce MRInput.java:87 (splits -> records) +
+MRInputAMSplitGenerator.java:61 + TezSplitGrouper.java:43.  The generic
+machinery (FileSplit, split computation/grouping, the format-driven input +
+initializer) lives in tez_tpu.io.formats; this module keeps the historical
+``tez_tpu.io.text:TextInput`` / ``TextSplitGenerator`` descriptor names as
+thin text-format bindings (the format defaults to "text" when the payload
+names none).
 """
 from __future__ import annotations
 
-import dataclasses
-import glob as globlib
-import os
-from typing import Any, Iterator, List, Sequence, Tuple
-
-from tez_tpu.api.events import InputDataInformationEvent, TezAPIEvent
-from tez_tpu.api.initializer import (InputConfigureVertexTasksEvent,
-                                     InputInitializer)
-from tez_tpu.api.runtime import KeyValueReader, LogicalInput, Reader
-from tez_tpu.common.counters import FileSystemCounter, TaskCounter
+from tez_tpu.io.formats import (FileSplit, MRInput,  # noqa: F401 — re-
+                                MRSplitGenerator,    # exported compat names
+                                _LineReader, compute_splits, group_splits)
 
 
-@dataclasses.dataclass(frozen=True)
-class FileSplit:
-    path: str
-    start: int
-    length: int
-
-
-def compute_splits(paths: Sequence[str], desired_splits: int,
-                   min_split_bytes: int = 64 * 1024) -> List[FileSplit]:
-    """Byte-range splits over the input files (newline alignment is handled
-    at read time: a split starts after its first newline unless at offset 0,
-    and reads through the record straddling its end — standard InputFormat
-    semantics)."""
-    files = []
-    for p in paths:
-        matches = sorted(globlib.glob(p)) if any(c in p for c in "*?[") else [p]
-        for m in matches:
-            if os.path.isdir(m):
-                files.extend(sorted(
-                    os.path.join(m, f) for f in os.listdir(m)
-                    if os.path.isfile(os.path.join(m, f))))
-            else:
-                files.append(m)
-    total = sum(os.path.getsize(f) for f in files)
-    if total == 0 or desired_splits <= 0:
-        return [FileSplit(f, 0, os.path.getsize(f)) for f in files]
-    target = max(min_split_bytes, total // desired_splits)
-    splits: List[FileSplit] = []
-    for f in files:
-        size = os.path.getsize(f)
-        pos = 0
-        while pos < size:
-            length = min(target, size - pos)
-            # avoid tiny trailing splits (< half target merges into last)
-            if size - (pos + length) < target // 2:
-                length = size - pos
-            splits.append(FileSplit(f, pos, length))
-            pos += length
-    return splits
-
-
-def group_splits(splits: List[FileSplit], target_count: int
-                 ) -> List[List[FileSplit]]:
-    """TezSplitGrouper analog: coalesce splits to ~target_count groups
-    (locality is moot on local FS, so greedy size-balanced grouping)."""
-    if target_count <= 0 or len(splits) <= target_count:
-        return [[s] for s in splits]
-    groups: List[List[FileSplit]] = [[] for _ in range(target_count)]
-    sizes = [0] * target_count
-    for s in sorted(splits, key=lambda s: -s.length):
-        i = sizes.index(min(sizes))
-        groups[i].append(s)
-        sizes[i] += s.length
-    return [g for g in groups if g]
-
-
-class TextSplitGenerator(InputInitializer):
+class TextSplitGenerator(MRSplitGenerator):
     """AM-side initializer: payload {"paths": [...], "desired_splits": N or
     -1 (use vertex parallelism or one wave of slots)}."""
 
-    def initialize(self) -> List[Any]:
-        payload = self.context.user_payload.load() or {}
-        paths = payload.get("paths", [])
-        desired = payload.get("desired_splits", -1)
-        if desired <= 0:
-            desired = self.context.num_tasks
-        if desired <= 0:
-            desired = max(1, self.context.get_total_available_resource())
-        splits = compute_splits(paths, desired,
-                                payload.get("min_split_bytes", 64 * 1024))
-        groups = group_splits(splits, desired)
-        if self.context.num_tasks > 0:
-            # fixed vertex parallelism: every task needs exactly one split
-            # event (possibly empty) or it would wait forever
-            while len(groups) < self.context.num_tasks:
-                groups.append([])
-            groups = groups[:self.context.num_tasks] if \
-                len(groups) <= self.context.num_tasks else \
-                self._fold(groups, self.context.num_tasks)
-        events: List[Any] = [
-            InputConfigureVertexTasksEvent(num_tasks=len(groups))]
-        for i, group in enumerate(groups):
-            events.append(InputDataInformationEvent(
-                source_index=i, user_payload=group, target_index=i))
-        return events
 
-    @staticmethod
-    def _fold(groups: List[List[FileSplit]], n: int) -> List[List[FileSplit]]:
-        out: List[List[FileSplit]] = [[] for _ in range(n)]
-        for i, g in enumerate(groups):
-            out[i % n].extend(g)
-        return out
-
-
-class _LineReader(KeyValueReader):
-    """Yields (byte offset, line bytes) per record — TextInputFormat parity."""
-
-    def __init__(self, splits: Sequence[FileSplit], context: Any):
-        self.splits = splits
-        self.context = context
-
-    def iter_chunks(self, chunk_bytes: int = 8 << 20
-                    ) -> Iterator[bytes]:
-        """Vectorization-friendly reader: yields large line-aligned byte
-        chunks covering exactly this reader's splits (same boundary
-        semantics as line iteration: a split owns lines STARTING in
-        (start, end]).  Batch-first processors (e.g. the vectorized
-        tokenizer) consume these instead of per-record lines — the
-        TPU-native answer to the reference's per-record hot loop."""
-        bytes_read = self.context.counters.find_counter(
-            FileSystemCounter.FILE_BYTES_READ)
-        read_ops = self.context.counters.find_counter(
-            FileSystemCounter.FILE_READ_OPS)
-        for split in self.splits:
-            with open(split.path, "rb") as fh:
-                read_ops.increment()
-                fh.seek(split.start)
-                pos = split.start
-                if split.start > 0:
-                    skipped = fh.readline()  # partial record owned by prev
-                    pos += len(skipped)
-                    bytes_read.increment(len(skipped))
-                end = split.start + split.length
-                while pos <= end:
-                    want = min(chunk_bytes, end - pos + 1)
-                    chunk = fh.read(want)
-                    if not chunk:
-                        break
-                    if not chunk.endswith(b"\n"):
-                        # extend to the line boundary (the line STARTING at
-                        # or before `end` belongs to this split in full)
-                        tail = fh.readline()
-                        chunk += tail
-                    pos += len(chunk)
-                    bytes_read.increment(len(chunk))
-                    self.context.notify_progress()
-                    yield chunk
-
-    def __iter__(self) -> Iterator[Tuple[int, bytes]]:
-        # counters update incrementally inside the loop (a consumer may stop
-        # early, closing the generator — a post-loop epilogue would be
-        # skipped entirely; and re-iteration must not double-count)
-        records = self.context.counters.find_counter(
-            TaskCounter.INPUT_RECORDS_PROCESSED)
-        bytes_read = self.context.counters.find_counter(
-            FileSystemCounter.FILE_BYTES_READ)
-        read_ops = self.context.counters.find_counter(
-            FileSystemCounter.FILE_READ_OPS)
-        n = 0
-        for split in self.splits:
-            with open(split.path, "rb") as fh:
-                read_ops.increment()
-                fh.seek(split.start)
-                pos = split.start
-                if split.start > 0:
-                    skipped = fh.readline()  # partial record owned by prev
-                    pos += len(skipped)
-                    bytes_read.increment(len(skipped))
-                end = split.start + split.length
-                # a line STARTING exactly at `end` belongs to this split
-                # (the next split discards its first line since start > 0) —
-                # LineRecordReader boundary semantics
-                while pos <= end:
-                    line = fh.readline()
-                    if not line:
-                        break
-                    yield pos, line.rstrip(b"\r\n")
-                    pos += len(line)
-                    bytes_read.increment(len(line))   # ACTUAL bytes consumed
-                    records.increment()
-                    n += 1
-                    if (n & 0x3FFF) == 0:
-                        self.context.notify_progress()
-
-
-class TextInput(LogicalInput):
+class TextInput(MRInput):
     """Task-side root input: reads the splits delivered by the initializer
-    (or directly from payload {"paths": [...]} with no initializer)."""
-
-    def initialize(self) -> List[TezAPIEvent]:
-        self._splits: List[FileSplit] = []
-        self._has_split_event = False
-        payload = self.context.user_payload.load() or {}
-        if isinstance(payload, dict) and payload.get("static_splits"):
-            self._splits = list(payload["static_splits"])
-            self._has_split_event = True
-        return []
-
-    def handle_events(self, events: Sequence[TezAPIEvent]) -> None:
-        for ev in events:
-            if isinstance(ev, InputDataInformationEvent):
-                self._splits.extend(ev.user_payload or [])
-                self._has_split_event = True
-                total = sum(s.length for s in ev.user_payload or [])
-                self.context.counters.increment(
-                    TaskCounter.INPUT_SPLIT_LENGTH_BYTES, total)
-
-    def get_reader(self) -> Reader:
-        import time
-        deadline = time.time() + 60
-        while not self._has_split_event:
-            if time.time() > deadline:
-                raise TimeoutError("no split event received")
-            time.sleep(0.01)
-            self.context.notify_progress()
-        return _LineReader(self._splits, self.context)
-
-    def close(self) -> List[TezAPIEvent]:
-        return []
+    (or directly from payload {"static_splits": [...]})."""

@@ -528,3 +528,130 @@ def test_am_recovery_restores_reconfigured_vertex(tmp_staging, tmp_path):
     assert d.get("TOTAL_LAUNCHED_TASKS", 0) == 1
     assert d.get("NUM_SUCCEEDED_TASKS", 0) == 3
     am2.stop()
+
+
+def test_dag_aware_preemption_spares_unrelated_branches(tmp_staging):
+    """DagAwareYarnTaskScheduler analog: preemption victims must be
+    DESCENDANTS of the vertices whose requests are blocked — unrelated
+    branch work keeps running, and with no descendant running there is no
+    preemption at all (killing unrelated work cannot unblock the waiting
+    request)."""
+    from tez_tpu.am.task_scheduler import DagAwareTaskSchedulerService
+    from tez_tpu.common.ids import DAGId
+
+    class _V:
+        def __init__(self, name, dests):
+            self.name = name
+            self.out_edges = {
+                d: type("E", (), {"destination_vertex":
+                                  type("V", (), {"name": d})()})()
+                for d in dests}
+
+    class _Dag:
+        dag_id = "dag_x"
+
+        def __init__(self):
+            # A -> B; C independent; D -> E
+            self.vertices = {"A": _V("A", ["B"]), "B": _V("B", []),
+                             "C": _V("C", []),
+                             "D": _V("D", ["E"]), "E": _V("E", [])}
+            self._by_index = {0: self.vertices["A"],
+                              1: self.vertices["B"],
+                              2: self.vertices["C"],
+                              3: self.vertices["D"],
+                              4: self.vertices["E"]}
+
+        def vertex_by_id(self, vid):
+            return self._by_index.get(vid.id)
+
+    class _Ctx:
+        conf = C.TezConfiguration({})
+
+        def __init__(self):
+            self.dispatched = []
+            self.current_dag = _Dag()
+
+        def ensure_runners(self, backlog):
+            pass
+
+        def dispatch(self, event):
+            self.dispatched.append(event)
+
+    did = DAGId("app_1_da", 1)
+    a_att = did.vertex(0).task(0).attempt(0)
+    b_att = did.vertex(1).task(0).attempt(0)
+    c_att = did.vertex(2).task(0).attempt(0)
+
+    def kills(ctx):
+        return [e for e in ctx.dispatched
+                if getattr(e, "event_type", None) is not None
+                and e.event_type.name == "TA_KILL_REQUEST"]
+
+    # case 1: B (descendant) and C (unrelated) fill the slots; A waits ->
+    # only B is preempted
+    ctx = _Ctx()
+    sched = DagAwareTaskSchedulerService(ctx, num_slots=2)
+    sched.schedule(b_att, "spec-b", priority=20)
+    sched.schedule(c_att, "spec-c", priority=20)
+    assert sched.get_task("c0", timeout=0.1) is not None
+    assert sched.get_task("c1", timeout=0.1) is not None
+    sched.schedule(a_att, "spec-a", priority=5)
+    got = kills(ctx)
+    assert len(got) == 1 and got[0].attempt_id == b_att, got
+
+    # case 2: only unrelated C work runs -> no preemption at all
+    ctx2 = _Ctx()
+    sched2 = DagAwareTaskSchedulerService(ctx2, num_slots=2)
+    c2 = did.vertex(2).task(1).attempt(0)
+    sched2.schedule(c_att, "spec-c", priority=20)
+    sched2.schedule(c2, "spec-c2", priority=20)
+    assert sched2.get_task("c0", timeout=0.1) is not None
+    assert sched2.get_task("c1", timeout=0.1) is not None
+    sched2.schedule(a_att, "spec-a", priority=5)
+    assert not kills(ctx2), kills(ctx2)
+
+    # case 3: the blocked set covers descendants of EVERY waiting vertex,
+    # not just the best priority: A (prio 5, no descendants running) and D
+    # (prio 10) both wait; D's descendant E runs -> E is preempted
+    ctx4 = _Ctx()
+    sched4 = DagAwareTaskSchedulerService(ctx4, num_slots=2)
+    d_att = did.vertex(3).task(0).attempt(0)
+    e_att = did.vertex(4).task(0).attempt(0)
+    sched4.schedule(e_att, "spec-e", priority=20)
+    sched4.schedule(c_att, "spec-c", priority=20)
+    assert sched4.get_task("c0", timeout=0.1) is not None
+    assert sched4.get_task("c1", timeout=0.1) is not None
+    sched4.schedule(a_att, "spec-a", priority=5)
+    sched4.schedule(d_att, "spec-d", priority=10)
+    got4 = kills(ctx4)
+    assert len(got4) == 1 and got4[0].attempt_id == e_att, got4
+
+    # the stock scheduler WOULD have preempted in case 2 (contrast)
+    from tez_tpu.am.task_scheduler import LocalTaskSchedulerService
+    ctx3 = _Ctx()
+    sched3 = LocalTaskSchedulerService(ctx3, num_slots=2)
+    sched3.schedule(c_att, "spec-c", priority=20)
+    sched3.schedule(c2, "spec-c2", priority=20)
+    assert sched3.get_task("c0", timeout=0.1) is not None
+    assert sched3.get_task("c1", timeout=0.1) is not None
+    sched3.schedule(a_att, "spec-a", priority=5)
+    assert len(kills(ctx3)) == 1
+
+
+def test_dag_aware_scheduler_conf_seam(tmp_staging):
+    """tez.am.task.scheduler.class selects the scheduler; a full DAG runs
+    through the DAG-aware one."""
+    from tez_tpu.am.task_scheduler import DagAwareTaskSchedulerService
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging,
+                               "tez.am.task.scheduler.class": "dag-aware",
+                               "tez.am.local.num-containers": 2})
+    am = DAGAppMaster("app_1_das", conf)
+    am.start()
+    assert isinstance(am.task_scheduler, DagAwareTaskSchedulerService)
+    v = Vertex.create("v", ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor",
+        payload={"sleep_ms": 1}), 3)
+    plan = DAG.create("das").add_vertex(v).create_dag_plan()
+    dag_id = am.submit_dag(plan)
+    assert am.wait_for_dag(dag_id, timeout=30) is DAGState.SUCCEEDED
+    am.stop()

@@ -23,8 +23,8 @@ from tez_tpu.am.history import (HistoryEvent, HistoryEventHandler,
                                 HistoryEventType)
 from tez_tpu.am.launcher import RunnerPool
 from tez_tpu.am.task_comm import TaskCommunicatorManager
-from tez_tpu.am.task_scheduler import (LocalTaskSchedulerService,
-                                       TaskSchedulerManager)
+from tez_tpu.am.task_scheduler import (TaskSchedulerManager,
+                                       create_task_scheduler)
 from tez_tpu.common import config as C
 from tez_tpu.common.counters import TezCounters
 from tez_tpu.common.dispatcher import Dispatcher
@@ -55,7 +55,7 @@ class DAGAppMaster:
             self.dispatcher = Dispatcher(f"am-{app_id}")
         self.dag_counters = TezCounters()
         num_slots = conf.get(C.AM_NUM_CONTAINERS) or max(2, os.cpu_count() or 2)
-        self.task_scheduler = LocalTaskSchedulerService(self, num_slots)
+        self.task_scheduler = create_task_scheduler(self, num_slots)
         self.scheduler_manager = TaskSchedulerManager(self, self.task_scheduler)
         self.task_comm = TaskCommunicatorManager(self)
         from tez_tpu.common.security import JobTokenSecretManager

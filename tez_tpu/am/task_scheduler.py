@@ -117,11 +117,14 @@ class LocalTaskSchedulerService(TaskSchedulerService):
             budget = limit - len(self._preempting)
             if budget <= 0:
                 return
+            eligible = self._victim_filter(
+                [a for _p, _s, a, _spec in self._heap if a in self._queued])
             victims = sorted(
                 ((self._priorities.get(att, 0), att)
                  for att in self._running
                  if self._priorities.get(att, 0) > best_waiting
-                 and att not in self._preempting),
+                 and att not in self._preempting
+                 and eligible(att)),
                 key=lambda x: -x[0])[:budget]
             self._preempting.update(att for _, att in victims)
         for prio, att in victims:
@@ -131,6 +134,12 @@ class LocalTaskSchedulerService(TaskSchedulerService):
                 TaskAttemptEventType.TA_KILL_REQUEST, att,
                 diagnostics=f"preempted: priority-{best_waiting} work "
                             "waiting for a slot"))
+
+    def _victim_filter(self, waiting: List[TaskAttemptId]):
+        """Hook: which running attempts MAY be preempted, given every
+        queued attempt.  The stock policy allows any; the DAG-aware
+        subclass restricts to descendants of the waiting vertices."""
+        return lambda att: True
 
     def deallocate(self, attempt_id: TaskAttemptId,
                    failed: bool = False) -> None:
@@ -184,6 +193,86 @@ class LocalTaskSchedulerService(TaskSchedulerService):
         with self._lock:
             self._shutdown = True
             self._available.notify_all()
+
+
+class DagAwareTaskSchedulerService(LocalTaskSchedulerService):
+    """DAG-topology-aware preemption (reference:
+    DagAwareYarnTaskScheduler.java:96, maybePreempt:1172).
+
+    The stock scheduler preempts ANY strictly-lower-priority running
+    attempt when better work waits.  That can kill unrelated branch work
+    whose eviction cannot unblock the waiting request — and whose re-run
+    throws away progress.  Here victims must be DESCENDANTS of a vertex
+    with requests waiting at the best priority (the reference's
+    blocked-set ∩ assigned-vertices rule): preempting a descendant is
+    always productive, because the descendant cannot finish before its
+    blocked ancestor anyway."""
+
+    def __init__(self, ctx: Any, num_slots: int):
+        super().__init__(ctx, num_slots)
+        self._descendants_cache: Dict[str, Dict[str, Set[str]]] = {}
+
+    # ----------------------------------------------------------- topology
+    def _descendants(self) -> Dict[str, Set[str]]:
+        """vertex name -> set of (transitive) descendant vertex names for
+        the current DAG (reference: vertexDescendants BitSets)."""
+        dag = getattr(self.ctx, "current_dag", None)
+        if dag is None:
+            return {}
+        key = str(dag.dag_id)
+        cached = self._descendants_cache.get(key)
+        if cached is not None:
+            return cached
+        children = {name: [e.destination_vertex.name
+                           for e in v.out_edges.values()]
+                    for name, v in dag.vertices.items()}
+        memo: Dict[str, Set[str]] = {}
+
+        def desc(name: str) -> Set[str]:
+            got = memo.get(name)
+            if got is not None:
+                return got
+            memo[name] = out = set()   # pre-seed: DAG => no cycles, but a
+            # partially-built entry keeps this robust anyway
+            for c in children.get(name, ()):
+                out.add(c)
+                out |= desc(c)
+            return out
+
+        result = {name: desc(name) for name in children}
+        self._descendants_cache = {key: result}   # one DAG at a time
+        return result
+
+    def _vertex_name(self, attempt_id: TaskAttemptId) -> str:
+        dag = getattr(self.ctx, "current_dag", None)
+        if dag is None:
+            return ""
+        v = dag.vertex_by_id(attempt_id.vertex_id)
+        return v.name if v is not None else ""
+
+    def _victim_filter(self, waiting: List[TaskAttemptId]):
+        """Victims must be descendants of ANY vertex with queued requests
+        (the reference's blocked-set ∩ assigned-vertices rule) — evicting a
+        descendant always helps, because it cannot finish before its
+        blocked ancestor anyway."""
+        descendants = self._descendants()
+        blocked: Set[str] = set()
+        for a in waiting:
+            blocked |= descendants.get(self._vertex_name(a), set())
+        return lambda att: self._vertex_name(att) in blocked
+
+
+def create_task_scheduler(ctx: Any, num_slots: int) -> TaskSchedulerService:
+    """tez.am.task.scheduler.class: 'local' | 'dag-aware' | module:Class."""
+    from tez_tpu.common import config as C
+    name = ctx.conf.get(C.AM_TASK_SCHEDULER_CLASS) if ctx.conf is not None \
+        else "local"
+    if name in ("", "local", None):
+        return LocalTaskSchedulerService(ctx, num_slots)
+    if name == "dag-aware":
+        return DagAwareTaskSchedulerService(ctx, num_slots)
+    from tez_tpu.common.payload import resolve_class
+    return resolve_class(name)(ctx, num_slots)
 
 
 class TaskSchedulerManager:

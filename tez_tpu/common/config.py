@@ -153,6 +153,11 @@ AM_COMMIT_ALL_OUTPUTS_ON_SUCCESS = _key(
     "tez.am.commit-all-outputs-on-dag-success", True, Scope.DAG,
     "Reference: commit at DAG success vs per-vertex commit (DAGImpl commit modes)")
 AM_PREEMPTION_PERCENTAGE = _key("tez.am.preemption.percentage", 10, Scope.AM)
+AM_TASK_SCHEDULER_CLASS = _key(
+    "tez.am.task.scheduler.class", "local", Scope.AM,
+    "'local' (priority heap, unrestricted preemption), 'dag-aware' "
+    "(preemption victims restricted to descendants of the waiting "
+    "vertices — DagAwareYarnTaskScheduler analog), or module:Class")
 AM_CLIENT_HEARTBEAT_TIMEOUT_SECS = _key(
     "tez.am.client.heartbeat.timeout.secs", -1, Scope.AM,
     "Session AM shuts down after this long without any client request "

@@ -117,8 +117,7 @@ class LocalTaskSchedulerService(TaskSchedulerService):
             budget = limit - len(self._preempting)
             if budget <= 0:
                 return
-            eligible = self._victim_filter(
-                [a for _p, _s, a, _spec in self._heap if a in self._queued])
+            eligible = self._victim_filter(self._queued)
             victims = sorted(
                 ((self._priorities.get(att, 0), att)
                  for att in self._running
@@ -135,9 +134,9 @@ class LocalTaskSchedulerService(TaskSchedulerService):
                 diagnostics=f"preempted: priority-{best_waiting} work "
                             "waiting for a slot"))
 
-    def _victim_filter(self, waiting: List[TaskAttemptId]):
-        """Hook: which running attempts MAY be preempted, given every
-        queued attempt.  The stock policy allows any; the DAG-aware
+    def _victim_filter(self, waiting: "Set[TaskAttemptId]"):
+        """Hook: which running attempts MAY be preempted, given the set of
+        queued attempts.  The stock policy allows any; the DAG-aware
         subclass restricts to descendants of the waiting vertices."""
         return lambda att: True
 
@@ -250,7 +249,7 @@ class DagAwareTaskSchedulerService(LocalTaskSchedulerService):
         v = dag.vertex_by_id(attempt_id.vertex_id)
         return v.name if v is not None else ""
 
-    def _victim_filter(self, waiting: List[TaskAttemptId]):
+    def _victim_filter(self, waiting: "Set[TaskAttemptId]"):
         """Victims must be descendants of ANY vertex with queued requests
         (the reference's blocked-set ∩ assigned-vertices rule) — evicting a
         descendant always helps, because it cannot finish before its

@@ -260,6 +260,11 @@ SHUFFLE_FETCHER_CLASS = _key(
     "tez.runtime.shuffle.fetcher.class", "", Scope.VERTEX,
     "injectable fetch-session factory (tests: FetcherWithInjectableErrors "
     "analog); empty = TCP keep-alive session")
+TPU_MESH_MAX_ROWS_PER_ROUND = _key(
+    "tez.runtime.tpu.mesh.max-rows-per-round", 0, Scope.VERTEX,
+    "per-edge cap on rows moved per exchange round (skewed partitions run "
+    "multi-round above it); 0 = coordinator default "
+    "(TEZ_TPU_MESH_MAX_ROWS_PER_ROUND env or 1Mi rows)")
 TPU_RESIDENT_KEYS = _key(
     "tez.runtime.tpu.resident.keys", True, Scope.VERTEX,
     "keep sorted key lanes in HBM for downstream device merges "

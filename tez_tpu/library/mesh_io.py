@@ -59,6 +59,8 @@ class MeshOrderedPartitionedKVOutput(LogicalOutput):
                                        16))
         self.value_width = int(_conf_get(
             ctx, "tez.runtime.tpu.mesh.value.width.bytes", 16))
+        self.max_rows_per_round = int(_conf_get(
+            ctx, "tez.runtime.tpu.mesh.max-rows-per-round", 0))
         if _conf_get(ctx, "tez.runtime.key.comparator.class", ""):
             raise ValueError(
                 "mesh edges sort by raw key bytes on device; custom "
@@ -114,7 +116,8 @@ class MeshOrderedPartitionedKVOutput(LogicalOutput):
             num_producers=ctx.vertex_parallelism,
             num_consumers=self.num_physical_outputs,
             batch=batch, key_width=self.key_width,
-            value_width=self.value_width)
+            value_width=self.value_width,
+            max_rows_per_round=self.max_rows_per_round)
         ctx.counters.increment(TaskCounter.SHUFFLE_BYTES, batch.nbytes)
         payload = ShufflePayload(host=MESH_HOST, port=0,
                                  path_component=edge, last_event=True)

@@ -74,6 +74,7 @@ class _EdgeState:
     def __init__(self, num_producers: int, num_consumers: int):
         self.num_producers = num_producers
         self.num_consumers = num_consumers
+        self.max_rows_per_round: Optional[int] = None   # per-edge conf
         self.spans: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
         self.results: Optional[List[KVBatch]] = None
         self.error: Optional[BaseException] = None
@@ -95,6 +96,7 @@ class MeshExchangeCoordinator:
         self._meshes: Dict[int, object] = {}
         self.exchanges_run = 0
         self.rows_exchanged = 0
+        self.multi_round_exchanges = 0
 
     # ------------------------------------------------------------------ mesh
     def mesh_for(self, num_consumers: int):
@@ -119,7 +121,8 @@ class MeshExchangeCoordinator:
     def register_producer(self, edge_id: str, task_index: int,
                           num_producers: int, num_consumers: int,
                           batch: KVBatch, key_width: int,
-                          value_width: int) -> None:
+                          value_width: int,
+                          max_rows_per_round: Optional[int] = None) -> None:
         """Record one producer span (encoded).  The LAST registration runs
         the exchange inline on that producer's thread — the gang barrier:
         by then every producer's data is resident, which is exactly the
@@ -144,6 +147,8 @@ class MeshExchangeCoordinator:
         with self.lock:
             st = self.edges.setdefault(
                 edge_id, _EdgeState(num_producers, num_consumers))
+            if max_rows_per_round:
+                st.max_rows_per_round = int(max_rows_per_round)
             st.spans[task_index] = (lanes,
                                     klens.astype(np.uint32),
                                     vwords)
@@ -267,12 +272,12 @@ class MeshExchangeCoordinator:
                 np.uint32(W)).astype(np.int64)
         counts = np.bincount(part, minlength=W)
         max_part = int(counts.max())
-        rounds = max(1, -(-max_part // self.max_rows_per_round))
+        per_round = st.max_rows_per_round or self.max_rows_per_round
+        rounds = max(1, -(-max_part // per_round))
         # power-of-two bucketing keeps the compiled-program cache keys
         # stable across runs with slightly different cardinalities (the
         # kernel tolerates extra capacity as padding)
-        cap = min(_bucket(min(max_part, self.max_rows_per_round)),
-                  self.max_rows_per_round)
+        cap = min(_bucket(min(max_part, per_round)), per_round)
 
         # rank of each row within its partition (stable arrival order)
         order = np.argsort(part, kind="stable")
@@ -320,6 +325,8 @@ class MeshExchangeCoordinator:
                 self.rows_exchanged += n_round
         with self.lock:
             self.exchanges_run += 1
+            if rounds > 1:
+                self.multi_round_exchanges += 1
 
         if len(per_round_results) == 1:
             return per_round_results[0]
@@ -346,7 +353,10 @@ def mesh_coordinator() -> MeshExchangeCoordinator:
     global _coordinator
     with _coordinator_lock:
         if _coordinator is None:
-            _coordinator = MeshExchangeCoordinator()
+            import os
+            _coordinator = MeshExchangeCoordinator(
+                max_rows_per_round=int(os.environ.get(
+                    "TEZ_TPU_MESH_MAX_ROWS_PER_ROUND", 1 << 20)))
         return _coordinator
 
 

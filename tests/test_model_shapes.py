@@ -1,0 +1,48 @@
+"""Canned DAG topology builders (tez-tests dag shapes analog)."""
+import pytest
+
+from tez_tpu.client.dag_client import DAGStatusState
+from tez_tpu.client.tez_client import TezClient
+from tez_tpu.models import shapes
+
+
+@pytest.fixture()
+def client(tmp_staging):
+    c = TezClient.create("shapes", {"tez.staging-dir": tmp_staging,
+                                    "tez.am.local.num-containers": 4}).start()
+    yield c
+    c.stop()
+
+
+def test_shapes_verify():
+    for build in (shapes.simple_dag, shapes.simple_dag_3_vertices,
+                  shapes.simple_v_dag, shapes.simple_reverse_v_dag,
+                  shapes.two_levels_failing_dag,
+                  shapes.three_levels_failing_dag):
+        dag = build()
+        dag.create_dag_plan()   # runs verify()
+
+
+def test_three_levels_shape_runs(client):
+    status = client.submit_dag(
+        shapes.three_levels_failing_dag(payload={})) \
+        .wait_for_completion(timeout=120)
+    assert status.state is DAGStatusState.SUCCEEDED
+
+
+def test_multi_attempt_dag_retries_then_succeeds(client):
+    status = client.submit_dag(
+        shapes.multi_attempt_dag(failing_upto_attempt=1)) \
+        .wait_for_completion(timeout=120)
+    assert status.state is DAGStatusState.SUCCEEDED
+    am = client.framework_client.am
+    d = am.dag_counters.to_dict().get("DAGCounter", {})
+    # 3 vertices x (1 failed attempt + 1 success) at minimum
+    assert d.get("TOTAL_LAUNCHED_TASKS", 0) >= 6
+
+
+def test_failing_shape_fails(client):
+    dag = shapes.simple_dag(payload={"do_fail": True,
+                                     "failing_task_indices": [-1]})
+    status = client.submit_dag(dag).wait_for_completion(timeout=120)
+    assert status.state is DAGStatusState.FAILED

@@ -1,0 +1,12 @@
+"""tez_tpu — a TPU-native DAG data-processing framework.
+
+A re-design of the apache/tez programming model (DAG of vertices joined by
+data-movement edges, pluggable Input/Processor/Output task runtimes, a
+single orchestrating AppMaster) with the data plane rebuilt for TPU
+hardware: device-resident sort/merge via XLA, scatter-gather over ICI
+collectives under shard_map, and a host shuffle service for the DCN path.
+"""
+
+from tez_tpu.version import __version__
+
+__all__ = ["__version__"]

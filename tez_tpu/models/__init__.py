@@ -1,0 +1,9 @@
+"""Canned DAG topologies ("model" shapes) built on the public DSL.
+
+See tez_tpu.models.shapes for the tez-tests dag-shape analogs
+(SimpleTestDAG, V / reverse-V, multi-level failing DAGs, MultiAttemptDAG).
+"""
+
+from tez_tpu.models import shapes
+
+__all__ = ["shapes"]

@@ -38,6 +38,7 @@ from tez_tpu.common.security import (JobTokenSecretManager,
 from tez_tpu.ops.runformat import KVBatch, Run
 from tez_tpu.shuffle.service import (ShuffleDataNotFound, ShuffleService,
                                      local_shuffle_service)
+from tez_tpu.utils.backoff import ExponentialBackoff, retry_call
 
 log = logging.getLogger(__name__)
 
@@ -209,24 +210,27 @@ class ShuffleFetcher:
               partition_lo: int, partition_hi: int = -1) -> List[KVBatch]:
         if partition_hi < 0:
             partition_hi = partition_lo + 1
-        last: Optional[Exception] = None
-        for attempt in range(self.retries):
+
+        def one_try() -> List[KVBatch]:
+            session = FetchSession(self.secrets, host, port,
+                                   self.connect_timeout)
             try:
-                session = FetchSession(self.secrets, host, port,
-                                       self.connect_timeout)
-                try:
-                    return session.fetch_range(path, spill, partition_lo,
-                                               partition_hi)
-                finally:
-                    session.close()
-            except (ShuffleDataNotFound, PermissionError):
-                raise   # definitive: retrying cannot help
-            except (OSError, ValueError, struct.error) as e:
-                # struct.error covers truncated responses (server died
-                # mid-reply) — retryable like any connection fault
-                last = e
-                if attempt < self.retries - 1:
-                    time.sleep(self.backoff * (2 ** attempt))
-        raise ConnectionError(
-            f"fetch {host}:{port}/{path} failed after "
-            f"{self.retries} tries: {last!r}")
+                return session.fetch_range(path, spill, partition_lo,
+                                           partition_hi)
+            finally:
+                session.close()
+
+        try:
+            # struct.error covers truncated responses (server died
+            # mid-reply) — retryable like any connection fault
+            return retry_call(
+                one_try, self.retries,
+                retryable=(OSError, ValueError, struct.error),
+                backoff=ExponentialBackoff(self.backoff),
+                fatal=(ShuffleDataNotFound, PermissionError))
+        except (ShuffleDataNotFound, PermissionError):
+            raise   # definitive: retrying cannot help
+        except (OSError, ValueError, struct.error) as e:
+            raise ConnectionError(
+                f"fetch {host}:{port}/{path} failed after "
+                f"{self.retries} tries: {e!r}") from e

@@ -40,5 +40,10 @@ def render() -> str:
     return "\n".join(lines)
 
 
-if __name__ == "__main__":
+def main() -> int:
     sys.stdout.write(render())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

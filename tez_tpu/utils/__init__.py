@@ -1,0 +1,10 @@
+"""Cross-cutting utilities with no framework dependencies.
+
+(Per-subsystem helpers live next to their subsystem — e.g.
+tez_tpu/library/util.py for the IO library's config resolution; this
+package is for policies shared across transport clients and tools.)
+"""
+
+from tez_tpu.utils.backoff import ExponentialBackoff, retry_call
+
+__all__ = ["ExponentialBackoff", "retry_call"]

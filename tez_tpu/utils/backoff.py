@@ -1,0 +1,50 @@
+"""Retry/backoff policy shared by DCN-facing clients.
+
+Reference role: the exponential penalty schedule the shuffle clients apply
+between attempts (ShuffleScheduler's Penalty DelayQueue,
+tez-runtime-library .../orderedgrouped/ShuffleScheduler.java:179, and the
+fetcher retry loops in Fetcher.java:79).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple, Type
+
+
+class ExponentialBackoff:
+    """base * 2^attempt, capped; attempt counter owned by the caller."""
+
+    def __init__(self, base: float = 0.2, cap: float = 10.0):
+        self.base = base
+        self.cap = cap
+
+    def delay(self, attempt: int) -> float:
+        return min(self.cap, self.base * (2 ** attempt))
+
+    def sleep(self, attempt: int) -> None:
+        time.sleep(self.delay(attempt))
+
+
+def retry_call(fn: Callable, retries: int,
+               retryable: Tuple[Type[BaseException], ...],
+               backoff: Optional[ExponentialBackoff] = None,
+               fatal: Tuple[Type[BaseException], ...] = ()):
+    """Run fn() up to `retries` times, sleeping the policy's delay between
+    retryable failures.  `fatal` exception types propagate immediately
+    (definitive misses must not be retried — e.g. ShuffleDataNotFound
+    drives the InputReadErrorEvent path instead)."""
+    if retries < 1:
+        raise ValueError(f"retries must be >= 1, got {retries}")
+    policy = backoff or ExponentialBackoff()
+    last: Optional[BaseException] = None
+    for attempt in range(retries):
+        try:
+            return fn()
+        except fatal:
+            raise
+        except retryable as e:
+            last = e
+            if attempt < retries - 1:
+                policy.sleep(attempt)
+    assert last is not None
+    raise last

@@ -1,0 +1,7 @@
+"""Framework version, importable without side effects.
+
+Reference role: the Maven project version stamped into every artifact by
+tez-dist (tez-dist/pom.xml) and surfaced at runtime through TezUtilsInternal.
+"""
+
+__version__ = "0.2.0"

@@ -28,7 +28,9 @@ def test_dist_full_and_minimal(tmp_path):
     assert f"{root}/MANIFEST" in names
     with tarfile.open(minimal) as tf:
         min_names = tf.getnames()
-    assert not any("/examples/" in n or "/tools/" in n for n in min_names)
+    assert not any("/examples/" in n or "/models/" in n for n in min_names)
+    # tools stay in minimal (AM web imports them at request time)
+    assert any("/tools/analyzers.py" in n for n in min_names)
     assert any(n.endswith("tez_tpu/am/app_master.py") for n in min_names)
     assert any(n.endswith("native/ragged.cpp") for n in min_names)
     assert len(min_names) < len(names)

@@ -58,7 +58,10 @@ def _usage() -> int:
 
 def main() -> int:
     argv = sys.argv[1:]
-    if not argv or argv[0] in ("-h", "--help") or argv[0] not in _PROGRAMS:
+    if argv and argv[0] in ("-h", "--help"):
+        _usage()
+        return 0
+    if not argv or argv[0] not in _PROGRAMS:
         return _usage()
     run, args_help, _ = _PROGRAMS[argv[0]]
     state = run(argv[1:])

@@ -23,7 +23,7 @@ from tez_tpu.api.events import (CompositeRoutedDataMovementEvent,
 from tez_tpu.api.runtime import (KeyValueReader, KeyValuesReader,
                                  LogicalInput, MergedLogicalInput, Reader)
 from tez_tpu.common.counters import TaskCounter
-from tez_tpu.ops.runformat import KVBatch
+from tez_tpu.ops.runformat import KVBatch, adjacent_equal_rows
 from tez_tpu.ops.serde import Serde, get_serde
 from tez_tpu.shuffle.service import (ShuffleDataNotFound,
                                      local_shuffle_service)
@@ -438,9 +438,7 @@ class GroupedKVReader(KeyValuesReader):
         lengths = ko[1:] - ko[:-1]
         same = np.zeros(n, dtype=bool)
         cand = np.flatnonzero(lengths[1:] == lengths[:-1])
-        for i in cand:
-            same[i + 1] = kb[ko[i]:ko[i + 1]].tobytes() == \
-                kb[ko[i + 1]:ko[i + 2]].tobytes()
+        same[cand + 1] = adjacent_equal_rows(kb, ko, cand)
         return np.flatnonzero(~same).astype(np.int64)
 
     def __iter__(self) -> Iterator[Tuple[Any, Iterator[Any]]]:

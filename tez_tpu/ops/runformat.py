@@ -102,6 +102,34 @@ def gather_ragged(data: np.ndarray, offsets: np.ndarray,
     return data[idx], new_offsets
 
 
+def adjacent_equal_rows(data: np.ndarray, offsets: np.ndarray,
+                        cand: np.ndarray) -> np.ndarray:
+    """For each candidate row index i (caller guarantees rows i and i+1
+    have equal byte length), return True where row i's bytes equal row
+    i+1's — one flat gather per side + a per-pair reduction instead of a
+    Python loop over pairs (the grouping/combine hot path: adjacent-equal
+    detection over sorted runs, ValuesIterator.java:45 semantics)."""
+    m = len(cand)
+    if m == 0:
+        return np.zeros(0, dtype=bool)
+    lengths = (offsets[1:] - offsets[:-1])[cand]
+    out = np.ones(m, dtype=bool)          # zero-length pairs are equal
+    nz = np.flatnonzero(lengths)
+    if len(nz) == 0:
+        return out
+    nz_cand = cand[nz]
+    nz_len = lengths[nz]
+    within = _ranges(nz_len)
+    idx_a = np.repeat(offsets[nz_cand], nz_len) + within
+    idx_b = np.repeat(offsets[nz_cand + 1], nz_len) + within
+    neq = data[idx_a] != data[idx_b]
+    pair_starts = np.zeros(len(nz), dtype=np.int64)
+    np.cumsum(nz_len[:-1], out=pair_starts[1:])
+    mismatches = np.add.reduceat(neq.astype(np.int64), pair_starts)
+    out[nz] = mismatches == 0
+    return out
+
+
 def concat_ragged(parts: Sequence[Tuple[np.ndarray, np.ndarray]]
                   ) -> Tuple[np.ndarray, np.ndarray]:
     """Concatenate (data, offsets) raggeds."""

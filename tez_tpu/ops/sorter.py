@@ -24,7 +24,8 @@ import numpy as np
 from tez_tpu.common.counters import TaskCounter, TezCounters
 from tez_tpu.ops import device
 from tez_tpu.ops.keycodec import encode_keys, pad_to_matrix, matrix_to_lanes
-from tez_tpu.ops.runformat import KVBatch, Run, gather_ragged
+from tez_tpu.ops.runformat import (KVBatch, Run, adjacent_equal_rows,
+                                   gather_ragged)
 
 log = logging.getLogger(__name__)
 
@@ -538,9 +539,7 @@ def sum_long_combiner(run: Run) -> Run:
         cand = (partitions[1:] == partitions[:-1]) & \
             (lengths[1:] == lengths[:-1])
         idx = np.flatnonzero(cand)
-        for i in idx:  # verify bytes only for candidates
-            same[i + 1] = kb[ko[i]:ko[i + 1]].tobytes() == \
-                kb[ko[i + 1]:ko[i + 2]].tobytes()
+        same[idx + 1] = adjacent_equal_rows(kb, ko, idx)
     group_starts = np.flatnonzero(~same)
     # decode values (8-byte BE unsigned with sign-flip encoding); the fast
     # path requires every value to be exactly 8 bytes (long serde), not just

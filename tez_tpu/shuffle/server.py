@@ -202,7 +202,9 @@ class ShuffleFetcher:
     def __init__(self, secrets: JobTokenSecretManager, retries: int = 3,
                  backoff: float = 0.2, connect_timeout: float = 5.0):
         self.secrets = secrets
-        self.retries = retries
+        # clamp here: retry_call's retries<1 ValueError would otherwise be
+        # misread by fetch() as a retryable fetch fault
+        self.retries = max(1, retries)
         self.backoff = backoff
         self.connect_timeout = connect_timeout
 

@@ -27,7 +27,9 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-_MINIMAL_EXCLUDED_PKG_DIRS = ("examples", "tools", "models")
+# tools/ stays in minimal: the AM web controller imports swimlane/analyzer
+# modules at request time, so they are framework, not extras
+_MINIMAL_EXCLUDED_PKG_DIRS = ("examples", "models")
 _SKIP_NAMES = ("__pycache__", ".pytest_cache")
 
 

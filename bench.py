@@ -138,6 +138,13 @@ def _arm_watchdog() -> None:
     def _zero() -> None:
         if _bench_done.is_set():
             return
+        if os.environ.get("TEZ_BENCH_E2E_ONLY") == "1":
+            print(json.dumps({
+                "metric": f"OrderedWordCount E2E WATCHDOG: stalled during "
+                          f"{_phase[0]}",
+                "value": 0.0, "unit": "MB/s", "vs_baseline": 0.0}),
+                flush=True)
+            os._exit(0)
         if _kernel_line[0] is not None:
             # the kernel measurement completed and verified; only a later
             # stage (framework E2E) stalled — report the real number
@@ -309,11 +316,42 @@ def bench_framework(cpu_fallback: bool) -> dict:
         shutil.rmtree(td, ignore_errors=True)
 
 
+def _bench_framework_subprocess(cpu_fallback: bool) -> dict:
+    """Run the E2E stage in a FRESH process: the kernel bench leaves 2M-record
+    buffers + executables on the (relay-backed) device, and measuring the
+    framework in that polluted state under-reports it.  Falls back to
+    in-process on any subprocess failure."""
+    import subprocess
+    env = dict(os.environ)
+    env["TEZ_BENCH_E2E_ONLY"] = "1"
+    budget = float(os.environ.get("TEZ_BENCH_TIMEOUT", "480"))
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=budget)
+        for line in reversed(out.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                return json.loads(line)
+        raise RuntimeError(
+            f"no JSON from E2E subprocess: {out.stderr[-300:]!r}")
+    except Exception as e:  # noqa: BLE001 — degrade to in-process
+        sys.stderr.write(f"e2e subprocess failed ({e!r:.200}); "
+                         "running in-process\n")
+        return bench_framework(cpu_fallback)
+
+
 def main() -> int:
     cpu_fallback = os.environ.get("TEZ_BENCH_FALLBACK") == "1"
     if cpu_fallback:
         import jax
         jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("TEZ_BENCH_E2E_ONLY") == "1":
+        _arm_watchdog()
+        line = bench_framework(cpu_fallback)
+        if _bench_done is not None:
+            _bench_done.set()
+        print(json.dumps(line), flush=True)
+        return 0
     num_records = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
     key_len = 12
     num_producers, num_partitions = 4, 4
@@ -377,7 +415,10 @@ def main() -> int:
     fw_line = None
     if os.environ.get("TEZ_BENCH_SKIP_E2E") != "1":
         try:
-            fw_line = bench_framework(cpu_fallback)
+            if cpu_fallback:
+                fw_line = bench_framework(cpu_fallback)
+            else:
+                fw_line = _bench_framework_subprocess(cpu_fallback)
         except BaseException as e:  # noqa: BLE001 — the kernel line must
             # still print: a broken E2E stage degrades, never hides
             fw_line = {"metric": f"OrderedWordCount E2E FAILED: {e!r:.200}",

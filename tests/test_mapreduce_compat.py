@@ -153,3 +153,62 @@ def test_multi_mr_input_one_reader_per_split(tmp_path):
     inp2.context = _Ctx()
     inp2.initialize()
     assert [line for _, line in inp2.get_reader()] == [b"a1", b"a2", b"b1"]
+
+
+def test_split_wave_grouping_keys(tmp_path):
+    """tez.grouping.split-waves/min-size/max-size drive group count when
+    vertex parallelism is unbound (TezSplitGrouper semantics)."""
+    from tez_tpu.io.formats import MRSplitGenerator
+    from tez_tpu.common.payload import UserPayload
+
+    data = tmp_path / "in.txt"
+    data.write_bytes(b"x" * (1 << 20))   # 1 MiB
+
+    class Ctx:
+        num_tasks = -1
+        def __init__(self, payload):
+            self.user_payload = UserPayload.of(payload)
+        def get_total_available_resource(self):
+            return 4
+
+    class Gen(MRSplitGenerator):
+        def __init__(self, payload):
+            self.context = Ctx(payload)
+
+    def group_count(payload):
+        events = Gen(payload).initialize()
+        return events[0].num_tasks
+
+    base = {"paths": [str(data)], "min_split_bytes": 1024}
+    # min-size dominates: 1 MiB total / 50 MiB min => 1 group
+    assert group_count(dict(base)) == 1
+    # tiny min-size: waves x slots = 6 groups (1.7 * 4 -> 6)
+    assert group_count({**base, "tez.grouping.min-size": 1024}) == 6
+    # waves honored
+    assert group_count({**base, "tez.grouping.min-size": 1024,
+                        "tez.grouping.split-waves": 1.0}) == 4
+    # max-size forces MORE groups than waves would pick
+    assert group_count({**base, "tez.grouping.min-size": 1,
+                        "tez.grouping.max-size": 64 * 1024}) == 16
+
+
+def test_counter_limits_configurable():
+    from tez_tpu.common.counters import Limits
+    before = (Limits.MAX_COUNTERS, Limits.MAX_GROUPS)
+    try:
+        Limits.configure({"tez.counters.max": 7, "tez.counters.max.groups": 3})
+        assert (Limits.MAX_COUNTERS, Limits.MAX_GROUPS) == (7, 3)
+    finally:
+        Limits.MAX_COUNTERS, Limits.MAX_GROUPS = before
+
+
+def test_svm_descriptor_from_conf():
+    from tez_tpu.library.vertex_managers import ShuffleVertexManager
+    d = ShuffleVertexManager.create_descriptor(
+        {"tez.shuffle-vertex-manager.min-src-fraction": 0.5,
+         "tez.shuffle-vertex-manager.enable.auto-parallel": True},
+        min_task_parallelism=2)
+    p = d.payload.load()
+    assert p["min_fraction"] == 0.5 and p["auto_parallel"] is True
+    assert p["max_fraction"] == 0.75 and p["min_task_parallelism"] == 2
+    assert "ShuffleVertexManager" in d.class_name

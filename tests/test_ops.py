@@ -530,3 +530,23 @@ def test_resident_merge_mixed_lane_widths():
     got = [k for k, _ in merged.batch.iter_pairs()]
     assert got == sorted(got) and len(got) == 240
     assert sorted(got) == sorted(k for k, _, _ in all_keys)
+
+
+def test_encode_keys_device_parity():
+    """Device ragged->lanes encode == host encode (keycodec twins)."""
+    import numpy as np
+    from tez_tpu.ops.keycodec import encode_keys, encode_keys_device
+    rng = np.random.default_rng(3)
+    # lengths up to 40 so every width below has over-width keys (the
+    # mask-at-width-vs-rounded-lanes distinction only shows then)
+    rows = [rng.integers(97, 123, rng.integers(0, 41), dtype=np.int64)
+            .astype(np.uint8) for _ in range(500)]
+    kb = np.concatenate([r for r in rows if len(r)] or
+                        [np.zeros(0, np.uint8)])
+    ko = np.cumsum([0] + [len(r) for r in rows]).astype(np.int64)
+    for width in (4, 16, 31):
+        lanes_h, lens_h = encode_keys(kb, ko, width)
+        lanes_d, lens_d = encode_keys_device(kb, ko, width)
+        assert np.array_equal(lanes_h, np.asarray(lanes_d)), width
+        assert np.array_equal(lens_h.astype(np.int64),
+                              np.asarray(lens_d).astype(np.int64)), width

@@ -101,18 +101,30 @@ def test_runner_process_killed_midtask_recovers(tmp_staging):
                 "tez_tpu.library.processors:SleepProcessor",
                 payload={"sleep_ms": 4000}), 2))
         dc = c.submit_dag(dag)
-        deadline = time.time() + 20
+        # Deterministic victim selection: wait until some attempt is
+        # actually RUNNING in a live runner process, then kill THAT
+        # process (a fixed sleep races child startup on a loaded box).
+        from tez_tpu.am.task_impl import TaskAttemptState
+        deadline = time.time() + 30
         victim = None
         while time.time() < deadline and victim is None:
-            with am.runner_pool._lock:
-                procs = [p for p, _cid in am.runner_pool._procs.values()]
-            for p in procs:
-                if p.poll() is None:
-                    victim = p
-                    break
-            time.sleep(0.1)
-        assert victim is not None, "no runner process appeared"
-        time.sleep(1.0)       # let it pick a task up
+            running_cids = set()
+            d = am.current_dag
+            for v in (d.vertices.values() if d else ()):
+                for t in v.tasks.values():
+                    for a in t.attempts.values():
+                        if a.state is TaskAttemptState.RUNNING and \
+                                a.container_id is not None:
+                            running_cids.add(str(a.container_id))
+            if running_cids:
+                with am.runner_pool._lock:
+                    for p, cid in am.runner_pool._procs.values():
+                        if str(cid) in running_cids and p.poll() is None:
+                            victim = p
+                            break
+            if victim is None:
+                time.sleep(0.1)
+        assert victim is not None, "no attempt started in a runner process"
         os.kill(victim.pid, signal.SIGKILL)
         status = dc.wait_for_completion(timeout=60)
         assert status.state is DAGStatusState.SUCCEEDED

@@ -54,6 +54,8 @@ class DAGAppMaster:
         else:
             self.dispatcher = Dispatcher(f"am-{app_id}")
         self.dag_counters = TezCounters()
+        from tez_tpu.common.counters import Limits
+        Limits.configure(conf)
         num_slots = conf.get(C.AM_NUM_CONTAINERS) or max(2, os.cpu_count() or 2)
         self.task_scheduler = create_task_scheduler(self, num_slots)
         self.scheduler_manager = TaskSchedulerManager(self, self.task_scheduler)

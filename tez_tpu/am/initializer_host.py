@@ -51,6 +51,10 @@ class _InitializerContext(InputInitializerContext):
         return self._spec.input_descriptor.payload
 
     @property
+    def conf(self) -> Any:
+        return self._vertex.conf
+
+    @property
     def num_tasks(self) -> int:
         return self._vertex.num_tasks
 

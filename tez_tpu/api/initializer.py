@@ -41,6 +41,14 @@ class InputInitializerContext(abc.ABC):
         return UserPayload()
 
     @property
+    def conf(self) -> Any:
+        """Effective vertex/DAG configuration.  (The reference delivers
+        conf to split generators inside MRInputUserPayload; exposing it on
+        the context lets tez.grouping.* keys work without payload
+        plumbing.)"""
+        return {}
+
+    @property
     @abc.abstractmethod
     def num_tasks(self) -> int:
         """Vertex parallelism as declared (-1 = initializer decides)."""

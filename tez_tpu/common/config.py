@@ -169,6 +169,41 @@ CLIENT_AM_HEARTBEAT_INTERVAL_SECS = _key(
 DAG_SCHEDULER_CLASS = _key("tez.am.dag.scheduler.class",
                            "tez_tpu.am.dag_scheduler:DAGSchedulerNaturalOrder", Scope.AM)
 THREAD_DUMP_INTERVAL_MS = _key("tez.thread.dump.interval.ms", 0, Scope.VERTEX)
+TASK_AM_HEARTBEAT_INTERVAL_MS = _key(
+    "tez.task.am.heartbeat.interval-ms", 50, Scope.VERTEX,
+    "TaskReporter heartbeat period (reference: "
+    "tez.task.am.heartbeat.interval-ms.max)")
+COUNTERS_MAX = _key("tez.counters.max", 1200, Scope.AM,
+                    "Counter-per-group cap (Limits.java)")
+COUNTERS_MAX_GROUPS = _key("tez.counters.max.groups", 500, Scope.AM,
+                           "Counter-group cap (Limits.java)")
+SHUFFLE_VM_AUTO_PARALLEL = _key(
+    "tez.shuffle-vertex-manager.enable.auto-parallel", False, Scope.VERTEX,
+    "Let ShuffleVertexManager shrink consumer parallelism from observed "
+    "source output size (ShuffleVertexManager.java:78)")
+SHUFFLE_VM_MIN_SRC_FRACTION = _key(
+    "tez.shuffle-vertex-manager.min-src-fraction", 0.25, Scope.VERTEX,
+    "Source-completion fraction at which slow-start begins releasing tasks")
+SHUFFLE_VM_MAX_SRC_FRACTION = _key(
+    "tez.shuffle-vertex-manager.max-src-fraction", 0.75, Scope.VERTEX,
+    "Source-completion fraction at which every consumer task is released")
+SHUFFLE_VM_DESIRED_TASK_INPUT_SIZE = _key(
+    "tez.shuffle-vertex-manager.desired-task-input-size",
+    100 * 1024 * 1024, Scope.VERTEX,
+    "Auto-parallelism targets ceil(total/this) consumer tasks")
+SHUFFLE_VM_MIN_TASK_PARALLELISM = _key(
+    "tez.shuffle-vertex-manager.min-task-parallelism", 1, Scope.VERTEX,
+    "Auto-parallelism never shrinks below this")
+GROUPING_SPLIT_WAVES = _key(
+    "tez.grouping.split-waves", 1.7, Scope.VERTEX,
+    "Desired split groups per available slot when vertex parallelism is "
+    "unbound (TezSplitGrouper.TEZ_GROUPING_SPLIT_WAVES)")
+GROUPING_MIN_SIZE = _key(
+    "tez.grouping.min-size", 50 * 1024 * 1024, Scope.VERTEX,
+    "Lower bound on average grouped-split size")
+GROUPING_MAX_SIZE = _key(
+    "tez.grouping.max-size", 1024 * 1024 * 1024, Scope.VERTEX,
+    "Upper bound on average grouped-split size")
 TASK_JAX_PROFILE_DIR = _key(
     "tez.task.jax-profile.dir", "", Scope.VERTEX,
     "Write a per-task-attempt XLA profiler trace (TensorBoard/Perfetto) "

@@ -17,11 +17,26 @@ class CounterLimitExceeded(Exception):
 
 
 class Limits:
-    """Reference: common/counters/Limits.java."""
-    MAX_COUNTERS = 1200
-    MAX_GROUPS = 500
+    """Reference: common/counters/Limits.java (caps configurable via
+    tez.counters.max / tez.counters.max.groups, Limits.setConfiguration)."""
+    DEFAULT_MAX_COUNTERS = 1200
+    DEFAULT_MAX_GROUPS = 500
+    MAX_COUNTERS = DEFAULT_MAX_COUNTERS
+    MAX_GROUPS = DEFAULT_MAX_GROUPS
     MAX_COUNTER_NAME_LEN = 64
     MAX_GROUP_NAME_LEN = 256
+
+    @classmethod
+    def configure(cls, conf: Any) -> None:
+        # always resolve against the pristine defaults so one AM's caps
+        # never leak into the next AM in the same process
+        try:
+            cls.MAX_COUNTERS = int(conf.get("tez.counters.max",
+                                            cls.DEFAULT_MAX_COUNTERS))
+            cls.MAX_GROUPS = int(conf.get("tez.counters.max.groups",
+                                          cls.DEFAULT_MAX_GROUPS))
+        except (TypeError, ValueError, AttributeError):
+            pass
 
 
 class TaskCounter(enum.Enum):

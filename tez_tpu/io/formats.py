@@ -291,15 +291,41 @@ class MRSplitGenerator(InputInitializer):
 
     def initialize(self) -> List[Any]:
         payload = self.context.user_payload.load() or {}
+        conf = getattr(self.context, "conf", None) or {}
+
+        def knob(key: str, default: Any) -> Any:
+            # payload overrides conf overrides default (the edge-payload
+            # precedence rule)
+            return payload.get(key, conf.get(key, default))
+
         fmt = resolve_format(payload.get("format", "text"),
                              payload.get("format_params"))
         desired = payload.get("desired_splits", -1)
         if desired <= 0:
             desired = self.context.num_tasks
         if desired <= 0:
-            desired = max(1, self.context.get_total_available_resource())
+            # unbound parallelism: waves x available slots, with the group
+            # count clamped so the average grouped-split size stays inside
+            # [tez.grouping.min-size, tez.grouping.max-size]
+            # (TezSplitGrouper.java:43 wave/size semantics)
+            waves = float(knob("tez.grouping.split-waves", 1.7))
+            desired = max(1, int(
+                self.context.get_total_available_resource() * waves))
+        min_split = payload.get("min_split_bytes", 64 * 1024)
         splits = fmt.compute_splits(payload.get("paths", []), desired,
-                                    payload.get("min_split_bytes", 64 * 1024))
+                                    min_split)
+        total_bytes = sum(s.length for s in splits)
+        min_sz = int(knob("tez.grouping.min-size", 50 * 1024 * 1024))
+        max_sz = int(knob("tez.grouping.max-size", 1024 ** 3))
+        if self.context.num_tasks <= 0 and total_bytes > 0:
+            cap = max(1, total_bytes // max(1, min_sz))     # avg >= min-size
+            floor = -(-total_bytes // max(1, max_sz))       # avg <= max-size
+            clamped = max(min(desired, cap), floor)
+            if clamped > len(splits):
+                # need finer splits than the wave count produced
+                splits = fmt.compute_splits(payload.get("paths", []),
+                                            clamped, min_split)
+            desired = clamped
         groups = group_splits(splits, desired)
         if self.context.num_tasks > 0:
             # fixed vertex parallelism: every task needs exactly one split

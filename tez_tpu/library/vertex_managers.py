@@ -141,6 +141,33 @@ class ShuffleVertexManager(VertexManagerPlugin):
     DEFAULT_MIN_FRACTION = 0.25
     DEFAULT_MAX_FRACTION = 0.75
 
+    @classmethod
+    def create_descriptor(cls, conf: Any = None, **overrides: Any):
+        """Config-builder parity (ShuffleVertexManager.createConfigBuilder):
+        translate the registered tez.shuffle-vertex-manager.* conf keys into
+        the plugin payload; keyword overrides win."""
+        from tez_tpu.common import config as C
+        from tez_tpu.common.payload import VertexManagerPluginDescriptor
+        conf = conf or {}
+        # single source of defaults: the registered ConfKeys
+        payload = {
+            "min_fraction": conf.get(C.SHUFFLE_VM_MIN_SRC_FRACTION.name,
+                                     C.SHUFFLE_VM_MIN_SRC_FRACTION.default),
+            "max_fraction": conf.get(C.SHUFFLE_VM_MAX_SRC_FRACTION.name,
+                                     C.SHUFFLE_VM_MAX_SRC_FRACTION.default),
+            "auto_parallel": conf.get(C.SHUFFLE_VM_AUTO_PARALLEL.name,
+                                      C.SHUFFLE_VM_AUTO_PARALLEL.default),
+            "desired_task_input_size": conf.get(
+                C.SHUFFLE_VM_DESIRED_TASK_INPUT_SIZE.name,
+                C.SHUFFLE_VM_DESIRED_TASK_INPUT_SIZE.default),
+            "min_task_parallelism": conf.get(
+                C.SHUFFLE_VM_MIN_TASK_PARALLELISM.name,
+                C.SHUFFLE_VM_MIN_TASK_PARALLELISM.default),
+        }
+        payload.update(overrides)
+        return VertexManagerPluginDescriptor.create(
+            f"{cls.__module__}:{cls.__name__}", payload=payload)
+
     def initialize(self) -> None:
         payload = self.context.user_payload.load() or {}
         if not isinstance(payload, dict):

@@ -60,6 +60,53 @@ def encode_keys(key_bytes: np.ndarray, offsets: np.ndarray,
     return matrix_to_lanes(mat), lengths
 
 
+def encode_keys_device(key_bytes: np.ndarray, offsets: np.ndarray,
+                       width: int):
+    """Device-resident ragged->lanes encode: upload the RAW ragged bytes +
+    offsets and run the padded gather + big-endian lane packing as one XLA
+    program on the chip (gather is hardware-optimized there; a hand-rolled
+    per-row DMA kernel would be strictly worse).  Returns device arrays
+    (lanes u32[N, ceil(width/4)], lengths i32[N]).
+
+    This is the device twin of encode_keys — the answer to SURVEY.md §7's
+    "variable-length KV on TPU" risk: ragged keys cross the PCIe/ICI
+    boundary raw, and every derived fixed-width view lives in HBM.
+    """
+    import jax.numpy as jnp
+
+    n = len(offsets) - 1
+    if n == 0 or key_bytes.size == 0:
+        return (jnp.zeros((n, max(1, (width + 3) // 4)), dtype=jnp.uint32),
+                jnp.zeros((n,), dtype=jnp.int32))
+    return _encode_keys_jit(jnp.asarray(key_bytes),
+                            jnp.asarray(offsets.astype(np.int32)), width)
+
+
+def _encode_keys_jit(key_bytes, offsets, width: int):
+    import functools
+
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("width",))
+    def go(data, offs, width: int):
+        import jax.numpy as jnp
+        starts = offs[:-1]
+        lengths = (offs[1:] - starts).astype(jnp.int32)
+        w4 = width + ((-width) % 4)
+        j = jnp.arange(w4, dtype=jnp.int32)[None, :]
+        idx = jnp.clip(starts[:, None] + j, 0, data.shape[0] - 1)
+        # mask at WIDTH (not the lane-rounded w4): bytes past the configured
+        # width must zero-pad exactly like host pad_to_matrix
+        valid = j < jnp.minimum(lengths, width)[:, None]
+        mat = jnp.where(valid, jnp.take(data, idx), 0).astype(jnp.uint32)
+        m = mat.reshape(mat.shape[0], w4 // 4, 4)
+        lanes = (m[..., 0] << 24) | (m[..., 1] << 16) | \
+            (m[..., 2] << 8) | m[..., 3]
+        return lanes, lengths
+
+    return go(key_bytes, offsets, width)
+
+
 def lanes_to_matrix(lanes: np.ndarray) -> np.ndarray:
     """Inverse of matrix_to_lanes: big-endian uint32[N, L] -> uint8[N, L*4]."""
     n, num_lanes = lanes.shape

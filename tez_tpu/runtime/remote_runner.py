@@ -70,7 +70,23 @@ def run_loop(am_host: str, am_port: int, node_id: str, token_hex: str,
     return 0
 
 
+def _repin_jax_platform() -> None:
+    """Honor the caller's JAX_PLATFORMS request verbatim: an ambient
+    sitecustomize may have pinned a different platform in jax.config, which
+    outranks the env var — a runner handed JAX_PLATFORMS=cpu (e.g. the test
+    mesh, or a host-only deployment) must not initialize the TPU backend."""
+    env_platforms = os.environ.get("JAX_PLATFORMS", "")
+    if not env_platforms:
+        return
+    try:
+        import jax
+        jax.config.update("jax_platforms", env_platforms)
+    except Exception:  # noqa: BLE001 — backend already initialized / no jax
+        pass
+
+
 def main() -> int:
+    _repin_jax_platform()
     parser = argparse.ArgumentParser()
     parser.add_argument("--am-host", default="127.0.0.1")
     parser.add_argument("--am-port", type=int, required=True)

@@ -238,7 +238,13 @@ class TaskRunner:
 
     def _heartbeat_loop(self) -> None:
         from tez_tpu.am.task_comm import HeartbeatRequest
-        while not self._done.wait(HEARTBEAT_INTERVAL):
+        try:
+            interval = float(self.spec.conf.get(
+                "tez.task.am.heartbeat.interval-ms",
+                HEARTBEAT_INTERVAL * 1000)) / 1000.0
+        except (TypeError, ValueError):
+            interval = HEARTBEAT_INTERVAL
+        while not self._done.wait(interval):
             try:
                 self._heartbeat_once()
             except BaseException:  # noqa: BLE001

@@ -491,11 +491,23 @@ def analyze_dag(dag: DagInfo,
 
 
 def main() -> int:
-    """AnalyzerDriver CLI: python -m tez_tpu.tools.analyzers <jsonl...>"""
+    """AnalyzerDriver CLI: python -m tez_tpu.tools.analyzers <jsonl...>
+    or --cache-dir <dir> [dag_id...] (timeline-cache-backed reads)."""
     if len(sys.argv) < 2:
-        print("usage: analyzers <history.jsonl | dir | glob>...")
+        print("usage: analyzers <history.jsonl | dir | glob>... | "
+              "--cache-dir <dir> [dag_id...]")
         return 2
-    dags = parse_jsonl_files(sys.argv[1:])
+    if sys.argv[1] == "--cache-dir":
+        if len(sys.argv) < 3:
+            print("usage: analyzers --cache-dir <dir> [dag_id...]")
+            return 2
+        from tez_tpu.tools.history_cache import DagInfoCache
+        cache = DagInfoCache(sys.argv[2])
+        wanted = sys.argv[3:]
+        dags = {i: d for i, d in cache.all().items()
+                if not wanted or i in wanted}
+    else:
+        dags = parse_jsonl_files(sys.argv[1:])
     if not dags:
         print("no DAGs found")
         return 1

@@ -1,0 +1,119 @@
+"""Cached read path over history logs — the timeline-cache plugin analog.
+
+Reference role: tez-yarn-timeline-cache-plugin (ATS v1.5 entity-group
+cache): the timeline reader groups every entity belonging to one DAG into a
+per-DAG group so repeated reads of a finished DAG hit a cache instead of
+re-scanning the store.  Here the store is a directory of JSONL history
+files (JsonlHistoryLoggingService output); the cache tracks each file's
+(mtime, size) fingerprint, re-parses only changed files, keeps per-DAG
+DagInfo objects (the "entity group"), and LRU-evicts beyond a cap.
+
+Used by the analyzer CLI (`tez-analyzer --cache-dir`) and embeddable in a
+long-lived history-serving process the way the plugin serves the Tez UI.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from tez_tpu.tools.history_parser import DagInfo, parse_jsonl_files
+
+
+class DagInfoCache:
+    """Entity-group cache over a history log directory."""
+
+    def __init__(self, log_dir: str, max_dags: int = 64):
+        self.log_dir = log_dir
+        self.max_dags = max_dags
+        self._lock = threading.Lock()
+        self._fingerprints: Dict[str, Tuple[float, int]] = {}
+        # dag_id -> (DagInfo, source files); OrderedDict = LRU order
+        self._dags: "OrderedDict[str, DagInfo]" = OrderedDict()
+        self._dag_files: Dict[str, frozenset] = {}
+        self.hits = 0
+        self.misses = 0
+
+    # -- store scanning -----------------------------------------------------
+    def _scan(self) -> List[str]:
+        if not os.path.isdir(self.log_dir):
+            return []
+        return sorted(os.path.join(self.log_dir, f)
+                      for f in os.listdir(self.log_dir)
+                      if f.endswith(".jsonl"))
+
+    def _changed_files(self) -> List[str]:
+        changed = []
+        for path in self._scan():
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            fp = (st.st_mtime, st.st_size)
+            if self._fingerprints.get(path) != fp:
+                changed.append(path)
+                self._fingerprints[path] = fp
+        return changed
+
+    def refresh(self) -> int:
+        """Re-parse changed files; returns how many files were re-read.
+        A DAG whose events span several files is rebuilt from ALL its known
+        source files so partial re-parses cannot truncate it."""
+        with self._lock:
+            changed = self._changed_files()
+            if not changed:
+                return 0
+            # re-parse the union of changed files and any file sets of DAGs
+            # they touch (cheap: JSONL parse is line-local)
+            to_read = set(changed)
+            parsed = parse_jsonl_files(sorted(to_read))
+            for dag_id, info in parsed.items():
+                known = self._dag_files.get(dag_id, frozenset())
+                files = frozenset(to_read) | known
+                if known - to_read:
+                    # events for this DAG live in unchanged files too —
+                    # rebuild from the full set for a complete DagInfo
+                    info = parse_jsonl_files(sorted(files)).get(dag_id, info)
+                self._dag_files[dag_id] = files
+                self._dags[dag_id] = info
+                self._dags.move_to_end(dag_id)
+            while len(self._dags) > self.max_dags:
+                old_id, _ = self._dags.popitem(last=False)
+                self._dag_files.pop(old_id, None)
+            return len(changed)
+
+    # -- read API -----------------------------------------------------------
+    def get(self, dag_id: str) -> Optional[DagInfo]:
+        self.refresh()
+        with self._lock:
+            info = self._dags.get(dag_id)
+            if info is not None:
+                self.hits += 1
+                self._dags.move_to_end(dag_id)
+                return info
+            self.misses += 1
+        # miss for a possibly LRU-evicted DAG: the files are unchanged so
+        # refresh() won't re-read them — do a full bypass parse and
+        # re-admit the entry if it exists on disk
+        parsed = parse_jsonl_files(self._scan())
+        info = parsed.get(dag_id)
+        if info is not None:
+            with self._lock:
+                self._dags[dag_id] = info
+                self._dag_files[dag_id] = frozenset(self._scan())
+                self._dags.move_to_end(dag_id)
+                while len(self._dags) > self.max_dags:
+                    old_id, _ = self._dags.popitem(last=False)
+                    self._dag_files.pop(old_id, None)
+        return info
+
+    def dag_ids(self) -> List[str]:
+        self.refresh()
+        with self._lock:
+            return list(self._dags)
+
+    def all(self) -> Dict[str, DagInfo]:
+        self.refresh()
+        with self._lock:
+            return dict(self._dags)

@@ -195,7 +195,15 @@ def _arm_watchdog() -> None:
         except Exception:  # noqa: BLE001 — the zero timer is still armed
             pass
 
-    for delay, fn in ((fallback_delay, _fallback), (budget, _zero)):
+    timers = [(budget, _zero)]
+    if os.environ.get("TEZ_BENCH_E2E_ONLY") != "1":
+        # The CPU-fallback timer exists to catch a relay that stalls during
+        # backend init/compile.  The E2E-only child is only ever spawned
+        # AFTER the kernel stage proved the device alive, and its runs are
+        # legitimately minutes long — arming the 150 s fallback there would
+        # kill a healthy measurement and mislabel it a stall.
+        timers.insert(0, (fallback_delay, _fallback))
+    for delay, fn in timers:
         t = threading.Timer(delay, fn)
         t.daemon = True
         t.start()

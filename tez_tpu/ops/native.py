@@ -37,9 +37,14 @@ def _load() -> "ctypes.CDLL | None":
         if _lib not in (None,):
             return _lib if _lib is not False else None
         try:
-            if not os.path.exists(_SO_PATH):
+            # make is a no-op when current and rebuilds a stale .so after a
+            # source change (the .so is newer-than-sources checked)
+            try:
                 subprocess.run(["make", "-C", _NATIVE_DIR, "-s"],
                                check=True, capture_output=True, timeout=120)
+            except Exception:  # noqa: BLE001 — no toolchain: use stale .so
+                if not os.path.exists(_SO_PATH):
+                    raise
             lib = ctypes.CDLL(_SO_PATH)
             lib.gather_ragged_u8.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,

@@ -35,7 +35,27 @@ def run_loop(am_host: str, am_port: int, node_id: str, token_hex: str,
 
     secrets = JobTokenSecretManager(bytes.fromhex(token_hex))
     umbilical = RemoteUmbilical(am_host, am_port, secrets)
-    shuffle_server = ShuffleServer(secrets, local_shuffle_service()).start()
+    native_dir = os.environ.get("TEZ_TPU_NATIVE_SHUFFLE_DIR", "")
+    shuffle_server = None
+    if native_dir:
+        # native sendfile data server (ShuffleHandler analog): registered
+        # runs are write-through serialized to disk; remote fetches never
+        # enter Python.  Falls back to the Python server if the native lib
+        # is unavailable on this host.
+        try:
+            from tez_tpu.shuffle.native_server import (FileShuffleStore,
+                                                       NativeShuffleServer)
+            store_dir = os.path.join(native_dir, f"runner-{os.getpid()}")
+            shuffle_server = NativeShuffleServer(secrets, store_dir).start()
+            # attach only after the server is up: a failed native start
+            # must not leave every spill double-written for nothing
+            local_shuffle_service().attach_store(FileShuffleStore(store_dir))
+        except Exception:  # noqa: BLE001
+            log.exception("native shuffle server unavailable; "
+                          "using the Python server")
+            shuffle_server = None
+    if shuffle_server is None:
+        shuffle_server = ShuffleServer(secrets, local_shuffle_service()).start()
     if not container_id:
         container_id = str(ContainerId(f"app_proc_{node_id}", os.getpid()))
     registry = ObjectRegistry()

@@ -1,0 +1,155 @@
+"""Native shuffle server bindings + the on-disk partition-blob store.
+
+Reference parity: the NM-resident ShuffleHandler serves every local spill
+file for every job from ONE native server with job-token HMAC auth and
+zero-copy sendfile (ShuffleHandler.java:159, IndexCache, FadvisedFileRegion)
+— index files say where each reducer's slice lives, and the server never
+deserializes data.  Here:
+
+- FileShuffleStore writes each registered Run as pre-serialized
+  single-partition blobs (`<hex(path)>_<spill>.data`) plus a TZIX index of
+  blob offsets (TezSpillRecord analog) — done once at producer close.
+- native/shuffle_server.cpp serves byte ranges straight from those files
+  via sendfile(2) on the SAME wire protocol as the Python ShuffleServer,
+  so the existing FetchSession/ShuffleFetcher clients work unchanged.
+
+Enable per-runner with TEZ_TPU_NATIVE_SHUFFLE_DIR (remote_runner wires the
+store as a write-through on the in-process registry: local fetches stay
+RAM short-circuited, remote fetches hit the C++ server).
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import struct
+import threading
+from typing import Optional
+
+from tez_tpu.common.security import JobTokenSecretManager
+from tez_tpu.ops.runformat import Run
+
+log = logging.getLogger(__name__)
+
+_INDEX_MAGIC = b"TZIX"
+
+
+def _base_name(path_component: str, spill_id: int) -> str:
+    return f"{path_component.encode().hex()}_{spill_id}"
+
+
+class FileShuffleStore:
+    """Write-through persistence for the ShuffleService registry."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def register(self, path_component: str, spill_id: int, run: Run) -> None:
+        """Serialize every partition once; readers get raw byte ranges."""
+        blobs = []
+        for p in range(run.num_partitions):
+            single = Run(run.partition(p),
+                         _two_entry_index(run.partition_row_count(p)))
+            blobs.append(single.to_bytes())
+        base = os.path.join(self.directory,
+                            _base_name(path_component, spill_id))
+        offsets = [0]
+        for b in blobs:
+            offsets.append(offsets[-1] + len(b))
+        with self._lock:
+            tmp = base + ".tmp"
+            with open(tmp, "wb") as fh:
+                for b in blobs:
+                    fh.write(b)
+            os.replace(tmp, base + ".data")
+            with open(base + ".index.tmp", "wb") as fh:
+                fh.write(_INDEX_MAGIC)
+                fh.write(struct.pack("<I", len(blobs)))
+                fh.write(struct.pack(f"<{len(offsets)}Q", *offsets))
+            # data strictly before index: a reader that sees the index can
+            # always sendfile the data
+            os.replace(base + ".index.tmp", base + ".index")
+
+    def unregister_prefix(self, prefix: str) -> int:
+        """Deletion-tracker hook: remove all files whose decoded path starts
+        with prefix."""
+        removed = 0
+        with self._lock:
+            for name in os.listdir(self.directory):
+                if not name.endswith(".index"):
+                    continue
+                hexpart = name[:-len(".index")].rsplit("_", 1)[0]
+                try:
+                    decoded = bytes.fromhex(hexpart).decode()
+                except ValueError:
+                    continue
+                if decoded.startswith(prefix):
+                    stem = name[:-len(".index")]
+                    for suffix in (".index", ".data"):
+                        try:
+                            os.unlink(os.path.join(self.directory,
+                                                   stem + suffix))
+                        except OSError:
+                            pass
+                    removed += 1
+        return removed
+
+
+def _two_entry_index(n_rows: int):
+    import numpy as np
+    return np.array([0, n_rows], dtype=np.int64)
+
+
+class NativeShuffleServer:
+    """ctypes wrapper over the C++ server (one per process)."""
+
+    def __init__(self, secrets: JobTokenSecretManager, store_dir: str,
+                 host: str = "127.0.0.1", port: int = 0):
+        from tez_tpu.ops.native import _load
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError("native shuffle server unavailable "
+                               "(libtezhost.so failed to build/load)")
+        self._configure_prototypes()
+        self.store_dir = store_dir
+        os.makedirs(store_dir, exist_ok=True)
+        secret = secrets.secret
+        self.port = int(self._lib.tez_shuffle_server_start(
+            store_dir.encode(), secret, len(secret), host.encode(), port))
+        if self.port <= 0:
+            raise RuntimeError(f"native shuffle server failed to bind "
+                               f"({host}:{port})")
+        log.info("native shuffle server serving %s on port %d",
+                 store_dir, self.port)
+
+    def _configure_prototypes(self) -> None:
+        lib = self._lib
+        lib.tez_shuffle_server_start.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_int32]
+        lib.tez_shuffle_server_start.restype = ctypes.c_int32
+        lib.tez_shuffle_server_port.restype = ctypes.c_int32
+        lib.tez_shuffle_server_bytes_served.restype = ctypes.c_uint64
+        lib.tez_shuffle_server_auth_failures.restype = ctypes.c_uint64
+
+    @property
+    def bytes_served(self) -> int:
+        return int(self._lib.tez_shuffle_server_bytes_served())
+
+    @property
+    def auth_failures(self) -> int:
+        return int(self._lib.tez_shuffle_server_auth_failures())
+
+    def start(self) -> "NativeShuffleServer":
+        return self   # started at construction (bind reports errors early)
+
+    def stop(self) -> None:
+        self._lib.tez_shuffle_server_stop()
+
+
+def native_available() -> bool:
+    from tez_tpu.ops.native import _load
+    lib = _load()
+    return lib is not None and hasattr(lib, "tez_shuffle_server_start")

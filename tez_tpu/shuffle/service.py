@@ -14,7 +14,7 @@ DAG/vertex deletion tracking mirrors the reference's DeletionTracker.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from tez_tpu.ops.runformat import KVBatch, Run
 
@@ -29,11 +29,29 @@ class ShuffleService:
     def __init__(self) -> None:
         self._runs: Dict[Tuple[str, int], Run] = {}
         self._lock = threading.Lock()
+        self._store: Any = None
+
+    def attach_store(self, store: Any) -> None:
+        """Write-through persistence (FileShuffleStore): every registered
+        run is also serialized to disk so the native sendfile server can
+        serve it without touching Python.  Local fetches keep hitting the
+        in-RAM registry."""
+        self._store = store
 
     # -- producer side -------------------------------------------------------
     def register(self, path_component: str, spill_id: int, run: Run) -> None:
         with self._lock:
             self._runs[(path_component, spill_id)] = run
+        if self._store is not None:
+            self._store.register(path_component, spill_id, run)
+            # a concurrent unregister_prefix between the RAM insert and the
+            # file write would miss our files (its disk sweep ran first);
+            # re-check and self-clean so deleted outputs never linger on
+            # disk where the native server would keep serving them
+            with self._lock:
+                still = (path_component, spill_id) in self._runs
+            if not still:
+                self._store.unregister_prefix(path_component)
 
     def unregister_prefix(self, prefix: str) -> int:
         """Deletion tracker: drop all outputs whose path starts with prefix
@@ -42,7 +60,9 @@ class ShuffleService:
             victims = [k for k in self._runs if k[0].startswith(prefix)]
             for k in victims:
                 del self._runs[k]
-            return len(victims)
+        if self._store is not None:
+            self._store.unregister_prefix(prefix)
+        return len(victims)
 
     # -- consumer side (local short-circuit) ---------------------------------
     def fetch_partition(self, path_component: str, spill_id: int,

@@ -32,6 +32,11 @@ class DagInfoCache:
         # dag_id -> (DagInfo, source files); OrderedDict = LRU order
         self._dags: "OrderedDict[str, DagInfo]" = OrderedDict()
         self._dag_files: Dict[str, frozenset] = {}
+        # negative cache: dag_id -> store generation at which the bypass
+        # parse proved it absent (repeated lookups of a bogus id must not
+        # re-read the whole directory every call)
+        self._absent: Dict[str, int] = {}
+        self._generation = 0
         self.hits = 0
         self.misses = 0
 
@@ -64,6 +69,8 @@ class DagInfoCache:
             changed = self._changed_files()
             if not changed:
                 return 0
+            self._generation += 1
+            self._absent.clear()
             # re-parse the union of changed files and any file sets of DAGs
             # they touch (cheap: JSONL parse is line-local)
             to_read = set(changed)
@@ -93,11 +100,16 @@ class DagInfoCache:
                 self._dags.move_to_end(dag_id)
                 return info
             self.misses += 1
+            if self._absent.get(dag_id) == self._generation:
+                return None   # already proven absent at this store state
         # miss for a possibly LRU-evicted DAG: the files are unchanged so
         # refresh() won't re-read them — do a full bypass parse and
         # re-admit the entry if it exists on disk
         parsed = parse_jsonl_files(self._scan())
         info = parsed.get(dag_id)
+        if info is None:
+            with self._lock:
+                self._absent[dag_id] = self._generation
         if info is not None:
             with self._lock:
                 self._dags[dag_id] = info

@@ -220,14 +220,18 @@ def _make_corpus(path: str, target_mb: int, seed: int = 0):
     total = 0
     counts = np.zeros(vocab, dtype=np.int64)
     chunk_words = 1 << 20
+    words_per_line = 8192   # ~64 KB lines: text splits stay balanced
+    # (multi-MB lines would skew line-aligned splits across tokenizers)
     with open(path, "w") as fh:
         while total < target_mb << 20:
             ids = rng.zipf(1.3, chunk_words).astype(np.int64) % vocab
             counts += np.bincount(ids, minlength=vocab)
-            text = " ".join(words[ids])
-            fh.write(text)
-            fh.write("\n")
-            total += len(text) + 1
+            chunk = words[ids]
+            for s in range(0, len(chunk), words_per_line):
+                text = " ".join(chunk[s:s + words_per_line])
+                fh.write(text)
+                fh.write("\n")
+                total += len(text) + 1
     golden = {words[i]: int(counts[i]) for i in np.flatnonzero(counts)}
     return total, golden
 

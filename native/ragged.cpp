@@ -80,4 +80,33 @@ void concat_ragged_u8(const uint8_t** datas, const int64_t* sizes,
     for (auto& th : pool) th.join();
 }
 
+
+// Adjacent-row equality over a ragged u8 array: for each candidate index
+// cand[j] (caller guarantees rows cand[j] and cand[j]+1 have equal byte
+// length), out[j] = 1 iff the two rows' bytes match.  Per-pair memcmp
+// across threads — the numpy formulation materializes an int64 index per
+// BYTE (8x expansion) on the grouping/combine hot path.
+void adjacent_equal_u8(const uint8_t* data, const int64_t* offsets,
+                       const int64_t* cand, int64_t n_cand,
+                       uint8_t* out, int32_t n_threads) {
+    if (n_cand <= 0) return;
+    int threads = std::max(1, (int)n_threads);
+    std::vector<std::thread> pool;
+    int64_t per = (n_cand + threads - 1) / threads;
+    for (int t = 0; t < threads; t++) {
+        int64_t lo = t * per, hi = std::min<int64_t>(n_cand, lo + per);
+        if (lo >= hi) break;
+        pool.emplace_back([=]() {
+            for (int64_t j = lo; j < hi; j++) {
+                int64_t i = cand[j];
+                int64_t len = offsets[i + 1] - offsets[i];
+                out[j] = (len == 0) ||
+                    std::memcmp(data + offsets[i], data + offsets[i + 1],
+                                (size_t)len) == 0;
+            }
+        });
+    }
+    for (auto& th : pool) th.join();
+}
+
 }  // extern "C"

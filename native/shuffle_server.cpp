@@ -19,7 +19,7 @@
 #include <arpa/inet.h>
 #include <atomic>
 #include <cerrno>
-#include <sys/select.h>
+#include <poll.h>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -204,9 +204,68 @@ bool json_string(const std::string& j, const char* key, std::string* out) {
   std::string s;
   for (size_t i = q1 + 1; i < j.size(); i++) {
     char c = j[i];
-    if (c == '\\') {                     // only \\ and \" appear in paths
+    if (c == '\\') {                     // full JSON escape set
       if (i + 1 >= j.size()) return false;
-      s += j[++i];
+      char e = j[++i];
+      switch (e) {
+        case '"': s += '"'; break;
+        case '\\': s += '\\'; break;
+        case '/': s += '/'; break;
+        case 'b': s += '\b'; break;
+        case 'f': s += '\f'; break;
+        case 'n': s += '\n'; break;
+        case 'r': s += '\r'; break;
+        case 't': s += '\t'; break;
+        case 'u': {
+          if (i + 4 >= j.size()) return false;
+          auto nib = [](char c) -> int {
+            if (c >= '0' && c <= '9') return c - '0';
+            if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+            if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+            return -1;
+          };
+          int cp = 0;
+          for (int k = 1; k <= 4; k++) {
+            int v = nib(j[i + k]);
+            if (v < 0) return false;
+            cp = (cp << 4) | v;
+          }
+          i += 4;
+          // UTF-16 surrogate pair -> code point
+          if (cp >= 0xD800 && cp <= 0xDBFF && i + 6 < j.size() &&
+              j[i + 1] == '\\' && j[i + 2] == 'u') {
+            int lo = 0;
+            bool ok2 = true;
+            for (int k = 3; k <= 6; k++) {
+              int v = nib(j[i + k]);
+              if (v < 0) { ok2 = false; break; }
+              lo = (lo << 4) | v;
+            }
+            if (ok2 && lo >= 0xDC00 && lo <= 0xDFFF) {
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+              i += 6;
+            }
+          }
+          // UTF-8 encode (matches Python's json path bytes)
+          if (cp < 0x80) {
+            s += char(cp);
+          } else if (cp < 0x800) {
+            s += char(0xC0 | (cp >> 6));
+            s += char(0x80 | (cp & 0x3F));
+          } else if (cp < 0x10000) {
+            s += char(0xE0 | (cp >> 12));
+            s += char(0x80 | ((cp >> 6) & 0x3F));
+            s += char(0x80 | (cp & 0x3F));
+          } else {
+            s += char(0xF0 | (cp >> 18));
+            s += char(0x80 | ((cp >> 12) & 0x3F));
+            s += char(0x80 | ((cp >> 6) & 0x3F));
+            s += char(0x80 | (cp & 0x3F));
+          }
+          break;
+        }
+        default: return false;
+      }
     } else if (c == '"') {
       *out = s;
       return true;
@@ -251,15 +310,13 @@ struct Server {
 };
 
 // Wait (poll) for readability with periodic stop checks, so idle keep-alive
-// connections survive but shutdown wakes them within ~200 ms.
+// connections survive but shutdown wakes them within ~200 ms.  poll(2), not
+// select: fds can exceed FD_SETSIZE in a thread-per-connection server.
 bool wait_readable(Server* srv, int fd) {
   while (!srv->stopping.load()) {
-    fd_set rfds;
-    FD_ZERO(&rfds);
-    FD_SET(fd, &rfds);
-    timeval tv{0, 200 * 1000};
-    int r = select(fd + 1, &rfds, nullptr, nullptr, &tv);
-    if (r > 0) return true;
+    pollfd pfd{fd, POLLIN, 0};
+    int r = poll(&pfd, 1, 200);
+    if (r > 0) return (pfd.revents & (POLLIN | POLLHUP)) != 0;
     if (r < 0 && errno != EINTR) return false;
   }
   return false;
@@ -387,6 +444,7 @@ void accept_loop(Server* srv) {
     int fd = accept(srv->listen_fd, nullptr, nullptr);
     if (fd < 0) {
       if (srv->stopping.load()) return;
+      usleep(50 * 1000);   // EMFILE etc.: back off, don't spin hot
       continue;
     }
     srv->active_connections.fetch_add(1);

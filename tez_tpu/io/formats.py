@@ -301,10 +301,9 @@ class MRSplitGenerator(InputInitializer):
         fmt = resolve_format(payload.get("format", "text"),
                              payload.get("format_params"))
         desired = payload.get("desired_splits", -1)
-        explicit = desired > 0
         if desired <= 0:
             desired = self.context.num_tasks
-        wave_path = desired <= 0
+        wave_path = desired <= 0   # neither payload nor parallelism set it
         if desired <= 0:
             # unbound parallelism: waves x available slots, with the group
             # count clamped so the average grouped-split size stays inside
@@ -321,7 +320,7 @@ class MRSplitGenerator(InputInitializer):
         max_sz = int(knob("tez.grouping.max-size", 1024 ** 3))
         # size clamp applies ONLY on the wave path: an explicit
         # desired_splits (payload) or fixed vertex parallelism wins
-        if wave_path and not explicit and total_bytes > 0:
+        if wave_path and total_bytes > 0:
             cap = max(1, total_bytes // max(1, min_sz))     # avg >= min-size
             floor = -(-total_bytes // max(1, max_sz))       # avg <= max-size
             clamped = max(min(desired, cap), floor)

@@ -51,6 +51,10 @@ def _load() -> "ctypes.CDLL | None":
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_int32]
             lib.gather_ragged_u8.restype = None
+            lib.adjacent_equal_u8.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32]
+            lib.adjacent_equal_u8.restype = None
             _lib = lib
             log.info("native host ops loaded from %s", _SO_PATH)
         except Exception as e:  # noqa: BLE001 — toolchain may be absent
@@ -91,3 +95,24 @@ def gather_ragged_native(data: np.ndarray, offsets: np.ndarray,
         out.ctypes.data_as(ctypes.c_void_p),
         ctypes.c_int32(threads))
     return out, out_offsets
+
+
+def adjacent_equal_native(data: np.ndarray, offsets: np.ndarray,
+                          cand: np.ndarray) -> Optional[np.ndarray]:
+    """Threaded per-pair memcmp for adjacent-row equality; None when the
+    native lib is unavailable (caller falls back to numpy)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "adjacent_equal_u8"):
+        return None
+    data = np.ascontiguousarray(data)
+    offsets = np.ascontiguousarray(offsets.astype(np.int64))
+    cand64 = np.ascontiguousarray(cand.astype(np.int64))
+    out = np.empty(len(cand64), dtype=np.uint8)
+    lib.adjacent_equal_u8(
+        data.ctypes.data_as(ctypes.c_void_p),
+        offsets.ctypes.data_as(ctypes.c_void_p),
+        cand64.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(len(cand64)),
+        out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int32(min(8, os.cpu_count() or 1)))
+    return out.astype(bool)

@@ -113,6 +113,13 @@ def adjacent_equal_rows(data: np.ndarray, offsets: np.ndarray,
     if m == 0:
         return np.zeros(0, dtype=bool)
     lengths = (offsets[1:] - offsets[:-1])[cand]
+    if int(lengths.sum()) >= (1 << 20):
+        # the numpy path materializes one int64 index per BYTE (8x memory
+        # expansion); the native threaded memcmp avoids it on large runs
+        from tez_tpu.ops.native import adjacent_equal_native
+        native = adjacent_equal_native(data, offsets, cand)
+        if native is not None:
+            return native
     out = np.ones(m, dtype=bool)          # zero-length pairs are equal
     nz = np.flatnonzero(lengths)
     if len(nz) == 0:

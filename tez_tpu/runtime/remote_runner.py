@@ -35,6 +35,10 @@ def run_loop(am_host: str, am_port: int, node_id: str, token_hex: str,
 
     secrets = JobTokenSecretManager(bytes.fromhex(token_hex))
     umbilical = RemoteUmbilical(am_host, am_port, secrets)
+    # consumers on OTHER hosts dial advertise_host: a non-loopback
+    # advertisement requires a non-loopback bind (both server flavors)
+    bind_host = "127.0.0.1" if advertise_host in ("127.0.0.1", "localhost") \
+        else "0.0.0.0"
     native_dir = os.environ.get("TEZ_TPU_NATIVE_SHUFFLE_DIR", "")
     shuffle_server = None
     if native_dir:
@@ -46,7 +50,8 @@ def run_loop(am_host: str, am_port: int, node_id: str, token_hex: str,
             from tez_tpu.shuffle.native_server import (FileShuffleStore,
                                                        NativeShuffleServer)
             store_dir = os.path.join(native_dir, f"runner-{os.getpid()}")
-            shuffle_server = NativeShuffleServer(secrets, store_dir).start()
+            shuffle_server = NativeShuffleServer(
+                secrets, store_dir, host=bind_host).start()
             # attach only after the server is up: a failed native start
             # must not leave every spill double-written for nothing
             local_shuffle_service().attach_store(FileShuffleStore(store_dir))
@@ -55,7 +60,8 @@ def run_loop(am_host: str, am_port: int, node_id: str, token_hex: str,
                           "using the Python server")
             shuffle_server = None
     if shuffle_server is None:
-        shuffle_server = ShuffleServer(secrets, local_shuffle_service()).start()
+        shuffle_server = ShuffleServer(secrets, local_shuffle_service(),
+                                       host=bind_host).start()
     if not container_id:
         container_id = str(ContainerId(f"app_proc_{node_id}", os.getpid()))
     registry = ObjectRegistry()

@@ -109,6 +109,8 @@ class DagInfoCache:
         info = parsed.get(dag_id)
         if info is None:
             with self._lock:
+                if len(self._absent) >= 4 * self.max_dags:
+                    self._absent.pop(next(iter(self._absent)))
                 self._absent[dag_id] = self._generation
         if info is not None:
             with self._lock:

@@ -212,3 +212,54 @@ def test_svm_descriptor_from_conf():
     assert p["min_fraction"] == 0.5 and p["auto_parallel"] is True
     assert p["max_fraction"] == 0.75 and p["min_task_parallelism"] == 2
     assert "ShuffleVertexManager" in d.class_name
+
+
+def count_pass_reduce(word, values):
+    from tez_tpu.ops.serde import VarLongSerde
+    s = VarLongSerde()
+    # (word, ones...) -> (word, total) still VarLong encoded for stage 2
+    yield word, s.to_bytes(sum(s.from_bytes(v) for v in values))
+
+
+def fold_first_letter_reduce(word, values):
+    from tez_tpu.ops.serde import VarLongSerde
+    s = VarLongSerde()
+    yield word[:1], s.to_bytes(sum(s.from_bytes(v) for v in values))
+
+
+def total_reduce(letter, values):
+    from tez_tpu.ops.serde import VarLongSerde
+    s = VarLongSerde()
+    yield letter, str(sum(s.from_bytes(v) for v in values)).encode()
+
+
+def test_mr_chain_dag_three_stages(tmp_path):
+    """YARNRunner-style chained-job translation: map -> reduce1 (word
+    totals) -> reduce2 (fold to first letter) -> reduce3 (letter totals),
+    one DAG, byte-verified (TestOrderedWordCount / MRR shape)."""
+    from tez_tpu.io.mapreduce import mr_chain_dag
+    corpus = tmp_path / "in.txt"
+    corpus.write_text("apple ant bee bear apple cat\n" * 50)
+    out = str(tmp_path / "out")
+    dag = mr_chain_dag(
+        "mrr", [str(corpus)], out,
+        map_fn="tests.test_mapreduce_compat:wc_map_long",
+        reduce_fns=[
+            "tests.test_mapreduce_compat:count_pass_reduce",
+            "tests.test_mapreduce_compat:fold_first_letter_reduce",
+            "tests.test_mapreduce_compat:total_reduce"],
+        num_mappers=2, num_reducers=[2, 2, 1],
+        key_serde="text", value_serde="text")
+    assert len(dag.vertices) == 4
+    with TezClient.create("mrr", {"tez.staging-dir":
+                                  str(tmp_path / "s")}) as c:
+        status = c.submit_dag(dag).wait_for_completion(timeout=90)
+    assert status.state is DAGStatusState.SUCCEEDED
+    got = {}
+    for f in os.listdir(out):
+        if f.startswith("part-"):
+            for line in open(os.path.join(out, f)):
+                k, v = line.split("\t")
+                got[k] = int(v.strip())
+    # a: apple*2 + ant = 150, b: bee + bear = 100, c: cat = 50
+    assert got == {"a": 150, "b": 100, "c": 50}

@@ -44,6 +44,13 @@ class TaskCounter(enum.Enum):
     NUM_SPECULATIONS = enum.auto()
     REDUCE_INPUT_GROUPS = enum.auto()
     REDUCE_INPUT_RECORDS = enum.auto()
+    REDUCE_OUTPUT_RECORDS = enum.auto()
+    # reference-parity entries (TaskCounter.java): INPUT_GROUPS is the
+    # deprecated map-side alias, SKIPPED_RECORDS / APPROXIMATE_INPUT_RECORDS
+    # exist for analyzer/API compatibility
+    INPUT_GROUPS = enum.auto()
+    SKIPPED_RECORDS = enum.auto()
+    APPROXIMATE_INPUT_RECORDS = enum.auto()
     REDUCE_SKIPPED_GROUPS = enum.auto()
     REDUCE_SKIPPED_RECORDS = enum.auto()
     SPLIT_RAW_BYTES = enum.auto()

@@ -76,8 +76,12 @@ class ReduceProcessor(SimpleProcessor):
                 for k, v in reader:
                     acc.setdefault(k, []).append(v)
                 groups = iter(sorted(acc.items()))
+            from tez_tpu.common.counters import TaskCounter
+            out_records = self.context.counters.find_counter(
+                TaskCounter.REDUCE_OUTPUT_RECORDS)
             for k, vs in groups:
                 for ok, ov in reducer(k, vs):
+                    out_records.increment()
                     for w in writers:
                         w.write(ok, ov)
 
@@ -97,6 +101,49 @@ def simple_mr_dag(name: str, input_paths, output_path: str,
     over a sorted shuffle, file-committed output.  multi_input swaps in the
     MultiMRInput analog (one reader per split).  map_fn/reduce_fn are
     "module:callable" strings (must be importable in runner processes)."""
+    return mr_chain_dag(name, input_paths, output_path, map_fn,
+                        reduce_fns=[reduce_fn], num_mappers=num_mappers,
+                        num_reducers=num_reducers, key_serde=key_serde,
+                        value_serde=value_serde,
+                        stage_serdes=[intermediate_serdes],
+                        combiner=combiner, input_format=input_format,
+                        format_params=format_params,
+                        multi_input=multi_input)
+
+
+def mr_chain_dag(name: str, input_paths, output_path: str,
+                 map_fn: str, reduce_fns, num_mappers: int = -1,
+                 num_reducers=2,
+                 key_serde: str = "bytes", value_serde: str = "bytes",
+                 stage_serdes=None,
+                 combiner: str = "",
+                 input_format: str = "text",
+                 format_params: Optional[dict] = None,
+                 multi_input: bool = False) -> DAG:
+    """Chained-job translation (MRR): one map vertex plus N reduce stages —
+    map -> r1 -> ... -> rN — each stage joined by its own sorted
+    scatter-gather edge, the last stage file-committed.
+
+    Reference role: the MR client shim translating a SEQUENCE of dependent
+    MR jobs into one DAG (YARNRunner-style; the canonical MRR workloads are
+    tez-tests TestOrderedWordCount.java / MRRSleepJob.java).
+
+    reduce_fns: list of "module:callable" strings, one per stage.
+    num_reducers: int (same for all stages) or list per stage.
+    stage_serdes: per-EDGE (key, value) serde names, len(reduce_fns)
+    entries; defaults to ("bytes", "bytes") everywhere.
+    """
+    if not reduce_fns:
+        raise ValueError("mr_chain_dag needs at least one reduce stage")
+    n_stages = len(reduce_fns)
+    if isinstance(num_reducers, int):
+        num_reducers = [num_reducers] * n_stages
+    if len(num_reducers) != n_stages:
+        raise ValueError(f"num_reducers: want {n_stages} entries")
+    stage_serdes = stage_serdes or [("bytes", "bytes")] * n_stages
+    if len(stage_serdes) != n_stages:
+        raise ValueError(f"stage_serdes: want {n_stages} entries")
+
     input_cls = "tez_tpu.io.formats:MultiMRInput" if multi_input \
         else "tez_tpu.io.formats:MRInput"
     mapper = Vertex.create("map", ProcessorDescriptor.create(
@@ -111,21 +158,31 @@ def simple_mr_dag(name: str, input_paths, output_path: str,
                      "desired_splits": num_mappers,
                      "format": input_format,
                      "format_params": format_params})))
-    reducer = Vertex.create("reduce", ProcessorDescriptor.create(
-        ReduceProcessor, payload={"reduce_fn": reduce_fn}), num_reducers)
-    reducer.add_data_sink("output", DataSinkDescriptor.create(
-        OutputDescriptor.create("tez_tpu.io.file_output:FileOutput",
-                                payload={"path": output_path,
-                                         "key_serde": key_serde,
-                                         "value_serde": value_serde}),
-        OutputCommitterDescriptor.create(
-            "tez_tpu.io.file_output:FileOutputCommitter",
-            payload={"path": output_path})))
-    builder = OrderedPartitionedKVEdgeConfig.new_builder(
-        *intermediate_serdes)
-    if combiner:
-        builder.set_combiner(combiner)
-    dag = DAG.create(name).add_vertex(mapper).add_vertex(reducer)
-    dag.add_edge(Edge.create(mapper, reducer,
-                             builder.build().create_default_edge_property()))
+    dag = DAG.create(name).add_vertex(mapper)
+    upstream = mapper
+    for i, (fn, par, serdes) in enumerate(
+            zip(reduce_fns, num_reducers, stage_serdes)):
+        last = i == n_stages - 1
+        reducer = Vertex.create(
+            f"reduce{i + 1}" if n_stages > 1 else "reduce",
+            ProcessorDescriptor.create(ReduceProcessor,
+                                       payload={"reduce_fn": fn}), par)
+        if last:
+            reducer.add_data_sink("output", DataSinkDescriptor.create(
+                OutputDescriptor.create(
+                    "tez_tpu.io.file_output:FileOutput",
+                    payload={"path": output_path,
+                             "key_serde": key_serde,
+                             "value_serde": value_serde}),
+                OutputCommitterDescriptor.create(
+                    "tez_tpu.io.file_output:FileOutputCommitter",
+                    payload={"path": output_path})))
+        builder = OrderedPartitionedKVEdgeConfig.new_builder(*serdes)
+        if combiner and i == 0:
+            builder.set_combiner(combiner)   # map-side combine only
+        dag.add_vertex(reducer)
+        dag.add_edge(Edge.create(
+            upstream, reducer,
+            builder.build().create_default_edge_property()))
+        upstream = reducer
     return dag

@@ -48,6 +48,9 @@ class _SorterWriter(KeyValuesWriter):
         self.partition_fn = partition_fn
         self.num_partitions = num_partitions
         self._n = 0
+        # resolved once: find_counter locks the registry per call
+        self._out_bytes_ctr = context.counters.find_counter(
+            TaskCounter.OUTPUT_BYTES)
 
     def write(self, key: Any, value: Any) -> None:
         # a custom Partitioner sees the LOGICAL key/value (pre-serde),
@@ -59,8 +62,7 @@ class _SorterWriter(KeyValuesWriter):
         k = self.key_serde.to_bytes(key)
         v = self.val_serde.to_bytes(value)
         self.sorter.write(k, v, partition=partition)
-        self.context.counters.increment(TaskCounter.OUTPUT_BYTES,
-                                        len(k) + len(v))
+        self._out_bytes_ctr.increment(len(k) + len(v))
         self._n += 1
         if (self._n & 0x3FFF) == 0:
             self.context.notify_progress()   # liveness + kill check

@@ -56,10 +56,13 @@ class UnorderedPartitionedWriter:
         self._runs: List[Run] = []
         self.num_spills = 0
         self.on_spill = None   # pipelined / no-final-merge mode
+        # resolved once: find_counter locks the registry per call
+        self._out_records_ctr = self.counters.find_counter(
+            TaskCounter.OUTPUT_RECORDS)
 
     def write(self, key: bytes, value: bytes) -> None:
         self._span.add(key, value)
-        self.counters.increment(TaskCounter.OUTPUT_RECORDS)
+        self._out_records_ctr.increment()
         if self._span.nbytes >= self.span_budget:
             self._partition_span()
 
@@ -129,13 +132,14 @@ class _UnorderedWriterFacade(KeyValuesWriter):
         self.val_serde = val_serde
         self.context = context
         self._n = 0
+        self._out_bytes_ctr = context.counters.find_counter(
+            TaskCounter.OUTPUT_BYTES)
 
     def write(self, key: Any, value: Any) -> None:
         k = self.key_serde.to_bytes(key)
         v = self.val_serde.to_bytes(value)
         self.writer.write(k, v)
-        self.context.counters.increment(TaskCounter.OUTPUT_BYTES,
-                                        len(k) + len(v))
+        self._out_bytes_ctr.increment(len(k) + len(v))
         self._n += 1
         if (self._n & 0x3FFF) == 0:
             self.context.notify_progress()

@@ -51,10 +51,13 @@ def _load() -> "ctypes.CDLL | None":
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_int32]
             lib.gather_ragged_u8.restype = None
-            lib.adjacent_equal_u8.argtypes = [
-                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32]
-            lib.adjacent_equal_u8.restype = None
+            if hasattr(lib, "adjacent_equal_u8"):
+                # a stale prebuilt .so (no toolchain to rebuild) may lack
+                # the newer symbol; only that feature degrades, not the lib
+                lib.adjacent_equal_u8.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32]
+                lib.adjacent_equal_u8.restype = None
             _lib = lib
             log.info("native host ops loaded from %s", _SO_PATH)
         except Exception as e:  # noqa: BLE001 — toolchain may be absent

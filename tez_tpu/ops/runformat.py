@@ -113,7 +113,8 @@ def adjacent_equal_rows(data: np.ndarray, offsets: np.ndarray,
     if m == 0:
         return np.zeros(0, dtype=bool)
     lengths = (offsets[1:] - offsets[:-1])[cand]
-    if int(lengths.sum()) >= (1 << 20):
+    from tez_tpu.ops.native import MIN_NATIVE_BYTES
+    if int(lengths.sum()) >= MIN_NATIVE_BYTES:
         # the numpy path materializes one int64 index per BYTE (8x memory
         # expansion); the native threaded memcmp avoids it on large runs
         from tez_tpu.ops.native import adjacent_equal_native

@@ -175,6 +175,10 @@ class DeviceSorter:
         self.span_budget = span_budget_bytes
         self.spill_dir = spill_dir
         self.counters = counters or TezCounters()
+        # per-record hot path: resolve the counter ONCE (find_counter takes
+        # a registry lock per call)
+        self._out_records_ctr = self.counters.find_counter(
+            TaskCounter.OUTPUT_RECORDS)
         self.combiner = combiner
         self.partitioner = partitioner
         self.mem_budget = mem_budget_bytes or (span_budget_bytes * 2)
@@ -211,13 +215,13 @@ class DeviceSorter:
                 f"partitioner returned {partition}, valid range is "
                 f"[0, {self.num_partitions})")
         self._span.add(key, value, partition)
-        self.counters.increment(TaskCounter.OUTPUT_RECORDS)
+        self._out_records_ctr.increment()
         if self._span.nbytes >= self.span_budget:
             self._sort_span()
 
     def write_batch(self, batch: KVBatch) -> None:
         self._span.add_batch(batch)
-        self.counters.increment(TaskCounter.OUTPUT_RECORDS, batch.num_records)
+        self._out_records_ctr.increment(batch.num_records)
         if self._span.nbytes >= self.span_budget:
             self._sort_span()
 

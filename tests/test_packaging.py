@@ -53,3 +53,33 @@ def test_example_driver_runs_wordcount(tmp_path, capsys, monkeypatch):
         sys, "argv", ["tez-examples", "wordcount", str(corpus), out_dir])
     assert driver.main() == 0
     assert "SUCCEEDED" in capsys.readouterr().out
+
+
+def test_cartesian_product_example(tmp_path, capsys, monkeypatch):
+    left = tmp_path / "l.txt"; left.write_text("a b\n")
+    right = tmp_path / "r.txt"; right.write_text("x y z\n")
+    out = str(tmp_path / "out")
+    monkeypatch.setattr(sys, "argv", ["tez-examples", "cartesianproduct",
+                                      str(left), str(right), out])
+    assert driver.main() == 0
+    import os
+    pairs = set()
+    for f in os.listdir(out):
+        if f.startswith("part-"):
+            for line in open(os.path.join(out, f)):
+                pairs.add(line.split("\t")[0])
+    assert pairs == {f"{a}|{b}" for a in "ab" for b in "xyz"}
+
+
+def test_simple_session_example(tmp_path, capsys, monkeypatch):
+    files = []
+    for i in range(2):
+        p = tmp_path / f"in{i}.txt"
+        p.write_text(f"w{i} w{i} other\n")
+        files.append(str(p))
+    out = str(tmp_path / "out")
+    monkeypatch.setattr(sys, "argv", ["tez-examples", "simplesessionexample",
+                                      *files, out])
+    assert driver.main() == 0
+    import os
+    assert sorted(os.listdir(out)) == ["dag0", "dag1"]

@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import sys
 
-from tez_tpu.examples import (hash_join, mrr, ordered_wordcount,
+from tez_tpu.examples import (cartesian_product, hash_join, mrr,
+                              ordered_wordcount, simple_session,
                               sort_merge_join, wordcount)
 
 
@@ -46,6 +47,12 @@ _PROGRAMS = {
     "hashjoin": (
         _three_arg(hash_join.run), "<stream> <hash> <output_dir>",
         "broadcast-edge hash join (small side replicated)"),
+    "cartesianproduct": (
+        _three_arg(cartesian_product.run), "<left> <right> <output_dir>",
+        "cross product via the CUSTOM cartesian-product edge"),
+    "simplesessionexample": (
+        _two_arg(simple_session.run), "<input...> <output_dir>",
+        "several DAGs through one session with runner reuse"),
 }
 
 

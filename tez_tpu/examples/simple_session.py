@@ -1,0 +1,42 @@
+"""SimpleSessionExample: several DAGs through ONE session, reusing runners.
+
+Reference parity: tez-examples/.../SimpleSessionExample.java (one TezClient
+session submits a DAG per input, containers are reused across DAGs instead
+of being torn down between jobs).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+from tez_tpu.client.tez_client import TezClient
+from tez_tpu.examples import wordcount
+
+
+def run(input_paths, output_dir: str, conf=None) -> str:
+    """One word-count DAG per input file, all inside one session.
+    Returns the final state of the last DAG ('SUCCEEDED' if all did)."""
+    conf = dict(conf or {})
+    conf.setdefault("tez.session.mode", True)
+    last = "SUCCEEDED"
+    with TezClient.create("SimpleSession", conf) as client:
+        client.pre_warm()
+        for i, path in enumerate(input_paths):
+            dag = wordcount.build_dag(
+                [path], os.path.join(output_dir, f"dag{i}"))
+            # unique per-session DAG names (reference: session rejects dups)
+            dag.name = f"wordcount-{i}"
+            status = client.submit_dag(dag).wait_for_completion()
+            last = status.state.name
+            if last != "SUCCEEDED":
+                return last
+    return last
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 3:
+        print("usage: simple_session <input...> <output_dir>")
+        sys.exit(2)
+    state = run(sys.argv[1:-1], sys.argv[-1])
+    print(state)
+    sys.exit(0 if state == "SUCCEEDED" else 1)

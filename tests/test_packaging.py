@@ -25,6 +25,9 @@ def test_dist_full_and_minimal(tmp_path):
     assert any(n.endswith("tez_tpu/examples/driver.py") for n in names)
     assert any(n.endswith("/bench.py") for n in names)
     assert any(n.endswith("native/ragged.cpp") for n in names)
+    # every source the Makefile needs must ship, or make -C native fails
+    assert any(n.endswith("native/shuffle_server.cpp") for n in names)
+    assert any(n.endswith("native/Makefile") for n in names)
     assert f"{root}/MANIFEST" in names
     with tarfile.open(minimal) as tf:
         min_names = tf.getnames()
@@ -33,6 +36,7 @@ def test_dist_full_and_minimal(tmp_path):
     assert any("/tools/analyzers.py" in n for n in min_names)
     assert any(n.endswith("tez_tpu/am/app_master.py") for n in min_names)
     assert any(n.endswith("native/ragged.cpp") for n in min_names)
+    assert any(n.endswith("native/shuffle_server.cpp") for n in min_names)
     assert len(min_names) < len(names)
 
 

@@ -288,8 +288,13 @@ bool json_int(const std::string& j, const char* key, int64_t* out) {
   if (i < j.size() && j[i] == '-') { neg = true; i++; }
   if (i >= j.size() || j[i] < '0' || j[i] > '9') return false;
   int64_t v = 0;
-  for (; i < j.size() && j[i] >= '0' && j[i] <= '9'; i++)
+  int digits = 0;
+  for (; i < j.size() && j[i] >= '0' && j[i] <= '9'; i++) {
+    // parse runs pre-auth: cap at 18 digits so a crafted digit run can't
+    // overflow signed int64 (UB) before the HMAC check rejects the request
+    if (++digits > 18) return false;
     v = v * 10 + (j[i] - '0');
+  }
   *out = neg ? -v : v;
   return true;
 }
@@ -392,16 +397,32 @@ void handle_connection(Server* srv, int fd) {
         "_" + std::to_string(spill);
     int idx_fd = open((base + ".index").c_str(), O_RDONLY);
     if (idx_fd < 0) { reply_header(fd, "{\"status\": \"not_found\"}"); continue; }
+    // The TZIX index is written little-endian (struct '<I'/'<Q' in
+    // shuffle/native_server.py); decode byte-wise so a big-endian host
+    // reads the same values instead of byte-swapped garbage.
     char magic[4];
+    uint8_t np_raw[4];
     uint32_t num_parts = 0;
     bool idx_ok = read_exact(idx_fd, magic, 4) &&
                   memcmp(magic, "TZIX", 4) == 0 &&
-                  read_exact(idx_fd, &num_parts, 4) &&
-                  num_parts < (1u << 24);
+                  read_exact(idx_fd, np_raw, 4);
+    if (idx_ok) {
+      num_parts = uint32_t(np_raw[0]) | (uint32_t(np_raw[1]) << 8) |
+                  (uint32_t(np_raw[2]) << 16) | (uint32_t(np_raw[3]) << 24);
+      idx_ok = num_parts < (1u << 24);
+    }
     std::vector<uint64_t> offs;
     if (idx_ok) {
-      offs.resize(num_parts + 1);
-      idx_ok = read_exact(idx_fd, offs.data(), offs.size() * 8);
+      std::vector<uint8_t> raw((size_t(num_parts) + 1) * 8);
+      idx_ok = read_exact(idx_fd, raw.data(), raw.size());
+      if (idx_ok) {
+        offs.resize(num_parts + 1);
+        for (size_t p = 0; p < offs.size(); p++) {
+          uint64_t v = 0;
+          for (int b = 7; b >= 0; b--) v = (v << 8) | raw[p * 8 + b];
+          offs[p] = v;
+        }
+      }
     }
     close(idx_fd);
     if (!idx_ok || lo < 0 || hi > int64_t(num_parts) || lo >= hi) {

@@ -1,13 +1,19 @@
-"""ctypes bindings for the native host ops (native/ragged.cpp).
+"""ctypes bindings for the native host ops (tez_tpu/native/ragged.cpp).
 
-Auto-builds `native/libtezhost.so` with g++ on first use (cached); every
+Auto-builds `libtezhost.so` with g++ on first use (cached); every
 caller has a numpy fallback, so a missing toolchain degrades gracefully.
+
+The native sources ship INSIDE the package (`tez_tpu/native/`) so pip
+installs get them; when the install dir is read-only (site-packages), the
+build happens in a per-user cache dir instead (`TEZ_TPU_CACHE_DIR` or
+`~/.cache/tez_tpu`).
 """
 from __future__ import annotations
 
 import ctypes
 import logging
 import os
+import shutil
 import subprocess
 import threading
 from typing import Optional, Tuple
@@ -16,9 +22,33 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "libtezhost.so")
+_NATIVE_DIR = os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "native")
+_SOURCES = ("ragged.cpp", "shuffle_server.cpp", "Makefile")
+
+
+def _build_dir() -> str:
+    """Where to run make: the package dir when writable, else a user cache
+    keyed by version (read-only site-packages installs)."""
+    if os.access(_NATIVE_DIR, os.W_OK):
+        return _NATIVE_DIR
+    from tez_tpu.version import __version__
+    cache_root = os.environ.get("TEZ_TPU_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "tez_tpu")
+    bdir = os.path.join(cache_root, f"native-{__version__}")
+    os.makedirs(bdir, exist_ok=True)
+    for fname in _SOURCES:
+        src = os.path.join(_NATIVE_DIR, fname)
+        dst = os.path.join(bdir, fname)
+        if os.path.exists(src) and (
+                not os.path.exists(dst)
+                or os.path.getmtime(dst) < os.path.getmtime(src)):
+            # temp + rename: a concurrent builder's `make` must never see
+            # a half-copied source (the Makefile already renames the .so)
+            tmp = f"{dst}.{os.getpid()}.tmp"
+            shutil.copy2(src, tmp)
+            os.replace(tmp, dst)
+    return bdir
 
 _lib: "ctypes.CDLL | None | bool" = None   # None=untried, False=unavailable
 _lock = threading.Lock()
@@ -37,15 +67,22 @@ def _load() -> "ctypes.CDLL | None":
         if _lib not in (None,):
             return _lib if _lib is not False else None
         try:
+            bdir = _build_dir()
+            so_path = os.path.join(bdir, "libtezhost.so")
             # make is a no-op when current and rebuilds a stale .so after a
             # source change (the .so is newer-than-sources checked)
             try:
-                subprocess.run(["make", "-C", _NATIVE_DIR, "-s"],
+                subprocess.run(["make", "-C", bdir, "-s"],
                                check=True, capture_output=True, timeout=120)
             except Exception:  # noqa: BLE001 — no toolchain: use stale .so
-                if not os.path.exists(_SO_PATH):
+                prebuilt = os.path.join(_NATIVE_DIR, "libtezhost.so")
+                if os.path.exists(so_path):
+                    pass
+                elif os.path.exists(prebuilt):
+                    so_path = prebuilt
+                else:
                     raise
-            lib = ctypes.CDLL(_SO_PATH)
+            lib = ctypes.CDLL(so_path)
             lib.gather_ragged_u8.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
@@ -59,7 +96,7 @@ def _load() -> "ctypes.CDLL | None":
                     ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32]
                 lib.adjacent_equal_u8.restype = None
             _lib = lib
-            log.info("native host ops loaded from %s", _SO_PATH)
+            log.info("native host ops loaded from %s", so_path)
         except Exception as e:  # noqa: BLE001 — toolchain may be absent
             log.warning("native host ops unavailable (%s); numpy fallback",
                         e)

@@ -44,7 +44,7 @@ def _walk_files(root: str, rel_base: str):
 
 
 def _try_build_native() -> str | None:
-    native_dir = os.path.join(_REPO, "native")
+    native_dir = os.path.join(_REPO, "tez_tpu", "native")
     so = os.path.join(native_dir, "libtezhost.so")
     try:
         subprocess.run(["make", "-C", native_dir], check=True,
@@ -59,14 +59,22 @@ def _try_build_native() -> str | None:
 
 def build(minimal: bool, out_dir: str) -> str:
     from tez_tpu.version import __version__
-    if not os.path.isdir(os.path.join(_REPO, "native")):
+    # bench.py + docs/ exist only in a source checkout (native sources now
+    # ship inside the wheel, so they no longer distinguish the two)
+    if not (os.path.exists(os.path.join(_REPO, "bench.py"))
+            and os.path.isdir(os.path.join(_REPO, "docs"))):
         raise SystemExit(
-            "tez-dist assembles from a source checkout (native/, docs/, "
-            f"pyproject.toml beside the package); {_REPO} has no native/ "
-            "directory — run it from the repository root")
+            "tez-dist assembles from a source checkout (docs/, bench.py, "
+            f"pyproject.toml beside the package); {_REPO} lacks them — "
+            "run it from the repository root")
     name = f"tez-tpu-{__version__}" + ("-minimal" if minimal else "")
     os.makedirs(out_dir, exist_ok=True)
     out_path = os.path.join(out_dir, name + ".tar.gz")
+
+    # full assemblies bundle a freshly built libtezhost.so; minimal ships
+    # native as source only (built on first use by ops/native.py) — and a
+    # stale committed .so must never ride along either assembly
+    ship_so = (_try_build_native() is not None) if not minimal else False
 
     members: list[tuple[str, str]] = []
     pkg_root = os.path.join(_REPO, "tez_tpu")
@@ -74,17 +82,13 @@ def build(minimal: bool, out_dir: str) -> str:
         parts = os.path.relpath(full, pkg_root).split(os.sep)
         if minimal and parts[0] in _MINIMAL_EXCLUDED_PKG_DIRS:
             continue
+        base = os.path.basename(full)
+        if parts[0] == "native" and base.endswith((".so", ".tmp")) and \
+                not (ship_so and base == "libtezhost.so"):
+            continue
         members.append((full, rel))
 
-    native_dir = os.path.join(_REPO, "native")
-    for fname in ("ragged.cpp", "Makefile"):
-        p = os.path.join(native_dir, fname)
-        if os.path.exists(p):
-            members.append((p, f"{name}/native/{fname}"))
     if not minimal:
-        so = _try_build_native()
-        if so:
-            members.append((so, f"{name}/native/libtezhost.so"))
         for extra_dir in ("docs",):
             for full, rel in _walk_files(os.path.join(_REPO, extra_dir),
                                          f"{name}/{extra_dir}"):

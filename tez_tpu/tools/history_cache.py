@@ -49,6 +49,9 @@ class DagInfoCache:
                       if f.endswith(".jsonl"))
 
     def _changed_files(self) -> List[str]:
+        """Changed paths with their NEW fingerprints — which are committed
+        only after a successful parse (refresh rolls back on error), so a
+        partially-flushed JSONL line from a live AM is retried next call."""
         changed = []
         for path in self._scan():
             try:
@@ -57,8 +60,7 @@ class DagInfoCache:
                 continue
             fp = (st.st_mtime, st.st_size)
             if self._fingerprints.get(path) != fp:
-                changed.append(path)
-                self._fingerprints[path] = fp
+                changed.append((path, fp))
         return changed
 
     def refresh(self) -> int:
@@ -73,7 +75,7 @@ class DagInfoCache:
             self._absent.clear()
             # re-parse the union of changed files and any file sets of DAGs
             # they touch (cheap: JSONL parse is line-local)
-            to_read = set(changed)
+            to_read = set(p for p, _ in changed)
             parsed = parse_jsonl_files(sorted(to_read))
             for dag_id, info in parsed.items():
                 known = self._dag_files.get(dag_id, frozenset())
@@ -88,6 +90,15 @@ class DagInfoCache:
             while len(self._dags) > self.max_dags:
                 old_id, _ = self._dags.popitem(last=False)
                 self._dag_files.pop(old_id, None)
+            # commit fingerprints only after every parse returned.  Note the
+            # parser tolerates torn lines (it skips unparseable lines rather
+            # than raising), so the usual retry path for a half-flushed file
+            # is the file's size changing when the AM finishes the line; the
+            # deferred commit additionally guarantees that an unexpected
+            # parse exception (I/O error, bug) leaves the old fingerprints
+            # in place so the next refresh() retries the same files.
+            for path, fp in changed:
+                self._fingerprints[path] = fp
             return len(changed)
 
     # -- read API -----------------------------------------------------------

@@ -437,7 +437,8 @@ def test_device_resident_span_and_merge():
     producer_runs = []
     golden_rows = {p: [] for p in range(num_partitions)}
     for prod in range(3):
-        s = DeviceSorter(num_partitions=num_partitions, key_width=16)
+        s = DeviceSorter(num_partitions=num_partitions, key_width=16,
+                         device_min_records=0)   # force the resident path
         pairs = []
         for i in range(400):
             k = f"k{rng.integers(0, 120):04d}".encode()   # <= 16B: resident
@@ -480,7 +481,7 @@ def test_resident_view_dropped_on_serialization():
     import pickle
     from tez_tpu.ops.runformat import KVBatch, Run
     from tez_tpu.ops.sorter import DeviceSorter
-    s = DeviceSorter(num_partitions=2)
+    s = DeviceSorter(num_partitions=2, device_min_records=0)
     for i in range(50):
         s.write(f"k{i:02d}".encode(), b"v")
     run = s.flush()
@@ -516,7 +517,8 @@ def test_resident_merge_mixed_lane_widths():
     runs = []
     all_keys = []
     for prod, klen in enumerate((4, 12)):      # 1 lane vs 3 lanes
-        s = DeviceSorter(num_partitions=1, key_width=16)
+        s = DeviceSorter(num_partitions=1, key_width=16,
+                         device_min_records=0)
         for i in range(120):
             k = f"{i % 37:0{klen}d}".encode()
             all_keys.append((k, prod, i))
@@ -550,3 +552,72 @@ def test_encode_keys_device_parity():
         assert np.array_equal(lanes_h, np.asarray(lanes_d)), width
         assert np.array_equal(lens_h.astype(np.int64),
                               np.asarray(lens_d).astype(np.int64)), width
+
+
+def test_native_wordcount_aggregator_matches_counter():
+    """Fused native tokenize+count == collections.Counter over bytes.split()
+    (the WordCount map task's whole data plane in one C pass)."""
+    from collections import Counter
+    from tez_tpu.ops.native import WordCountAggregator
+    agg = WordCountAggregator.create()
+    if agg is None:
+        import pytest
+        pytest.skip("native lib unavailable")
+    chunks = [b"the cat\tsat  on\nthe mat\n", b"", b"mat cat mat\r\nthe\x0bend\n"]
+    for c in chunks:
+        agg.feed(c)
+    kb, ko, counts = agg.emit()
+    agg.close()
+    got = {bytes(kb[ko[i]:ko[i + 1]]): int(counts[i])
+           for i in range(len(counts))}
+    assert got == dict(Counter(b"".join(chunks).split()))
+
+
+def test_native_hash_sum_matches_python():
+    import numpy as np
+    from tez_tpu.ops.native import hash_sum_native
+    rng = np.random.default_rng(5)
+    keys = [f"k{rng.integers(0, 50)}".encode() for _ in range(3000)]
+    vals = rng.integers(-100, 100, 3000).astype(np.int64)
+    offsets = np.zeros(3001, np.int64)
+    np.cumsum([len(k) for k in keys], out=offsets[1:])
+    kb = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    res = hash_sum_native(kb, offsets, vals)
+    if res is None:
+        import pytest
+        pytest.skip("native lib unavailable")
+    first_idx, sums = res
+    golden: dict = {}
+    order = []
+    for k, v in zip(keys, vals.tolist()):
+        if k not in golden:
+            golden[k] = 0
+            order.append(k)
+        golden[k] += v
+    assert [keys[i] for i in first_idx.tolist()] == order
+    assert {keys[i]: int(s) for i, s in zip(first_idx, sums)} == golden
+
+
+def test_presort_hash_combine_shrinks_sort_and_keeps_result():
+    """With a sum combiner and long values, duplicate keys collapse BEFORE
+    the device sort (COMBINE_* counters record it) and the run equals the
+    post-sort-combine result."""
+    from tez_tpu.common.counters import TaskCounter, TezCounters
+    from tez_tpu.ops.serde import VarLongSerde
+    serde = VarLongSerde()
+    words = [f"w{i % 7}".encode() for i in range(5000)]
+    counters = TezCounters()
+    sorter = DeviceSorter(num_partitions=2, combiner=sum_long_combiner,
+                          counters=counters)
+    for w in words:
+        sorter.write(w, serde.to_bytes(1))
+    run = sorter.flush()
+    got = {k: serde.from_bytes(v) for k, v in run.batch.iter_pairs()}
+    from collections import Counter
+    assert got == {k: c for k, c in Counter(words).items()}
+    snap = counters.to_dict()
+    combine_in = sum(g.get("COMBINE_INPUT_RECORDS", 0)
+                     for g in snap.values())
+    combine_out = sum(g.get("COMBINE_OUTPUT_RECORDS", 0)
+                      for g in snap.values())
+    assert combine_in == 5000 and combine_out == 7

@@ -321,6 +321,10 @@ KEY_WIDTH_BYTES = _key("tez.runtime.tpu.key.width.bytes", 16, Scope.VERTEX,
                        "Fixed normalized key width for device radix sort (TPU-specific)")
 DEVICE_BATCH_RECORDS = _key("tez.runtime.tpu.batch.records", 1 << 20, Scope.VERTEX,
                             "Records per device sort batch (static shape bucket)")
+DEVICE_SORT_MIN_RECORDS = _key(
+    "tez.runtime.tpu.device.sort.min.records", 1 << 16, Scope.VERTEX,
+    "Spans smaller than this sort on host even under the device engine "
+    "(dispatch + transfer overhead exceeds the sort); 0 = always device")
 HOST_SPILL_DIR = _key("tez.runtime.tpu.host.spill.dir", "", Scope.VERTEX,
                       "Where device buffers spill when HBM budget is exceeded; "
                       "'' = <staging>/spill")

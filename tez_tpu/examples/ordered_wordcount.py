@@ -50,6 +50,34 @@ class VectorTokenProcessor(SimpleProcessor):
         one = VarLongSerde().to_bytes(1)
         reader = inputs["input"].get_reader()
         writer = outputs["summation"].get_writer()
+
+        # fused native tokenize+count when the edge carries a sum combiner:
+        # one C pass replaces tokenize -> 4M-record batch -> combine (the
+        # map task becomes emit-of-partial-counts, which is exactly what
+        # the combiner would have produced)
+        out = outputs["summation"]
+        sorter = getattr(out, "sorter", None)
+        from tez_tpu.ops.sorter import sum_long_combiner
+        if sorter is not None and sorter.combiner is sum_long_combiner:
+            from tez_tpu.ops.native import WordCountAggregator
+            agg = WordCountAggregator.create()
+            if agg is not None:
+                try:
+                    for chunk in reader.iter_chunks():
+                        agg.feed(bytes(chunk))
+                    key_bytes, key_offsets, counts = agg.emit()
+                finally:
+                    agg.close()
+                enc = (counts.view(np.uint64)
+                       ^ np.uint64(1 << 63)).astype(">u8")
+                val_bytes = np.frombuffer(enc.tobytes(),
+                                          dtype=np.uint8).copy()
+                val_offsets = np.arange(len(counts) + 1,
+                                        dtype=np.int64) * 8
+                writer.write_batch(KVBatch(key_bytes, key_offsets,
+                                           val_bytes, val_offsets))
+                return
+
         for chunk in reader.iter_chunks():
             data = np.frombuffer(chunk, dtype=np.uint8)
             # full bytes.split() whitespace set: space \t \n \v \f \r
@@ -71,24 +99,84 @@ class VectorTokenProcessor(SimpleProcessor):
 
 
 class SumProcessor(SimpleProcessor):
-    """Sum counts per word, emit (count, word) toward the sorter."""
+    """Sum counts per word, emit (count, word) toward the sorter.
+
+    Batch-first when the reader supports it: per-group sums via one
+    np.add.reduceat, output shipped as a single pre-serialized KVBatch."""
 
     def run(self, inputs: Dict[str, LogicalInput],
             outputs: Dict[str, LogicalOutput]) -> None:
+        import numpy as np
         reader = inputs["tokenizer"].get_reader()
         writer = outputs["sorter"].get_writer()
+        peek = getattr(reader, "peek_batch", None)
+        if peek is not None and hasattr(writer, "write_batch"):
+            batch = peek()
+            n = batch.num_records
+            # probe BEFORE grouped_batch() so a fall-through to __iter__
+            # doesn't double-count the group counters
+            if n == 0:
+                return
+            if bool(np.all(np.diff(batch.val_offsets) == 8)):
+                from tez_tpu.ops.runformat import KVBatch, gather_ragged
+                from tez_tpu.ops.serde import (decode_longs_be,
+                                               encode_longs_be)
+                batch, starts = reader.grouped_batch()
+                decoded = decode_longs_be(batch.val_bytes, n)
+                sums = np.add.reduceat(decoded, starts)
+                words_b, words_o = gather_ragged(
+                    batch.key_bytes, batch.key_offsets, starts)
+                key_bytes = encode_longs_be(sums)
+                key_offsets = np.arange(len(sums) + 1, dtype=np.int64) * 8
+                writer.write_batch(KVBatch(key_bytes, key_offsets,
+                                           words_b, words_o))
+                return
         for word, counts in reader:
             writer.write(sum(counts), word)
 
 
 class NoOpSorterProcessor(SimpleProcessor):
     """Write the (count, word) stream — already globally count-ordered when
-    sorter parallelism is 1 (reference: OrderedWordCount NoOpSorter)."""
+    sorter parallelism is 1 (reference: OrderedWordCount NoOpSorter).
+
+    Batch-first when reader and writer support it: output lines assemble
+    via ONE ragged gather over a pool of [word rows + per-group
+    '\\t<count>\\n' tails] (zero per-record Python)."""
 
     def run(self, inputs: Dict[str, LogicalInput],
             outputs: Dict[str, LogicalOutput]) -> None:
+        import numpy as np
         reader = inputs["summation"].get_reader()
         writer = outputs["output"].get_writer()
+        peek = getattr(reader, "peek_batch", None)
+        if peek is not None and hasattr(writer, "write_raw"):
+            from tez_tpu.ops.runformat import gather_ragged
+            from tez_tpu.ops.serde import decode_longs_be
+            batch = peek()
+            n = batch.num_records
+            if n == 0:
+                return
+            if bool(np.all(np.diff(batch.key_offsets) == 8)):
+                batch, starts = reader.grouped_batch()
+                counts = decode_longs_be(batch.key_bytes, n)
+                tails = [b"\t%d\n" % int(counts[s]) for s in starts]
+                tail_bytes = np.frombuffer(b"".join(tails), dtype=np.uint8)
+                tail_lens = np.array([len(t) for t in tails],
+                                     dtype=np.int64)
+                pool_bytes = np.concatenate([batch.val_bytes, tail_bytes])
+                pool_offsets = np.concatenate([
+                    batch.val_offsets,
+                    batch.val_offsets[-1] + np.cumsum(tail_lens)])
+                # record i -> rows (word_i, tail_of_group(i))
+                group_of = np.zeros(n, dtype=np.int64)
+                group_of[starts[1:]] = 1
+                group_of = np.cumsum(group_of)
+                perm = np.empty(2 * n, dtype=np.int64)
+                perm[0::2] = np.arange(n)
+                perm[1::2] = n + group_of
+                lines, _ = gather_ragged(pool_bytes, pool_offsets, perm)
+                writer.write_raw(lines.tobytes(), n)
+                return
         for count, words in reader:
             for word in words:
                 writer.write(word, str(count))

@@ -42,6 +42,13 @@ class _PartWriter(KeyValueWriter):
         self._records_ctr.increment()
         self._bytes_ctr.increment(len(k) + len(self.sep) + len(v) + 1)
 
+    def write_raw(self, data: bytes, n_records: int) -> None:
+        """Pre-formatted record bytes (separators/newlines included) from a
+        vectorized consumer — one write call for the whole block."""
+        self._fh.write(data)
+        self._records_ctr.increment(n_records)
+        self._bytes_ctr.increment(len(data))
+
     def close(self) -> None:
         self._fh.close()
         self.context.counters.increment(FileSystemCounter.FILE_WRITE_OPS)

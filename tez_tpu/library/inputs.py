@@ -307,6 +307,8 @@ class OrderedGroupedKVInput(LogicalInput):
         self._mm_budget = budget_mb << 20
         self._mm_kwargs = dict(
             key_width=self.key_width, engine=engine, merge_factor=factor,
+            device_min_records=int(_conf_get(
+                ctx, "tez.runtime.tpu.device.sort.min.records", 1 << 16)),
             merge_threshold=float(_conf_get(
                 ctx, "tez.runtime.shuffle.merge.percent", 0.9)),
             max_single_fraction=float(_conf_get(
@@ -455,6 +457,24 @@ class GroupedKVReader(KeyValuesReader):
             yield key, values
         self.context.counters.increment(TaskCounter.REDUCE_INPUT_GROUPS,
                                         groups)
+
+    def grouped_batch(self) -> Tuple[KVBatch, np.ndarray]:
+        """Vectorized view for batch-first consumers: the merged sorted
+        KVBatch plus group-start row indices (one per distinct key).  A
+        zero-Python-per-record alternative to __iter__.  Increments
+        REDUCE_INPUT_GROUPS exactly as a full iteration would
+        (REDUCE_INPUT_RECORDS is recorded once at merge time by the input,
+        not here); callers that inspect the batch and then fall back to
+        __iter__ should use `peek_batch()` instead."""
+        self.context.counters.increment(TaskCounter.REDUCE_INPUT_GROUPS,
+                                        len(self._group_starts))
+        return self.batch, self._group_starts
+
+    def peek_batch(self) -> KVBatch:
+        """The merged batch WITHOUT counter effects — for consumers probing
+        whether the vectorized path applies (e.g. uniform value widths)
+        before committing to grouped_batch() or __iter__."""
+        return self.batch
 
 
 class StreamingGroupedKVReader(KeyValuesReader):

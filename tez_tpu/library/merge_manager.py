@@ -58,6 +58,7 @@ class ShuffleMergeManager:
                  spill_dir: str,
                  key_width: int = 16,
                  engine: str = "device",
+                 device_min_records: "int | None" = None,
                  merge_factor: int = 64,
                  merge_threshold: float = 0.9,
                  max_single_fraction: float = 0.25,
@@ -69,6 +70,9 @@ class ShuffleMergeManager:
         self.spill_dir = spill_dir
         self.key_width = key_width
         self.engine = engine
+        from tez_tpu.ops.sorter import DEVICE_SORT_MIN_RECORDS
+        self.device_min_records = DEVICE_SORT_MIN_RECORDS \
+            if device_min_records is None else device_min_records
         self.merge_factor = max(2, merge_factor)
         self.merge_threshold = merge_threshold
         self.max_single = int(self.budget * max_single_fraction) \
@@ -247,6 +251,7 @@ class ShuffleMergeManager:
         runs = [_as_run(b) for _, _, b in items if b.num_records > 0]
         merged = merge_sorted_runs(runs, 1, self.key_width,
                                    engine=self.engine,
+                                   device_min_records=self.device_min_records,
                                    merge_factor=self.merge_factor,
                                    key_normalizer=self.key_normalizer) \
             if runs else _as_run(KVBatch.empty())
@@ -356,6 +361,7 @@ class ShuffleMergeManager:
             merged = runs[0] if len(runs) == 1 else merge_sorted_runs(
                 runs, 1, self.key_width, counters=self.counters,
                 engine=self.engine, merge_factor=self.merge_factor,
+                device_min_records=self.device_min_records,
                 key_normalizer=self.key_normalizer)
             return MergedResult(batch=merged.batch)
         # leftover memory becomes one more (bounded) sorted segment
@@ -365,6 +371,7 @@ class ShuffleMergeManager:
             mem_seg = merge_sorted_runs(
                 mem_runs, 1, self.key_width, counters=self.counters,
                 engine=self.engine, merge_factor=self.merge_factor,
+                device_min_records=self.device_min_records,
                 key_normalizer=self.key_normalizer).batch
         return MergedResult(stream=_StreamPlan(self, disk, mem_seg))
 

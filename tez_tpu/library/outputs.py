@@ -130,6 +130,8 @@ class OrderedPartitionedKVOutput(LogicalOutput):
             spill_codec=spill_codec,
             resident_keys=bool(_conf_get(
                 ctx, "tez.runtime.tpu.resident.keys", True)),
+            device_min_records=int(_conf_get(
+                ctx, "tez.runtime.tpu.device.sort.min.records", 1 << 16)),
         )
         ctx.request_initial_memory(sort_mb << 20, None,
                            component_type="PARTITIONED_SORTED_OUTPUT")

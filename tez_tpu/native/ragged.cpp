@@ -110,3 +110,143 @@ void adjacent_equal_u8(const uint8_t* data, const int64_t* offsets,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Hash aggregation (map-side combine).
+//
+// The reference runs its combiner AFTER the sort, over each spill
+// (PipelinedSorter semantics); on TPU the economics invert — collapsing
+// duplicate keys BEFORE the device sort shrinks the expensive step
+// (pad/lanes/sort/gather) by the duplication factor.  These helpers give
+// the host a C-speed open-addressing hash table for that pre-combine and
+// for fused tokenize+count (the WordCount family's entire map task).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+inline uint64_t fnv1a(const uint8_t* p, int64_t len) {
+    uint64_t h = 1469598103934665603ull;
+    for (int64_t i = 0; i < len; i++) {
+        h ^= p[i];
+        h *= 1099511628211ull;
+    }
+    return h;
+}
+
+// Open-addressing table mapping byte-string keys -> int64 accumulator.
+// Keys are appended to an arena on first occurrence; emit order is
+// first-occurrence order (deterministic for a given input).
+struct HashAgg {
+    std::vector<int64_t> table;      // entry index + 1; 0 = empty
+    struct Entry { uint64_t hash; int64_t off; int32_t len; int64_t acc; };
+    std::vector<Entry> entries;
+    std::vector<uint8_t> arena;
+    uint64_t mask;
+
+    HashAgg() : table(1 << 12, 0), mask((1 << 12) - 1) {}
+
+    void grow() {
+        size_t ns = table.size() * 2;
+        std::vector<int64_t>(ns, 0).swap(table);
+        mask = ns - 1;
+        for (size_t e = 0; e < entries.size(); e++) {
+            uint64_t slot = entries[e].hash & mask;
+            while (table[slot]) slot = (slot + 1) & mask;
+            table[slot] = (int64_t)e + 1;
+        }
+    }
+
+    void add(const uint8_t* key, int64_t len, int64_t value) {
+        uint64_t h = fnv1a(key, len);
+        uint64_t slot = h & mask;
+        while (true) {
+            int64_t idx = table[slot];
+            if (idx == 0) break;
+            const Entry& e = entries[idx - 1];
+            if (e.hash == h && e.len == len &&
+                std::memcmp(arena.data() + e.off, key, (size_t)len) == 0) {
+                entries[idx - 1].acc += value;
+                return;
+            }
+            slot = (slot + 1) & mask;
+        }
+        int64_t off = (int64_t)arena.size();
+        arena.insert(arena.end(), key, key + len);
+        entries.push_back({h, off, (int32_t)len, value});
+        table[slot] = (int64_t)entries.size();
+        if (entries.size() * 10 > table.size() * 7) grow();
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// --- fused tokenize + count (stateful across feeds) ----------------------
+// Contract: each feed() is whitespace-complete (the text reader yields
+// line-aligned chunks), so tokens never span feed boundaries.
+
+void* tz_wc_create() { return new HashAgg(); }
+
+void tz_wc_feed(void* handle, const uint8_t* data, int64_t n) {
+    HashAgg* agg = (HashAgg*)handle;
+    int64_t i = 0;
+    while (i < n) {
+        // skip whitespace (space \t \n \v \f \r — bytes.split() set)
+        while (i < n && (data[i] == 32 || (data[i] >= 9 && data[i] <= 13)))
+            i++;
+        int64_t start = i;
+        while (i < n && !(data[i] == 32 || (data[i] >= 9 && data[i] <= 13)))
+            i++;
+        if (i > start) agg->add(data + start, i - start, 1);
+    }
+}
+
+void tz_wc_stats(void* handle, int64_t* n_unique, int64_t* total_key_bytes) {
+    HashAgg* agg = (HashAgg*)handle;
+    *n_unique = (int64_t)agg->entries.size();
+    *total_key_bytes = (int64_t)agg->arena.size();
+}
+
+// key_offsets: n_unique+1 entries; key_bytes: arena size; counts: n_unique
+void tz_wc_emit(void* handle, uint8_t* key_bytes, int64_t* key_offsets,
+                int64_t* counts) {
+    HashAgg* agg = (HashAgg*)handle;
+    std::memcpy(key_bytes, agg->arena.data(), agg->arena.size());
+    int64_t off = 0;
+    for (size_t e = 0; e < agg->entries.size(); e++) {
+        key_offsets[e] = off;
+        off += agg->entries[e].len;
+        counts[e] = agg->entries[e].acc;
+    }
+    key_offsets[agg->entries.size()] = off;
+}
+
+void tz_wc_destroy(void* handle) { delete (HashAgg*)handle; }
+
+// --- generic pre-sort combine: sum int64 values of equal keys -------------
+// first_idx[u] = record index of key u's first occurrence (caller gathers
+// the key bytes); sums[u] = total value.  Both sized n by the caller.
+// Returns the number of unique keys.
+int64_t hash_sum_i64(const uint8_t* key_bytes, const int64_t* key_offsets,
+                     int64_t n, const int64_t* values,
+                     int64_t* first_idx, int64_t* sums) {
+    HashAgg agg;
+    // remember first-occurrence record index per unique key: the arena
+    // offset uniquely identifies the entry, so track indices alongside
+    std::vector<int64_t> firsts;
+    firsts.reserve(1024);
+    for (int64_t i = 0; i < n; i++) {
+        size_t before = agg.entries.size();
+        agg.add(key_bytes + key_offsets[i],
+                key_offsets[i + 1] - key_offsets[i], values[i]);
+        if (agg.entries.size() > before) firsts.push_back(i);
+    }
+    for (size_t u = 0; u < agg.entries.size(); u++) {
+        first_idx[u] = firsts[u];
+        sums[u] = agg.entries[u].acc;
+    }
+    return (int64_t)agg.entries.size();
+}
+
+}  // extern "C"

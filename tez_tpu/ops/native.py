@@ -95,6 +95,24 @@ def _load() -> "ctypes.CDLL | None":
                     ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                     ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32]
                 lib.adjacent_equal_u8.restype = None
+            if hasattr(lib, "tz_wc_create"):
+                lib.tz_wc_create.argtypes = []
+                lib.tz_wc_create.restype = ctypes.c_void_p
+                lib.tz_wc_feed.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                           ctypes.c_int64]
+                lib.tz_wc_feed.restype = None
+                lib.tz_wc_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                            ctypes.c_void_p]
+                lib.tz_wc_stats.restype = None
+                lib.tz_wc_emit.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                           ctypes.c_void_p, ctypes.c_void_p]
+                lib.tz_wc_emit.restype = None
+                lib.tz_wc_destroy.argtypes = [ctypes.c_void_p]
+                lib.tz_wc_destroy.restype = None
+                lib.hash_sum_i64.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+                lib.hash_sum_i64.restype = ctypes.c_int64
             _lib = lib
             log.info("native host ops loaded from %s", so_path)
         except Exception as e:  # noqa: BLE001 — toolchain may be absent
@@ -135,6 +153,83 @@ def gather_ragged_native(data: np.ndarray, offsets: np.ndarray,
         out.ctypes.data_as(ctypes.c_void_p),
         ctypes.c_int32(threads))
     return out, out_offsets
+
+
+class WordCountAggregator:
+    """Fused tokenize + hash-count over byte chunks (native); None-pattern:
+    use `create()` and fall back to a numpy tokenizer when it returns None.
+
+    Each `feed()` must be whitespace-complete (line-aligned chunks from the
+    text reader), so tokens never span feed boundaries.
+    """
+
+    def __init__(self, lib: "ctypes.CDLL"):
+        self._lib = lib
+        self._h = lib.tz_wc_create()
+
+    @staticmethod
+    def create() -> "WordCountAggregator | None":
+        lib = _load()
+        if lib is None or not hasattr(lib, "tz_wc_create"):
+            return None
+        return WordCountAggregator(lib)
+
+    def feed(self, chunk: bytes) -> None:
+        self._lib.tz_wc_feed(self._h, chunk, len(chunk))
+
+    def emit(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """-> (key_bytes, key_offsets, counts) in first-occurrence order."""
+        n_unique = ctypes.c_int64()
+        total = ctypes.c_int64()
+        self._lib.tz_wc_stats(self._h, ctypes.byref(n_unique),
+                              ctypes.byref(total))
+        n, tot = n_unique.value, total.value
+        key_bytes = np.empty(tot, dtype=np.uint8)
+        key_offsets = np.empty(n + 1, dtype=np.int64)
+        counts = np.empty(n, dtype=np.int64)
+        if n:
+            self._lib.tz_wc_emit(
+                self._h, key_bytes.ctypes.data_as(ctypes.c_void_p),
+                key_offsets.ctypes.data_as(ctypes.c_void_p),
+                counts.ctypes.data_as(ctypes.c_void_p))
+        else:
+            key_offsets[0] = 0
+        return key_bytes, key_offsets, counts
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tz_wc_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # noqa: D105 — belt-and-braces native cleanup
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+def hash_sum_native(key_bytes: np.ndarray, key_offsets: np.ndarray,
+                    values: np.ndarray
+                    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Sum int64 `values` of equal keys (first-occurrence order): returns
+    (first_idx, sums) or None when the native lib is unavailable."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "hash_sum_i64"):
+        return None
+    n = len(values)
+    key_bytes = np.ascontiguousarray(key_bytes)
+    key_offsets = np.ascontiguousarray(key_offsets.astype(np.int64))
+    values = np.ascontiguousarray(values.astype(np.int64))
+    first_idx = np.empty(n, dtype=np.int64)
+    sums = np.empty(n, dtype=np.int64)
+    n_unique = lib.hash_sum_i64(
+        key_bytes.ctypes.data_as(ctypes.c_void_p),
+        key_offsets.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n),
+        values.ctypes.data_as(ctypes.c_void_p),
+        first_idx.ctypes.data_as(ctypes.c_void_p),
+        sums.ctypes.data_as(ctypes.c_void_p))
+    return first_idx[:n_unique].copy(), sums[:n_unique].copy()
 
 
 def adjacent_equal_native(data: np.ndarray, offsets: np.ndarray,

@@ -61,6 +61,23 @@ class VarLongSerde(Serde):
         return struct.unpack(">Q", data)[0] - (1 << 63)
 
 
+def encode_longs_be(values: "np.ndarray") -> "np.ndarray":
+    """Vectorized VarLongSerde.to_bytes: int64 array -> uint8 array of
+    8-byte big-endian sign-flipped encodings (byte order == numeric order)."""
+    import numpy as np
+    enc = (values.astype(np.int64).view(np.uint64)
+           ^ np.uint64(1 << 63)).astype(">u8")
+    return np.frombuffer(enc.tobytes(), dtype=np.uint8).copy()
+
+
+def decode_longs_be(val_bytes: "np.ndarray", n: int) -> "np.ndarray":
+    """Vectorized VarLongSerde.from_bytes over n fixed-8-byte values."""
+    import numpy as np
+    u = np.ascontiguousarray(val_bytes).reshape(n, 8)
+    return (u.view(">u8").astype(np.uint64).ravel()
+            ^ np.uint64(1 << 63)).view(np.int64)
+
+
 class PickleSerde(Serde):
     name = "pickle"
 

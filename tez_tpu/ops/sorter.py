@@ -141,6 +141,16 @@ class SpanBuffer:
 
 Combiner = Callable[[Run], Run]
 
+#: Below this many records a device dispatch (trace/compile-cache lookup +
+#: H2D/D2H) costs more than the host sort itself; the device engine routes
+#: smaller spans to the host sorter.  The TPU-native framework pattern:
+#: accelerate the big batches, keep the chatter off the chip.
+DEVICE_SORT_MIN_RECORDS = 1 << 16
+
+
+def _route_engine(engine: str, n: int, min_records: int) -> str:
+    return "host" if engine == "device" and n < min_records else engine
+
 
 class DeviceSorter:
     """The OrderedPartitionedKVOutput engine."""
@@ -157,10 +167,12 @@ class DeviceSorter:
                  merge_factor: int = 64,
                  key_normalizer: Optional[Callable[[bytes], bytes]] = None,
                  spill_codec: Optional[str] = None,
-                 resident_keys: bool = True):
+                 resident_keys: bool = True,
+                 device_min_records: int = DEVICE_SORT_MIN_RECORDS):
         self.num_partitions = num_partitions
         self.key_width = max(4, key_width)
         self.engine = engine   # 'device' (TPU kernels) | 'host' (np.lexsort)
+        self.device_min_records = device_min_records
         #: keep sorted key lanes in HBM for downstream device merges.  The
         #: pinned HBM (~(key width + 4) B/row per registered output, freed
         #: at DAG deletion) is OUTSIDE the host memory budgets — operators
@@ -226,12 +238,47 @@ class DeviceSorter:
             self._sort_span()
 
     # -- span sort (device) --------------------------------------------------
+    def _precombine(self, batch: KVBatch,
+                    custom_parts: Optional[np.ndarray]) -> KVBatch:
+        """Hash-combine BEFORE the sort when the combiner allows it.
+
+        The reference combines after each spill sort
+        (PipelinedSorter.java:559 -> combiner on the sorted stream); on TPU
+        the sort is the expensive device step, so collapsing duplicate keys
+        first shrinks pad/lanes/sort/gather by the duplication factor.  The
+        post-sort combiner still runs (idempotent for sum) and covers the
+        paths this fast path declines."""
+        if self.combiner is not sum_long_combiner or \
+                custom_parts is not None:
+            return batch
+        n = batch.num_records
+        if n < 2:
+            return batch
+        if not bool(np.all(np.diff(batch.val_offsets) == 8)):
+            return batch   # long-serde fixed-8 values only
+        from tez_tpu.ops.native import hash_sum_native
+        from tez_tpu.ops.serde import decode_longs_be, encode_longs_be
+        decoded = decode_longs_be(batch.val_bytes, n)
+        res = hash_sum_native(batch.key_bytes, batch.key_offsets, decoded)
+        if res is None:
+            return batch   # native lib unavailable
+        first_idx, sums = res
+        kb2, ko2 = gather_ragged(batch.key_bytes, batch.key_offsets,
+                                 first_idx)
+        vb = encode_longs_be(sums)
+        vo = np.arange(len(sums) + 1, dtype=np.int64) * 8
+        self.counters.increment(TaskCounter.COMBINE_INPUT_RECORDS, n)
+        self.counters.increment(TaskCounter.COMBINE_OUTPUT_RECORDS,
+                                len(sums))
+        return KVBatch(kb2, ko2, vb, vo)
+
     def _finalize_span(self) -> Run:
         """Sort + combine the current span (shared by spill and flush)."""
         batch = self._span.to_batch()
         custom_parts = np.asarray(self._span.parts, dtype=np.int32) \
             if self._span.parts else None
         self._span = SpanBuffer()
+        batch = self._precombine(batch, custom_parts)
         run = self.sort_batch(batch, custom_partitions=custom_parts)
         if self.combiner is not None:
             run = self.combiner(run)
@@ -251,7 +298,8 @@ class DeviceSorter:
             self.num_spills += 1
 
             def _bg() -> None:
-                run = self.sort_batch(batch, custom_partitions=custom_parts)
+                pre = self._precombine(batch, custom_parts)
+                run = self.sort_batch(pre, custom_partitions=custom_parts)
                 if self.combiner is not None:
                     run = self.combiner(run)
                 if self.on_spill is not None:
@@ -274,8 +322,12 @@ class DeviceSorter:
     def sort_batch(self, batch: KVBatch,
                    custom_partitions: Optional[np.ndarray] = None) -> Run:
         t0 = time.time()
+        # hybrid routing: tiny spans sort faster on host than a device
+        # round-trip, even under the device engine
+        engine = _route_engine(self.engine, batch.num_records,
+                               self.device_min_records)
         if custom_partitions is None and self.partitioner == "hash" and \
-                self.engine != "host" and self.key_normalizer is None and \
+                engine != "host" and self.key_normalizer is None and \
                 self.resident_keys:
             klens = batch.key_offsets[1:] - batch.key_offsets[:-1]
             wmax = int(klens.max(initial=1))
@@ -310,7 +362,7 @@ class DeviceSorter:
             assert len(custom_partitions) == batch.num_records, \
                 "custom partitions must cover every record in the span"
             partitions = custom_partitions
-            if self.engine == "host":
+            if engine == "host":
                 from tez_tpu.ops.host_sort import host_sort_run
                 sorted_partitions, perm = host_sort_run(partitions, lanes,
                                                         lengths)
@@ -326,7 +378,7 @@ class DeviceSorter:
             hash_w = 1 << max(2, (wmax - 1).bit_length())
             hmat, hlens = pad_to_matrix(batch.key_bytes, batch.key_offsets,
                                         hash_w)
-            if self.engine == "host":
+            if engine == "host":
                 from tez_tpu.ops.host_sort import (host_hash_partition,
                                                    host_sort_run)
                 partitions = host_hash_partition(hmat, hlens,
@@ -338,7 +390,7 @@ class DeviceSorter:
                     hmat, hlens, lanes, lengths, self.num_partitions)
         else:
             partitions = np.zeros(batch.num_records, dtype=np.int32)
-            if self.engine == "host":
+            if engine == "host":
                 from tez_tpu.ops.host_sort import host_sort_run
                 sorted_partitions, perm = host_sort_run(partitions, lanes,
                                                         lengths)
@@ -437,7 +489,8 @@ class DeviceSorter:
         merged = merge_sorted_runs(runs, self.num_partitions, self.key_width,
                                    counters=self.counters, engine=self.engine,
                                    merge_factor=self.merge_factor,
-                                   key_normalizer=self.key_normalizer)
+                                   key_normalizer=self.key_normalizer,
+                                   device_min_records=self.device_min_records)
         if self.combiner is not None:
             merged = self.combiner(merged)
         return merged
@@ -449,7 +502,9 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
                       engine: str = "device",
                       merge_factor: int = 0,
                       key_normalizer: Optional[Callable[[bytes], bytes]]
-                      = None) -> Run:
+                      = None,
+                      device_min_records: int = DEVICE_SORT_MIN_RECORDS
+                      ) -> Run:
     """k-way merge of partition-sorted runs (TezMerger analog): concatenate,
     stable device sort by (partition, key prefix), host tie-break.
 
@@ -467,9 +522,10 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
                 # inner passes skip counters: only the final pass reports
                 # (avoids double-counting MERGED_MAP_OUTPUTS / merge millis)
                 nxt.append(chunk[0] if len(chunk) == 1 else
-                           merge_sorted_runs(chunk, num_partitions,
-                                             key_width, None, engine,
-                                             key_normalizer=key_normalizer))
+                           merge_sorted_runs(
+                               chunk, num_partitions, key_width, None,
+                               engine, key_normalizer=key_normalizer,
+                               device_min_records=device_min_records))
             level = nxt
         runs = level
     t0 = time.time()
@@ -491,6 +547,11 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
                 counters.increment(TaskCounter.MERGED_MAP_OUTPUTS, len(runs))
             return Run(sorted_batch,
                        np.array([0, sorted_batch.num_records], np.int64))
+    # hybrid routing for the generic path only — when producer key lanes
+    # are already device-resident the resident merge above is cheaper than
+    # any host sort regardless of size
+    engine = _route_engine(engine, sum(r.batch.num_records for r in runs),
+                           device_min_records)
     batch = KVBatch.concat([r.batch for r in runs])
     partitions = np.concatenate([
         np.repeat(np.arange(r.num_partitions, dtype=np.int32),

@@ -15,9 +15,18 @@ headline; VERDICT round-1 items 1+5):
    synthetic records, device-resident, vs a strong vectorized numpy host
    baseline.
 
-Through the axon relay the TPU backend can stall at init; the watchdog
-re-runs everything in a clean CPU subprocess (honest, labeled fallback)
-rather than hanging the harness.
+Device liveness is PROBED FIRST in disposable subprocesses (the axon
+relay can stall `jax.devices()` indefinitely; the parent never imports
+jax until a child proved the backend responds, and each probe attempt
+also seeds the persistent compile cache).  Only after the probe fails
+for the whole warm budget does the bench re-run everything in a clean
+CPU subprocess (honest, labeled fallback).
+
+The kernel headline's vs_baseline follows BASELINE.md's protocol: the
+reference's own sorter semantics, measured on this host.  No JVM exists
+in this image, so the baseline is the C++ PipelinedSorter/TezMerger
+proxy (tez_tpu/native/baseline_proxy.cpp, clearly labeled); the numpy
+host engine comparison is printed as a separate info line.
 """
 from __future__ import annotations
 
@@ -352,6 +361,87 @@ def _bench_framework_subprocess(cpu_fallback: bool) -> dict:
         return bench_framework(cpu_fallback)
 
 
+_PROBE_SRC = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+ds = jax.devices()
+x = jnp.asarray(np.arange(4096, dtype=np.int32)[::-1].copy())
+y = jax.jit(jax.lax.sort)(x)
+assert int(np.asarray(y)[0]) == 0
+print("PROBE_OK", ds[0].platform, flush=True)
+"""
+
+
+def probe_device() -> bool:
+    """Prove the backend answers WITHOUT importing jax in this process.
+
+    Each attempt is a disposable subprocess (a stalled axon claim hangs
+    `jax.devices()` forever — only a child can be abandoned); a success
+    also warms the relay + persistent compile cache for the parent.
+    Attempts continue until TEZ_BENCH_WARM_BUDGET seconds (default 240)
+    elapse."""
+    if os.environ.get("TEZ_BENCH_FALLBACK") == "1":
+        return True   # CPU child: nothing to probe
+    budget = float(os.environ.get("TEZ_BENCH_WARM_BUDGET", "240"))
+    per_try = float(os.environ.get("TEZ_BENCH_PROBE_TIMEOUT", "120"))
+    import subprocess
+    deadline = time.time() + budget
+    attempt = 0
+    while time.time() < deadline:
+        attempt += 1
+        left = max(10.0, deadline - time.time())
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True,
+                timeout=min(per_try, left))
+            if "PROBE_OK" in out.stdout:
+                sys.stderr.write(f"device probe ok (attempt {attempt})\n")
+                return True
+            sys.stderr.write(
+                f"probe attempt {attempt} failed: {out.stderr[-200:]!r}\n")
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"probe attempt {attempt} timed out\n")
+        except Exception as e:  # noqa: BLE001 — keep probing until budget
+            sys.stderr.write(f"probe attempt {attempt} error: {e!r:.150}\n")
+    return False
+
+
+def rerun_on_cpu() -> int:
+    """The staged last resort: every probe failed, so the whole bench
+    re-runs in a clean CPU child (honest '[CPU FALLBACK]' labels)."""
+    import subprocess
+    env = dict(os.environ)
+    env["TEZ_BENCH_FALLBACK"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    # drop the axon sitecustomize: it pins the TPU platform in jax.config,
+    # which outranks JAX_PLATFORMS
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p)
+    budget = float(os.environ.get("TEZ_BENCH_TIMEOUT", "480"))
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
+            env=env, capture_output=True, text=True, timeout=budget)
+        printed = False
+        for line in out.stdout.strip().splitlines():
+            if line.startswith("{"):
+                print(line, flush=True)
+                printed = True
+        if printed:
+            return 0
+        sys.stderr.write(out.stderr[-500:] + "\n")
+    except Exception as e:  # noqa: BLE001 — report rather than hang
+        sys.stderr.write(f"cpu fallback failed: {e!r:.200}\n")
+    print(json.dumps({
+        "metric": "ordered-shuffle-sort throughput "
+                  "(UNAVAILABLE: device stalled AND cpu fallback failed)",
+        "value": 0.0, "unit": "MB/s", "vs_baseline": 0.0}), flush=True)
+    return 0
+
+
 def main() -> int:
     cpu_fallback = os.environ.get("TEZ_BENCH_FALLBACK") == "1"
     if cpu_fallback:
@@ -364,13 +454,17 @@ def main() -> int:
             _bench_done.set()
         print(json.dumps(line), flush=True)
         return 0
+    # -- stage 0: prove the device answers before touching jax here; a
+    # failed probe degrades to the labeled CPU re-run (VERDICT r2 item 1:
+    # warm the backend before arming timers, fallback only as last resort)
+    if not cpu_fallback and not probe_device():
+        return rerun_on_cpu()
     num_records = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
     key_len = 12
     num_producers, num_partitions = 4, 4
     _arm_watchdog()
 
-    # -- stage 1: tiny-shape pipeline proves the device is alive (and seeds
-    # the jit cache path) long before the fallback timer fires
+    # -- stage 1: tiny-shape pipeline seeds the jit cache path
     _phase[0] = "device warmup (tiny shape)"
     kb0, ko0, vb0, vo0 = make_records(65_536, key_len, seed=7)
     tpu_path(prepare_device_inputs(kb0, ko0, vb0, vo0, key_len),
@@ -397,10 +491,18 @@ def main() -> int:
                              key_len)
     host_s = time.time() - t0
 
-    # sanity: same keys per partition in same order
+    # reference baseline: PipelinedSorter/TezMerger semantics in C++
+    # (BASELINE.md — no JVM in this image, proxy clearly labeled)
+    from tez_tpu.ops.native import pipelined_sorter_proxy
+    n = num_records
+    proxy = pipelined_sorter_proxy(kb.reshape(n, key_len),
+                                   vb.reshape(n, 8),
+                                   num_producers, num_partitions)
+    proxy_s = proxy[0] if proxy is not None else None
+
+    # byte-identity: device keys AND values vs the host golden
     sorted_parts, out_lanes, out_vals, perm, counts = \
         [np.asarray(x) for x in tpu_out]
-    n = num_records
     sorted_keys = kb.reshape(n, key_len)[perm[:n]]
     bounds = np.zeros(num_partitions + 1, dtype=np.int64)
     np.cumsum(counts, out=bounds[1:])
@@ -409,18 +511,40 @@ def main() -> int:
         assert got.shape == host_out[c].shape, \
             f"partition {c}: {got.shape} vs {host_out[c].shape}"
         assert np.array_equal(got, host_out[c]), f"partition {c} mismatch"
+    if proxy is not None:
+        _, proxy_keys, proxy_vals, proxy_counts = proxy
+        assert np.array_equal(proxy_counts, counts[:num_partitions]), \
+            "proxy/device partition counts diverge"
+        assert np.array_equal(sorted_keys, proxy_keys), \
+            "proxy/device key order diverges"
+        # values from the DEVICE output (not reconstructed via perm):
+        # byte-identical payloads are the reducer-output contract
+        dev_vals = out_vals[:n].copy().view(np.uint8).reshape(n, 8)
+        assert np.array_equal(dev_vals, proxy_vals), \
+            "device values diverge from baseline"
 
-    # the kernel line is safe from here on: a stage-3 stall reports it
+    # the kernel line is safe from here on: a later stall reports it
     mbps = total_mb / tpu_s
-    label = (f"ordered-shuffle-sort throughput ({num_records} recs, "
-             f"{num_partitions} partitions, HBM-resident)")
-    if cpu_fallback:
-        label += " [CPU FALLBACK: TPU relay stalled]"
+    suffix = " [CPU FALLBACK: TPU relay stalled]" if cpu_fallback else ""
+    print(json.dumps({
+        "metric": (f"ordered-shuffle-sort vs numpy-lexsort host engine "
+                   f"(info line; same {num_records} recs){suffix}"),
+        "value": round(mbps, 2), "unit": "MB/s",
+        "vs_baseline": round(host_s / tpu_s, 3)}), flush=True)
+    if proxy_s is not None:
+        vs = round(proxy_s / tpu_s, 3)
+        base_note = (f"baseline=PipelinedSorter-semantics C++ proxy "
+                     f"{proxy_s:.2f}s (no JVM in image; BASELINE.md)")
+    else:
+        vs = round(host_s / tpu_s, 3)
+        base_note = "baseline=numpy host engine (native proxy unavailable)"
     _kernel_line[0] = {
-        "metric": label,
+        "metric": (f"ordered-shuffle-sort throughput ({num_records} recs, "
+                   f"{num_partitions} partitions, HBM-resident, keys+values "
+                   f"byte-verified; {base_note})" + suffix),
         "value": round(mbps, 2),
         "unit": "MB/s",
-        "vs_baseline": round(host_s / tpu_s, 3),
+        "vs_baseline": vs,
     }
 
     # -- stage 3: framework E2E (second metric; BASELINE.md protocol)

@@ -300,6 +300,14 @@ TPU_MESH_MAX_ROWS_PER_ROUND = _key(
     "per-edge cap on rows moved per exchange round (skewed partitions run "
     "multi-round above it); 0 = coordinator default "
     "(TEZ_TPU_MESH_MAX_ROWS_PER_ROUND env or 1Mi rows)")
+TPU_MESH_MAX_KEY_BYTES = _key(
+    "tez.runtime.tpu.mesh.max.key.bytes", 256, Scope.VERTEX,
+    "hard cap on key bytes the mesh exchange carries (slot widths "
+    "auto-widen to the data below it); bigger records -> host shuffle edge")
+TPU_MESH_MAX_VALUE_BYTES = _key(
+    "tez.runtime.tpu.mesh.max.value.bytes", 1024, Scope.VERTEX,
+    "hard cap on value bytes the mesh exchange carries; bigger records -> "
+    "host shuffle edge")
 TPU_RESIDENT_KEYS = _key(
     "tez.runtime.tpu.resident.keys", True, Scope.VERTEX,
     "keep sorted key lanes in HBM for downstream device merges "

@@ -12,10 +12,14 @@ counters all see a normal SCATTER_GATHER edge); the payload's
 `host="(mesh)"` marks that the bytes move through the exchange, not the
 shuffle servers.
 
-Contract: keys up to tez.runtime.tpu.key.width.bytes and values up to
-tez.runtime.tpu.mesh.value.width.bytes travel on-device (loud
-MeshCapacityError otherwise); consumer parallelism must not exceed the
-mesh's device count (one partition per worker).
+Contract: key/value slot widths auto-widen to the data, up to
+tez.runtime.tpu.mesh.max.key.bytes (256) / .max.value.bytes (1024) — the
+configured widths are slot-size hints (loud MeshCapacityError beyond the
+caps: per-row HBM slots make one huge record tax every row — such records
+belong on the host shuffle edge).  Consumer parallelism MAY exceed the
+device count: the exchange routes over the largest device count dividing
+the consumer count and splits each device's key-sorted output into its
+consumer partitions on host.
 """
 from __future__ import annotations
 
@@ -59,6 +63,10 @@ class MeshOrderedPartitionedKVOutput(LogicalOutput):
                                        16))
         self.value_width = int(_conf_get(
             ctx, "tez.runtime.tpu.mesh.value.width.bytes", 16))
+        self.max_key_bytes = int(_conf_get(
+            ctx, "tez.runtime.tpu.mesh.max.key.bytes", 256))
+        self.max_value_bytes = int(_conf_get(
+            ctx, "tez.runtime.tpu.mesh.max.value.bytes", 1024))
         self.max_rows_per_round = int(_conf_get(
             ctx, "tez.runtime.tpu.mesh.max-rows-per-round", 0))
         if _conf_get(ctx, "tez.runtime.key.comparator.class", ""):
@@ -117,7 +125,9 @@ class MeshOrderedPartitionedKVOutput(LogicalOutput):
             num_consumers=self.num_physical_outputs,
             batch=batch, key_width=self.key_width,
             value_width=self.value_width,
-            max_rows_per_round=self.max_rows_per_round)
+            max_rows_per_round=self.max_rows_per_round,
+            max_key_bytes=self.max_key_bytes,
+            max_value_bytes=self.max_value_bytes)
         ctx.counters.increment(TaskCounter.SHUFFLE_BYTES, batch.nbytes)
         payload = ShufflePayload(host=MESH_HOST, port=0,
                                  path_component=edge, last_event=True)

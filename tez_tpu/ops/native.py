@@ -24,7 +24,8 @@ log = logging.getLogger(__name__)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))), "native")
-_SOURCES = ("ragged.cpp", "shuffle_server.cpp", "Makefile")
+_SOURCES = ("ragged.cpp", "shuffle_server.cpp", "baseline_proxy.cpp",
+            "Makefile")
 
 
 def _build_dir() -> str:
@@ -113,6 +114,13 @@ def _load() -> "ctypes.CDLL | None":
                     ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
                     ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
                 lib.hash_sum_i64.restype = ctypes.c_int64
+            if hasattr(lib, "pipelined_sorter_proxy"):
+                lib.pipelined_sorter_proxy.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                    ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+                    ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p]
+                lib.pipelined_sorter_proxy.restype = ctypes.c_double
             _lib = lib
             log.info("native host ops loaded from %s", so_path)
         except Exception as e:  # noqa: BLE001 — toolchain may be absent
@@ -230,6 +238,36 @@ def hash_sum_native(key_bytes: np.ndarray, key_offsets: np.ndarray,
         first_idx.ctypes.data_as(ctypes.c_void_p),
         sums.ctypes.data_as(ctypes.c_void_p))
     return first_idx[:n_unique].copy(), sums[:n_unique].copy()
+
+
+def pipelined_sorter_proxy(keys: np.ndarray, vals: np.ndarray,
+                           num_producers: int, num_partitions: int
+                           ) -> "Optional[Tuple[float, np.ndarray, np.ndarray, np.ndarray]]":
+    """Run the PipelinedSorter/TezMerger-semantics C++ baseline proxy
+    (native/baseline_proxy.cpp; see BASELINE.md) over fixed-width records.
+
+    keys: (n, key_len) u8; vals: (n, val_len) u8.  Returns (wall_seconds,
+    merged_keys, merged_vals, per_partition_counts) or None when the
+    native lib is unavailable."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "pipelined_sorter_proxy"):
+        return None
+    n, key_len = keys.shape
+    val_len = vals.shape[1] if vals.size else 0
+    keys = np.ascontiguousarray(keys)
+    vals = np.ascontiguousarray(vals)
+    out_keys = np.empty_like(keys)
+    out_vals = np.empty_like(vals)
+    counts = np.zeros(num_partitions, dtype=np.int64)
+    secs = lib.pipelined_sorter_proxy(
+        keys.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(key_len),
+        vals.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(val_len),
+        ctypes.c_int64(n), ctypes.c_int32(num_producers),
+        ctypes.c_int32(num_partitions),
+        out_keys.ctypes.data_as(ctypes.c_void_p),
+        out_vals.ctypes.data_as(ctypes.c_void_p),
+        counts.ctypes.data_as(ctypes.c_void_p))
+    return float(secs), out_keys, out_vals, counts
 
 
 def adjacent_equal_native(data: np.ndarray, offsets: np.ndarray,

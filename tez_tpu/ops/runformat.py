@@ -172,6 +172,12 @@ class KVBatch:
     val_offsets: np.ndarray
     dev_keys: Optional[tuple] = dataclasses.field(
         default=None, compare=False, repr=False)
+    #: producer promise: keys in this batch are already unique (e.g. the
+    #: fused tokenize+count aggregator) — the sorter skips its pre-sort
+    #: hash combine for spans made only of such batches.  Dropped (False)
+    #: by take()/concat()/serialization like dev_keys.
+    pre_combined: bool = dataclasses.field(
+        default=False, compare=False, repr=False)
 
     def __getstate__(self) -> dict:
         state = self.__dict__.copy()

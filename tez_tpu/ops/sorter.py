@@ -103,6 +103,7 @@ class SpanBuffer:
         self.nbytes = 0
         self.batches: List[KVBatch] = []
         self._partitioned: Optional[bool] = None   # set by the first add
+        self.all_pre_combined = True   # every added batch promised unique keys
 
     def _set_mode(self, partitioned: bool) -> None:
         if self._partitioned is None:
@@ -115,6 +116,7 @@ class SpanBuffer:
     def add(self, key: bytes, value: bytes,
             partition: Optional[int] = None) -> None:
         self._set_mode(partition is not None)
+        self.all_pre_combined = False
         self.keys.append(key)
         self.vals.append(value)
         if partition is not None:
@@ -123,6 +125,8 @@ class SpanBuffer:
 
     def add_batch(self, batch: KVBatch) -> None:
         self._set_mode(False)
+        if not batch.pre_combined:
+            self.all_pre_combined = False
         self.batches.append(batch)
         self.nbytes += batch.nbytes
 
@@ -239,7 +243,8 @@ class DeviceSorter:
 
     # -- span sort (device) --------------------------------------------------
     def _precombine(self, batch: KVBatch,
-                    custom_parts: Optional[np.ndarray]) -> KVBatch:
+                    custom_parts: Optional[np.ndarray],
+                    skip: bool = False) -> KVBatch:
         """Hash-combine BEFORE the sort when the combiner allows it.
 
         The reference combines after each spill sort
@@ -248,7 +253,7 @@ class DeviceSorter:
         first shrinks pad/lanes/sort/gather by the duplication factor.  The
         post-sort combiner still runs (idempotent for sum) and covers the
         paths this fast path declines."""
-        if self.combiner is not sum_long_combiner or \
+        if skip or self.combiner is not sum_long_combiner or \
                 custom_parts is not None:
             return batch
         n = batch.num_records
@@ -277,8 +282,12 @@ class DeviceSorter:
         batch = self._span.to_batch()
         custom_parts = np.asarray(self._span.parts, dtype=np.int32) \
             if self._span.parts else None
+        # a span made entirely of pre-combined batches (e.g. ONE fused
+        # tokenizer emission) has nothing for the hash pass to collapse
+        skip_pre = self._span.all_pre_combined and \
+            len(self._span.batches) == 1
         self._span = SpanBuffer()
-        batch = self._precombine(batch, custom_parts)
+        batch = self._precombine(batch, custom_parts, skip=skip_pre)
         run = self.sort_batch(batch, custom_partitions=custom_parts)
         if self.combiner is not None:
             run = self.combiner(run)
@@ -293,12 +302,14 @@ class DeviceSorter:
             batch = self._span.to_batch()
             custom_parts = np.asarray(self._span.parts, dtype=np.int32) \
                 if self._span.parts else None
+            skip_pre = self._span.all_pre_combined and \
+                len(self._span.batches) == 1
             self._span = SpanBuffer()
             spill_id = self.num_spills
             self.num_spills += 1
 
             def _bg() -> None:
-                pre = self._precombine(batch, custom_parts)
+                pre = self._precombine(batch, custom_parts, skip=skip_pre)
                 run = self.sort_batch(pre, custom_partitions=custom_parts)
                 if self.combiner is not None:
                     run = self.combiner(run)

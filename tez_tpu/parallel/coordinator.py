@@ -99,22 +99,29 @@ class MeshExchangeCoordinator:
         self.multi_round_exchanges = 0
 
     # ------------------------------------------------------------------ mesh
-    def mesh_for(self, num_consumers: int):
-        from tez_tpu.parallel.mesh import make_mesh
+    def devices_for(self, num_consumers: int) -> int:
+        """How many devices carry a W-consumer exchange: the largest device
+        count d <= |devices| with W % d == 0.  d < W means each device
+        carries W/d consumer partitions (routing hash%d is consistent with
+        consumer partition hash%W exactly when d divides W), split apart on
+        host after the exchange."""
         import jax
+        avail = len(jax.devices())
+        d = min(avail, num_consumers)
+        while num_consumers % d != 0:
+            d -= 1
+        return d
+
+    def mesh_for(self, num_devices: int):
+        from tez_tpu.parallel.mesh import make_mesh
         if self._mesh is not None and \
-                self._mesh.devices.size == num_consumers:
+                self._mesh.devices.size == num_devices:
             return self._mesh
-        cached = self._meshes.get(num_consumers)
+        cached = self._meshes.get(num_devices)
         if cached is not None:
             return cached
-        if len(jax.devices()) < num_consumers:
-            raise MeshCapacityError(
-                f"mesh edge needs {num_consumers} devices (one per consumer "
-                f"partition), have {len(jax.devices())}; lower consumer "
-                f"parallelism or use the host shuffle edge")
-        mesh = make_mesh(n_devices=num_consumers)
-        self._meshes[num_consumers] = mesh
+        mesh = make_mesh(n_devices=num_devices)
+        self._meshes[num_devices] = mesh
         return mesh
 
     # ------------------------------------------------------------- producers
@@ -122,24 +129,39 @@ class MeshExchangeCoordinator:
                           num_producers: int, num_consumers: int,
                           batch: KVBatch, key_width: int,
                           value_width: int,
-                          max_rows_per_round: Optional[int] = None) -> None:
+                          max_rows_per_round: Optional[int] = None,
+                          max_key_bytes: int = 256,
+                          max_value_bytes: int = 1024) -> None:
         """Record one producer span (encoded).  The LAST registration runs
         the exchange inline on that producer's thread — the gang barrier:
         by then every producer's data is resident, which is exactly the
-        gang-scheduling condition CONCURRENT edges declare."""
+        gang-scheduling condition CONCURRENT edges declare.
+
+        Widths AUTO-WIDEN to the span's actual max key/value (rounded to
+        whole u32 lanes) up to the hard caps — the configured widths are
+        slot-size hints, not limits (VERDICT r2 item 5; reference carries
+        arbitrary KV, IFile.java:67).  Spans with different widths zero-pad
+        to the edge max at exchange time (zero lanes == absent bytes, so
+        ordering is unaffected).  Beyond the caps the record belongs on the
+        host shuffle edge — HBM slots are per-row, so a single huge record
+        would tax every row."""
         if len(batch.key_offsets) > 1:
             max_key = int(np.max(np.diff(batch.key_offsets)))
-            if max_key > key_width:
+            if max_key > max_key_bytes:
                 raise MeshCapacityError(
                     f"mesh edge carries keys up to "
-                    f"tez.runtime.tpu.key.width.bytes={key_width}B, "
-                    f"found {max_key}B; raise the width")
+                    f"tez.runtime.tpu.mesh.max.key.bytes={max_key_bytes}B, "
+                    f"found {max_key}B; use the host shuffle edge for "
+                    f"records this large")
+            key_width = max(key_width, ((max_key + 3) // 4) * 4)
             max_val = int(np.max(np.diff(batch.val_offsets)))
-            if max_val > value_width:
+            if max_val > max_value_bytes:
                 raise MeshCapacityError(
                     f"mesh edge carries values up to "
-                    f"tez.runtime.tpu.mesh.value.width.bytes={value_width}B,"
-                    f" found {max_val}B; raise the width")
+                    f"tez.runtime.tpu.mesh.max.value.bytes="
+                    f"{max_value_bytes}B, found {max_val}B; use the host "
+                    f"shuffle edge for records this large")
+            value_width = max(value_width, ((max_val + 3) // 4) * 4)
         kmat, klens = pad_to_matrix(batch.key_bytes, batch.key_offsets,
                                     key_width)
         lanes = matrix_to_lanes(kmat)
@@ -248,14 +270,25 @@ class MeshExchangeCoordinator:
         from tez_tpu.ops.runformat import Run
 
         W = st.num_consumers
-        mesh = self.mesh_for(W)
+        D = self.devices_for(W)     # devices carrying the exchange; each
+        mesh = self.mesh_for(D)     # holds W/D consumer partitions
         with self.lock:
             spans = [st.spans[i] for i in sorted(st.spans)]
-        lanes = np.concatenate([s[0] for s in spans]) \
+        # harmonize widths: spans auto-widened independently — zero-pad
+        # narrow ones (zero lanes/words == absent bytes; order unaffected)
+        max_lanes = max((s[0].shape[1] for s in spans), default=1)
+        max_vw = max((s[2].shape[1] for s in spans), default=1)
+
+        def _widen(a: np.ndarray, width: int) -> np.ndarray:
+            if a.shape[1] == width:
+                return a
+            return np.pad(a, ((0, 0), (0, width - a.shape[1])))
+
+        lanes = np.concatenate([_widen(s[0], max_lanes) for s in spans]) \
             if spans else np.zeros((0, 1), np.uint32)
         klens = np.concatenate([s[1] for s in spans]) \
             if spans else np.zeros((0,), np.uint32)
-        vwords = np.concatenate([s[2] for s in spans]) \
+        vwords = np.concatenate([_widen(s[2], max_vw) for s in spans]) \
             if spans else np.zeros((0, 1), np.uint32)
         total = lanes.shape[0]
         num_lanes = lanes.shape[1]
@@ -264,13 +297,16 @@ class MeshExchangeCoordinator:
             return [KVBatch.empty() for _ in range(W)]
 
         # exact routing on host: byte-masked FNV over the padded key matrix
-        # (reconstruct the byte matrix from lanes — cheap, vectorized)
+        # (reconstruct the byte matrix from lanes — cheap, vectorized).
+        # Routing is hash % D; with D | W that equals (hash % W) % D, so
+        # device d receives exactly the rows of consumer partitions
+        # {c : c % D == d} (split apart after the exchange).
         from tez_tpu.ops.device import _bucket
         from tez_tpu.ops.keycodec import lanes_to_matrix
         kmat = lanes_to_matrix(lanes)
-        part = (fnv_rows_host(kmat, klens.astype(np.int64)) %
-                np.uint32(W)).astype(np.int64)
-        counts = np.bincount(part, minlength=W)
+        hashes = fnv_rows_host(kmat, klens.astype(np.int64))
+        part = (hashes % np.uint32(D)).astype(np.int64)
+        counts = np.bincount(part, minlength=D)
         max_part = int(counts.max())
         per_round = st.max_rows_per_round or self.max_rows_per_round
         rounds = max(1, -(-max_part // per_round))
@@ -282,7 +318,7 @@ class MeshExchangeCoordinator:
         # rank of each row within its partition (stable arrival order)
         order = np.argsort(part, kind="stable")
         ranks = np.empty(total, dtype=np.int64)
-        starts = np.zeros(W + 1, dtype=np.int64)
+        starts = np.zeros(D + 1, dtype=np.int64)
         np.cumsum(counts, out=starts[1:])
         ranks[order] = np.arange(total, dtype=np.int64) - \
             np.repeat(starts[:-1], counts)
@@ -295,8 +331,8 @@ class MeshExchangeCoordinator:
             if n_round == 0:
                 continue
             # rows per worker, padded AND bucketed (stable compile keys)
-            N = _bucket(-(-n_round // W))
-            pad = W * N - n_round
+            N = _bucket(-(-n_round // D))
+            pad = D * N - n_round
             r_lanes = np.concatenate(
                 [lanes[sel],
                  np.zeros((pad, num_lanes), np.uint32)])
@@ -314,13 +350,13 @@ class MeshExchangeCoordinator:
                 raise MeshCapacityError(
                     f"mesh exchange overflow: {dropped_total} rows dropped "
                     f"(cap {cap}, round {r}) — capacity accounting bug")
-            out_lanes = np.asarray(out_lanes).reshape(W, -1, num_lanes)
-            out_klens = np.asarray(out_klens).reshape(W, -1)
-            out_vwords = np.asarray(out_vwords).reshape(W, -1, value_words)
-            out_valid = np.asarray(out_valid).reshape(W, -1)
+            out_lanes = np.asarray(out_lanes).reshape(D, -1, num_lanes)
+            out_klens = np.asarray(out_klens).reshape(D, -1)
+            out_vwords = np.asarray(out_vwords).reshape(D, -1, value_words)
+            out_valid = np.asarray(out_valid).reshape(D, -1)
             per_round_results.append([
                 _decode_rows(out_lanes[w], out_klens[w], out_vwords[w],
-                             out_valid[w]) for w in range(W)])
+                             out_valid[w]) for w in range(D)])
             with self.lock:
                 self.rows_exchanged += n_round
         with self.lock:
@@ -329,20 +365,43 @@ class MeshExchangeCoordinator:
                 self.multi_round_exchanges += 1
 
         if len(per_round_results) == 1:
-            return per_round_results[0]
-        merged: List[KVBatch] = []
-        for w in range(W):
-            runs = [Run(res[w],
-                        np.array([0, res[w].num_records], dtype=np.int64))
-                    for res in per_round_results if res[w].num_records > 0]
-            if not runs:
-                merged.append(KVBatch.empty())
-            elif len(runs) == 1:
-                merged.append(runs[0].batch)
-            else:
-                merged.append(merge_sorted_runs(
-                    runs, 1, num_lanes * 4, engine="host").batch)
-        return merged
+            per_device = per_round_results[0]
+        else:
+            per_device = []
+            for w in range(D):
+                runs = [Run(res[w],
+                            np.array([0, res[w].num_records],
+                                     dtype=np.int64))
+                        for res in per_round_results
+                        if res[w].num_records > 0]
+                if not runs:
+                    per_device.append(KVBatch.empty())
+                elif len(runs) == 1:
+                    per_device.append(runs[0].batch)
+                else:
+                    per_device.append(merge_sorted_runs(
+                        runs, 1, num_lanes * 4, engine="host").batch)
+        if W == D:
+            return per_device
+        # consumers exceed devices: device d holds partitions
+        # {c : c % D == d} key-sorted; split them apart (stable selection
+        # from a key-sorted stream stays key-sorted)
+        results: List[Optional[KVBatch]] = [None] * W
+        for d in range(D):
+            batch = per_device[d]
+            if batch.num_records == 0:
+                for c in range(d, W, D):
+                    results[c] = KVBatch.empty()
+                continue
+            bmat, blens = pad_to_matrix(batch.key_bytes, batch.key_offsets,
+                                        num_lanes * 4)
+            c_part = (fnv_rows_host(bmat, blens.astype(np.int64)) %
+                      np.uint32(W)).astype(np.int64)
+            for c in range(d, W, D):
+                sel = np.flatnonzero(c_part == c)
+                results[c] = batch.take(sel) if sel.size else \
+                    KVBatch.empty()
+        return results    # type: ignore[return-value]
 
 
 _coordinator: Optional[MeshExchangeCoordinator] = None

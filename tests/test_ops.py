@@ -621,3 +621,33 @@ def test_presort_hash_combine_shrinks_sort_and_keeps_result():
     combine_out = sum(g.get("COMBINE_OUTPUT_RECORDS", 0)
                       for g in snap.values())
     assert combine_in == 5000 and combine_out == 7
+
+
+def test_pre_combined_span_skips_hash_combine():
+    """A span made of ONE pre_combined batch (the fused tokenize+count
+    aggregator's promise: keys already unique) must skip the pre-sort hash
+    pass entirely — COMBINE_INPUT_RECORDS stays 0 (ADVICE r3: the skip
+    logic was dead because no emitter set the flag)."""
+    import numpy as np
+
+    from tez_tpu.common.counters import TezCounters
+    from tez_tpu.ops.runformat import KVBatch
+    from tez_tpu.ops.serde import VarLongSerde
+    serde = VarLongSerde()
+    keys = [f"w{i:04d}".encode() for i in range(512)]
+    ko = np.zeros(len(keys) + 1, dtype=np.int64)
+    np.cumsum([len(k) for k in keys], out=ko[1:])
+    kb = np.frombuffer(b"".join(keys), dtype=np.uint8).copy()
+    vb = np.frombuffer(b"".join(serde.to_bytes(i + 1) for i in
+                                range(len(keys))), dtype=np.uint8).copy()
+    vo = np.arange(len(keys) + 1, dtype=np.int64) * 8
+    counters = TezCounters()
+    sorter = DeviceSorter(num_partitions=2, combiner=sum_long_combiner,
+                          counters=counters)
+    sorter.write_batch(KVBatch(kb, ko, vb, vo, pre_combined=True))
+    run = sorter.flush()
+    got = {k: serde.from_bytes(v) for k, v in run.batch.iter_pairs()}
+    assert got == {k: i + 1 for i, k in enumerate(keys)}
+    snap = counters.to_dict()
+    assert sum(g.get("COMBINE_INPUT_RECORDS", 0)
+               for g in snap.values()) == 0

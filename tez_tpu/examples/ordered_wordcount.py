@@ -74,8 +74,12 @@ class VectorTokenProcessor(SimpleProcessor):
                                           dtype=np.uint8).copy()
                 val_offsets = np.arange(len(counts) + 1,
                                         dtype=np.int64) * 8
+                # keys are already unique (one row per distinct word):
+                # pre_combined lets a single-span sort skip its redundant
+                # pre-sort hash combine pass
                 writer.write_batch(KVBatch(key_bytes, key_offsets,
-                                           val_bytes, val_offsets))
+                                           val_bytes, val_offsets,
+                                           pre_combined=True))
                 return
 
         for chunk in reader.iter_chunks():
@@ -109,28 +113,39 @@ class SumProcessor(SimpleProcessor):
         import numpy as np
         reader = inputs["tokenizer"].get_reader()
         writer = outputs["sorter"].get_writer()
-        peek = getattr(reader, "peek_batch", None)
-        if peek is not None and hasattr(writer, "write_batch"):
-            batch = peek()
-            n = batch.num_records
-            # probe BEFORE grouped_batch() so a fall-through to __iter__
-            # doesn't double-count the group counters
-            if n == 0:
-                return
-            if bool(np.all(np.diff(batch.val_offsets) == 8)):
-                from tez_tpu.ops.runformat import KVBatch, gather_ragged
-                from tez_tpu.ops.serde import (decode_longs_be,
-                                               encode_longs_be)
-                batch, starts = reader.grouped_batch()
-                decoded = decode_longs_be(batch.val_bytes, n)
-                sums = np.add.reduceat(decoded, starts)
-                words_b, words_o = gather_ragged(
-                    batch.key_bytes, batch.key_offsets, starts)
-                key_bytes = encode_longs_be(sums)
-                key_offsets = np.arange(len(sums) + 1, dtype=np.int64) * 8
-                writer.write_batch(KVBatch(key_bytes, key_offsets,
-                                           words_b, words_o))
-                return
+        # probe the writer config BEFORE consuming the reader: a custom
+        # Partitioner rejects write_batch, and falling back mid-stream
+        # would lose already-consumed groups
+        if hasattr(reader, "grouped_blocks") and \
+                getattr(writer, "supports_batch", False):
+            from tez_tpu.ops.runformat import KVBatch, gather_ragged
+            from tez_tpu.ops.serde import decode_longs_be, encode_longs_be
+            for batch, starts in reader.grouped_blocks():
+                n = batch.num_records
+                if n == 0:
+                    continue
+                if bool(np.all(np.diff(batch.val_offsets) == 8)):
+                    decoded = decode_longs_be(batch.val_bytes, n)
+                    sums = np.add.reduceat(decoded, starts)
+                    words_b, words_o = gather_ragged(
+                        batch.key_bytes, batch.key_offsets, starts)
+                    key_bytes = encode_longs_be(sums)
+                    key_offsets = np.arange(len(sums) + 1,
+                                            dtype=np.int64) * 8
+                    writer.write_batch(KVBatch(key_bytes, key_offsets,
+                                               words_b, words_o))
+                else:
+                    # mixed-width values (non-long serde): per-record via
+                    # the reader's OWN serdes for this block only — groups
+                    # are complete per block, so correctness is unaffected
+                    bounds = np.append(starts, n)
+                    for s, e in zip(bounds[:-1], bounds[1:]):
+                        word = reader.key_serde.from_bytes(batch.key(int(s)))
+                        total = sum(
+                            reader.val_serde.from_bytes(batch.value(i))
+                            for i in range(int(s), int(e)))
+                        writer.write(total, word)
+            return
         for word, counts in reader:
             writer.write(sum(counts), word)
 
@@ -148,18 +163,27 @@ class NoOpSorterProcessor(SimpleProcessor):
         import numpy as np
         reader = inputs["summation"].get_reader()
         writer = outputs["output"].get_writer()
-        peek = getattr(reader, "peek_batch", None)
-        if peek is not None and hasattr(writer, "write_raw"):
+        if hasattr(reader, "grouped_blocks") and hasattr(writer, "write_raw"):
             from tez_tpu.ops.runformat import gather_ragged
             from tez_tpu.ops.serde import decode_longs_be
-            batch = peek()
-            n = batch.num_records
-            if n == 0:
-                return
-            if bool(np.all(np.diff(batch.key_offsets) == 8)):
-                batch, starts = reader.grouped_batch()
+            # honor a configured output separator (the iterator path writes
+            # through _PartWriter, which does) — format the same bytes here
+            sep = getattr(writer, "sep", b"\t")
+            for batch, starts in reader.grouped_blocks():
+                n = batch.num_records
+                if n == 0:
+                    continue
+                if not bool(np.all(np.diff(batch.key_offsets) == 8)):
+                    # non-long count keys: per-record for this block only
+                    bounds = np.append(starts, n)
+                    for s, e in zip(bounds[:-1], bounds[1:]):
+                        count = reader.key_serde.from_bytes(batch.key(int(s)))
+                        for i in range(int(s), int(e)):
+                            word = reader.val_serde.from_bytes(batch.value(i))
+                            writer.write(word, str(count))
+                    continue
                 counts = decode_longs_be(batch.key_bytes, n)
-                tails = [b"\t%d\n" % int(counts[s]) for s in starts]
+                tails = [sep + b"%d\n" % int(counts[s]) for s in starts]
                 tail_bytes = np.frombuffer(b"".join(tails), dtype=np.uint8)
                 tail_lens = np.array([len(t) for t in tails],
                                      dtype=np.int64)
@@ -176,7 +200,7 @@ class NoOpSorterProcessor(SimpleProcessor):
                 perm[1::2] = n + group_of
                 lines, _ = gather_ragged(pool_bytes, pool_offsets, perm)
                 writer.write_raw(lines.tobytes(), n)
-                return
+            return
         for count, words in reader:
             for word in words:
                 writer.write(word, str(count))

@@ -387,7 +387,8 @@ class OrderedGroupedKVInput(LogicalInput):
         self._wait_and_merge()
         if self._stream_plan is not None:
             return StreamingGroupedKVReader(self._stream_plan, self.key_serde,
-                                            self.val_serde, self.context)
+                                            self.val_serde, self.context,
+                                            key_normalizer=self._key_normalizer)
         batch = self._merged
         if self._group_starts is None:
             # one normalization pass for group detection, cached so repeat
@@ -470,6 +471,13 @@ class GroupedKVReader(KeyValuesReader):
                                         len(self._group_starts))
         return self.batch, self._group_starts
 
+    def grouped_blocks(self) -> Iterator[Tuple[KVBatch, np.ndarray]]:
+        """Block-stream view: yields (sorted KVBatch, group_starts) with
+        every group complete within its block.  For the in-RAM reader that
+        is a single block; the streaming reader yields many — consumers
+        written against this API handle both without branching."""
+        yield self.grouped_batch()
+
     def peek_batch(self) -> KVBatch:
         """The merged batch WITHOUT counter effects — for consumers probing
         whether the vectorized path applies (e.g. uniform value widths)
@@ -478,43 +486,94 @@ class GroupedKVReader(KeyValuesReader):
 
 
 class StreamingGroupedKVReader(KeyValuesReader):
-    """Grouped reader over a streaming merge plan (bounded memory): records
-    arrive sorted from the disk-run heap merge; adjacent equal SORT keys
-    (normalized form when a comparator is configured) form one group.
-    Re-iterable — each iteration re-reads the chunked disk runs."""
+    """Grouped reader over a streaming merge plan (bounded memory): sorted
+    blocks arrive from the vectorized disk-run block merge; adjacent equal
+    SORT keys (normalized form when a comparator is configured) form one
+    group.  Re-iterable — each iteration re-reads the chunked disk runs."""
 
     def __init__(self, plan: Any, key_serde: Serde, val_serde: Serde,
-                 context: Any):
+                 context: Any, key_normalizer: Any = None):
         self.plan = plan
         self.key_serde = key_serde
         self.val_serde = val_serde
         self.context = context
+        self.key_normalizer = key_normalizer
 
-    def __iter__(self) -> Iterator[Tuple[Any, Iterator[Any]]]:
-        import itertools
+    def grouped_blocks(self) -> Iterator[Tuple[KVBatch, np.ndarray]]:
+        """Yields (sorted KVBatch, group_starts) with every group COMPLETE
+        within its block: each merged block's trailing group is carried into
+        the next block, so batch-first consumers need no cross-block
+        bookkeeping.  Each incoming block is group-scanned ONCE; a group
+        spanning m blocks accumulates as a piece list (one concat when it
+        closes), so total work stays linear in records.  Resident memory is
+        one merged block plus the open group (a single key's records — the
+        pathological one-giant-key case degrades to holding that key's
+        group, which any grouped consumer must materialize anyway).  Counts
+        REDUCE_INPUT_GROUPS and — unlike grouped_batch(), whose records
+        were counted at merge time — REDUCE_INPUT_RECORDS, since the
+        streaming merge never materializes a counted whole."""
         counters = self.context.counters
+        norm = self.key_normalizer
+        carry: List[KVBatch] = []     # pieces of the one open group
+        carry_key: Optional[bytes] = None   # its SORT key (normalized form)
+
+        def sort_key(batch: KVBatch, i: int) -> bytes:
+            k = batch.key(i)
+            return norm(k) if norm is not None else k
+
+        def close_carry() -> Tuple[KVBatch, np.ndarray]:
+            out = carry[0] if len(carry) == 1 else KVBatch.concat(carry)
+            return out, np.zeros(1, dtype=np.int64)
+
         groups = 0
         records = 0
-
-        for _, group in itertools.groupby(self.plan.iter_records(),
-                                          key=lambda r: r[0]):
-            first = next(group)
-            key = self.key_serde.from_bytes(first[1])
-
-            def _values(first=first, group=group):
-                nonlocal records
-                records += 1
-                yield self.val_serde.from_bytes(first[2])
-                for rec in group:
-                    records += 1
-                    yield self.val_serde.from_bytes(rec[2])
-
-            groups += 1
-            if (groups & 0x3FF) == 0:
+        for block in self.plan.iter_batches():
+            n = block.num_records
+            if n == 0:
+                continue
+            starts = GroupedKVReader._compute_groups(block, norm)
+            if carry_key is not None and sort_key(block, 0) == carry_key:
+                if len(starts) <= 1:
+                    carry.append(block)   # whole block continues the group
+                    continue
+                cut = int(starts[1])      # the open group closes here
+                carry.append(block.slice_rows(0, cut))
+                groups += 1
+                records += sum(p.num_records for p in carry)
+                yield close_carry()
+                carry = []
+                block = block.slice_rows(cut, n)
+                n -= cut
+                starts = (starts[1:] - cut).astype(np.int64)
+            elif carry:
+                groups += 1
+                records += sum(p.num_records for p in carry)
+                yield close_carry()
+                carry = []
+            # hold the trailing (possibly open) group; emit the rest
+            last = int(starts[-1])
+            carry = [block.slice_rows(last, n)]
+            carry_key = sort_key(block, last)
+            if last > 0:
+                groups += len(starts) - 1
+                records += last
                 self.context.notify_progress()
-            yield key, _values()
+                yield block.slice_rows(0, last), starts[:-1]
+        if carry and sum(p.num_records for p in carry) > 0:
+            groups += 1
+            records += sum(p.num_records for p in carry)
+            yield close_carry()
         counters.increment(TaskCounter.REDUCE_INPUT_GROUPS, groups)
         counters.increment(TaskCounter.REDUCE_INPUT_RECORDS, records)
+
+    def __iter__(self) -> Iterator[Tuple[Any, Iterator[Any]]]:
+        for batch, starts in self.grouped_blocks():
+            bounds = np.append(starts, batch.num_records)
+            for s, e in zip(bounds[:-1], bounds[1:]):
+                key = self.key_serde.from_bytes(batch.key(int(s)))
+                values = (self.val_serde.from_bytes(batch.value(i))
+                          for i in range(int(s), int(e)))
+                yield key, values
 
 
 class UnorderedKVReaderAdapter(KeyValueReader):

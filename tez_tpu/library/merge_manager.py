@@ -24,7 +24,6 @@ source the producer's sorted order is preserved exactly).
 """
 from __future__ import annotations
 
-import heapq
 import logging
 import os
 import threading
@@ -34,6 +33,7 @@ from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from tez_tpu.common.counters import TaskCounter, TezCounters
+from tez_tpu.ops.block_merge import iter_merged_blocks
 from tez_tpu.ops.runformat import (ChunkedRunWriter, KVBatch, Run,
                                    iter_chunked_run)
 from tez_tpu.ops.sorter import merge_sorted_runs, normalize_batch_keys
@@ -304,39 +304,29 @@ class ShuffleMergeManager:
                                 w.bytes_written)
         return path
 
-    def _record_iter(self, source) -> Iterator[Tuple[bytes, bytes, bytes]]:
-        """(sort_key, key, value) stream from a chunked run path or KVBatch;
+    def _block_iter(self, source) -> Iterator[KVBatch]:
+        """Sorted KVBatch blocks from a chunked run path or an in-RAM batch;
         resident memory is one block at a time for paths."""
-        blocks = iter_chunked_run(source) if isinstance(source, str) \
+        return iter_chunked_run(source) if isinstance(source, str) \
             else iter([source])
-        norm = self.key_normalizer
-        for batch in blocks:
-            if norm is not None:
-                nb, no = normalize_batch_keys(batch, norm)
-                for i in range(batch.num_records):
-                    yield (nb[no[i]:no[i + 1]].tobytes(), batch.key(i),
-                           batch.value(i))
-            else:
-                for i in range(batch.num_records):
-                    k = batch.key(i)
-                    yield (k, k, batch.value(i))
+
+    def _merged_block_iter(self, sources: Sequence) -> Iterator[KVBatch]:
+        """Blockwise vectorized k-way merge over paths/batches (age order =
+        source order, so equal keys keep the reference MergeQueue's
+        arrival-order semantics)."""
+        return iter_merged_blocks(
+            [self._block_iter(s) for s in sources], self.key_width,
+            engine=self.engine, key_normalizer=self.key_normalizer,
+            merge_factor=self.merge_factor,
+            device_min_records=self.device_min_records)
 
     def _stream_merge_to_disk(self, paths: List[str]) -> str:
         out_path = os.path.join(self.spill_dir,
                                 f"mmerge_{uuid.uuid4().hex}.crun")
         w = ChunkedRunWriter(out_path, codec=self.codec,
                              block_records=self.block_records)
-        keys: List[bytes] = []
-        vals: List[bytes] = []
-        for _, k, v in heapq.merge(*[self._record_iter(p) for p in paths],
-                                   key=lambda r: r[0]):
-            keys.append(k)
-            vals.append(v)
-            if len(keys) >= self.block_records:
-                w.append(KVBatch.from_pairs(list(zip(keys, vals))))
-                keys, vals = [], []
-        if keys:
-            w.append(KVBatch.from_pairs(list(zip(keys, vals))))
+        for block in self._merged_block_iter(paths):
+            w.append(block)
         w.close()
         self.counters.increment(TaskCounter.ADDITIONAL_SPILLS_BYTES_WRITTEN,
                                 w.bytes_written)
@@ -398,12 +388,30 @@ class _StreamPlan:
         self.disk = disk
         self.mem_seg = mem_seg
 
-    def iter_records(self) -> Iterator[Tuple[bytes, bytes, bytes]]:
+    def _sources(self) -> List[Any]:
         sources: List[Any] = list(self.disk)
         if self.mem_seg is not None:
             sources.append(self.mem_seg)
-        return heapq.merge(*[self.mm._record_iter(s) for s in sources],
-                           key=lambda r: r[0])
+        return sources
+
+    def iter_batches(self) -> Iterator[KVBatch]:
+        """Globally-sorted merged blocks (the vectorized consumer path)."""
+        return self.mm._merged_block_iter(self._sources())
+
+    def iter_records(self) -> Iterator[Tuple[bytes, bytes, bytes]]:
+        """Per-record view for generic consumers, built on the blockwise
+        merge (one normalization pass per block, not per comparison)."""
+        norm = self.mm.key_normalizer
+        for batch in self.iter_batches():
+            if norm is not None:
+                nb, no = normalize_batch_keys(batch, norm)
+                for i in range(batch.num_records):
+                    yield (nb[no[i]:no[i + 1]].tobytes(), batch.key(i),
+                           batch.value(i))
+            else:
+                for i in range(batch.num_records):
+                    k = batch.key(i)
+                    yield (k, k, batch.value(i))
 
 
 class MergedResult:

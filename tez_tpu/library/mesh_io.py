@@ -84,6 +84,8 @@ class MeshOrderedPartitionedKVOutput(LogicalOutput):
         output = self
 
         class _W(KeyValuesWriter):
+            supports_batch = True   # no custom-partitioner mode on mesh edges
+
             def write(self, key, value) -> None:
                 k = output.key_serde.to_bytes(key)
                 v = output.val_serde.to_bytes(value)

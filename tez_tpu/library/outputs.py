@@ -67,6 +67,14 @@ class _SorterWriter(KeyValuesWriter):
         if (self._n & 0x3FFF) == 0:
             self.context.notify_progress()   # liveness + kill check
 
+    @property
+    def supports_batch(self) -> bool:
+        """True when write_batch() will be accepted — batch-first consumers
+        probe this BEFORE consuming their reader, so an unsupported config
+        (custom Partitioner) falls back to write() instead of failing the
+        task mid-stream."""
+        return self.partition_fn is None
+
     def write_batch(self, batch: Any) -> None:
         """Batch-first write path: a KVBatch of PRE-SERIALIZED records goes
         straight to the sorter (no per-record Python).  Only valid with the

@@ -110,6 +110,16 @@ class MeshExchangeCoordinator:
         d = min(avail, num_consumers)
         while num_consumers % d != 0:
             d -= 1
+        if d * 2 <= min(avail, num_consumers):
+            # e.g. W=7 consumers on 4 devices -> d=1: the whole exchange
+            # funnels through one device and splits on host.  Legal but
+            # quietly wasteful — surface it so the operator can pick a
+            # consumer count that divides (or is a multiple of) the mesh.
+            log.warning(
+                "mesh exchange: %d consumers on %d devices routes through "
+                "only %d device(s) (largest divisor); consider a consumer "
+                "parallelism divisible by the device count", num_consumers,
+                avail, d)
         return d
 
     def mesh_for(self, num_devices: int):

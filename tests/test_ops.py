@@ -102,10 +102,14 @@ def test_sorter_host_spill(tmp_path):
                           spill_dir=str(tmp_path), mem_budget_bytes=4096)
     for k, v in pairs:
         sorter.write(k, v)
+    # spans over the mem budget spill as partition-indexed files
+    assert any(f.endswith(".prun") for f in os.listdir(tmp_path))
     run = sorter.flush()
-    assert any(f.endswith(".run") for f in os.listdir(tmp_path))
     golden = golden_sorted(pairs, 2)
     assert list(run.batch.iter_pairs()) == [(k, v) for _, k, _, v in golden]
+    # flush consumed and removed the span spills (the final FileRun was
+    # materialized and deleted by the flush() compat shim)
+    assert not any(f.endswith(".prun") for f in os.listdir(tmp_path))
 
 
 def test_run_save_load_checksum(tmp_path):
@@ -328,27 +332,41 @@ def test_custom_comparator_multi_span_flush():
     assert got == sorted(keys, reverse=True)
 
 
+def _first_prun_blob(path):
+    """First length-prefixed Run blob inside a partition-indexed spill file
+    (container header, then [u64 len][blob]...)."""
+    import struct
+    from tez_tpu.ops.runformat import PR_MAGIC
+    data = open(path, "rb").read()
+    assert data.startswith(PR_MAGIC)
+    off = len(PR_MAGIC)
+    (blob_len,) = struct.unpack_from("<Q", data, off)
+    return data[off + 8:off + 8 + blob_len]
+
+
 def test_spill_compression_conf(tmp_path):
     """Compressed spills: Run blobs carry the codec flag; reads are
     transparent (self-describing header, reference: IFile codec)."""
     import os
-    from tez_tpu.ops.runformat import MAGIC
+    import struct
+    from tez_tpu.ops.runformat import MAGIC, PR_MAGIC
     from tez_tpu.ops.sorter import DeviceSorter
     spill = str(tmp_path)
-    s = DeviceSorter(num_partitions=2, span_budget_bytes=512,
+    s = DeviceSorter(num_partitions=2, span_budget_bytes=4096,
                      mem_budget_bytes=1, spill_dir=spill, spill_codec="zlib")
-    for i in range(200):
+    for i in range(2000):
         s.write(f"key{i % 20:03d}".encode(), b"v" * 16)
-    run = s.flush()
-    assert run.batch.num_records == 200
-    files = os.listdir(spill)
+    files = [f for f in os.listdir(spill) if f.endswith(".prun")]
     assert files, "nothing spilled"
-    blob = open(os.path.join(spill, files[0]), "rb").read()
+    blob = _first_prun_blob(os.path.join(spill, files[0]))
     assert blob.startswith(MAGIC)
     assert blob[len(MAGIC)] == 1      # codec flag = compressed
+    total = sum(os.path.getsize(os.path.join(spill, f)) for f in files)
+    run = s.flush()
+    assert run.batch.num_records == 2000
     # compressed spill should beat the raw size for this repetitive data
-    raw = 200 * (6 + 16)
-    assert len(blob) < raw
+    raw = 2000 * (6 + 16)
+    assert total < raw
 
 
 def test_compress_conf_wired_end_to_end(tmp_path):
@@ -364,23 +382,28 @@ def test_compress_conf_wired_end_to_end(tmp_path):
             fh.write(f"uniqueword{i:06d} ")
     spill_dir = str(tmp_path / "spill")
     out = str(tmp_path / "out")
-    state = ordered_wordcount.run(
-        [str(corpus)], out,
-        conf={"tez.staging-dir": str(tmp_path / "s"),
-              "tez.runtime.io.sort.mb": 1,
-              "tez.runtime.compress": True,
-              "tez.runtime.tpu.host.spill.dir": spill_dir},
-        tokenizer_parallelism=1)
+    from tez_tpu.client.tez_client import TezClient
+    conf = {"tez.staging-dir": str(tmp_path / "s"),
+            "tez.runtime.io.sort.mb": 1,
+            "tez.runtime.compress": True,
+            "tez.runtime.tpu.host.spill.dir": spill_dir}
+    with TezClient.create("compress-e2e", conf) as client:
+        dag = ordered_wordcount.build_dag([str(corpus)], out,
+                                          tokenizer_parallelism=1)
+        dag_client = client.submit_dag(dag)
+        state = dag_client.wait_for_completion().state.name
+        final = dag_client.get_dag_status(with_counters=True)
     assert state == "SUCCEEDED"
-    import os
-    spills = [f for f in os.listdir(spill_dir)] if os.path.isdir(spill_dir) \
-        else []
-    compressed = 0
-    for f in spills:
-        blob = open(os.path.join(spill_dir, f), "rb").read()
-        if blob.startswith(MAGIC) and blob[len(MAGIC)] == 1:
-            compressed += 1
-    assert compressed >= 1, f"no compressed spills in {len(spills)} files"
+    # spill files are consumed (and removed) by the streaming final merge,
+    # so compression is proven by the byte counters: actual disk writes
+    # (compressed) must undercut the logical spilled KV payload
+    tc = final.counters.to_dict().get("TaskCounter", {})
+    spilled_records = tc.get("SPILLED_RECORDS", 0)
+    host_spill = tc.get("HOST_SPILL_BYTES", 0)
+    logical = tc.get("OUTPUT_BYTES", 0)
+    assert spilled_records > 0, "span spill never engaged"
+    assert host_spill > 0
+    assert host_spill < logical, (host_spill, logical)
 
 
 def test_codec_registry_zstd_roundtrip(tmp_path):
@@ -416,10 +439,10 @@ def test_zstd_conf_through_sorter(tmp_path):
                      mem_budget_bytes=1, spill_dir=spill, spill_codec="zstd")
     for i in range(200):
         s.write(f"key{i % 20:03d}".encode(), b"v" * 16)
+    blob = _first_prun_blob(os.path.join(spill, os.listdir(spill)[0]))
+    assert blob[len(MAGIC)] == 2      # zstd flag
     run = s.flush()
     assert run.batch.num_records == 200
-    blob = open(os.path.join(spill, os.listdir(spill)[0]), "rb").read()
-    assert blob[len(MAGIC)] == 2      # zstd flag
 
 
 def test_device_resident_span_and_merge():

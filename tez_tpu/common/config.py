@@ -249,7 +249,7 @@ IO_SORT_MB = _key("tez.runtime.io.sort.mb", 256, Scope.VERTEX,
                   "Device sort span budget (HBM MiB); reference: buffer for PipelinedSorter")
 IO_SORT_FACTOR = _key("tez.runtime.io.sort.factor", 64, Scope.VERTEX,
                       "k-way merge width; reference: TezRuntimeConfiguration io.sort.factor")
-SORTER_CLASS = _key("tez.runtime.sorter.class", "device", Scope.VERTEX,
+SORTER_CLASS = _key("tez.runtime.sorter.class", "auto", Scope.VERTEX,
                     "'device' (TPU radix/segmented sort) or 'host' (numpy fallback)")
 COMBINER_CLASS = _key("tez.runtime.combiner.class", "", Scope.VERTEX)
 SORT_THREADS = _key("tez.runtime.sort.threads", 0, Scope.VERTEX,

@@ -301,7 +301,7 @@ class OrderedGroupedKVInput(LogicalInput):
         codec = None
         if _conf_get(ctx, "tez.runtime.compress", False):
             codec = _conf_get(ctx, "tez.runtime.compress.codec", "zlib")
-        engine = _conf_get(ctx, "tez.runtime.sorter.class", "device")
+        engine = _conf_get(ctx, "tez.runtime.sorter.class", "auto")
         factor = int(_conf_get(ctx, "tez.runtime.io.sort.factor", 64))
 
         self._mm_budget = budget_mb << 20

@@ -69,7 +69,8 @@ class ShuffleMergeManager:
         self.budget = int(budget_bytes)
         self.spill_dir = spill_dir
         self.key_width = key_width
-        self.engine = engine
+        from tez_tpu.ops.sorter import resolve_engine
+        self.engine = resolve_engine(engine)
         from tez_tpu.ops.sorter import DEVICE_SORT_MIN_RECORDS
         self.device_min_records = DEVICE_SORT_MIN_RECORDS \
             if device_min_records is None else device_min_records

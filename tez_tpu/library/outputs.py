@@ -106,7 +106,7 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                                              "bytes"))
         self.val_serde = get_serde(_conf_get(ctx, "tez.runtime.value.class",
                                              "bytes"))
-        engine = _conf_get(ctx, "tez.runtime.sorter.class", "device")
+        engine = _conf_get(ctx, "tez.runtime.sorter.class", "auto")
         merge_factor = int(_conf_get(ctx, "tez.runtime.io.sort.factor", 64))
         sort_threads = int(_conf_get(ctx, "tez.runtime.sort.threads", 0))
         partitioner_cls = _conf_get(ctx, "tez.runtime.partitioner.class",
@@ -189,7 +189,7 @@ class OrderedPartitionedKVOutput(LogicalOutput):
         self.context.counters.increment(TaskCounter.SHUFFLE_CHUNK_COUNT)
 
     def close(self) -> List[TezAPIEvent]:
-        final_run = self.sorter.flush()
+        final_run = self.sorter.flush_run()
         if self._pipelined:
             # final empty marker event with last_event=True for completeness
             payload = ShufflePayload(

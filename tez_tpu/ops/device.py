@@ -38,6 +38,20 @@ FNV_OFFSET = np.uint32(2166136261)
 FNV_PRIME = np.uint32(16777619)
 
 
+@functools.lru_cache(maxsize=1)
+def accelerator_present() -> bool:
+    """True when the default JAX backend is an accelerator (TPU/GPU).
+
+    The `auto` engine routes through this: device kernels are a *loss* on
+    the CPU backend (XLA CPU sort + dispatch overhead vs numpy/native), so
+    auto picks the host engine there and the device engine whenever a real
+    chip answers.  Cached — one backend query per process."""
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:  # noqa: BLE001 — backend init failure = no accelerator
+        return False
+
+
 def uniform_clamped_lengths(lengths: np.ndarray, width_cap: int):
     """(is_uniform, pad_value) over CLAMPED lengths — the shared uniformity
     test for the skip-length-pass optimization (clamp first: all-long keys

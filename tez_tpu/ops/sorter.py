@@ -24,8 +24,9 @@ import numpy as np
 from tez_tpu.common.counters import TaskCounter, TezCounters
 from tez_tpu.ops import device
 from tez_tpu.ops.keycodec import encode_keys, pad_to_matrix, matrix_to_lanes
-from tez_tpu.ops.runformat import (KVBatch, Run, adjacent_equal_rows,
-                                   gather_ragged)
+from tez_tpu.ops.runformat import (FileRun, KVBatch, PartitionedRunWriter,
+                                   Run, adjacent_equal_rows, gather_ragged,
+                                   save_run_partitioned)
 
 log = logging.getLogger(__name__)
 
@@ -152,6 +153,15 @@ Combiner = Callable[[Run], Run]
 DEVICE_SORT_MIN_RECORDS = 1 << 16
 
 
+def resolve_engine(engine: str) -> str:
+    """Resolve the `auto` engine: device kernels when an accelerator
+    backend answers, host kernels on the CPU fallback (where an XLA:CPU
+    sort + dispatch round-trip loses to numpy/native outright)."""
+    if engine == "auto":
+        return "device" if device.accelerator_present() else "host"
+    return engine
+
+
 def _route_engine(engine: str, n: int, min_records: int) -> str:
     return "host" if engine == "device" and n < min_records else engine
 
@@ -175,7 +185,8 @@ class DeviceSorter:
                  device_min_records: int = DEVICE_SORT_MIN_RECORDS):
         self.num_partitions = num_partitions
         self.key_width = max(4, key_width)
-        self.engine = engine   # 'device' (TPU kernels) | 'host' (np.lexsort)
+        # 'device' (TPU kernels) | 'host' (np.lexsort/native) | 'auto'
+        self.engine = resolve_engine(engine)
         self.device_min_records = device_min_records
         #: keep sorted key lanes in HBM for downstream device merges.  The
         #: pinned HBM (~(key width + 4) B/row per registered output, freed
@@ -426,8 +437,8 @@ class DeviceSorter:
         if self.spill_dir is not None and \
                 self._runs_nbytes + run.nbytes > self.mem_budget:
             path = os.path.join(self.spill_dir,
-                                f"spill_{uuid.uuid4().hex}.run")
-            run.save(path, codec=self.spill_codec)
+                                f"spill_{uuid.uuid4().hex}.prun")
+            save_run_partitioned(run, path, codec=self.spill_codec)
             # count bytes actually written: with compression on, disk I/O
             # is what these counters exist to report
             written = os.path.getsize(path)
@@ -460,23 +471,32 @@ class DeviceSorter:
         if error is not None:
             raise error
 
-    def _load_runs(self) -> List[Run]:
-        out = []
-        for r in self._runs:
-            if isinstance(r, str):
-                read = os.path.getsize(r)
-                run = Run.load(r)
-                self.counters.increment(
-                    TaskCounter.ADDITIONAL_SPILLS_BYTES_READ, read)
-                out.append(run)
-            else:
-                out.append(r)
-        return out
-
     # -- flush ---------------------------------------------------------------
     def flush(self) -> Optional[Run]:
+        """Final merge of all spans, fully materialized (compat surface for
+        in-RAM callers/tests).  Returns None in pipelined mode.  Spill-scale
+        callers want flush_run(), which keeps disk-resident data on disk."""
+        result = self.flush_run()
+        if isinstance(result, FileRun):
+            run = result.to_run()
+            result.delete()
+            return run
+        return result
+
+    def flush_run(self):
         """Final merge of all spans.  Returns None in pipelined mode (spans
-        already shipped via on_spill; a trailing partial span ships here)."""
+        already shipped via on_spill; a trailing partial span ships here).
+
+        In-RAM cases return a `Run` exactly as before (single-span fast
+        path; all-RAM multi-span device merge with HBM-resident keys).  When
+        any span spilled to disk, the merge instead STREAMS: a partition-
+        major blockwise k-way merge (ops/block_merge.py) over the
+        partition-indexed span files, written incrementally to one final
+        partition-indexed file — no second full sort, no full
+        materialization; resident memory is one block per span.  Returns a
+        disk-backed `FileRun` (reference: the final IFile + TezSpillRecord
+        a PipelinedSorter task publishes, PipelinedSorter.java:559 final
+        merge -> TezMerger.java:76)."""
         assert not self._closed
         self._closed = True
         if self.on_spill is not None:
@@ -490,21 +510,81 @@ class DeviceSorter:
             return self._finalize_span()
         self._sort_span()
         self._drain_pending(store=True)
-        runs = self._load_runs()
+        runs = list(self._runs)
         self._runs = []
         if not runs:
             return Run(KVBatch.empty(),
                        np.zeros(self.num_partitions + 1, dtype=np.int64))
-        if len(runs) == 1:
-            return runs[0]
-        merged = merge_sorted_runs(runs, self.num_partitions, self.key_width,
-                                   counters=self.counters, engine=self.engine,
-                                   merge_factor=self.merge_factor,
-                                   key_normalizer=self.key_normalizer,
-                                   device_min_records=self.device_min_records)
-        if self.combiner is not None:
-            merged = self.combiner(merged)
-        return merged
+        if not any(isinstance(r, str) for r in runs):
+            if len(runs) == 1:
+                return runs[0]
+            merged = merge_sorted_runs(
+                runs, self.num_partitions, self.key_width,
+                counters=self.counters, engine=self.engine,
+                merge_factor=self.merge_factor,
+                key_normalizer=self.key_normalizer,
+                device_min_records=self.device_min_records)
+            if self.combiner is not None:
+                merged = self.combiner(merged)
+            return merged
+        return self._stream_final_merge(runs)
+
+    def _stream_final_merge(self, runs: List["Run | str"]) -> "FileRun":
+        """Blockwise partition-major merge of spilled + resident spans into
+        one partition-indexed file."""
+        from tez_tpu.ops.block_merge import iter_merged_blocks
+        sources: List["Run | FileRun"] = []
+        for r in runs:
+            if isinstance(r, str):
+                self.counters.increment(
+                    TaskCounter.ADDITIONAL_SPILLS_BYTES_READ,
+                    os.path.getsize(r))
+                sources.append(FileRun(r))
+            else:
+                sources.append(r)
+        path = os.path.join(self.spill_dir,
+                            f"final_{uuid.uuid4().hex}.prun")
+        writer = PartitionedRunWriter(path, self.num_partitions,
+                                      codec=self.spill_codec)
+        self.counters.increment(TaskCounter.MERGED_MAP_OUTPUTS, len(sources))
+        try:
+            for p in range(self.num_partitions):
+                srcs = []
+                for s in sources:
+                    if s.partition_row_count(p) == 0:
+                        continue
+                    srcs.append(s.iter_partition_blocks(p)
+                                if isinstance(s, FileRun)
+                                else iter([s.partition(p)]))
+                for block in iter_merged_blocks(
+                        srcs, self.key_width, engine=self.engine,
+                        key_normalizer=self.key_normalizer,
+                        merge_factor=self.merge_factor,
+                        device_min_records=self.device_min_records):
+                    if self.combiner is not None:
+                        # block-local combine: legal for the (associative)
+                        # combiner contract; a key split across block edges
+                        # keeps at most one extra record per edge, and the
+                        # consumer's grouped reader re-unifies it
+                        combined = self.combiner(Run(
+                            block, np.array([0, block.num_records],
+                                            dtype=np.int64)))
+                        block = combined.batch
+                    writer.append(block, p)
+            writer.close()
+        except BaseException:
+            writer.abort()
+            raise
+        self.counters.increment(TaskCounter.ADDITIONAL_SPILLS_BYTES_WRITTEN,
+                                writer.bytes_written)
+        # span spill files are dead now
+        for r in runs:
+            if isinstance(r, str):
+                try:
+                    os.remove(r)
+                except OSError:
+                    pass
+        return FileRun(path)
 
 
 def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,

@@ -47,26 +47,25 @@ class FileShuffleStore:
         self._lock = threading.Lock()
 
     def register(self, path_component: str, spill_id: int, run: Run) -> None:
-        """Serialize every partition once; readers get raw byte ranges."""
-        blobs = []
-        for p in range(run.num_partitions):
-            single = Run(run.partition(p),
-                         _two_entry_index(run.partition_row_count(p)))
-            blobs.append(single.to_bytes())
+        """Serialize partition-at-a-time; readers get raw byte ranges.
+        Resident memory is one partition's blob (a disk-backed FileRun is
+        never materialized whole)."""
         base = os.path.join(self.directory,
                             _base_name(path_component, spill_id))
-        offsets = [0]
-        for b in blobs:
-            offsets.append(offsets[-1] + len(b))
         with self._lock:
             tmp = base + ".tmp"
+            offsets = [0]
             with open(tmp, "wb") as fh:
-                for b in blobs:
-                    fh.write(b)
+                for p in range(run.num_partitions):
+                    single = Run(run.partition(p),
+                                 _two_entry_index(run.partition_row_count(p)))
+                    blob = single.to_bytes()
+                    fh.write(blob)
+                    offsets.append(offsets[-1] + len(blob))
             os.replace(tmp, base + ".data")
             with open(base + ".index.tmp", "wb") as fh:
                 fh.write(_INDEX_MAGIC)
-                fh.write(struct.pack("<I", len(blobs)))
+                fh.write(struct.pack("<I", run.num_partitions))
                 fh.write(struct.pack(f"<{len(offsets)}Q", *offsets))
             # data strictly before index: a reader that sees the index can
             # always sendfile the data

@@ -55,11 +55,15 @@ class ShuffleService:
 
     def unregister_prefix(self, prefix: str) -> int:
         """Deletion tracker: drop all outputs whose path starts with prefix
-        (per-DAG / per-vertex cleanup)."""
+        (per-DAG / per-vertex cleanup).  Disk-backed runs (FileRun) also
+        remove their backing file."""
         with self._lock:
             victims = [k for k in self._runs if k[0].startswith(prefix)]
-            for k in victims:
-                del self._runs[k]
+            dead = [self._runs.pop(k) for k in victims]
+        for run in dead:
+            deleter = getattr(run, "delete", None)
+            if deleter is not None:
+                deleter()
         if self._store is not None:
             self._store.unregister_prefix(prefix)
         return len(victims)
@@ -71,7 +75,14 @@ class ShuffleService:
             run = self._runs.get((path_component, spill_id))
         if run is None:
             raise ShuffleDataNotFound(f"{path_component}/{spill_id}")
-        return run.partition(partition)
+        try:
+            return run.partition(partition)
+        except FileNotFoundError:
+            # disk-backed run deleted by a concurrent unregister_prefix
+            # (DAG teardown) between the registry lookup and the read —
+            # same contract as a missing registration
+            raise ShuffleDataNotFound(
+                f"{path_component}/{spill_id}") from None
 
     def fetch_partition_range(self, path_component: str, spill_id: int,
                               start: int, stop: int) -> List[KVBatch]:
@@ -79,7 +90,11 @@ class ShuffleService:
             run = self._runs.get((path_component, spill_id))
         if run is None:
             raise ShuffleDataNotFound(f"{path_component}/{spill_id}")
-        return [run.partition(p) for p in range(start, stop)]
+        try:
+            return [run.partition(p) for p in range(start, stop)]
+        except FileNotFoundError:
+            raise ShuffleDataNotFound(
+                f"{path_component}/{spill_id}") from None
 
     def partition_size(self, path_component: str, spill_id: int,
                        partition: int) -> int:
@@ -87,7 +102,11 @@ class ShuffleService:
             run = self._runs.get((path_component, spill_id))
         if run is None:
             raise ShuffleDataNotFound(f"{path_component}/{spill_id}")
-        return run.partition_nbytes(partition)
+        try:
+            return run.partition_nbytes(partition)
+        except FileNotFoundError:
+            raise ShuffleDataNotFound(
+                f"{path_component}/{spill_id}") from None
 
     def stats(self) -> Tuple[int, int]:
         with self._lock:

@@ -112,11 +112,24 @@ def run(target_mb: int, vocab: int, sort_mb: int, engine: str,
         t0 = time.time()
         distinct = verify_output(out_dir, golden)
         verify_s = time.time() - t0
+        from tez_tpu.ops.sorter import resolve_engine
+        resolved = resolve_engine(engine)
+        if engine == "host":
+            # --engine host exists to BYPASS the device stack; querying the
+            # backend just for metadata would block on a stalled PJRT init
+            backend = "(not queried)"
+        else:
+            import jax
+            backend = jax.default_backend()
         return {
             "metric": (f"OrderedWordCount spill-scale E2E ({target_mb} MB "
                        f"input, vocab {vocab}, io.sort.mb={sort_mb}, "
-                       f"combine OFF, {engine} engine, output verified "
+                       f"combine OFF, engine={engine}->{resolved} on "
+                       f"jax backend={backend}, output verified "
                        f"vs streamed host golden)"),
+            "engine_requested": engine,
+            "engine_resolved": resolved,
+            "jax_backend": backend,
             "value": round(nbytes / 1e6 / wall, 2),
             "unit": "MB/s",
             "wall_seconds": round(wall, 1),
@@ -134,8 +147,10 @@ def main() -> int:
     ap.add_argument("--mb", type=int, default=1024)
     ap.add_argument("--vocab-size", type=int, default=2_000_000)
     ap.add_argument("--sort-mb", type=int, default=64)
-    ap.add_argument("--engine", default="device",
-                    help="device|host sorter engine")
+    ap.add_argument("--engine", default="auto",
+                    help="auto|device|host sorter engine (auto = device "
+                         "kernels when an accelerator backend answers, "
+                         "host kernels on the CPU fallback)")
     ap.add_argument("--parallelism", type=int, default=4)
     ap.add_argument("--out", default="")
     args = ap.parse_args()

@@ -186,6 +186,32 @@ class ShuffleFetchTable:
             if payload.is_empty(partition):
                 batch = None
             else:
+                if mm is not None:
+                    # disk-direct short-circuit: a disk-backed producer run
+                    # on this host merges straight off its partition-
+                    # indexed file — no materialization, no re-spill
+                    # (reference: LocalDiskFetchedInput / the
+                    # SHUFFLE_BYTES_DISK_DIRECT path)
+                    src = self.service.local_file_source(
+                        payload.path_component, payload.spill_id, partition)
+                    if src is not None:
+                        path, nbytes = src
+                        if mm.commit_local_file(slot, path, partition,
+                                                nbytes, generation):
+                            with self._deliver_lock:
+                                ctr = self.context.counters
+                                ctr.increment(TaskCounter.SHUFFLE_BYTES,
+                                              nbytes)
+                                ctr.increment(
+                                    TaskCounter.SHUFFLE_BYTES_DISK_DIRECT,
+                                    nbytes)
+                                ctr.increment(
+                                    TaskCounter.LOCAL_SHUFFLED_INPUTS)
+                                ctr.increment(
+                                    TaskCounter.NUM_SHUFFLED_INPUTS)
+                            self._commit_fetch(slot, payload, version,
+                                               stamp, generation, None)
+                        return
                 batch = self._fetch_local(payload, partition)
                 with self._deliver_lock:
                     self.context.counters.increment(

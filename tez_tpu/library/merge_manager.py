@@ -45,6 +45,20 @@ def _as_run(batch: KVBatch) -> Run:
     return Run(batch, np.array([0, batch.num_records], dtype=np.int64))
 
 
+class _FileSource:
+    """Disk-direct shuffle source: one partition of a producer's
+    partition-indexed output file, merged straight off the producer's disk
+    (LocalDiskFetchedInput analog) — never copied into this consumer's
+    memory budget or spill dir."""
+
+    __slots__ = ("path", "partition", "nbytes")
+
+    def __init__(self, path: str, partition: int, nbytes: int):
+        self.path = path
+        self.partition = partition
+        self.nbytes = nbytes
+
+
 class ShuffleMergeManager:
     """Admission + background mem->disk merging for one consumer input.
 
@@ -91,6 +105,9 @@ class ShuffleMergeManager:
         self._seq = 0
         self._disk_runs: List[str] = []          # chunked run paths, by age
         self._disk_slots: set = set()            # slots with data on disk
+        # disk-direct sources (producer-owned files; never merged by the
+        # background merger — they cost no memory and no consumer disk)
+        self._file_sources: List[Tuple[int, int, _FileSource]] = []
         self._merging: List[Tuple[int, int, KVBatch]] = []  # claimed by merger
         self._stalled = 0                        # fetchers waiting in commit
         self._slot_gen: dict = {}                # slot -> reset generation
@@ -176,6 +193,20 @@ class ShuffleMergeManager:
         self.counters.increment(TaskCounter.SHUFFLE_BYTES_TO_MEM, batch.nbytes)
         return True
 
+    def commit_local_file(self, slot: int, path: str, partition: int,
+                          nbytes: int, generation: int = 0) -> bool:
+        """Admit a disk-direct source (same-host producer's partition-
+        indexed file).  Costs no memory budget and no consumer disk; the
+        blocks stream from the producer's file at merge time.  Returns
+        False if dropped as stale (slot reset since `generation`)."""
+        with self.lock:
+            if self._slot_gen.get(slot, 0) != generation:
+                return False
+            self._file_sources.append(
+                (slot, self._seq, _FileSource(path, partition, nbytes)))
+            self._seq += 1
+        return True
+
     def on_slot_reset(self, slot: int) -> List[KVBatch]:
         """A producer is re-running.  The slot's generation bumps (so
         in-flight fetches of the old attempt drop at commit), its in-memory
@@ -196,6 +227,10 @@ class ShuffleMergeManager:
             dropped = [b for s, _, b in self._mem if s == slot]
             self._mem = [(s, q, b) for s, q, b in self._mem if s != slot]
             self._mem_bytes -= sum(b.nbytes for b in dropped)
+            # disk-direct sources are never folded into shared merge files:
+            # dropping the slot's entries is a complete undo
+            self._file_sources = [t for t in self._file_sources
+                                  if t[0] != slot]
             self.lock.notify_all()
             return dropped
 
@@ -306,10 +341,16 @@ class ShuffleMergeManager:
         return path
 
     def _block_iter(self, source) -> Iterator[KVBatch]:
-        """Sorted KVBatch blocks from a chunked run path or an in-RAM batch;
-        resident memory is one block at a time for paths."""
-        return iter_chunked_run(source) if isinstance(source, str) \
-            else iter([source])
+        """Sorted KVBatch blocks from a chunked run path, a disk-direct
+        file source, or an in-RAM batch; resident memory is one block at a
+        time for the disk shapes."""
+        if isinstance(source, str):
+            return iter_chunked_run(source)
+        if isinstance(source, _FileSource):
+            from tez_tpu.ops.runformat import FileRun
+            return FileRun(source.path).iter_partition_blocks(
+                source.partition)
+        return iter([source])
 
     def _merged_block_iter(self, sources: Sequence) -> Iterator[KVBatch]:
         """Blockwise vectorized k-way merge over paths/batches (age order =
@@ -345,7 +386,27 @@ class ShuffleMergeManager:
             self._raise_if_broken()
             mem = sorted(self._mem)
             disk = list(self._disk_runs)
-        if not disk:
+            # no byte-size filter: empty PARTITIONS never commit (gated by
+            # the producer's row-count flags), and a committed source whose
+            # records are all zero-length pairs still carries rows
+            file_entries = sorted(self._file_sources)
+        files = [fs for _, _, fs in file_entries]
+        file_bytes = sum(fs.nbytes for fs in files)
+        if files and self.budget > 0 and not disk and \
+                file_bytes + self._mem_bytes <= \
+                self.budget * self.merge_threshold:
+            # small disk-direct inputs: cheaper to materialize and take the
+            # in-RAM merged-batch path than to stream; slot-major order is
+            # preserved by merging them into the mem list under their real
+            # (slot, seq) keys
+            from tez_tpu.ops.runformat import FileRun
+            for s, q, fs in file_entries:
+                batch = FileRun(fs.path).partition(fs.partition)
+                if batch.num_records > 0:
+                    mem.append((s, q, batch))
+            mem.sort(key=lambda t: t[:2])
+            files = []
+        if not disk and not files:
             runs = [_as_run(b) for _, _, b in mem if b.num_records > 0]
             if not runs:
                 return MergedResult(batch=KVBatch.empty())
@@ -364,7 +425,7 @@ class ShuffleMergeManager:
                 engine=self.engine, merge_factor=self.merge_factor,
                 device_min_records=self.device_min_records,
                 key_normalizer=self.key_normalizer).batch
-        return MergedResult(stream=_StreamPlan(self, disk, mem_seg))
+        return MergedResult(stream=_StreamPlan(self, disk + files, mem_seg))
 
     def cleanup(self) -> None:
         with self.lock:

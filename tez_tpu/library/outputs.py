@@ -181,6 +181,31 @@ class OrderedPartitionedKVOutput(LogicalOutput):
         ]
 
     def _ship_spill(self, run: Run, spill_id: int) -> None:
+        # spill-scale pipelined spans go to disk as partition-indexed files
+        # and register disk-backed: RAM stays bounded, same-host consumers
+        # merge disk-direct off the span file, and there is NO producer
+        # final merge at all (the pipelined point, reference:
+        # tez.runtime.pipelined-shuffle.enabled -> one event per spill)
+        sorter = self.sorter
+        ctr = self.context.counters
+        # _store_run convention: every shipped span counts as spilled
+        ctr.increment(TaskCounter.SPILLED_RECORDS, run.batch.num_records)
+        if sorter.spill_dir is not None and run.nbytes >= (1 << 20) and \
+                not self.service.has_store():
+            # (with a write-through store attached the store's own file IS
+            # the disk copy — writing a pspill too would double the I/O)
+            import uuid as _uuid
+            from tez_tpu.ops.runformat import (FileRun,
+                                               save_run_partitioned)
+            path = os.path.join(sorter.spill_dir,
+                                f"pspill_{_uuid.uuid4().hex}.prun")
+            save_run_partitioned(run, path, codec=sorter.spill_codec)
+            written = os.path.getsize(path)
+            ctr.increment(TaskCounter.ADDITIONAL_SPILLS_BYTES_WRITTEN,
+                          written)
+            ctr.increment(TaskCounter.ADDITIONAL_SPILL_COUNT)
+            ctr.increment(TaskCounter.HOST_SPILL_BYTES, written)
+            run = FileRun(path)
         self.service.register(output_path_component(self.context), spill_id,
                               run)
         # last=False; close() sends the final marker

@@ -112,6 +112,144 @@ void adjacent_equal_u8(const uint8_t* data, const int64_t* offsets,
 }  // extern "C"
 
 // ---------------------------------------------------------------------------
+// Span sort (host engine): partition + stable sort over ragged keys.
+//
+// The host twin of the device hash_sort_span kernel and the C-speed
+// replacement for the numpy path (pad-to-matrix -> u32 lanes -> 6-key
+// lexsort -> host tie-break).  Sorting row indices directly against the
+// ragged key bytes needs no padded matrix, resolves ties exactly (full-key
+// memcmp), and releases the GIL for the whole call (ctypes), so concurrent
+// producer tasks in one process actually overlap — the reference gets this
+// for free from JVM threads (PipelinedSorter sortmaster); numpy never does.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Big-endian zero-padded first-8-bytes prefix: unsigned compare of prefixes
+// orders like memcmp of the first 8 bytes.
+inline uint64_t key_prefix(const uint8_t* p, int64_t len) {
+    uint64_t v = 0;
+    int64_t m = len < 8 ? len : 8;
+    for (int64_t i = 0; i < m; i++) v |= (uint64_t)p[i] << (56 - 8 * i);
+    return v;
+}
+
+struct SpanSortCtx {
+    const uint8_t* bytes;
+    const int64_t* offsets;
+    const int32_t* parts;          // may be null (single partition)
+    const uint64_t* prefix;
+
+    // Total order (partition, key bytes, original index): any comparison
+    // sort then yields exactly the stable permutation.
+    bool less(int64_t a, int64_t b) const {
+        if (parts && parts[a] != parts[b]) return parts[a] < parts[b];
+        if (prefix[a] != prefix[b]) return prefix[a] < prefix[b];
+        int64_t la = offsets[a + 1] - offsets[a];
+        int64_t lb = offsets[b + 1] - offsets[b];
+        if (la > 8 && lb > 8) {
+            int64_t m = (la < lb ? la : lb) - 8;
+            int c = std::memcmp(bytes + offsets[a] + 8,
+                                bytes + offsets[b] + 8, (size_t)m);
+            if (c) return c < 0;
+        }
+        if (la != lb) return la < lb;
+        return a < b;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+// 32-bit FNV-1a over each full key, mod num_partitions — must stay
+// byte-identical to the device kernel and numpy host partitioner.
+void tz_fnv32_partition(const uint8_t* key_bytes, const int64_t* key_offsets,
+                        int64_t n, int32_t num_partitions, int32_t* parts,
+                        int32_t n_threads) {
+    if (n <= 0) return;
+    int threads = std::max(1, (int)n_threads);
+    std::vector<std::thread> pool;
+    int64_t per = (n + threads - 1) / threads;
+    for (int t = 0; t < threads; t++) {
+        int64_t lo = t * per, hi = std::min<int64_t>(n, lo + per);
+        if (lo >= hi) break;
+        pool.emplace_back([=]() {
+            for (int64_t i = lo; i < hi; i++) {
+                uint32_t h = 2166136261u;
+                for (int64_t j = key_offsets[i]; j < key_offsets[i + 1]; j++) {
+                    h ^= key_bytes[j];
+                    h *= 16777619u;
+                }
+                parts[i] = (int32_t)(h % (uint32_t)num_partitions);
+            }
+        });
+    }
+    for (auto& th : pool) th.join();
+}
+
+// Stable sort permutation of rows by (partition, key bytes).  partitions
+// may be null (single-partition sort, e.g. run merges).  Parallel merge
+// sort over indices: chunk std::sort, then level-by-level inplace_merge,
+// both parallel.
+void tz_sort_partition_keys(const uint8_t* key_bytes,
+                            const int64_t* key_offsets,
+                            const int32_t* partitions, int64_t n,
+                            int64_t* perm, int32_t n_threads) {
+    if (n <= 0) return;
+    std::vector<uint64_t> prefix((size_t)n);
+    int threads = std::max(1, (int)n_threads);
+    {
+        std::vector<std::thread> pool;
+        int64_t per = (n + threads - 1) / threads;
+        for (int t = 0; t < threads; t++) {
+            int64_t lo = t * per, hi = std::min<int64_t>(n, lo + per);
+            if (lo >= hi) break;
+            pool.emplace_back([=, &prefix]() {
+                for (int64_t i = lo; i < hi; i++)
+                    prefix[(size_t)i] = key_prefix(
+                        key_bytes + key_offsets[i],
+                        key_offsets[i + 1] - key_offsets[i]);
+            });
+        }
+        for (auto& th : pool) th.join();
+    }
+    SpanSortCtx ctx{key_bytes, key_offsets, partitions, prefix.data()};
+    auto cmp = [&ctx](int64_t a, int64_t b) { return ctx.less(a, b); };
+    for (int64_t i = 0; i < n; i++) perm[i] = i;
+    if (n < (1 << 15) || threads == 1) {
+        std::sort(perm, perm + n, cmp);
+        return;
+    }
+    // chunked parallel sort
+    int chunks = threads;
+    std::vector<int64_t> bounds(chunks + 1);
+    for (int c = 0; c <= chunks; c++) bounds[c] = n * c / chunks;
+    {
+        std::vector<std::thread> pool;
+        for (int c = 0; c < chunks; c++)
+            pool.emplace_back([&, c]() {
+                std::sort(perm + bounds[c], perm + bounds[c + 1], cmp);
+            });
+        for (auto& th : pool) th.join();
+    }
+    // pairwise parallel merges
+    for (int step = 1; step < chunks; step *= 2) {
+        std::vector<std::thread> pool;
+        for (int c = 0; c + step < chunks; c += 2 * step) {
+            int64_t lo = bounds[c], mid = bounds[c + step];
+            int64_t hi = bounds[std::min(chunks, c + 2 * step)];
+            pool.emplace_back([=, &cmp]() {
+                std::inplace_merge(perm + lo, perm + mid, perm + hi, cmp);
+            });
+        }
+        for (auto& th : pool) th.join();
+    }
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
 // Hash aggregation (map-side combine).
 //
 // The reference runs its combiner AFTER the sort, over each spill

@@ -114,6 +114,15 @@ def _load() -> "ctypes.CDLL | None":
                     ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
                     ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
                 lib.hash_sum_i64.restype = ctypes.c_int64
+            if hasattr(lib, "tz_sort_partition_keys"):
+                lib.tz_fnv32_partition.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                    ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32]
+                lib.tz_fnv32_partition.restype = None
+                lib.tz_sort_partition_keys.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32]
+                lib.tz_sort_partition_keys.restype = None
             if hasattr(lib, "pipelined_sorter_proxy"):
                 lib.pipelined_sorter_proxy.argtypes = [
                     ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
@@ -268,6 +277,54 @@ def pipelined_sorter_proxy(keys: np.ndarray, vals: np.ndarray,
         out_vals.ctypes.data_as(ctypes.c_void_p),
         counts.ctypes.data_as(ctypes.c_void_p))
     return float(secs), out_keys, out_vals, counts
+
+
+def fnv32_partition_native(key_bytes: np.ndarray, key_offsets: np.ndarray,
+                           num_partitions: int) -> Optional[np.ndarray]:
+    """Threaded 32-bit FNV-1a hash partition over full ragged keys
+    (byte-identical to the device kernel and numpy host partitioner);
+    None when the native lib is unavailable."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tz_fnv32_partition"):
+        return None
+    n = len(key_offsets) - 1
+    key_bytes = np.ascontiguousarray(key_bytes)
+    key_offsets = np.ascontiguousarray(key_offsets.astype(np.int64))
+    parts = np.empty(n, dtype=np.int32)
+    lib.tz_fnv32_partition(
+        key_bytes.ctypes.data_as(ctypes.c_void_p),
+        key_offsets.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n), ctypes.c_int32(num_partitions),
+        parts.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int32(min(8, os.cpu_count() or 1)))
+    return parts
+
+
+def sort_partition_keys_native(key_bytes: np.ndarray,
+                               key_offsets: np.ndarray,
+                               partitions: Optional[np.ndarray]
+                               ) -> Optional[np.ndarray]:
+    """Stable sort permutation by (partition, full key bytes) — parallel
+    native merge sort over row indices, GIL released for the whole call.
+    None when the native lib is unavailable."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tz_sort_partition_keys"):
+        return None
+    n = len(key_offsets) - 1
+    key_bytes = np.ascontiguousarray(key_bytes)
+    key_offsets = np.ascontiguousarray(key_offsets.astype(np.int64))
+    parts_ptr = None
+    if partitions is not None:
+        partitions = np.ascontiguousarray(partitions.astype(np.int32))
+        parts_ptr = partitions.ctypes.data_as(ctypes.c_void_p)
+    perm = np.empty(n, dtype=np.int64)
+    lib.tz_sort_partition_keys(
+        key_bytes.ctypes.data_as(ctypes.c_void_p),
+        key_offsets.ctypes.data_as(ctypes.c_void_p),
+        parts_ptr, ctypes.c_int64(n),
+        perm.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int32(min(8, os.cpu_count() or 1)))
+    return perm
 
 
 def adjacent_equal_native(data: np.ndarray, offsets: np.ndarray,

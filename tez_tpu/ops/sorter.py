@@ -378,6 +378,11 @@ class DeviceSorter:
                 batch, self.key_normalizer)
         else:
             sort_bytes, sort_offsets = batch.key_bytes, batch.key_offsets
+        if engine == "host":
+            run = self._native_host_sort(batch, sort_bytes, sort_offsets,
+                                         custom_partitions, t0)
+            if run is not None:
+                return run
         mat, lengths = pad_to_matrix(sort_bytes, sort_offsets, self.key_width)
         lanes = matrix_to_lanes(mat)
         if custom_partitions is not None:
@@ -426,6 +431,45 @@ class DeviceSorter:
             keyfn)
         if refinement is not None:
             sorted_batch = sorted_batch.take(refinement)
+        self.counters.find_counter(TaskCounter.DEVICE_SORT_MILLIS)\
+            .increment(int((time.time() - t0) * 1000))
+        return Run.from_sorted_batch(sorted_batch, sorted_partitions,
+                                     self.num_partitions)
+
+    def _native_host_sort(self, batch: KVBatch, sort_bytes: np.ndarray,
+                          sort_offsets: np.ndarray,
+                          custom_parts: Optional[np.ndarray],
+                          t0: float) -> Optional[Run]:
+        """C-speed host span sort: threaded FNV partition + stable parallel
+        index sort over the ragged sort keys (full-key compares — no padded
+        matrix, no tie-break pass), GIL released so concurrent tasks
+        overlap.  None when the native lib is unavailable (numpy lexsort
+        path takes over)."""
+        from tez_tpu.ops.native import (fnv32_partition_native,
+                                        sort_partition_keys_native)
+        parts: Optional[np.ndarray]
+        if custom_parts is not None:
+            # same guard as the numpy path — a short array would read past
+            # the buffer inside the C comparator, not raise
+            assert len(custom_parts) == batch.num_records, \
+                "custom partitions must cover every record in the span"
+            parts = custom_parts
+        elif self.partitioner == "hash" and self.num_partitions > 1:
+            parts = fnv32_partition_native(batch.key_bytes,
+                                           batch.key_offsets,
+                                           self.num_partitions)
+            if parts is None:
+                return None
+        else:
+            parts = None    # everything lands in partition 0
+        perm = sort_partition_keys_native(sort_bytes, sort_offsets, parts)
+        if perm is None:
+            return None
+        sorted_batch = batch.take(perm)
+        if parts is None:
+            sorted_partitions = np.zeros(batch.num_records, dtype=np.int32)
+        else:
+            sorted_partitions = parts[perm]
         self.counters.find_counter(TaskCounter.DEVICE_SORT_MILLIS)\
             .increment(int((time.time() - t0) * 1000))
         return Run.from_sorted_batch(sorted_batch, sorted_partitions,
@@ -652,6 +696,22 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
         sort_bytes, sort_offsets = normalize_batch_keys(batch, key_normalizer)
     else:
         sort_bytes, sort_offsets = batch.key_bytes, batch.key_offsets
+    if engine == "host":
+        # native index sort: full-key compares, run-order ties (= MergeQueue
+        # age order via the concat index), GIL released
+        from tez_tpu.ops.native import sort_partition_keys_native
+        perm_n = sort_partition_keys_native(
+            sort_bytes, sort_offsets,
+            partitions if num_partitions > 1 else None)
+        if perm_n is not None:
+            sorted_batch = batch.take(perm_n)
+            sorted_partitions = partitions[perm_n]
+            if counters is not None:
+                counters.find_counter(TaskCounter.DEVICE_MERGE_MILLIS)\
+                    .increment(int((time.time() - t0) * 1000))
+                counters.increment(TaskCounter.MERGED_MAP_OUTPUTS, len(runs))
+            return Run.from_sorted_batch(sorted_batch, sorted_partitions,
+                                         num_partitions)
     mat, lengths = pad_to_matrix(sort_bytes, sort_offsets, key_width)
     lanes = matrix_to_lanes(mat)
     if engine == "host":

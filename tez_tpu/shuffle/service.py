@@ -38,6 +38,9 @@ class ShuffleService:
         in-RAM registry."""
         self._store = store
 
+    def has_store(self) -> bool:
+        return self._store is not None
+
     # -- producer side -------------------------------------------------------
     def register(self, path_component: str, spill_id: int, run: Run) -> None:
         with self._lock:
@@ -95,6 +98,19 @@ class ShuffleService:
         except FileNotFoundError:
             raise ShuffleDataNotFound(
                 f"{path_component}/{spill_id}") from None
+
+    def local_file_source(self, path_component: str, spill_id: int,
+                          partition: int) -> Optional[Tuple[str, int]]:
+        """Disk-direct short-circuit (LocalDiskFetchedInput analog): when
+        the registered run is disk-backed (FileRun), return its (path,
+        partition_nbytes) so a same-host consumer can merge straight off
+        the producer's partition-indexed file — no materialization, no
+        re-spill.  None when the run is RAM-resident or unknown."""
+        with self._lock:
+            run = self._runs.get((path_component, spill_id))
+        if run is None or not hasattr(run, "iter_partition_blocks"):
+            return None
+        return run.path, run.partition_nbytes(partition)
 
     def partition_size(self, path_component: str, spill_id: int,
                        partition: int) -> int:

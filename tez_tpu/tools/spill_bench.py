@@ -74,7 +74,7 @@ def verify_output(out_dir: str, golden_counts: np.ndarray) -> int:
 
 
 def run(target_mb: int, vocab: int, sort_mb: int, engine: str,
-        parallelism: int) -> dict:
+        parallelism: int, pipelined: bool = False) -> dict:
     from tez_tpu.client.tez_client import TezClient
     from tez_tpu.examples import ordered_wordcount
     td = tempfile.mkdtemp(prefix="tez_spill_")
@@ -87,6 +87,10 @@ def run(target_mb: int, vocab: int, sort_mb: int, engine: str,
                 "tez.runtime.sorter.class": engine,
                 "tez.runtime.io.sort.mb": sort_mb,
                 "tez.runtime.tpu.host.spill.dir": os.path.join(td, "spill")}
+        if pipelined:
+            # one event per spilled span, NO producer final merge
+            # (reference: tez.runtime.pipelined-shuffle.enabled)
+            conf["tez.runtime.pipelined-shuffle.enabled"] = True
         out_dir = os.path.join(td, "out")
         t0 = time.time()
         with TezClient.create("spill-bench", conf) as client:
@@ -124,7 +128,8 @@ def run(target_mb: int, vocab: int, sort_mb: int, engine: str,
         return {
             "metric": (f"OrderedWordCount spill-scale E2E ({target_mb} MB "
                        f"input, vocab {vocab}, io.sort.mb={sort_mb}, "
-                       f"combine OFF, engine={engine}->{resolved} on "
+                       f"combine OFF, {'pipelined, ' if pipelined else ''}"
+                       f"engine={engine}->{resolved} on "
                        f"jax backend={backend}, output verified "
                        f"vs streamed host golden)"),
             "engine_requested": engine,
@@ -152,10 +157,13 @@ def main() -> int:
                          "kernels when an accelerator backend answers, "
                          "host kernels on the CPU fallback)")
     ap.add_argument("--parallelism", type=int, default=4)
+    ap.add_argument("--pipelined", action="store_true",
+                    help="one event per spilled span; no producer final "
+                         "merge (tez.runtime.pipelined-shuffle.enabled)")
     ap.add_argument("--out", default="")
     args = ap.parse_args()
     rec = run(args.mb, args.vocab_size, args.sort_mb, args.engine,
-              args.parallelism)
+              args.parallelism, pipelined=args.pipelined)
     line = json.dumps(rec)
     print(line, flush=True)
     if args.out:

@@ -82,20 +82,29 @@ class VectorTokenProcessor(SimpleProcessor):
                                            pre_combined=True))
                 return
 
+        from tez_tpu.ops.native import split_ws_native
         for chunk in reader.iter_chunks():
-            data = np.frombuffer(chunk, dtype=np.uint8)
-            # full bytes.split() whitespace set: space \t \n \v \f \r
-            ws = (data == 32) | ((data >= 9) & (data <= 13))
-            sel = ~ws
-            if not sel.any():
-                continue
-            key_bytes = data[sel].copy()
-            starts_mask = sel & np.concatenate(([True], ws[:-1]))
-            run_id = np.cumsum(starts_mask)[sel]        # 1-based word id
-            lengths = np.bincount(run_id - 1)
-            n = len(lengths)
-            key_offsets = np.zeros(n + 1, np.int64)
-            np.cumsum(lengths, out=key_offsets[1:])
+            native = split_ws_native(bytes(chunk))
+            if native is not None:
+                # one C pass (GIL released): compacted word bytes + offsets
+                key_bytes, key_offsets = native
+                n = len(key_offsets) - 1
+                if n == 0:
+                    continue
+            else:
+                data = np.frombuffer(chunk, dtype=np.uint8)
+                # full bytes.split() whitespace set: space \t \n \v \f \r
+                ws = (data == 32) | ((data >= 9) & (data <= 13))
+                sel = ~ws
+                if not sel.any():
+                    continue
+                key_bytes = data[sel].copy()
+                starts_mask = sel & np.concatenate(([True], ws[:-1]))
+                run_id = np.cumsum(starts_mask)[sel]    # 1-based word id
+                lengths = np.bincount(run_id - 1)
+                n = len(lengths)
+                key_offsets = np.zeros(n + 1, np.int64)
+                np.cumsum(lengths, out=key_offsets[1:])
             val_bytes = np.frombuffer(one * n, dtype=np.uint8).copy()
             val_offsets = np.arange(n + 1, dtype=np.int64) * len(one)
             writer.write_batch(KVBatch(key_bytes, key_offsets,

@@ -324,18 +324,20 @@ extern "C" {
 // Contract: each feed() is whitespace-complete (the text reader yields
 // line-aligned chunks), so tokens never span feed boundaries.
 
+// bytes.split() whitespace set: space \t \n \v \f \r
+static inline bool tz_is_ws(uint8_t c) {
+    return c == 32 || (c >= 9 && c <= 13);
+}
+
 void* tz_wc_create() { return new HashAgg(); }
 
 void tz_wc_feed(void* handle, const uint8_t* data, int64_t n) {
     HashAgg* agg = (HashAgg*)handle;
     int64_t i = 0;
     while (i < n) {
-        // skip whitespace (space \t \n \v \f \r — bytes.split() set)
-        while (i < n && (data[i] == 32 || (data[i] >= 9 && data[i] <= 13)))
-            i++;
+        while (i < n && tz_is_ws(data[i])) i++;
         int64_t start = i;
-        while (i < n && !(data[i] == 32 || (data[i] >= 9 && data[i] <= 13)))
-            i++;
+        while (i < n && !tz_is_ws(data[i])) i++;
         if (i > start) agg->add(data + start, i - start, 1);
     }
 }
@@ -361,6 +363,26 @@ void tz_wc_emit(void* handle, uint8_t* key_bytes, int64_t* key_offsets,
 }
 
 void tz_wc_destroy(void* handle) { delete (HashAgg*)handle; }
+
+// --- raw whitespace split (no combine): one pass, compacted words --------
+// out_bytes: caller-allocated n bytes (worst case: no whitespace);
+// out_offsets: caller-allocated (n+1)/2 + 2 entries.  Returns word count.
+int64_t tz_split_ws(const uint8_t* data, int64_t n, uint8_t* out_bytes,
+                    int64_t* out_offsets) {
+    int64_t words = 0, out = 0, i = 0;
+    out_offsets[0] = 0;
+    while (i < n) {
+        while (i < n && tz_is_ws(data[i])) i++;
+        int64_t start = i;
+        while (i < n && !tz_is_ws(data[i])) i++;
+        if (i > start) {
+            std::memcpy(out_bytes + out, data + start, (size_t)(i - start));
+            out += i - start;
+            out_offsets[++words] = out;
+        }
+    }
+    return words;
+}
 
 // --- generic pre-sort combine: sum int64 values of equal keys -------------
 // first_idx[u] = record index of key u's first occurrence (caller gathers

@@ -114,6 +114,11 @@ def _load() -> "ctypes.CDLL | None":
                     ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
                     ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
                 lib.hash_sum_i64.restype = ctypes.c_int64
+            if hasattr(lib, "tz_split_ws"):
+                lib.tz_split_ws.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                    ctypes.c_void_p]
+                lib.tz_split_ws.restype = ctypes.c_int64
             if hasattr(lib, "tz_sort_partition_keys"):
                 lib.tz_fnv32_partition.argtypes = [
                     ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
@@ -277,6 +282,22 @@ def pipelined_sorter_proxy(keys: np.ndarray, vals: np.ndarray,
         out_vals.ctypes.data_as(ctypes.c_void_p),
         counts.ctypes.data_as(ctypes.c_void_p))
     return float(secs), out_keys, out_vals, counts
+
+
+def split_ws_native(chunk: bytes) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """One-pass whitespace split of a text chunk into compacted ragged
+    (word_bytes, word_offsets); None when the native lib is unavailable."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tz_split_ws"):
+        return None
+    n = len(chunk)
+    out_bytes = np.empty(n, dtype=np.uint8)
+    out_offsets = np.empty((n + 1) // 2 + 2, dtype=np.int64)
+    words = lib.tz_split_ws(chunk, ctypes.c_int64(n),
+                            out_bytes.ctypes.data_as(ctypes.c_void_p),
+                            out_offsets.ctypes.data_as(ctypes.c_void_p))
+    offsets = out_offsets[:words + 1].copy()
+    return out_bytes[:int(offsets[-1])].copy(), offsets
 
 
 def fnv32_partition_native(key_bytes: np.ndarray, key_offsets: np.ndarray,

@@ -223,3 +223,178 @@ def test_kubernetes_driver_gated_loudly():
     from tez_tpu.am.cluster_binding import KubernetesPodDriver
     with pytest.raises(RuntimeError, match="kubernetes"):
         KubernetesPodDriver()
+
+
+class _FakePodApiError(Exception):
+    """ApiException-shaped (the driver's contract is `.status`)."""
+
+    def __init__(self, status):
+        super().__init__(f"fake api error {status}")
+        self.status = status
+
+
+class FakeCoreV1Api:
+    """A CoreV1Api-shaped fake whose 'kubelet' EXECUTES the pod manifest's
+    container command as a local process — the manifest is validated by
+    running it, not by eyeballing.  Records every API call; pod phase
+    follows the real process (Pending->Running->Succeeded/Failed)."""
+
+    def __init__(self):
+        import threading
+        self.calls = []
+        self.manifests = {}
+        self._procs = {}
+        self._lock = threading.Lock()
+
+    def create_namespaced_pod(self, namespace, manifest):
+        import os
+        import subprocess
+        import sys
+        name = manifest["metadata"]["name"]
+        with self._lock:
+            self.calls.append(("create", namespace, name))
+            if name in self._procs:
+                raise _FakePodApiError(409)
+            self.manifests[name] = manifest
+            spec = manifest["spec"]["containers"][0]
+            cmd = list(spec["command"])
+            cmd[0] = sys.executable          # "python" -> this interpreter
+            # the downward-API POD_IP substitution a real kubelet performs
+            cmd = ["127.0.0.1" if a == "$(POD_IP)" else a for a in cmd]
+            env = dict(os.environ)
+            for e in spec.get("env", []):
+                if "value" in e:
+                    env[e["name"]] = e["value"]
+            env["POD_IP"] = "127.0.0.1"
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            env["PYTHONPATH"] = repo_root + os.pathsep + \
+                env.get("PYTHONPATH", "")
+            self._procs[name] = subprocess.Popen(cmd, env=env)
+
+    def read_namespaced_pod(self, name, namespace):
+        import types
+        with self._lock:
+            self.calls.append(("read", namespace, name))
+            proc = self._procs.get(name)
+        if proc is None:
+            raise _FakePodApiError(404)
+        rc = proc.poll()
+        phase = "Running" if rc is None else \
+            ("Succeeded" if rc == 0 else "Failed")
+        return types.SimpleNamespace(
+            status=types.SimpleNamespace(phase=phase))
+
+    def delete_namespaced_pod(self, name, namespace):
+        with self._lock:
+            self.calls.append(("delete", namespace, name))
+            proc = self._procs.pop(name, None)
+        if proc is None:
+            raise _FakePodApiError(404)
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            proc.kill()
+
+
+_FAKE_K8S_API = FakeCoreV1Api()
+
+
+class FakeK8sBackedDriver:
+    """Zero-arg factory for tez.am.pod-pool.driver.class: the REAL
+    KubernetesPodDriver wired to the module's fake API server."""
+
+    def __new__(cls):
+        from tez_tpu.am.cluster_binding import KubernetesPodDriver
+        return KubernetesPodDriver(namespace="tez-test",
+                                   image="tez-tpu-runner:test",
+                                   core_api=_FAKE_K8S_API)
+
+
+def test_kubernetes_driver_two_pod_dag(tmp_staging, tmp_path):
+    """VERDICT r3 item 6: the REAL KubernetesPodDriver (manifest build,
+    create/read/delete API protocol, phase handling) drives a 2-pod DAG
+    end to end against a fake API whose kubelet runs the manifests."""
+    import collections
+    import os
+    import random
+    from tez_tpu.examples import ordered_wordcount
+
+    _FAKE_K8S_API.calls.clear()
+    _FAKE_K8S_API.manifests.clear()
+    corpus = tmp_path / "in.txt"
+    rng = random.Random(11)
+    golden = collections.Counter()
+    with open(corpus, "w") as fh:
+        for _ in range(2500):
+            w = f"w{rng.randint(0, 150):03d}"
+            golden[w] += 1
+            fh.write(w + " ")
+    out = str(tmp_path / "out")
+    conf = {"tez.staging-dir": tmp_staging,
+            "tez.runner.mode": "pods",
+            "tez.am.pod-pool.driver.class":
+                "test_service_plugins:FakeK8sBackedDriver",
+            "tez.am.pod-pool.max-pods": 2,
+            "tez.am.local.num-containers": 2,
+            "tez.am.runner.env": {"JAX_PLATFORMS": "cpu"}}
+    with TezClient.create("k8spool", conf) as c:
+        dag = ordered_wordcount.build_dag(
+            [str(corpus)], out, tokenizer_parallelism=2,
+            summation_parallelism=2, sorter_parallelism=1)
+        status = c.submit_dag(dag).wait_for_completion(timeout=120)
+        assert status.state is DAGStatusState.SUCCEEDED
+        from tez_tpu.am.cluster_binding import KubernetesPodDriver
+        assert isinstance(c.framework_client.am.runner_pool.driver,
+                          KubernetesPodDriver)
+    rows = {}
+    for f in sorted(os.listdir(out)):
+        if f.startswith("part-"):
+            for line in open(os.path.join(out, f), "rb"):
+                w, cnt = line.rstrip(b"\n").split(b"\t")
+                rows[w.decode()] = int(cnt)
+    assert rows == dict(golden)
+    # the driver spoke the full API protocol to the fake server
+    kinds = [k for k, *_ in _FAKE_K8S_API.calls]
+    assert kinds.count("create") == 2
+    assert "read" in kinds and "delete" in kinds
+    assert all(ns == "tez-test" for _, ns, _ in _FAKE_K8S_API.calls)
+    # manifests carried the deployment contract the driver promises
+    for m in _FAKE_K8S_API.manifests.values():
+        spec = m["spec"]["containers"][0]
+        assert spec["image"] == "tez-tpu-runner:test"
+        assert "--node-id" in spec["command"]
+        env_names = {e["name"] for e in spec["env"]}
+        assert "TEZ_TPU_JOB_TOKEN" in env_names and "POD_IP" in env_names
+
+
+def test_kubernetes_driver_poll_phases_and_404(tmp_path):
+    """Phase mapping + 404-reap + transient-fault tolerance of poll()."""
+    import types
+    from tez_tpu.am.cluster_binding import KubernetesPodDriver
+
+    class _Api:
+        def __init__(self):
+            self.phase = "Pending"
+            self.fail = None
+
+        def read_namespaced_pod(self, name, ns):
+            if self.fail is not None:
+                raise self.fail
+            return types.SimpleNamespace(
+                status=types.SimpleNamespace(phase=self.phase))
+
+    api = _Api()
+    d = KubernetesPodDriver(core_api=api)
+    assert d.poll("p") is None            # Pending: still coming up
+    api.phase = "Running"
+    assert d.poll("p") is None
+    api.phase = "Succeeded"
+    assert d.poll("p") == 0
+    api.phase = "Failed"
+    assert d.poll("p") == 1
+    api.fail = _FakePodApiError(404)      # evicted outside the pool
+    assert d.poll("p") == 1
+    api.fail = _FakePodApiError(500)      # transient API fault: keep pod
+    assert d.poll("p") is None

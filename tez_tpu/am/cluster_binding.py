@@ -94,24 +94,31 @@ class KubernetesPodDriver(PodDriver):
 
     def __init__(self, namespace: str = "default",
                  image: str = "tez-tpu-runner:latest",
-                 pod_template: Optional[Dict[str, Any]] = None):
-        try:
-            import kubernetes  # noqa: F401
-        except ImportError:
-            raise RuntimeError(
-                "KubernetesPodDriver needs the `kubernetes` client, which "
-                "is not installed in this environment; use the "
-                "ProcessPodDriver (process-per-host) or install the client "
-                "in your deployment image") from None
-        from kubernetes import client, config
-        try:
-            config.load_incluster_config()
-        except Exception:  # noqa: BLE001
-            config.load_kube_config()
-        self._core = client.CoreV1Api()
+                 pod_template: Optional[Dict[str, Any]] = None,
+                 core_api: Optional[Any] = None):
+        """`core_api` injects a CoreV1Api-shaped client (tests drive a fake
+        API server through the full manifest/launch/poll/stop protocol);
+        None = the real kubernetes client + in-cluster/kubeconfig creds."""
+        if core_api is None:
+            try:
+                import kubernetes  # noqa: F401
+            except ImportError:
+                raise RuntimeError(
+                    "KubernetesPodDriver needs the `kubernetes` client, "
+                    "which is not installed in this environment; use the "
+                    "ProcessPodDriver (process-per-host) or install the "
+                    "client in your deployment image") from None
+            from kubernetes import client, config
+            try:
+                config.load_incluster_config()
+            except Exception:  # noqa: BLE001
+                config.load_kube_config()
+            core_api = client.CoreV1Api()
+        self._core = core_api
         self.namespace = namespace
         self.image = image
         self.pod_template = pod_template or {}
+        self._poll_faults: Dict[Any, int] = {}
 
     def _pod_manifest(self, pod_name: str, node_id: str,
                       env: Dict[str, str], am_host: str, am_port: int,
@@ -156,15 +163,29 @@ class KubernetesPodDriver(PodDriver):
                                idle_timeout))
         return pod_name
 
+    #: consecutive poll failures per pod before the fault stops being
+    #: treated as transient and surfaces (dead creds / broken API server
+    #: must not leave a phantom fleet "running" forever)
+    POLL_FAULT_LIMIT = 20
+
     def poll(self, handle: Any) -> Optional[int]:
-        from kubernetes.client.rest import ApiException
         try:
             pod = self._core.read_namespaced_pod(handle, self.namespace)
-        except ApiException as e:
-            if e.status == 404:
+        except Exception as e:  # noqa: BLE001 — ApiException-shaped (any
+            # client impl raises its own type; the contract is `.status`)
+            if getattr(e, "status", None) == 404:
                 return 1   # deleted/evicted outside the pool: reap it
-            log.warning("pod %s poll failed (%s); keeping it", handle, e)
+            faults = self._poll_faults.get(handle, 0) + 1
+            self._poll_faults[handle] = faults
+            if faults >= self.POLL_FAULT_LIMIT:
+                raise RuntimeError(
+                    f"pod {handle}: {faults} consecutive poll failures "
+                    f"(last: {e}); the Kubernetes API is not answering — "
+                    f"check credentials/connectivity") from e
+            log.warning("pod %s poll failed (%s); keeping it (%d/%d)",
+                        handle, e, faults, self.POLL_FAULT_LIMIT)
             return None    # transient API fault must not kill the fleet
+        self._poll_faults.pop(handle, None)
         phase = pod.status.phase
         if phase in ("Succeeded", "Failed"):
             return 0 if phase == "Succeeded" else 1

@@ -308,6 +308,12 @@ TPU_MESH_MAX_VALUE_BYTES = _key(
     "tez.runtime.tpu.mesh.max.value.bytes", 1024, Scope.VERTEX,
     "hard cap on value bytes the mesh exchange carries; bigger records -> "
     "host shuffle edge")
+TPU_MESH_EXCHANGE_DEADLINE_SECS = _key(
+    "tez.runtime.tpu.mesh.exchange.deadline.secs", 0.0, Scope.VERTEX,
+    "straggler defense on the mesh gang barrier: consumers waiting longer "
+    "than this for the edge's producers fail the edge actionably (naming "
+    "the missing producer task indices) instead of stalling forever; "
+    "0 = wait indefinitely (AM task-level failure detection still applies)")
 TPU_RESIDENT_KEYS = _key(
     "tez.runtime.tpu.resident.keys", True, Scope.VERTEX,
     "keep sorted key lanes in HBM for downstream device merges "

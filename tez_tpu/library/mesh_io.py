@@ -160,6 +160,9 @@ class MeshOrderedGroupedKVInput(LogicalInput):
         self._batch: Optional[KVBatch] = None
         self._reading = False
         self._group_starts = None
+        # straggler defense on the gang barrier (0 = wait forever)
+        self._deadline = float(_conf_get(
+            ctx, "tez.runtime.tpu.mesh.exchange.deadline.secs", 0.0)) or None
         ctx.request_initial_memory(0, None,
                                    component_type="SORTED_MERGED_INPUT")
         return []
@@ -199,11 +202,27 @@ class MeshOrderedGroupedKVInput(LogicalInput):
                                 "event %r", ev)
             self._lock.notify_all()
 
-    def _wait_complete(self) -> None:
+    def _wait_complete(self) -> Optional[float]:
+        """Returns the REMAINING deadline budget (None = unbounded) so the
+        coordinator barrier wait consumes the same window, not a fresh
+        one — the configured deadline bounds the whole stall."""
+        import time
+        deadline = None if self._deadline is None \
+            else time.monotonic() + self._deadline
         with self._lock:
             while len(self._complete) < self.num_physical_inputs:
                 if self._failed:
                     raise RuntimeError(self._failed)
+                if deadline is not None and time.monotonic() > deadline:
+                    missing = sorted(set(range(self.num_physical_inputs)) -
+                                     self._complete)
+                    raise TimeoutError(
+                        f"mesh edge into {self.context.vertex_name}: "
+                        f"{len(self._complete)}/{self.num_physical_inputs} "
+                        f"producers completed within "
+                        f"{self._deadline:.0f}s; missing producer task "
+                        f"indices {missing[:16]}"
+                        f"{'...' if len(missing) > 16 else ''}")
                 self._lock.wait(0.2)
                 self.context.notify_progress()
             if self._failed:
@@ -213,6 +232,9 @@ class MeshOrderedGroupedKVInput(LogicalInput):
             # no window where a failure lands between this check and the
             # batch read/assignment in get_reader
             self._reading = True
+        if deadline is None:
+            return None
+        return max(0.5, deadline - time.monotonic())
 
     def get_reader(self) -> GroupedKVReader:
         with self._lock:
@@ -222,7 +244,7 @@ class MeshOrderedGroupedKVInput(LogicalInput):
             import time
             ctx = self.context
             t0 = time.time()
-            self._wait_complete()
+            remaining = self._wait_complete()
             from tez_tpu.parallel.coordinator import mesh_coordinator
             edge = _edge_id(ctx.task_attempt_id.dag_id,
                             ctx.source_vertex_name, ctx.vertex_name)
@@ -230,6 +252,7 @@ class MeshOrderedGroupedKVInput(LogicalInput):
                 edge, ctx.task_index,
                 num_producers=self.num_physical_inputs,
                 num_consumers=ctx.vertex_parallelism,
+                timeout=remaining,
                 progress=ctx.notify_progress)
             with self._lock:
                 if self._failed:

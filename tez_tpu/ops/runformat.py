@@ -285,15 +285,53 @@ class Run:
         return self.batch.nbytes
 
     # -- host-spill serialization (checksummed; IFileOutputStream analog) ----
+    # Offset arrays (key_offsets / val_offsets) are DELTA-CODED on the
+    # wire: per-record LENGTHS in the narrowest unsigned dtype that fits
+    # (u8/u16/u32; i64 raw offsets beyond that).  For small-record spills
+    # this is the difference between 16 B and 2 B of index per record —
+    # on-disk size was otherwise ~2x the KV payload.  Wire dtype chars
+    # '1'/'2'/'4' mark delta-u8/u16/u32; everything stays self-describing.
+    _DELTA_CHARS = {b"1": np.uint8, b"2": np.uint16, b"4": np.uint32}
+
+    @staticmethod
+    def _encode_offsets(offsets: np.ndarray) -> Tuple[bytes, np.ndarray]:
+        if len(offsets) and int(offsets[0]) != 0:
+            # delta coding reconstructs from base 0: a rebased view must
+            # ship raw (lossless) rather than silently rebase
+            return offsets.dtype.char.encode(), offsets
+        lens = np.diff(offsets)
+        m = int(lens.max(initial=0))
+        if m < (1 << 8):
+            return b"1", lens.astype(np.uint8)
+        if m < (1 << 16):
+            return b"2", lens.astype(np.uint16)
+        if m < (1 << 32):
+            return b"4", lens.astype(np.uint32)
+        return offsets.dtype.char.encode(), offsets
+
+    @staticmethod
+    def _decode_offsets(char: bytes, raw: np.ndarray) -> np.ndarray:
+        offsets = np.zeros(len(raw) + 1, dtype=np.int64)
+        np.cumsum(raw, out=offsets[1:])
+        return offsets
+
+    def _wire_arrays(self) -> List[Tuple[bytes, np.ndarray]]:
+        kc, ko = self._encode_offsets(self.batch.key_offsets)
+        vc, vo = self._encode_offsets(self.batch.val_offsets)
+        return [(self.batch.key_bytes.dtype.char.encode(),
+                 self.batch.key_bytes),
+                (kc, ko),
+                (self.batch.val_bytes.dtype.char.encode(),
+                 self.batch.val_bytes),
+                (vc, vo),
+                (self.row_index.dtype.char.encode(), self.row_index)]
+
     def to_bytes(self, codec: Optional[str] = None) -> bytes:
         flag, compress, _ = resolve_codec(codec)
         buf = io.BytesIO()
-        arrays = (self.batch.key_bytes, self.batch.key_offsets,
-                  self.batch.val_bytes, self.batch.val_offsets,
-                  self.row_index)
-        for a in arrays:
+        for char, a in self._wire_arrays():
             raw = compress(np.ascontiguousarray(a).tobytes())
-            buf.write(struct.pack("<cQ", a.dtype.char.encode(), len(raw)))
+            buf.write(struct.pack("<cQ", char, len(raw)))
             buf.write(raw)
         payload = buf.getvalue()
         header = MAGIC + struct.pack(
@@ -319,41 +357,36 @@ class Run:
         for _ in range(5):
             dtype_c, length = struct.unpack("<cQ", buf.read(9))
             raw = decompress(buf.read(length))
-            arrays.append(np.frombuffer(raw, dtype=np.dtype(
-                dtype_c.decode())).copy())
+            dt = Run._DELTA_CHARS.get(dtype_c)
+            if dt is not None:
+                arrays.append(Run._decode_offsets(
+                    dtype_c, np.frombuffer(raw, dtype=dt)))
+            else:
+                arrays.append(np.frombuffer(raw, dtype=np.dtype(
+                    dtype_c.decode())).copy())
         kb, ko, vb, vo, ri = arrays
         return Run(KVBatch(kb, ko, vb, vo), ri)
 
-    def _arrays(self) -> Tuple[np.ndarray, ...]:
-        return (self.batch.key_bytes, self.batch.key_offsets,
-                self.batch.val_bytes, self.batch.val_offsets,
-                self.row_index)
-
-    def serialized_size(self) -> int:
-        """Exact on-disk size of the UNCOMPRESSED wire format (codecs make
-        the size data-dependent — use to_bytes and measure)."""
-        return len(MAGIC) + 13 + sum(9 + a.nbytes for a in self._arrays())
-
     def write_to(self, fh, codec: Optional[str] = None) -> int:
         """Stream this run into an open file.  The uncompressed hot path
-        writes each array buffer directly (one checksum pass + one write
-        pass — no BytesIO assembly, no tobytes copies); codecs fall back
-        to the blob builder.  Returns bytes written."""
+        writes each wire array buffer directly (one checksum pass + one
+        write pass — no BytesIO assembly, no tobytes copies); codecs fall
+        back to the blob builder.  Returns bytes written."""
         flag, _compress, _ = resolve_codec(codec)
         if flag != 0:
             blob = self.to_bytes(codec)
             fh.write(blob)
             return len(blob)
-        arrays = [np.ascontiguousarray(a) for a in self._arrays()]
-        headers = [struct.pack("<cQ", a.dtype.char.encode(), a.nbytes)
-                   for a in arrays]
+        pairs = [(c, np.ascontiguousarray(a)) for c, a in
+                 self._wire_arrays()]
+        headers = [struct.pack("<cQ", c, a.nbytes) for c, a in pairs]
         crc = 0
-        for h, a in zip(headers, arrays):
+        for h, (_c, a) in zip(headers, pairs):
             crc = zlib.crc32(h, crc)
             crc = zlib.crc32(memoryview(a).cast("B"), crc)
-        size = sum(len(h) + a.nbytes for h, a in zip(headers, arrays))
+        size = sum(len(h) + a.nbytes for h, (_c, a) in zip(headers, pairs))
         fh.write(MAGIC + struct.pack("<BIQ", 0, crc, size))
-        for h, a in zip(headers, arrays):
+        for h, (_c, a) in zip(headers, pairs):
             fh.write(h)
             fh.write(memoryview(a).cast("B"))
         return len(MAGIC) + 13 + size
@@ -387,11 +420,15 @@ def _write_block(fh, piece: KVBatch, codec: Optional[str]) -> int:
     the blob size (excluding the 8-byte prefix)."""
     run = Run(piece, np.array([0, piece.num_records], dtype=np.int64))
     if codec is None:
-        # streamed write: size is exact upfront, no blob assembly
-        size = run.serialized_size()
+        # streamed write: length backfilled after the streaming pass (the
+        # writers' targets are regular seekable files)
+        at = fh.tell()
+        fh.write(struct.pack("<Q", 0))
+        size = run.write_to(fh)
+        end = fh.tell()
+        fh.seek(at)
         fh.write(struct.pack("<Q", size))
-        written = run.write_to(fh)
-        assert written == size
+        fh.seek(end)
     else:
         blob = run.to_bytes(codec)
         size = len(blob)

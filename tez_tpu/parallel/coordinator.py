@@ -184,6 +184,11 @@ class MeshExchangeCoordinator:
             st.spans[task_index] = (lanes,
                                     klens.astype(np.uint32),
                                     vwords)
+            if isinstance(st.error, TimeoutError):
+                # a straggler poisoned the edge, and here it is: the edge
+                # is viable again — consumer RETRIES must see a fresh
+                # barrier, not the stale poison
+                st.error = None
             if st.results is not None:
                 # a producer RE-RAN after the exchange: invalidate and
                 # re-exchange with the replacement span (consumers that
@@ -225,16 +230,42 @@ class MeshExchangeCoordinator:
                       num_producers: int, num_consumers: int,
                       timeout: Optional[float] = None,
                       progress=None) -> KVBatch:
+        """Block until the edge's exchange lands.  `timeout` is the
+        straggler defense for the gang barrier (VERDICT r3 item 7): a
+        producer that never registers would otherwise stall every consumer
+        forever.  On expiry the whole edge is POISONED (st.error) naming
+        the missing producers, so sibling consumers fail fast instead of
+        each burning its own full deadline — the actionable failure path;
+        the AM's task retry / failure blame takes it from there (reference
+        analog: the fetch penalty box + ShuffleScheduler.java:179
+        too-long-stalled escape)."""
         import time
-        deadline = None if timeout is None else time.time() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self.lock:
             st = self.edges.setdefault(
                 edge_id, _EdgeState(num_producers, num_consumers))
             while st.results is None and st.error is None:
-                if deadline is not None and time.time() > deadline:
-                    raise TimeoutError(
+                # the deadline guards the PRODUCER barrier only: once every
+                # span is in (or an exchange is in flight), a slow exchange
+                # is compute, not a straggler — AM task-level failure
+                # detection owns hung exchanges
+                barrier_open = len(st.spans) < st.num_producers and \
+                    not st.executing
+                if deadline is not None and barrier_open and \
+                        time.monotonic() > deadline:
+                    missing = sorted(set(range(st.num_producers)) -
+                                     set(st.spans))
+                    err = TimeoutError(
                         f"mesh exchange {edge_id}: "
-                        f"{len(st.spans)}/{st.num_producers} producers")
+                        f"{len(st.spans)}/{st.num_producers} producers "
+                        f"after {timeout:.0f}s; missing producer task "
+                        f"indices {missing[:16]}"
+                        f"{'...' if len(missing) > 16 else ''}")
+                    # the edge cannot complete without the missing spans —
+                    # poison it so sibling consumers fail fast
+                    st.error = err
+                    self.lock.notify_all()
+                    raise err
                 self.lock.wait(0.2)
                 if progress is not None:
                     progress()

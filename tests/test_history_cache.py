@@ -48,7 +48,8 @@ def test_cache_invalidates_on_append(history_dir):
     first = cache.get(ids[0])
     # append a new DAG's history into a NEW file in the same dir
     path = os.path.join(history_dir, "extra.jsonl")
-    src = [f for f in os.listdir(history_dir) if f != "extra.jsonl"][0]
+    from tez_tpu.am.history import scan_history_store
+    src = scan_history_store(history_dir)[0]
     import re
     with open(os.path.join(history_dir, src)) as fh:
         body = re.sub(r"dag_(\d)", r"dagX_\1", fh.read())
@@ -98,3 +99,73 @@ def test_cache_evicted_dag_still_readable(tmp_path):
     evicted = ({"dag_0", "dag_1", "dag_2"} - present).pop()
     info = cache.get(evicted)
     assert info is not None and info.state == "SUCCEEDED"
+
+
+def test_store_layout_is_date_partitioned(history_dir):
+    """The docstring's promise is now true: journals land under
+    date=YYYY-MM-DD/app_<id>_<pid>.jsonl (ProtoHistoryLoggingService's
+    date-partitioned layout)."""
+    import os
+    import re
+    entries = sorted(os.listdir(history_dir))
+    assert entries and all(re.fullmatch(r"date=\d{4}-\d{2}-\d{2}", e)
+                           for e in entries), entries
+    files = os.listdir(os.path.join(history_dir, entries[0]))
+    assert files and all(re.fullmatch(r"app_.+_\d+\.jsonl", f)
+                         for f in files), files
+
+
+def _fake_day(tmp_path, day, app, events):
+    import os
+    from tez_tpu.am.history import HistoryEvent, HistoryEventType
+    d = tmp_path / f"date={day}"
+    d.mkdir(parents=True, exist_ok=True)
+    with open(d / f"app_{app}_1.jsonl", "a") as fh:
+        for dag_id, etype, data in events:
+            fh.write(HistoryEvent(HistoryEventType[etype], dag_id=dag_id,
+                                  data=data).to_json() + "\n")
+
+
+def test_manifest_scan_multi_day_and_date_bounds(tmp_path):
+    """scan_history_store walks the partitions (+ legacy flat files) and
+    honors inclusive date bounds."""
+    from tez_tpu.am.history import scan_history_store
+    _fake_day(tmp_path, "2026-07-27", "a1",
+              [("dag_1", "DAG_SUBMITTED", {"dag_name": "d1"})])
+    _fake_day(tmp_path, "2026-07-28", "a2",
+              [("dag_2", "DAG_SUBMITTED", {"dag_name": "d2"})])
+    _fake_day(tmp_path, "2026-07-29", "a3",
+              [("dag_3", "DAG_SUBMITTED", {"dag_name": "d3"})])
+    (tmp_path / "legacy.jsonl").write_text("")
+    got = scan_history_store(str(tmp_path))
+    assert len(got) == 4 and got[-1].endswith("legacy.jsonl")
+    got = scan_history_store(str(tmp_path), date_from="2026-07-28")
+    assert [p for p in got if "date=" in p] == \
+        [p for p in got] and len(got) == 2
+    got = scan_history_store(str(tmp_path), date_from="2026-07-28",
+                             date_to="2026-07-28")
+    assert len(got) == 1 and "date=2026-07-28" in got[0]
+
+
+def test_cache_and_parser_over_multi_day_store(tmp_path):
+    """DagInfoCache + history parser read a store whose DAGs span several
+    date partitions (a DAG finishing after midnight has events in two)."""
+    from tez_tpu.tools.history_parser import parse_jsonl_files
+    _fake_day(tmp_path, "2026-07-28", "am1", [
+        ("dag_x", "DAG_SUBMITTED", {"dag_name": "overnight"}),
+        ("dag_x", "DAG_STARTED", {}),
+    ])
+    _fake_day(tmp_path, "2026-07-29", "am1", [
+        ("dag_x", "DAG_FINISHED", {"state": "SUCCEEDED"}),
+        ("dag_y", "DAG_SUBMITTED", {"dag_name": "fresh"}),
+        ("dag_y", "DAG_FINISHED", {"state": "FAILED"}),
+    ])
+    cache = DagInfoCache(str(tmp_path))
+    ids = set(cache.dag_ids())
+    assert ids == {"dag_x", "dag_y"}
+    assert cache.get("dag_x").state == "SUCCEEDED"
+    assert cache.get("dag_y").state == "FAILED"
+    # the parser CLI path: a bare directory argument manifest-scans it
+    dags = parse_jsonl_files([str(tmp_path)])
+    assert set(dags) == {"dag_x", "dag_y"}
+    assert dags["dag_x"].name == "overnight"

@@ -263,3 +263,95 @@ def test_mr_chain_dag_three_stages(tmp_path):
                 got[k] = int(v.strip())
     # a: apple*2 + ant = 150, b: bee + bear = 100, c: cat = 50
     assert got == {"a": 150, "b": 100, "c": 50}
+
+
+def ident_map(offset, line):
+    for w in line.split():
+        yield w, b"1"
+
+
+def test_mr_job_conf_translation_e2e(tmp_path):
+    """VERDICT r3 item 8: a conf-DEFINED job (Hadoop mapreduce.* keys,
+    Writable class names) translates through mr_job_to_dag and runs E2E —
+    the YARNRunner seam."""
+    from tez_tpu.io.mapreduce import mr_job_to_dag
+    corpus = tmp_path / "in.txt"
+    corpus.write_text("x y z x y x\n" * 100)
+    out = str(tmp_path / "out")
+    conf = {
+        "mapreduce.job.name": "conf-wc",
+        "mapreduce.job.map.class":
+            "tests.test_mapreduce_compat:wc_map_long",
+        "mapreduce.job.reduce.class":
+            "tests.test_mapreduce_compat:wc_reduce",
+        "mapreduce.job.maps": 2,
+        "mapreduce.job.reduces": 2,
+        "mapreduce.input.fileinputformat.inputdir": str(corpus),
+        "mapreduce.output.fileoutputformat.outputdir": out,
+        "mapreduce.job.inputformat.class":
+            "org.apache.hadoop.mapreduce.lib.input.TextInputFormat",
+        "mapreduce.map.output.key.class": "org.apache.hadoop.io.Text",
+        "mapreduce.map.output.value.class":
+            "org.apache.hadoop.io.BytesWritable",
+        "mapreduce.job.output.key.class": "org.apache.hadoop.io.Text",
+        "mapreduce.job.output.value.class": "org.apache.hadoop.io.Text",
+    }
+    dag = mr_job_to_dag(conf)
+    assert dag.name == "conf-wc"
+    with TezClient.create("mrconf", {"tez.staging-dir":
+                                     str(tmp_path / "s")}) as c:
+        status = c.submit_dag(dag).wait_for_completion(timeout=60)
+    assert status.state is DAGStatusState.SUCCEEDED
+    got = {}
+    for f in os.listdir(out):
+        if f.startswith("part-"):
+            for line in open(os.path.join(out, f)):
+                k, v = line.split("\t")
+                got[k] = int(v)
+    assert got == {"x": 300, "y": 200, "z": 100}
+
+
+def test_mr_job_conf_legacy_aliases_and_map_only(tmp_path):
+    """mapred.* legacy keys work (new keys win on conflict); reduces=0
+    builds the map-only DAG committing straight to the sink."""
+    from tez_tpu.io.mapreduce import mr_job_to_dag
+    corpus = tmp_path / "in.txt"
+    corpus.write_text("a b\nc d\n")
+    out = str(tmp_path / "out")
+    conf = {
+        "mapred.job.name": "legacy-ignored",
+        "mapreduce.job.name": "maponly",       # new key wins
+        "mapred.mapper.class": "tests.test_mapreduce_compat:ident_map",
+        "mapred.reduce.tasks": 0,
+        "mapred.input.dir": str(corpus),
+        "mapred.output.dir": out,
+        "mapred.output.key.class": "org.apache.hadoop.io.Text",
+        "mapred.output.value.class": "org.apache.hadoop.io.Text",
+    }
+    dag = mr_job_to_dag(conf)
+    assert dag.name == "maponly"
+    assert len(dag.vertices) == 1            # truly map-only
+    with TezClient.create("mrlegacy", {"tez.staging-dir":
+                                       str(tmp_path / "s")}) as c:
+        status = c.submit_dag(dag).wait_for_completion(timeout=60)
+    assert status.state is DAGStatusState.SUCCEEDED
+    words = []
+    for f in os.listdir(out):
+        if f.startswith("part-"):
+            for line in open(os.path.join(out, f)):
+                words.append(line.split("\t")[0])
+    assert sorted(words) == ["a", "b", "c", "d"]
+
+
+def test_mr_job_conf_validation():
+    from tez_tpu.io.mapreduce import mr_job_to_dag
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="no mapper"):
+        mr_job_to_dag({"mapreduce.job.reduces": 1})
+    with _pytest.raises(ValueError, match="input dir"):
+        mr_job_to_dag({"mapreduce.job.map.class": "m:f"})
+    with _pytest.raises(ValueError, match="no reducer"):
+        mr_job_to_dag({"mapreduce.job.map.class": "m:f",
+                       "mapreduce.input.fileinputformat.inputdir": "/x",
+                       "mapreduce.output.fileoutputformat.outputdir": "/y",
+                       "mapreduce.job.reduces": 2})

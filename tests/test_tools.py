@@ -28,7 +28,7 @@ def history_dir(tmp_path_factory):
 
 
 def test_parse_history(history_dir):
-    dags = parse_jsonl_files([os.path.join(history_dir, "*.jsonl")])
+    dags = parse_jsonl_files([history_dir])
     assert len(dags) == 1
     dag = list(dags.values())[0]
     assert dag.name == "OrderedWordCount"
@@ -45,7 +45,7 @@ def test_parse_history(history_dir):
 
 
 def test_analyzers_produce_results(history_dir):
-    dags = parse_jsonl_files([os.path.join(history_dir, "*.jsonl")])
+    dags = parse_jsonl_files([history_dir])
     dag = list(dags.values())[0]
     results = analyze_dag(dag)
     assert len(results) == len(ALL_ANALYZERS)
@@ -121,7 +121,7 @@ def test_one_on_one_edge_analyzer(tmp_path):
         assert st.state.name == "SUCCEEDED"
     finally:
         c.stop()
-    dags = parse_jsonl_files([os.path.join(hist, "*.jsonl")])
+    dags = parse_jsonl_files([hist])
     dag_info = list(dags.values())[0]
     assert dag_info.edges and dag_info.edges[0]["movement"] == "ONE_TO_ONE"
     res = OneOnOneEdgeAnalyzer().analyze(dag_info)
@@ -129,7 +129,7 @@ def test_one_on_one_edge_analyzer(tmp_path):
 
 
 def test_swimlane_svg(history_dir):
-    dags = parse_jsonl_files([os.path.join(history_dir, "*.jsonl")])
+    dags = parse_jsonl_files([history_dir])
     dag = list(dags.values())[0]
     svg = render_svg(dag)
     assert svg.startswith("<svg") and svg.endswith("</svg>")
@@ -141,7 +141,7 @@ def test_analyzer_cli(history_dir, capsys):
     from tez_tpu.tools import analyzers
     old = sys.argv
     try:
-        sys.argv = ["analyzers", os.path.join(history_dir, "*.jsonl")]
+        sys.argv = ["analyzers", history_dir]
         assert analyzers.main() == 0
     finally:
         sys.argv = old
@@ -254,7 +254,8 @@ def test_counter_diff_cli(history_dir, capsys):
     import sys
     from tez_tpu.tools import counter_diff
     import glob as g
-    f = sorted(g.glob(os.path.join(history_dir, "*.jsonl")))[0]
+    from tez_tpu.am.history import scan_history_store
+    f = scan_history_store(history_dir)[0]
     old = sys.argv
     try:
         sys.argv = ["counter_diff", f, f]

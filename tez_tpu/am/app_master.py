@@ -82,7 +82,8 @@ class DAGAppMaster:
                 self.runner_pool = create_pod_pool(self, num_slots)
         else:
             self.runner_pool = RunnerPool(self, num_slots)
-        logging_service = HistoryEventHandler.create_logging_service(conf)
+        logging_service = HistoryEventHandler.create_logging_service(
+            conf, app_id=app_id)
         from tez_tpu.am.recovery import RecoveryService
         recovery_enabled = conf.get(C.DAG_RECOVERY_ENABLED)
         self.recovery_service = RecoveryService(self, attempt) \

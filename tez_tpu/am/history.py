@@ -116,25 +116,49 @@ class InMemoryHistoryLoggingService(HistoryLoggingService):
 
 
 class JsonlHistoryLoggingService(HistoryLoggingService):
-    """Date/app-partitioned JSONL files — the ProtoHistoryLoggingService
-    analog; also what the history parser/analyzers read."""
+    """Date/app-partitioned JSONL store — the ProtoHistoryLoggingService
+    analog (reference: ProtoHistoryLoggingService.java:47 writing
+    date-partitioned proto files scanned by a manifest reader).
 
-    def __init__(self, conf: Any = None, log_dir: str = ""):
+    Layout: `<log-dir>/date=YYYY-MM-DD/app_<app_id>_<pid>.jsonl`.  The
+    writer rolls to a new partition when the (UTC) date changes mid-run;
+    readers discover journals with `scan_history_store` (optionally
+    bounded to a date range, so a long-lived store never needs a full
+    directory walk)."""
+
+    def __init__(self, conf: Any = None, log_dir: str = "",
+                 app_id: str = ""):
         if not log_dir and conf is not None:
             log_dir = conf.get("tez.history.logging.log-dir") or ""
         self.log_dir = log_dir or "/tmp/tez-tpu-history"
+        self.app_id = app_id or \
+            ((conf.get("tez.app.id") if conf else "") or
+             f"app_{int(time.time())}")
         self._fh = None
+        self._date: Optional[str] = None
         self._lock = threading.Lock()
 
-    def start(self) -> None:
-        os.makedirs(self.log_dir, exist_ok=True)
+    def _roll(self) -> None:
+        """Under lock: open (or roll to) today's partition file."""
+        today = time.strftime("%Y-%m-%d", time.gmtime())
+        if self._fh is not None and self._date == today:
+            return
+        if self._fh is not None:
+            self._fh.flush()
+            self._fh.close()
+        part = os.path.join(self.log_dir, f"date={today}")
+        os.makedirs(part, exist_ok=True)
         self._fh = open(os.path.join(
-            self.log_dir, f"history_{int(time.time())}_{os.getpid()}.jsonl"), "a")
+            part, f"app_{self.app_id}_{os.getpid()}.jsonl"), "a")
+        self._date = today
+
+    def start(self) -> None:
+        with self._lock:
+            self._roll()
 
     def handle(self, event: HistoryEvent) -> None:
         with self._lock:
-            if self._fh is None:
-                self.start()
+            self._roll()
             self._fh.write(event.to_json() + "\n")
             if event.is_summary:
                 self._fh.flush()
@@ -146,6 +170,35 @@ class JsonlHistoryLoggingService(HistoryLoggingService):
                 self._fh.flush()
                 self._fh.close()
                 self._fh = None
+                self._date = None
+
+
+def scan_history_store(log_dir: str, date_from: Optional[str] = None,
+                       date_to: Optional[str] = None) -> List[str]:
+    """Manifest scan of a history store: every journal file under the
+    date-partitioned layout (`date=YYYY-MM-DD/*.jsonl`), optionally
+    bounded to [date_from, date_to] (inclusive, `YYYY-MM-DD` strings —
+    lexicographic compare IS date order).  Flat legacy `*.jsonl` files
+    directly under log_dir are included unless a date bound excludes the
+    unknown-date files explicitly (they carry no partition)."""
+    if not os.path.isdir(log_dir):
+        return []
+    out: List[str] = []
+    bounded = date_from is not None or date_to is not None
+    for name in sorted(os.listdir(log_dir)):
+        path = os.path.join(log_dir, name)
+        if name.startswith("date=") and os.path.isdir(path):
+            day = name[len("date="):]
+            if date_from is not None and day < date_from:
+                continue
+            if date_to is not None and day > date_to:
+                continue
+            out.extend(sorted(
+                os.path.join(path, f) for f in os.listdir(path)
+                if f.endswith(".jsonl")))
+        elif name.endswith(".jsonl") and not bounded:
+            out.append(path)   # legacy flat layout
+    return out
 
 
 class DevNullHistoryLoggingService(HistoryLoggingService):
@@ -173,8 +226,13 @@ class HistoryEventHandler:
         self.logging_service.handle(event)
 
     @staticmethod
-    def create_logging_service(conf: Any) -> HistoryLoggingService:
+    def create_logging_service(conf: Any,
+                               app_id: str = "") -> HistoryLoggingService:
         cls_name = conf.get("tez.history.logging.service.class") if conf else None
         if not cls_name:
             return InMemoryHistoryLoggingService()
-        return resolve_class(cls_name)(conf)
+        cls = resolve_class(cls_name)
+        try:
+            return cls(conf, app_id=app_id)
+        except TypeError:
+            return cls(conf)   # custom services with a conf-only signature

@@ -52,6 +52,7 @@ class Dispatcher:
         self._drained = threading.Condition()
         self._in_flight = 0
         self._delivered = 0   # monotonically counts handled events
+        self.peak_in_flight = 0   # high-water queue depth (storm metric)
         self.on_error: Callable[[BaseException, Event], None] | None = None
 
     # -- registration -------------------------------------------------------
@@ -66,6 +67,8 @@ class Dispatcher:
     def dispatch(self, event: Event) -> None:
         with self._drained:
             self._in_flight += 1
+            if self._in_flight > self.peak_in_flight:
+                self.peak_in_flight = self._in_flight
         self._queue.put(event)
 
     @property
@@ -200,6 +203,10 @@ class ShardedDispatcher(Dispatcher):
             self.on_error(exc, event)
         else:
             raise exc
+
+    def peak_depths(self) -> "list[int]":
+        """Per-shard high-water queue depths (storm diagnostics)."""
+        return [s.peak_in_flight for s in self._shards]
 
     def _shard_key(self, event: Event) -> int:
         for attr in ("attempt_id", "task_id", "vertex_id", "dag_id"):

@@ -111,6 +111,148 @@ def simple_mr_dag(name: str, input_paths, output_path: str,
                         multi_input=multi_input)
 
 
+def _map_vertex(map_fn: str, input_paths, num_mappers: int,
+                input_format: str, format_params: Optional[dict],
+                multi_input: bool) -> Vertex:
+    """The map vertex + its MRInput data source (shared by the conf
+    translation and the programmatic builders)."""
+    input_cls = "tez_tpu.io.formats:MultiMRInput" if multi_input \
+        else "tez_tpu.io.formats:MRInput"
+    mapper = Vertex.create("map", ProcessorDescriptor.create(
+        MapProcessor, payload={"map_fn": map_fn}), num_mappers)
+    mapper.add_data_source("input", DataSourceDescriptor.create(
+        InputDescriptor.create(input_cls,
+                               payload={"format": input_format,
+                                        "format_params": format_params}),
+        InputInitializerDescriptor.create(
+            "tez_tpu.io.formats:MRSplitGenerator",
+            payload={"paths": list(input_paths),
+                     "desired_splits": num_mappers,
+                     "format": input_format,
+                     "format_params": format_params})))
+    return mapper
+
+
+def _file_sink(output_path: str, key_serde: str,
+               value_serde: str) -> DataSinkDescriptor:
+    return DataSinkDescriptor.create(
+        OutputDescriptor.create(
+            "tez_tpu.io.file_output:FileOutput",
+            payload={"path": output_path, "key_serde": key_serde,
+                     "value_serde": value_serde}),
+        OutputCommitterDescriptor.create(
+            "tez_tpu.io.file_output:FileOutputCommitter",
+            payload={"path": output_path}))
+
+
+#: Hadoop Writable / format class names -> native serde / format names.
+#: Native names pass through, so a conf can mix both vocabularies.
+_WRITABLE_TO_SERDE = {
+    "org.apache.hadoop.io.Text": "text",
+    "org.apache.hadoop.io.LongWritable": "long",
+    "org.apache.hadoop.io.IntWritable": "long",
+    "org.apache.hadoop.io.BytesWritable": "bytes",
+    "org.apache.hadoop.io.NullWritable": "bytes",
+}
+_FORMAT_TO_NATIVE = {
+    "org.apache.hadoop.mapreduce.lib.input.TextInputFormat": "text",
+    "org.apache.hadoop.mapred.TextInputFormat": "text",
+    "org.apache.hadoop.mapreduce.lib.input.KeyValueTextInputFormat": "text",
+}
+
+
+def _job_conf(conf: dict, new_key: str, old_key: str, default=None):
+    """mapreduce.* wins over the legacy mapred.* alias (YARNRunner's
+    dual-vocabulary conf handling)."""
+    if new_key in conf:
+        return conf[new_key]
+    if old_key in conf:
+        return conf[old_key]
+    return default
+
+
+def _serde_for(cls_name: Optional[str], default: str = "bytes") -> str:
+    if not cls_name:
+        return default
+    return _WRITABLE_TO_SERDE.get(cls_name, cls_name)
+
+
+def mr_job_to_dag(job_conf: dict) -> DAG:
+    """Translate an MR JOB CONF into a DAG — the YARNRunner seam
+    (reference: tez-mapreduce/.../client/YARNRunner.java translating a
+    submitted MR job's Configuration into a 2-vertex Tez DAG;
+    MRRuntimeProtos.proto carries the conf to the runtime).
+
+    Honored keys (mapreduce.* with legacy mapred.* aliases):
+      job name        mapreduce.job.name            / mapred.job.name
+      mapper          mapreduce.job.map.class       / mapred.mapper.class
+      reducer         mapreduce.job.reduce.class    / mapred.reducer.class
+      combiner        mapreduce.job.combine.class   / mapred.combiner.class
+      map count hint  mapreduce.job.maps            / mapred.map.tasks
+      reduce count    mapreduce.job.reduces         / mapred.reduce.tasks
+      input paths     mapreduce.input.fileinputformat.inputdir
+                                                    / mapred.input.dir
+      output path     mapreduce.output.fileoutputformat.outputdir
+                                                    / mapred.output.dir
+      input format    mapreduce.job.inputformat.class
+                                                    / mapred.input.format.class
+      map out K/V     mapreduce.map.output.key.class / .value.class
+      job out K/V     mapreduce.job.output.key.class / .value.class
+
+    Mapper/reducer/combiner classes are "module:callable" paths (the
+    Python analog of class names); Hadoop Writable and TextInputFormat
+    class names map onto native serdes/formats, and native names pass
+    through.  mapreduce.job.reduces=0 builds the map-only DAG (mapper
+    commits straight to the output), exactly like the reference."""
+    g = lambda nk, ok, d=None: _job_conf(job_conf, nk, ok, d)  # noqa: E731
+    name = g("mapreduce.job.name", "mapred.job.name", "mr-job")
+    map_fn = g("mapreduce.job.map.class", "mapred.mapper.class")
+    if not map_fn:
+        raise ValueError(
+            "job conf has no mapper (mapreduce.job.map.class)")
+    reduce_fn = g("mapreduce.job.reduce.class", "mapred.reducer.class")
+    combiner = g("mapreduce.job.combine.class", "mapred.combiner.class", "")
+    num_maps = int(g("mapreduce.job.maps", "mapred.map.tasks", -1))
+    num_reduces = int(g("mapreduce.job.reduces", "mapred.reduce.tasks", 1))
+    inputs = g("mapreduce.input.fileinputformat.inputdir",
+               "mapred.input.dir")
+    output = g("mapreduce.output.fileoutputformat.outputdir",
+               "mapred.output.dir")
+    if not inputs or not output:
+        raise ValueError("job conf needs input dir(s) and an output dir")
+    input_paths = [p.strip() for p in str(inputs).split(",") if p.strip()]
+    in_fmt = g("mapreduce.job.inputformat.class",
+               "mapred.input.format.class", "text")
+    in_fmt = _FORMAT_TO_NATIVE.get(in_fmt, in_fmt)
+    out_k = _serde_for(g("mapreduce.job.output.key.class",
+                         "mapred.output.key.class"))
+    out_v = _serde_for(g("mapreduce.job.output.value.class",
+                         "mapred.output.value.class"))
+    # Hadoop semantics: map-output classes DEFAULT to the job output
+    # classes when unset (JobConf.getMapOutputKeyClass)
+    mid_k = _serde_for(g("mapreduce.map.output.key.class",
+                         "mapred.mapoutput.key.class"), default=out_k)
+    mid_v = _serde_for(g("mapreduce.map.output.value.class",
+                         "mapred.mapoutput.value.class"), default=out_v)
+
+    if num_reduces <= 0:
+        # map-only job: the mapper commits straight to the sink
+        mapper = _map_vertex(map_fn, input_paths, num_maps, in_fmt, None,
+                             multi_input=False)
+        mapper.add_data_sink("output", _file_sink(output, out_k, out_v))
+        return DAG.create(name).add_vertex(mapper)
+
+    if not reduce_fn:
+        raise ValueError(
+            f"job conf sets {num_reduces} reduces but no reducer class")
+    return simple_mr_dag(
+        name, input_paths, output, map_fn, reduce_fn,
+        num_mappers=num_maps, num_reducers=num_reduces,
+        key_serde=out_k, value_serde=out_v,
+        intermediate_serdes=(mid_k, mid_v),
+        combiner=combiner, input_format=in_fmt)
+
+
 def mr_chain_dag(name: str, input_paths, output_path: str,
                  map_fn: str, reduce_fns, num_mappers: int = -1,
                  num_reducers=2,
@@ -144,20 +286,8 @@ def mr_chain_dag(name: str, input_paths, output_path: str,
     if len(stage_serdes) != n_stages:
         raise ValueError(f"stage_serdes: want {n_stages} entries")
 
-    input_cls = "tez_tpu.io.formats:MultiMRInput" if multi_input \
-        else "tez_tpu.io.formats:MRInput"
-    mapper = Vertex.create("map", ProcessorDescriptor.create(
-        MapProcessor, payload={"map_fn": map_fn}), num_mappers)
-    mapper.add_data_source("input", DataSourceDescriptor.create(
-        InputDescriptor.create(input_cls,
-                               payload={"format": input_format,
-                                        "format_params": format_params}),
-        InputInitializerDescriptor.create(
-            "tez_tpu.io.formats:MRSplitGenerator",
-            payload={"paths": list(input_paths),
-                     "desired_splits": num_mappers,
-                     "format": input_format,
-                     "format_params": format_params})))
+    mapper = _map_vertex(map_fn, input_paths, num_mappers, input_format,
+                         format_params, multi_input)
     dag = DAG.create(name).add_vertex(mapper)
     upstream = mapper
     for i, (fn, par, serdes) in enumerate(
@@ -168,15 +298,8 @@ def mr_chain_dag(name: str, input_paths, output_path: str,
             ProcessorDescriptor.create(ReduceProcessor,
                                        payload={"reduce_fn": fn}), par)
         if last:
-            reducer.add_data_sink("output", DataSinkDescriptor.create(
-                OutputDescriptor.create(
-                    "tez_tpu.io.file_output:FileOutput",
-                    payload={"path": output_path,
-                             "key_serde": key_serde,
-                             "value_serde": value_serde}),
-                OutputCommitterDescriptor.create(
-                    "tez_tpu.io.file_output:FileOutputCommitter",
-                    payload={"path": output_path})))
+            reducer.add_data_sink("output", _file_sink(
+                output_path, key_serde, value_serde))
         builder = OrderedPartitionedKVEdgeConfig.new_builder(*serdes)
         if combiner and i == 0:
             builder.set_combiner(combiner)   # map-side combine only

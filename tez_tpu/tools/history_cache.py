@@ -42,11 +42,10 @@ class DagInfoCache:
 
     # -- store scanning -----------------------------------------------------
     def _scan(self) -> List[str]:
-        if not os.path.isdir(self.log_dir):
-            return []
-        return sorted(os.path.join(self.log_dir, f)
-                      for f in os.listdir(self.log_dir)
-                      if f.endswith(".jsonl"))
+        """Manifest scan over the date-partitioned store (flat legacy
+        files included)."""
+        from tez_tpu.am.history import scan_history_store
+        return scan_history_store(self.log_dir)
 
     def _changed_files(self) -> List[str]:
         """Changed paths with their NEW fingerprints — which are committed

@@ -203,9 +203,10 @@ def parse_jsonl_files(paths: List[str]) -> Dict[str, DagInfo]:
             c in pattern for c in "*?[") else [pattern]
         for path in matches:
             if os.path.isdir(path):
-                matches.extend(sorted(
-                    os.path.join(path, f) for f in os.listdir(path)
-                    if f.endswith(".jsonl")))
+                # a directory is a history STORE: manifest-scan it
+                # (date=YYYY-MM-DD partitions + flat legacy files)
+                from tez_tpu.am.history import scan_history_store
+                matches.extend(scan_history_store(path))
                 continue
             if not os.path.exists(path):
                 print(f"warning: no such history file: {path}",

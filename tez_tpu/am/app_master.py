@@ -68,9 +68,11 @@ class DAGAppMaster:
         runner_mode = conf.get(C.RUNNER_MODE)
         if runner_mode in ("subprocess", "pods"):
             from tez_tpu.am.umbilical_server import UmbilicalServer
+            from tez_tpu.common.tls import server_context
             self.umbilical_server = UmbilicalServer(
                 self.task_comm, self.secrets,
-                host=conf.get(C.UMBILICAL_BIND_HOST))
+                host=conf.get(C.UMBILICAL_BIND_HOST),
+                ssl_context=server_context(conf))
             if runner_mode == "subprocess":
                 from tez_tpu.am.launcher import SubprocessRunnerPool
                 self.runner_pool = SubprocessRunnerPool(self, num_slots)

@@ -70,8 +70,12 @@ class _Handler(socketserver.StreamRequestHandler):
 
 class DAGClientServer:
     def __init__(self, am: Any, secrets: JobTokenSecretManager,
-                 host: str = "127.0.0.1", port: int = 0):
-        self._tcp = socketserver.ThreadingTCPServer((host, port), _Handler)
+                 host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
+        from tez_tpu.common.tls import wrap_server_class
+        server_cls = wrap_server_class(socketserver.ThreadingTCPServer,
+                                       ssl_context)
+        self._tcp = server_cls((host, port), _Handler)
         self._tcp.daemon_threads = True
         self._tcp.am = am                # type: ignore[attr-defined]
         self._tcp.secrets = secrets      # type: ignore[attr-defined]
@@ -161,8 +165,10 @@ def main() -> int:
     })
     am = DAGAppMaster(new_app_id(), conf)
     am.start()
+    from tez_tpu.common.tls import server_context
     server = DAGClientServer(am, am.secrets, host=args.bind_host,
-                             port=args.port).start()
+                             port=args.port,
+                             ssl_context=server_context(conf)).start()
     hb_timeout = float(conf.get(C.AM_CLIENT_HEARTBEAT_TIMEOUT_SECS))
     if hb_timeout > 0:
         server.start_session_expiry(hb_timeout)

@@ -221,6 +221,8 @@ class PodPoolRunnerPool:
         for k, v in (self.ctx.conf.get("tez.am.runner.env") or {}).items():
             env[k] = str(v)
         env["TEZ_TPU_JOB_TOKEN"] = self.ctx.secrets.secret.hex()
+        from tez_tpu.common.tls import export_env
+        env.update(export_env(self.ctx.conf))
         return env
 
     def ensure_runners(self, backlog: int) -> None:

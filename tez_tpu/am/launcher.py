@@ -154,6 +154,8 @@ class SubprocessRunnerPool:
                     else:
                         env[k] = str(v)
                 env["TEZ_TPU_JOB_TOKEN"] = self.ctx.secrets.secret.hex()
+                from tez_tpu.common.tls import export_env
+                env.update(export_env(self.ctx.conf))
                 repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
                     os.path.abspath(__file__))))
                 existing = env.get("PYTHONPATH", "")

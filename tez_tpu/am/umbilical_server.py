@@ -123,10 +123,14 @@ class UmbilicalServer:
     """Serves the AM's TaskCommunicatorManager to remote runners."""
 
     def __init__(self, task_comm: Any, secrets: JobTokenSecretManager,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
         # host "0.0.0.0" for multi-host deployments
         # (conf: tez.am.umbilical.bind-host)
-        self._tcp = socketserver.ThreadingTCPServer((host, port), _Handler)
+        from tez_tpu.common.tls import wrap_server_class
+        server_cls = wrap_server_class(socketserver.ThreadingTCPServer,
+                                       ssl_context)
+        self._tcp = server_cls((host, port), _Handler)
         self._tcp.daemon_threads = True
         self._tcp.task_comm = task_comm     # type: ignore[attr-defined]
         self._tcp.secrets = secrets         # type: ignore[attr-defined]
@@ -153,8 +157,16 @@ class FramedClient:
     _purpose = b"override-me"
 
     def __init__(self, host: str, port: int,
-                 secrets: JobTokenSecretManager, timeout: float = 60.0):
+                 secrets: JobTokenSecretManager, timeout: float = 60.0,
+                 ssl_context=None):
         self._sock = socket.create_connection((host, port), timeout=timeout)
+        if ssl_context is None:
+            # runners launched before any conf arrives read TEZ_TPU_SSL_*
+            # from their launch env (common/tls.py export_env)
+            from tez_tpu.common.tls import client_context
+            ssl_context = client_context(None)
+        if ssl_context is not None:
+            self._sock = ssl_context.wrap_socket(self._sock)
         self._rfile = self._sock.makefile("rb")
         self._wfile = self._sock.makefile("wb")
         self._lock = threading.Lock()

@@ -78,14 +78,18 @@ class RemoteFrameworkClient:
                              "tez.job.token")
         host, _, port = addr.partition(":")
         secrets = JobTokenSecretManager(bytes.fromhex(token))
-        self.am = RemoteAMProxy(host, int(port), secrets)
+        from tez_tpu.common.tls import client_context
+        ssl_ctx = client_context(self.conf)
+        self.am = RemoteAMProxy(host, int(port), secrets,
+                                ssl_context=ssl_ctx)
         # Keepalive on its OWN connection (the main proxy is not safe for
         # interleaved calls): an idle-but-alive client must not trip the
         # AM's session expiry (reference: TezClient.sendAMHeartbeat:568).
         interval = float(self.conf.get(
             "tez.client.am.heartbeat.interval.secs", 5))
         if interval > 0:
-            self._hb_proxy = RemoteAMProxy(host, int(port), secrets)
+            self._hb_proxy = RemoteAMProxy(host, int(port), secrets,
+                                           ssl_context=ssl_ctx)
 
             def _beat() -> None:
                 while not self._hb_stop.wait(interval):

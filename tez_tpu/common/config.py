@@ -308,6 +308,17 @@ TPU_MESH_MAX_VALUE_BYTES = _key(
     "tez.runtime.tpu.mesh.max.value.bytes", 1024, Scope.VERTEX,
     "hard cap on value bytes the mesh exchange carries; bigger records -> "
     "host shuffle edge")
+SHUFFLE_SSL_ENABLE = _key(
+    "tez.runtime.shuffle.ssl.enable", False, Scope.AM,
+    "TLS on every DCN socket (shuffle server/fetcher + AM umbilical); "
+    "PEM paths below; in-channel HMAC auth stays on inside the stream "
+    "(reference: http/SSLFactory.java + TestSecureShuffle)")
+SHUFFLE_SSL_CERT = _key("tez.shuffle.ssl.cert.path", "", Scope.AM,
+                        "PEM certificate presented by every endpoint")
+SHUFFLE_SSL_KEY = _key("tez.shuffle.ssl.key.path", "", Scope.AM,
+                       "PEM private key")
+SHUFFLE_SSL_CA = _key("tez.shuffle.ssl.ca.path", "", Scope.AM,
+                      "CA bundle both sides verify against (mutual TLS)")
 TPU_MESH_EXCHANGE_DEADLINE_SECS = _key(
     "tez.runtime.tpu.mesh.exchange.deadline.secs", 0.0, Scope.VERTEX,
     "straggler defense on the mesh gang barrier: consumers waiting longer "

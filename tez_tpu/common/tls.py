@@ -1,0 +1,126 @@
+"""Optional TLS on the DCN socket paths (shuffle fetch + umbilical).
+
+Reference parity: tez-runtime-library http/SSLFactory.java (keystore/
+truststore SSL factory used by the fetchers) behind the
+`tez.runtime.shuffle.ssl.enable` knob, exercised by
+tez-tests TestSecureShuffle.java:70.  Design differences by intent:
+PEM files instead of JKS keystores (python `ssl`), ONE knob covers every
+DCN socket this framework owns (shuffle server/fetcher AND the AM
+umbilical — the reference leaves the umbilical to Hadoop RPC's own
+security layer, which does not exist here), and the in-channel HMAC
+handshakes stay on — TLS wraps them, it does not replace them.
+
+Config keys (TEZ_TPU_SSL_* env fallback, so a freshly-launched runner
+process can dial the AM umbilical before any conf has arrived):
+
+  tez.runtime.shuffle.ssl.enable   bool          TEZ_TPU_SSL_ENABLE=1
+  tez.shuffle.ssl.cert.path        PEM cert      TEZ_TPU_SSL_CERT
+  tez.shuffle.ssl.key.path         PEM key       TEZ_TPU_SSL_KEY
+  tez.shuffle.ssl.ca.path          CA bundle     TEZ_TPU_SSL_CA
+
+Every endpoint (server or client) presents the cert and verifies its
+peer against the CA — mutual TLS, which is what a shuffle fleet wants
+(any node is both producer and consumer).  Hostname checks are off
+(cluster nodes dial raw IPs); the CA is the trust root.
+"""
+from __future__ import annotations
+
+import os
+import ssl
+from typing import Any, Dict, Optional
+
+#: conf key -> env fallback
+_KEYS = {
+    "enable": ("tez.runtime.shuffle.ssl.enable", "TEZ_TPU_SSL_ENABLE"),
+    "cert": ("tez.shuffle.ssl.cert.path", "TEZ_TPU_SSL_CERT"),
+    "key": ("tez.shuffle.ssl.key.path", "TEZ_TPU_SSL_KEY"),
+    "ca": ("tez.shuffle.ssl.ca.path", "TEZ_TPU_SSL_CA"),
+}
+
+
+def _get(conf: Any, name: str) -> Any:
+    conf_key, env_key = _KEYS[name]
+    v = None
+    if conf is not None:
+        v = conf.get(conf_key)
+    if v in (None, ""):
+        v = os.environ.get(env_key)
+    return v
+
+
+def tls_config(conf: Any = None) -> Optional[Dict[str, str]]:
+    """-> {cert, key, ca} when TLS is enabled, else None.  Loud on a
+    half-configured setup — silently falling back to plaintext would be
+    worse than failing."""
+    enable = _get(conf, "enable")
+    if not enable or str(enable).lower() in ("0", "false", ""):
+        return None
+    cfg = {name: _get(conf, name) for name in ("cert", "key", "ca")}
+    missing = [n for n, v in cfg.items() if not v]
+    if missing:
+        raise ValueError(
+            f"shuffle TLS is enabled but {missing} not configured "
+            f"(tez.shuffle.ssl.*.path / TEZ_TPU_SSL_*)")
+    for n, path in cfg.items():
+        if not os.path.exists(path):
+            raise ValueError(f"shuffle TLS {n} file not found: {path}")
+    return cfg
+
+
+def _context(purpose: ssl.Purpose, cfg: Dict[str, str]) -> ssl.SSLContext:
+    ctx = ssl.create_default_context(purpose, cafile=cfg["ca"])
+    ctx.load_cert_chain(cfg["cert"], cfg["key"])
+    ctx.check_hostname = False          # cluster peers dial raw IPs
+    ctx.verify_mode = ssl.CERT_REQUIRED  # mutual: both sides verify
+    return ctx
+
+
+def server_context(conf: Any = None) -> Optional[ssl.SSLContext]:
+    cfg = tls_config(conf)
+    return None if cfg is None else _context(ssl.Purpose.CLIENT_AUTH, cfg)
+
+
+def client_context(conf: Any = None) -> Optional[ssl.SSLContext]:
+    cfg = tls_config(conf)
+    return None if cfg is None else _context(ssl.Purpose.SERVER_AUTH, cfg)
+
+
+def wrap_server_class(server_cls, ssl_context):
+    """TCP-server class whose accepted sockets are TLS-terminated (the
+    in-channel HMAC handshakes then run inside the encrypted stream);
+    passthrough when ssl_context is None.
+
+    The handshake is DEFERRED (do_handshake_on_connect=False): get_request
+    runs on the single accept thread, and a stalled or plaintext peer must
+    never block accepts for everyone — the handshake happens on the first
+    read inside the per-connection handler thread."""
+    if ssl_context is None:
+        return server_cls
+
+    class _TLSServer(server_cls):
+        def get_request(self):
+            sock, addr = server_cls.get_request(self)
+            return ssl_context.wrap_socket(
+                sock, server_side=True,
+                do_handshake_on_connect=False), addr
+
+    return _TLSServer
+
+
+def resolve_conf(getter) -> Dict[str, Any]:
+    """Build a TLS conf dict through a caller-supplied `getter(conf_key)`
+    (e.g. a runtime context whose config merges edge payloads) — keeps the
+    key vocabulary in this module."""
+    return {ck: getter(ck) for ck, _env in _KEYS.values()}
+
+
+def export_env(conf: Any) -> Dict[str, str]:
+    """Env block that carries the TLS config into launched runner
+    processes (subprocess/pod launchers merge this into the runner env)."""
+    cfg = tls_config(conf)
+    if cfg is None:
+        return {}
+    return {"TEZ_TPU_SSL_ENABLE": "1",
+            "TEZ_TPU_SSL_CERT": cfg["cert"],
+            "TEZ_TPU_SSL_KEY": cfg["key"],
+            "TEZ_TPU_SSL_CA": cfg["ca"]}

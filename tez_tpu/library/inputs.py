@@ -99,7 +99,12 @@ class ShuffleFetchTable:
             if session_cls:
                 factory = resolve_class(session_cls)
             else:
-                factory = lambda h, p: TcpFetchSession(self._secret, h, p)  # noqa: E731
+                from tez_tpu.common.tls import (client_context,
+                                                resolve_conf)
+                ssl_ctx = client_context(resolve_conf(
+                    lambda k: _conf_get(ctx, k, None)))
+                factory = lambda h, p: TcpFetchSession(  # noqa: E731
+                    self._secret, h, p, ssl_context=ssl_ctx)
             self._scheduler = FetchScheduler(
                 deliver=self._remote_done,
                 session_factory=factory,

@@ -39,8 +39,19 @@ def run_loop(am_host: str, am_port: int, node_id: str, token_hex: str,
     # advertisement requires a non-loopback bind (both server flavors)
     bind_host = "127.0.0.1" if advertise_host in ("127.0.0.1", "localhost") \
         else "0.0.0.0"
+    from tez_tpu.common.tls import server_context
+    shuffle_ssl = server_context(None)   # TEZ_TPU_SSL_* from the launch env
     native_dir = os.environ.get("TEZ_TPU_NATIVE_SHUFFLE_DIR", "")
     shuffle_server = None
+    if native_dir and shuffle_ssl is not None:
+        # the C++ sendfile server has no TLS; silently serving plaintext
+        # when the operator asked for encrypted shuffle would be a
+        # downgrade attack on ourselves — refuse loudly, use the TLS
+        # Python server
+        log.warning("TEZ_TPU_NATIVE_SHUFFLE_DIR ignored: shuffle TLS is "
+                    "enabled and the native server speaks plaintext; "
+                    "serving via the Python TLS server instead")
+        native_dir = ""
     if native_dir:
         # native sendfile data server (ShuffleHandler analog): registered
         # runs are write-through serialized to disk; remote fetches never
@@ -61,7 +72,8 @@ def run_loop(am_host: str, am_port: int, node_id: str, token_hex: str,
             shuffle_server = None
     if shuffle_server is None:
         shuffle_server = ShuffleServer(secrets, local_shuffle_service(),
-                                       host=bind_host).start()
+                                       host=bind_host,
+                                       ssl_context=shuffle_ssl).start()
     if not container_id:
         container_id = str(ContainerId(f"app_proc_{node_id}", os.getpid()))
     registry = ObjectRegistry()

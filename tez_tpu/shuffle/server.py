@@ -106,11 +106,17 @@ class ShuffleServer:
 
     def __init__(self, secrets: JobTokenSecretManager,
                  service: Optional[ShuffleService] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
         self.secrets = secrets
         self.service = service or local_shuffle_service()
-        self._tcp = socketserver.ThreadingTCPServer(
-            (host, port), _Handler, bind_and_activate=True)
+        # TLS termination at accept (SSLFactory analog): the HMAC
+        # handshake below runs INSIDE the encrypted channel
+        from tez_tpu.common.tls import wrap_server_class
+        server_cls = wrap_server_class(socketserver.ThreadingTCPServer,
+                                       ssl_context)
+        self._tcp = server_cls((host, port), _Handler,
+                               bind_and_activate=True)
         self._tcp.daemon_threads = True
         # handler back-references
         self._tcp.secrets = secrets          # type: ignore[attr-defined]
@@ -152,11 +158,13 @@ class FetchSession:
     the session."""
 
     def __init__(self, secrets: JobTokenSecretManager, host: str, port: int,
-                 connect_timeout: float = 5.0):
+                 connect_timeout: float = 5.0, ssl_context=None):
         self.secrets = secrets
         self.host, self.port = host, port
         self._sk = socket.create_connection((host, port),
                                             timeout=connect_timeout)
+        if ssl_context is not None:
+            self._sk = ssl_context.wrap_socket(self._sk)
         self._fh = self._sk.makefile("rb")
         self._nonce = self._fh.read(16)
         if len(self._nonce) != 16:
@@ -200,13 +208,15 @@ class ShuffleFetcher:
     InputReadErrorEvent path) and ConnectionError after retries."""
 
     def __init__(self, secrets: JobTokenSecretManager, retries: int = 3,
-                 backoff: float = 0.2, connect_timeout: float = 5.0):
+                 backoff: float = 0.2, connect_timeout: float = 5.0,
+                 ssl_context=None):
         self.secrets = secrets
         # clamp here: retry_call's retries<1 ValueError would otherwise be
         # misread by fetch() as a retryable fetch fault
         self.retries = max(1, retries)
         self.backoff = backoff
         self.connect_timeout = connect_timeout
+        self.ssl_context = ssl_context
 
     def fetch(self, host: str, port: int, path: str, spill: int,
               partition_lo: int, partition_hi: int = -1) -> List[KVBatch]:
@@ -215,7 +225,8 @@ class ShuffleFetcher:
 
         def one_try() -> List[KVBatch]:
             session = FetchSession(self.secrets, host, port,
-                                   self.connect_timeout)
+                                   self.connect_timeout,
+                                   ssl_context=self.ssl_context)
             try:
                 return session.fetch_range(path, spill, partition_lo,
                                            partition_hi)

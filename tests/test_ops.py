@@ -674,3 +674,28 @@ def test_pre_combined_span_skips_hash_combine():
     snap = counters.to_dict()
     assert sum(g.get("COMBINE_INPUT_RECORDS", 0)
                for g in snap.values()) == 0
+
+
+def test_owc_reference_proxy_matches_golden():
+    """The C++ OrderedWordCount reference-semantics proxy (the external
+    E2E baseline, BASELINE.md protocol) produces the exact word->count
+    map, count-sorted output."""
+    import collections
+    from tez_tpu.ops.native import owc_proxy
+    text = (b"tick tock tick boom tick tock\n" * 3000 +
+            b"quux tock\n" * 1500)
+    res = owc_proxy(text, 4, 4)
+    if res is None:
+        import pytest as _pytest
+        _pytest.skip("native lib unavailable")
+    secs, out = res
+    golden = collections.Counter(text.split())
+    got = {}
+    prev = -1
+    for line in out.decode().splitlines():
+        w, c = line.rsplit("\t", 1)
+        got[w.encode()] = int(c)
+        assert int(c) >= prev
+        prev = int(c)
+    assert got == dict(golden)
+    assert secs > 0

@@ -25,6 +25,7 @@
 #include <algorithm>
 #include <chrono>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <queue>
 #include <vector>
@@ -145,6 +146,190 @@ double pipelined_sorter_proxy(const uint8_t* key_bytes, int64_t key_len,
         out_counts[c] = part_rows;
     }
 
+    auto t1 = std::chrono::steady_clock::now();
+    return std::chrono::duration<double>(t1 - t0).count();
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Full OrderedWordCount E2E proxy (reference semantics end to end).
+//
+// tez-examples OrderedWordCount.java:56 — Tokenizer -> Summation -> Sorter
+// over two ordered scatter-gather edges.  The reference machinery this
+// reimplements faithfully: per-producer span sort with a sum combiner on
+// the sorted stream (PipelinedSorter + combiner), per-consumer segment
+// heap merge with grouped summation (TezMerger + ReduceProcessor), a
+// second sorted edge keyed on the count, and the final single-task merge
+// writing "word\tcount\n" lines.  C++ vs the reference's Java keeps this a
+// CONSERVATIVE baseline; producers run sequentially (single host core =
+// equal total work framing, same as the kernel proxy above).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+inline bool ws(uint8_t c) { return c == 32 || (c >= 9 && c <= 13); }
+
+struct WordEntry {
+    const uint8_t* w;
+    int32_t len;
+    int64_t cnt;
+};
+
+inline int word_cmp(const WordEntry& a, const WordEntry& b) {
+    int64_t m = a.len < b.len ? a.len : b.len;
+    int c = std::memcmp(a.w, b.w, (size_t)m);
+    if (c != 0) return c;
+    return a.len < b.len ? -1 : (a.len > b.len ? 1 : 0);
+}
+
+}  // namespace
+
+extern "C" {
+
+// text/n: the corpus.  M producers (tokenizer tasks), P partitions
+// (summation tasks); the sorter stage is one task (the example's shape).
+// out/out_cap receive the final "word\tcount\n" lines; *out_len gets the
+// byte count.  Returns wall-seconds for everything past argument setup,
+// or -1.0 when out_cap is too small.
+double owc_proxy(const uint8_t* text, int64_t n, int32_t num_producers,
+                 int32_t num_partitions, uint8_t* out, int64_t out_cap,
+                 int64_t* out_len) {
+    auto t0 = std::chrono::steady_clock::now();
+    int M = num_producers, P = num_partitions;
+
+    // --- split generation: whitespace-aligned slices (MRInput splits) ---
+    std::vector<int64_t> sb(M + 1, 0);
+    sb[M] = n;
+    for (int i = 1; i < M; i++) {
+        int64_t b = n * i / M;
+        while (b < n && !ws(text[b])) b++;
+        sb[i] = b;
+    }
+    std::sort(sb.begin(), sb.end());
+
+    // --- tokenizer tasks: tokenize, partition, span sort, combine ---
+    std::vector<std::vector<WordEntry>> prod(M);
+    std::vector<std::vector<int64_t>> pbounds(M);
+    for (int p = 0; p < M; p++) {
+        std::vector<WordEntry> words;
+        std::vector<int32_t> parts;
+        for (int64_t i = sb[p]; i < sb[p + 1];) {
+            while (i < sb[p + 1] && ws(text[i])) i++;
+            int64_t s = i;
+            while (i < sb[p + 1] && !ws(text[i])) i++;
+            if (i > s) {
+                words.push_back({text + s, (int32_t)(i - s), 1});
+                parts.push_back((int32_t)(
+                    fnv1a32(text + s, i - s) % (uint32_t)P));
+            }
+        }
+        std::vector<int64_t> order(words.size());
+        for (size_t i = 0; i < order.size(); i++) order[i] = (int64_t)i;
+        std::sort(order.begin(), order.end(),
+                  [&](int64_t a, int64_t b) {
+                      if (parts[a] != parts[b]) return parts[a] < parts[b];
+                      int c = word_cmp(words[a], words[b]);
+                      if (c != 0) return c < 0;
+                      return a < b;
+                  });
+        // combiner on the sorted span stream (PipelinedSorter + combine)
+        auto& entries = prod[p];
+        auto& bounds = pbounds[p];
+        bounds.assign(P + 1, 0);
+        int32_t prev_part = -1;
+        for (size_t i = 0; i < order.size(); i++) {
+            const WordEntry& we = words[order[i]];
+            int32_t c = parts[order[i]];
+            if (c != prev_part || entries.empty() ||
+                word_cmp(entries.back(), we) != 0) {
+                while (prev_part < c) bounds[++prev_part] =
+                    (int64_t)entries.size();
+                entries.push_back(we);
+            } else {
+                entries.back().cnt++;
+            }
+        }
+        while (prev_part < P) bounds[++prev_part] = (int64_t)entries.size();
+    }
+
+    // --- summation tasks: segment heap merge + grouped sum ------------
+    struct SegItem { const WordEntry* e; int32_t producer; int64_t pos; };
+    std::vector<std::vector<WordEntry>> summed(P);
+    for (int32_t c = 0; c < P; c++) {
+        auto cmp = [](const SegItem& a, const SegItem& b) {
+            int r = word_cmp(*a.e, *b.e);
+            if (r != 0) return r > 0;
+            return a.producer > b.producer;
+        };
+        std::priority_queue<SegItem, std::vector<SegItem>, decltype(cmp)>
+            heap(cmp);
+        for (int p = 0; p < M; p++) {
+            if (pbounds[p][c] < pbounds[p][c + 1]) {
+                heap.push({&prod[p][pbounds[p][c]], p, pbounds[p][c]});
+            }
+        }
+        auto& outp = summed[c];
+        while (!heap.empty()) {
+            SegItem it = heap.top();
+            heap.pop();
+            if (!outp.empty() && word_cmp(outp.back(), *it.e) == 0) {
+                outp.back().cnt += it.e->cnt;
+            } else {
+                outp.push_back(*it.e);
+            }
+            int64_t next = it.pos + 1;
+            if (next < pbounds[it.producer][c + 1]) {
+                heap.push({&prod[it.producer][next], it.producer, next});
+            }
+        }
+    }
+
+    // --- second sorted edge: key = count; single sorter task ----------
+    std::vector<std::vector<int64_t>> order2(P);
+    for (int32_t c = 0; c < P; c++) {
+        order2[c].resize(summed[c].size());
+        for (size_t i = 0; i < order2[c].size(); i++)
+            order2[c][i] = (int64_t)i;
+        auto& seg = summed[c];
+        std::sort(order2[c].begin(), order2[c].end(),
+                  [&](int64_t a, int64_t b) {
+                      if (seg[a].cnt != seg[b].cnt)
+                          return seg[a].cnt < seg[b].cnt;
+                      return a < b;   // stable (arrival order)
+                  });
+    }
+    struct CntItem { int64_t cnt; int32_t producer; int64_t pos; };
+    auto cmp2 = [](const CntItem& a, const CntItem& b) {
+        if (a.cnt != b.cnt) return a.cnt > b.cnt;   // min-heap on count
+        return a.producer > b.producer;
+    };
+    std::priority_queue<CntItem, std::vector<CntItem>, decltype(cmp2)>
+        heap2(cmp2);
+    for (int32_t c = 0; c < P; c++) {
+        if (!order2[c].empty())
+            heap2.push({summed[c][order2[c][0]].cnt, c, 0});
+    }
+    int64_t pos_out = 0;
+    while (!heap2.empty()) {
+        CntItem it = heap2.top();
+        heap2.pop();
+        const WordEntry& e = summed[it.producer][order2[it.producer][it.pos]];
+        char tail[32];
+        int tn = std::snprintf(tail, sizeof(tail), "\t%lld\n",
+                               (long long)e.cnt);
+        if (pos_out + e.len + tn > out_cap) return -1.0;
+        std::memcpy(out + pos_out, e.w, (size_t)e.len);
+        pos_out += e.len;
+        std::memcpy(out + pos_out, tail, (size_t)tn);
+        pos_out += tn;
+        int64_t next = it.pos + 1;
+        if (next < (int64_t)order2[it.producer].size()) {
+            heap2.push({summed[it.producer][order2[it.producer][next]].cnt,
+                        it.producer, next});
+        }
+    }
+    *out_len = pos_out;
     auto t1 = std::chrono::steady_clock::now();
     return std::chrono::duration<double>(t1 - t0).count();
 }

@@ -135,6 +135,12 @@ def _load() -> "ctypes.CDLL | None":
                     ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
                     ctypes.c_void_p]
                 lib.pipelined_sorter_proxy.restype = ctypes.c_double
+            if hasattr(lib, "owc_proxy"):
+                lib.owc_proxy.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                    ctypes.c_int32, ctypes.c_void_p, ctypes.c_int64,
+                    ctypes.c_void_p]
+                lib.owc_proxy.restype = ctypes.c_double
             _lib = lib
             log.info("native host ops loaded from %s", so_path)
         except Exception as e:  # noqa: BLE001 — toolchain may be absent
@@ -346,6 +352,35 @@ def sort_partition_keys_native(key_bytes: np.ndarray,
         perm.ctypes.data_as(ctypes.c_void_p),
         ctypes.c_int32(min(8, os.cpu_count() or 1)))
     return perm
+
+
+def owc_proxy(text: bytes, num_producers: int, num_partitions: int
+              ) -> "Optional[Tuple[float, bytes]]":
+    """Run the full-OrderedWordCount reference-semantics C++ proxy
+    (native/baseline_proxy.cpp) over a text corpus: tokenize -> span sort
+    + combine -> per-partition heap merge + sum -> count-keyed second
+    sort -> merged output lines.  Returns (wall_seconds, output_bytes) or
+    None when the native lib is unavailable."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "owc_proxy"):
+        return None
+    n = len(text)
+    # output = unique words + "\t<count>\n" tails: usually far below the
+    # input, but a mostly-distinct-short-word corpus can exceed it — grow
+    # and retry on the (safe) overflow signal
+    cap = max(1 << 20, n + (n >> 2))
+    for _attempt in range(3):
+        out = ctypes.create_string_buffer(cap)
+        out_len = ctypes.c_int64()
+        secs = lib.owc_proxy(text, ctypes.c_int64(n),
+                             ctypes.c_int32(num_producers),
+                             ctypes.c_int32(num_partitions),
+                             out, ctypes.c_int64(cap),
+                             ctypes.byref(out_len))
+        if secs >= 0:
+            return float(secs), out.raw[:out_len.value]
+        cap *= 4
+    raise RuntimeError("owc_proxy output buffer overflow")
 
 
 def adjacent_equal_native(data: np.ndarray, offsets: np.ndarray,

@@ -189,12 +189,15 @@ extern "C" {
 
 // text/n: the corpus.  M producers (tokenizer tasks), P partitions
 // (summation tasks); the sorter stage is one task (the example's shape).
+// combine != 0 runs the reference combiner on each sorted span (the
+// example's default); combine == 0 ships every (word, 1) record through
+// the sort/merge machinery raw — the spill-bench shape.
 // out/out_cap receive the final "word\tcount\n" lines; *out_len gets the
 // byte count.  Returns wall-seconds for everything past argument setup,
 // or -1.0 when out_cap is too small.
-double owc_proxy(const uint8_t* text, int64_t n, int32_t num_producers,
-                 int32_t num_partitions, uint8_t* out, int64_t out_cap,
-                 int64_t* out_len) {
+double owc_proxy_v2(const uint8_t* text, int64_t n, int32_t num_producers,
+                 int32_t num_partitions, int32_t combine, uint8_t* out,
+                 int64_t out_cap, int64_t* out_len) {
     auto t0 = std::chrono::steady_clock::now();
     int M = num_producers, P = num_partitions;
 
@@ -233,7 +236,8 @@ double owc_proxy(const uint8_t* text, int64_t n, int32_t num_producers,
                       if (c != 0) return c < 0;
                       return a < b;
                   });
-        // combiner on the sorted span stream (PipelinedSorter + combine)
+        // combiner on the sorted span stream (PipelinedSorter + combine);
+        // combine off = every record ships raw (spill-bench semantics)
         auto& entries = prod[p];
         auto& bounds = pbounds[p];
         bounds.assign(P + 1, 0);
@@ -241,7 +245,7 @@ double owc_proxy(const uint8_t* text, int64_t n, int32_t num_producers,
         for (size_t i = 0; i < order.size(); i++) {
             const WordEntry& we = words[order[i]];
             int32_t c = parts[order[i]];
-            if (c != prev_part || entries.empty() ||
+            if (!combine || c != prev_part || entries.empty() ||
                 word_cmp(entries.back(), we) != 0) {
                 while (prev_part < c) bounds[++prev_part] =
                     (int64_t)entries.size();

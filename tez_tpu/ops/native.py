@@ -135,12 +135,15 @@ def _load() -> "ctypes.CDLL | None":
                     ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
                     ctypes.c_void_p]
                 lib.pipelined_sorter_proxy.restype = ctypes.c_double
-            if hasattr(lib, "owc_proxy"):
-                lib.owc_proxy.argtypes = [
+            if hasattr(lib, "owc_proxy_v2"):
+                # _v2: the combine arg changed the C ABI — a stale prebuilt
+                # .so (no-toolchain fallback) must fail the hasattr gate,
+                # never be called with the new signature
+                lib.owc_proxy_v2.argtypes = [
                     ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
-                    ctypes.c_int32, ctypes.c_void_p, ctypes.c_int64,
-                    ctypes.c_void_p]
-                lib.owc_proxy.restype = ctypes.c_double
+                    ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p,
+                    ctypes.c_int64, ctypes.c_void_p]
+                lib.owc_proxy_v2.restype = ctypes.c_double
             _lib = lib
             log.info("native host ops loaded from %s", so_path)
         except Exception as e:  # noqa: BLE001 — toolchain may be absent
@@ -354,15 +357,17 @@ def sort_partition_keys_native(key_bytes: np.ndarray,
     return perm
 
 
-def owc_proxy(text: bytes, num_producers: int, num_partitions: int
-              ) -> "Optional[Tuple[float, bytes]]":
+def owc_proxy(text: bytes, num_producers: int, num_partitions: int,
+              combine: bool = True) -> "Optional[Tuple[float, bytes]]":
     """Run the full-OrderedWordCount reference-semantics C++ proxy
     (native/baseline_proxy.cpp) over a text corpus: tokenize -> span sort
-    + combine -> per-partition heap merge + sum -> count-keyed second
-    sort -> merged output lines.  Returns (wall_seconds, output_bytes) or
-    None when the native lib is unavailable."""
+    (+ combiner when `combine`) -> per-partition heap merge + sum ->
+    count-keyed second sort -> merged output lines.  combine=False ships
+    every (word, 1) record raw — the spill-bench shape.  Returns
+    (wall_seconds, output_bytes) or None when the native lib is
+    unavailable."""
     lib = _load()
-    if lib is None or not hasattr(lib, "owc_proxy"):
+    if lib is None or not hasattr(lib, "owc_proxy_v2"):
         return None
     n = len(text)
     # output = unique words + "\t<count>\n" tails: usually far below the
@@ -372,15 +377,40 @@ def owc_proxy(text: bytes, num_producers: int, num_partitions: int
     for _attempt in range(3):
         out = ctypes.create_string_buffer(cap)
         out_len = ctypes.c_int64()
-        secs = lib.owc_proxy(text, ctypes.c_int64(n),
+        secs = lib.owc_proxy_v2(text, ctypes.c_int64(n),
                              ctypes.c_int32(num_producers),
                              ctypes.c_int32(num_partitions),
+                             ctypes.c_int32(1 if combine else 0),
                              out, ctypes.c_int64(cap),
                              ctypes.byref(out_len))
         if secs >= 0:
             return float(secs), out.raw[:out_len.value]
         cap *= 4
     raise RuntimeError("owc_proxy output buffer overflow")
+
+
+def owc_proxy_counts(corpus_path: str, num_producers: int,
+                     num_partitions: int, combine: bool = True
+                     ) -> "Optional[Tuple[float, dict]]":
+    """Shared baseline harness for bench.py / spill_bench: run the
+    reference-semantics proxy over a corpus FILE and parse its output
+    lines into {word(str): count}.  Returns None only when the native lib
+    is unavailable; parse errors (corrupt proxy output) RAISE — a wrong
+    baseline must never masquerade as an absent one."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "owc_proxy_v2"):
+        return None
+    with open(corpus_path, "rb") as fh:
+        text = fh.read()
+    res = owc_proxy(text, num_producers, num_partitions, combine=combine)
+    if res is None:
+        return None
+    secs, out_bytes = res
+    counts: dict = {}
+    for line in out_bytes.decode().splitlines():
+        w, cnt = line.rsplit("\t", 1)
+        counts[w] = counts.get(w, 0) + int(cnt)
+    return secs, counts
 
 
 def adjacent_equal_native(data: np.ndarray, offsets: np.ndarray,

@@ -116,6 +116,30 @@ def run(target_mb: int, vocab: int, sort_mb: int, engine: str,
         t0 = time.time()
         distinct = verify_output(out_dir, golden)
         verify_s = time.time() - t0
+
+        # EXTERNAL baseline (BASELINE.md protocol): the reference-semantics
+        # C++ OrderedWordCount proxy with COMBINE OFF on the identical
+        # corpus — every (word,1) record through span sort + heap merges,
+        # the exact machinery this bench stresses.  All-RAM and
+        # single-pass (no spill I/O), which makes it a CONSERVATIVE
+        # baseline: the reference would also pay disk at this scale.
+        proxy_s = None
+        try:
+            from tez_tpu.ops.native import owc_proxy_counts
+            res = owc_proxy_counts(corpus, parallelism, parallelism,
+                                   combine=False)
+        except (ImportError, OSError) as e:   # availability, never parse
+            print(f"# owc_proxy baseline unavailable: {e}",
+                  file=sys.stderr)
+            res = None
+        if res is not None:
+            proxy_s, counts_by_word = res
+            got = np.zeros_like(golden)
+            for w, cnt in counts_by_word.items():
+                got[int(w[1:])] += cnt
+            if not np.array_equal(got, golden):
+                raise RuntimeError(
+                    "owc_proxy(no-combine) output mismatch vs golden")
         from tez_tpu.ops.sorter import resolve_engine
         resolved = resolve_engine(engine)
         if engine == "host":
@@ -137,6 +161,11 @@ def run(target_mb: int, vocab: int, sort_mb: int, engine: str,
             "jax_backend": backend,
             "value": round(nbytes / 1e6 / wall, 2),
             "unit": "MB/s",
+            "vs_baseline": round(proxy_s / wall, 3) if proxy_s else 0.0,
+            "baseline": (f"C++ reference-semantics OrderedWordCount proxy, "
+                         f"combine off, all-RAM single-pass (conservative): "
+                         f"{proxy_s:.1f}s on the same corpus"
+                         if proxy_s else "proxy unavailable"),
             "wall_seconds": round(wall, 1),
             "corpus_gen_seconds": round(gen_s, 1),
             "verify_seconds": round(verify_s, 1),

@@ -79,6 +79,26 @@ def attempt(timeout: float) -> dict:
                 "stderr_tail": (stderr or "")[-3000:]}
 
 
+def relay_port_probe(port: int = 8083, timeout: float = 3.0
+                     ) -> "tuple[bool, str, float]":
+    """Fast liveness pre-check: the axon plugin's stateless RPC port
+    (jax.devices() path — see TPU_DIAGNOSTIC.md).  Returns (up, error
+    detail, measured wall) — refused vs timed-out are DIFFERENT relay
+    failure modes and the log must say which."""
+    import socket
+    t0 = time.time()
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=timeout):
+            return True, "", time.time() - t0
+    except ConnectionRefusedError:
+        return False, f"relay-port-{port}-refused", time.time() - t0
+    except (socket.timeout, TimeoutError):
+        return False, f"relay-port-{port}-connect-timeout", time.time() - t0
+    except OSError as e:
+        return False, f"relay-port-{port}-{type(e).__name__}", \
+            time.time() - t0
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=float, default=60.0)
@@ -89,7 +109,14 @@ def main() -> None:
     n = 0
     while True:
         n += 1
-        res = attempt(args.attempt_timeout)
+        # cheap socket probe gates the expensive jax attempt; every 20th
+        # round goes the full way regardless (the port contract could
+        # change under us)
+        up, err, wall = relay_port_probe()
+        if not up and n % 20 != 0:
+            res = {"ok": False, "error": err, "wall_s": round(wall, 3)}
+        else:
+            res = attempt(args.attempt_timeout)
         res["attempt"] = n
         res["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         with open(LOG, "a") as f:

@@ -11,6 +11,7 @@
 // Build: make -C native   (g++ -O3 -shared; loaded via ctypes, with a numpy
 // fallback when the .so is missing).
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <algorithm>
@@ -134,30 +135,6 @@ inline uint64_t key_prefix(const uint8_t* p, int64_t len) {
     return v;
 }
 
-struct SpanSortCtx {
-    const uint8_t* bytes;
-    const int64_t* offsets;
-    const int32_t* parts;          // may be null (single partition)
-    const uint64_t* prefix;
-
-    // Total order (partition, key bytes, original index): any comparison
-    // sort then yields exactly the stable permutation.
-    bool less(int64_t a, int64_t b) const {
-        if (parts && parts[a] != parts[b]) return parts[a] < parts[b];
-        if (prefix[a] != prefix[b]) return prefix[a] < prefix[b];
-        int64_t la = offsets[a + 1] - offsets[a];
-        int64_t lb = offsets[b + 1] - offsets[b];
-        if (la > 8 && lb > 8) {
-            int64_t m = (la < lb ? la : lb) - 8;
-            int c = std::memcmp(bytes + offsets[a] + 8,
-                                bytes + offsets[b] + 8, (size_t)m);
-            if (c) return c < 0;
-        }
-        if (la != lb) return la < lb;
-        return a < b;
-    }
-};
-
 }  // namespace
 
 extern "C" {
@@ -189,62 +166,132 @@ void tz_fnv32_partition(const uint8_t* key_bytes, const int64_t* key_offsets,
 }
 
 // Stable sort permutation of rows by (partition, key bytes).  partitions
-// may be null (single-partition sort, e.g. run merges).  Parallel merge
-// sort over indices: chunk std::sort, then level-by-level inplace_merge,
-// both parallel.
+// may be null (single-partition sort, e.g. run merges).
+//
+// Shape chosen for cache behavior, which dominates on big spans: first a
+// stable COUNTING sort by partition (two O(n) passes), then per
+// partition a VALUE sort of packed 16-byte {prefix, idx} items — the hot
+// comparison touches one contiguous array instead of chasing three
+// indirections per compare; full-key compares run only on prefix ties.
+// Partition ranges sort across a thread pool (no-op on 1 core, real
+// parallelism elsewhere).
 void tz_sort_partition_keys(const uint8_t* key_bytes,
                             const int64_t* key_offsets,
                             const int32_t* partitions, int64_t n,
                             int64_t* perm, int32_t n_threads) {
     if (n <= 0) return;
-    std::vector<uint64_t> prefix((size_t)n);
+    struct Item { uint64_t prefix; int64_t idx; };
+    std::vector<Item> items((size_t)n);
+
+    // partition grouping (stable): count, prefix-sum, scatter
+    int64_t nparts = 1;
+    std::vector<int64_t> pstart;
+    if (partitions != nullptr) {
+        int32_t maxp = 0;
+        for (int64_t i = 0; i < n; i++)
+            if (partitions[i] > maxp) maxp = partitions[i];
+        nparts = (int64_t)maxp + 1;
+        pstart.assign((size_t)nparts + 1, 0);
+        for (int64_t i = 0; i < n; i++) pstart[partitions[i] + 1]++;
+        for (int64_t p = 0; p < nparts; p++) pstart[p + 1] += pstart[p];
+        std::vector<int64_t> cur(pstart.begin(), pstart.end() - 1);
+        for (int64_t i = 0; i < n; i++) {
+            items[(size_t)cur[partitions[i]]++] = {
+                key_prefix(key_bytes + key_offsets[i],
+                           key_offsets[i + 1] - key_offsets[i]), i};
+        }
+    } else {
+        pstart = {0, n};
+        for (int64_t i = 0; i < n; i++)
+            items[(size_t)i] = {
+                key_prefix(key_bytes + key_offsets[i],
+                           key_offsets[i + 1] - key_offsets[i]), i};
+    }
+
+    auto cmp = [&](const Item& a, const Item& b) {
+        if (a.prefix != b.prefix) return a.prefix < b.prefix;
+        int64_t la = key_offsets[a.idx + 1] - key_offsets[a.idx];
+        int64_t lb = key_offsets[b.idx + 1] - key_offsets[b.idx];
+        if (la > 8 && lb > 8) {
+            int64_t m = (la < lb ? la : lb) - 8;
+            int c = std::memcmp(key_bytes + key_offsets[a.idx] + 8,
+                                key_bytes + key_offsets[b.idx] + 8,
+                                (size_t)m);
+            if (c) return c < 0;
+        }
+        if (la != lb) return la < lb;
+        return a.idx < b.idx;          // total order == stable result
+    };
     int threads = std::max(1, (int)n_threads);
-    {
-        std::vector<std::thread> pool;
-        int64_t per = (n + threads - 1) / threads;
-        for (int t = 0; t < threads; t++) {
-            int64_t lo = t * per, hi = std::min<int64_t>(n, lo + per);
-            if (lo >= hi) break;
-            pool.emplace_back([=, &prefix]() {
-                for (int64_t i = lo; i < hi; i++)
-                    prefix[(size_t)i] = key_prefix(
-                        key_bytes + key_offsets[i],
-                        key_offsets[i + 1] - key_offsets[i]);
-            });
+    if (threads == 1 || n < (1 << 15)) {
+        // below the threshold thread spawn/join costs more than the sort
+        for (int64_t p = 0; p < nparts; p++)
+            std::sort(items.begin() + pstart[p],
+                      items.begin() + pstart[p + 1], cmp);
+    } else {
+        // two-level parallelism: each partition range splits into
+        // ~equal chunks (so ONE dominant partition — or the
+        // single-partition run-merge case — still uses every thread),
+        // chunks sort on a pool, then each level of pairwise
+        // inplace_merges runs on the pool across all partitions.
+        struct Range { int64_t lo, hi; };
+        int64_t target = std::max<int64_t>(1 << 15,
+                                           n / threads / 2 + 1);
+        std::vector<std::vector<int64_t>> chunk_bounds((size_t)nparts);
+        std::vector<Range> jobs;
+        for (int64_t p = 0; p < nparts; p++) {
+            int64_t lo = pstart[p], hi = pstart[p + 1];
+            int64_t len = hi - lo;
+            int64_t k = std::max<int64_t>(1, (len + target - 1) / target);
+            auto& cb = chunk_bounds[(size_t)p];
+            cb.resize((size_t)k + 1);
+            for (int64_t c = 0; c <= k; c++) cb[(size_t)c] = lo + len * c / k;
+            for (int64_t c = 0; c < k; c++)
+                jobs.push_back({cb[(size_t)c], cb[(size_t)c + 1]});
         }
-        for (auto& th : pool) th.join();
-    }
-    SpanSortCtx ctx{key_bytes, key_offsets, partitions, prefix.data()};
-    auto cmp = [&ctx](int64_t a, int64_t b) { return ctx.less(a, b); };
-    for (int64_t i = 0; i < n; i++) perm[i] = i;
-    if (n < (1 << 15) || threads == 1) {
-        std::sort(perm, perm + n, cmp);
-        return;
-    }
-    // chunked parallel sort
-    int chunks = threads;
-    std::vector<int64_t> bounds(chunks + 1);
-    for (int c = 0; c <= chunks; c++) bounds[c] = n * c / chunks;
-    {
-        std::vector<std::thread> pool;
-        for (int c = 0; c < chunks; c++)
-            pool.emplace_back([&, c]() {
-                std::sort(perm + bounds[c], perm + bounds[c + 1], cmp);
-            });
-        for (auto& th : pool) th.join();
-    }
-    // pairwise parallel merges
-    for (int step = 1; step < chunks; step *= 2) {
-        std::vector<std::thread> pool;
-        for (int c = 0; c + step < chunks; c += 2 * step) {
-            int64_t lo = bounds[c], mid = bounds[c + step];
-            int64_t hi = bounds[std::min(chunks, c + 2 * step)];
-            pool.emplace_back([=, &cmp]() {
-                std::inplace_merge(perm + lo, perm + mid, perm + hi, cmp);
-            });
+        auto run_jobs = [&](auto&& fn) {
+            std::atomic<size_t> next(0);
+            std::vector<std::thread> pool;
+            int nt = std::min<int64_t>(threads, (int64_t)jobs.size());
+            for (int t = 0; t < nt; t++)
+                pool.emplace_back([&]() {
+                    for (size_t j; (j = next.fetch_add(1)) < jobs.size();)
+                        fn(jobs[j]);
+                });
+            for (auto& th : pool) th.join();
+        };
+        run_jobs([&](const Range& r) {
+            std::sort(items.begin() + r.lo, items.begin() + r.hi, cmp);
+        });
+        // merge ladders, one level at a time across every partition
+        struct MJob { int64_t lo, mid, hi; };
+        for (int64_t step = 1;; step *= 2) {
+            std::vector<MJob> mjobs;
+            for (int64_t p = 0; p < nparts; p++) {
+                auto& cb = chunk_bounds[(size_t)p];
+                int64_t k = (int64_t)cb.size() - 1;
+                for (int64_t c = 0; c + step < k; c += 2 * step) {
+                    int64_t hi_idx = std::min<int64_t>(k, c + 2 * step);
+                    mjobs.push_back({cb[(size_t)c], cb[(size_t)(c + step)],
+                                     cb[(size_t)hi_idx]});
+                }
+            }
+            if (mjobs.empty()) break;
+            std::atomic<size_t> next(0);
+            std::vector<std::thread> pool;
+            int nt = std::min<int64_t>(threads, (int64_t)mjobs.size());
+            for (int t = 0; t < nt; t++)
+                pool.emplace_back([&]() {
+                    for (size_t j; (j = next.fetch_add(1)) < mjobs.size();)
+                        std::inplace_merge(items.begin() + mjobs[j].lo,
+                                           items.begin() + mjobs[j].mid,
+                                           items.begin() + mjobs[j].hi,
+                                           cmp);
+                });
+            for (auto& th : pool) th.join();
         }
-        for (auto& th : pool) th.join();
     }
+    for (int64_t i = 0; i < n; i++) perm[i] = items[(size_t)i].idx;
 }
 
 }  // extern "C"

@@ -172,8 +172,8 @@ def gather_ragged_native(data: np.ndarray, offsets: np.ndarray,
     np.cumsum(lengths[perm], out=out_offsets[1:])
     out = np.empty(int(out_offsets[-1]), dtype=np.uint8)
     data = np.ascontiguousarray(data)
-    offsets = np.ascontiguousarray(offsets.astype(np.int64))
-    perm64 = np.ascontiguousarray(perm.astype(np.int64))
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    perm64 = np.ascontiguousarray(perm, dtype=np.int64)
     threads = min(8, os.cpu_count() or 1)
     lib.gather_ragged_u8(
         data.ctypes.data_as(ctypes.c_void_p),
@@ -249,8 +249,8 @@ def hash_sum_native(key_bytes: np.ndarray, key_offsets: np.ndarray,
         return None
     n = len(values)
     key_bytes = np.ascontiguousarray(key_bytes)
-    key_offsets = np.ascontiguousarray(key_offsets.astype(np.int64))
-    values = np.ascontiguousarray(values.astype(np.int64))
+    key_offsets = np.ascontiguousarray(key_offsets, dtype=np.int64)
+    values = np.ascontiguousarray(values, dtype=np.int64)
     first_idx = np.empty(n, dtype=np.int64)
     sums = np.empty(n, dtype=np.int64)
     n_unique = lib.hash_sum_i64(
@@ -319,7 +319,7 @@ def fnv32_partition_native(key_bytes: np.ndarray, key_offsets: np.ndarray,
         return None
     n = len(key_offsets) - 1
     key_bytes = np.ascontiguousarray(key_bytes)
-    key_offsets = np.ascontiguousarray(key_offsets.astype(np.int64))
+    key_offsets = np.ascontiguousarray(key_offsets, dtype=np.int64)
     parts = np.empty(n, dtype=np.int32)
     lib.tz_fnv32_partition(
         key_bytes.ctypes.data_as(ctypes.c_void_p),
@@ -342,10 +342,10 @@ def sort_partition_keys_native(key_bytes: np.ndarray,
         return None
     n = len(key_offsets) - 1
     key_bytes = np.ascontiguousarray(key_bytes)
-    key_offsets = np.ascontiguousarray(key_offsets.astype(np.int64))
+    key_offsets = np.ascontiguousarray(key_offsets, dtype=np.int64)
     parts_ptr = None
     if partitions is not None:
-        partitions = np.ascontiguousarray(partitions.astype(np.int32))
+        partitions = np.ascontiguousarray(partitions, dtype=np.int32)
         parts_ptr = partitions.ctypes.data_as(ctypes.c_void_p)
     perm = np.empty(n, dtype=np.int64)
     lib.tz_sort_partition_keys(
@@ -421,8 +421,8 @@ def adjacent_equal_native(data: np.ndarray, offsets: np.ndarray,
     if lib is None or not hasattr(lib, "adjacent_equal_u8"):
         return None
     data = np.ascontiguousarray(data)
-    offsets = np.ascontiguousarray(offsets.astype(np.int64))
-    cand64 = np.ascontiguousarray(cand.astype(np.int64))
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    cand64 = np.ascontiguousarray(cand, dtype=np.int64)
     out = np.empty(len(cand64), dtype=np.uint8)
     lib.adjacent_equal_u8(
         data.ctypes.data_as(ctypes.c_void_p),

@@ -216,9 +216,11 @@ class KVBatch:
         if self.dev_keys is not None:
             lanes, lens, lo, _hi = self.dev_keys
             dev = (lanes, lens, lo + start, lo + stop)   # view, no copy
+        # the subtraction already yields fresh int64 arrays — an astype
+        # here would be a second full copy on the per-block hot path
         return KVBatch(
-            self.key_bytes[ko[0]:ko[-1]], (ko - ko[0]).astype(np.int64),
-            self.val_bytes[vo[0]:vo[-1]], (vo - vo[0]).astype(np.int64),
+            self.key_bytes[ko[0]:ko[-1]], ko - ko[0],
+            self.val_bytes[vo[0]:vo[-1]], vo - vo[0],
             dev_keys=dev)
 
     @staticmethod

@@ -256,3 +256,39 @@ def test_event_storm_100x100_scatter_gather(tmp_staging):
         assert max(peaks) < 2500, peaks
     finally:
         c.stop()
+
+
+def test_event_storm_1k_x_1k_stretch(tmp_staging):
+    """Stretch storm (SURVEY §7): 1000x1000 SCATTER_GATHER — one MILLION
+    logical edge routes — completes promptly with bounded AM queues."""
+    import time
+    from tez_tpu.library.conf import OrderedPartitionedKVEdgeConfig
+
+    c = TezClient.create("storm1k", {"tez.staging-dir": tmp_staging,
+                                     "tez.am.local.num-containers": 8}).start()
+    try:
+        p = Vertex.create("p", ProcessorDescriptor.create(
+            "tez_tpu.library.processors:SleepProcessor",
+            payload={"sleep_ms": 0}), 1000)
+        q = Vertex.create("q", ProcessorDescriptor.create(
+            "tez_tpu.library.processors:SleepProcessor",
+            payload={"sleep_ms": 0}), 1000)
+        edge = OrderedPartitionedKVEdgeConfig.new_builder(
+            "bytes", "bytes").build()
+        dag = DAG.create("storm1m").add_vertex(p).add_vertex(q)
+        dag.add_edge(Edge.create(p, q, edge.create_default_edge_property()))
+        t0 = time.time()
+        st = c.submit_dag(dag).wait_for_completion(timeout=360)
+        wall = time.time() - t0
+        assert st.state is DAGStatusState.SUCCEEDED
+        assert st.vertex_status["q"].progress.succeeded_task_count == 1000
+        assert wall < 180, f"1M-route storm took {wall:.0f}s"
+        am = c.framework_client.am
+        peaks = am.dispatcher.peak_depths() \
+            if hasattr(am.dispatcher, "peak_depths") \
+            else [am.dispatcher.peak_in_flight]
+        # composite routing expands on demand: queues must stay far below
+        # the 1M logical expansion
+        assert max(peaks) < 50_000, peaks
+    finally:
+        c.stop()

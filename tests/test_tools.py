@@ -182,6 +182,7 @@ def test_am_web_endpoint(tmp_path):
     from tez_tpu.common.payload import ProcessorDescriptor
     from tez_tpu.dag.dag import DAG, Vertex
     c = TezClient.create("web", {"tez.staging-dir": str(tmp_path / "s"),
+                                 "tez.fake.access.token": "hunter2",
                                  "tez.am.web.enabled": True}).start()
     try:
         dag = DAG.create("webdag").add_vertex(Vertex.create(
@@ -212,6 +213,23 @@ def test_am_web_endpoint(tmp_path):
         res = json.loads(urllib.request.urlopen(url + "analyzers").read())
         assert {"critical_path", "dag_overview"} <= \
             {r["analyzer"] for r in res}
+        # attempt drill-down: counters + diagnostics + timing per attempt
+        aid = tasks[0]["attempts"][0]["id"]
+        att = json.loads(urllib.request.urlopen(
+            url + "attempt?id=" + urllib.parse.quote(aid)).read())
+        assert att["state"] == "SUCCEEDED" and att["vertex"] == "v"
+        assert "TaskCounter" in att["counters"]
+        assert json.loads(urllib.request.urlopen(
+            url + "attempt?id=bogus").read())["error"]
+        # per-vertex counter aggregation
+        vc = json.loads(urllib.request.urlopen(
+            url + "counters?vertex=v").read())
+        assert "TaskCounter" in vc
+        # effective conf with secrets redacted
+        conf = json.loads(urllib.request.urlopen(url + "conf").read())
+        assert conf.get("tez.am.web.enabled") in (True, "True")
+        assert conf["tez.fake.access.token"] == "<redacted>"
+        assert "hunter2" not in json.dumps(conf)
     finally:
         c.stop()
 

@@ -8,12 +8,15 @@ by the AM itself instead of an Ember app reading ATS.
 
 Endpoints:
   GET /                 single-page app (tabs: overview, graph, tasks,
-                        counters, swimlane, history, analyzers)
+                        counters, config, swimlane, history, analyzers)
   GET /status           JSON DAG status (DAGClient schema)
   GET /dags             JSON all DAGs this session (session mode)
   GET /graph            JSON DAG structure (vertices + typed edges)
   GET /tasks?vertex=N   JSON per-task/attempt detail for one vertex
+  GET /attempt?id=A     JSON one attempt's counters/diagnostics/timing
   GET /counters         JSON aggregated DAG counters
+  GET /counters?vertex=N  per-vertex counter aggregation
+  GET /conf             JSON effective DAG configuration (secrets redacted)
   GET /history          JSON recent history events (in-memory logger only)
   GET /analyzers        JSON analyzer suite run over live history
   GET /swimlane.svg     container swimlane SVG
@@ -49,9 +52,10 @@ svg text{font-family:monospace;font-size:12px}
 <h2 id="t">tez_tpu AM</h2>
 <div class="tabs" id="tabs"></div><div id="panel"></div>
 <script>
-const TABS = ["overview","graph","tasks","counters","swimlane","history",
-              "analyzers"];
+const TABS = ["overview","graph","tasks","counters","config","swimlane",
+              "history","analyzers"];
 let cur = "overview", selVertex = null, timer = null, gen = 0;
+let selAttempt = null, taskFilter = "", counterVertex = "";
 const $ = id => document.getElementById(id);
 const esc = s => String(s).replace(/[&<>]/g,
   c => ({'&':'&amp;','<':'&lt;','>':'&gt;'}[c]));
@@ -103,9 +107,14 @@ async function render() {
   } else if (cur === 'tasks') {
     const names = Object.keys(s.vertices || {});
     if (!selVertex || !names.includes(selVertex)) selVertex = names[0];
-    let h = 'vertex: <select onchange="selVertex=this.value;render()">' +
+    let h = 'vertex: ' +
+      '<select onchange="selVertex=this.value;selAttempt=null;render()">' +
       names.map(n =>
         `<option ${n===selVertex?'selected':''}>${esc(n)}</option>`).join('') +
+      '</select> state filter: ' +
+      '<select onchange="taskFilter=this.value;render()">' +
+      ['','RUNNING','SUCCEEDED','FAILED','KILLED','SCHEDULED'].map(f =>
+        `<option ${f===taskFilter?'selected':''}>${f}</option>`).join('') +
       '</select>';
     if (selVertex) {
       const rows = await j('/tasks?vertex=' + encodeURIComponent(selVertex));
@@ -113,29 +122,66 @@ async function render() {
       h += '<table><tr><th>task</th><th>state</th><th>attempt</th>' +
            '<th>attempt state</th><th>node</th><th>duration</th></tr>';
       for (const t of rows) {
+        if (taskFilter && t.state !== taskFilter &&
+            !t.attempts.some(a => a.state === taskFilter)) continue;
         if (!t.attempts.length)
           h += `<tr><td>${t.index}</td><td class="${esc(t.state)}">` +
                `${esc(t.state)}</td><td colspan=4></td></tr>`;
         for (const a of t.attempts)
           h += `<tr><td>${t.index}</td><td class="${esc(t.state)}">` +
-               `${esc(t.state)}</td><td>${esc(a.id)}</td>` +
+               `${esc(t.state)}</td>` +
+               `<td><a href="#" onclick="selAttempt='${esc(a.id)}';` +
+               `render();return false">${esc(a.id)}</a></td>` +
                `<td class="${esc(a.state)}">${esc(a.state)}</td>` +
                `<td>${esc(a.node)}</td><td>${a.duration_s}s</td></tr>`;
       }
       h += '</table>';
+      if (selAttempt) {
+        const d = await j('/attempt?id=' + encodeURIComponent(selAttempt));
+        if (g !== gen) return;
+        if (!d.error) {
+          h += `<h3>${esc(d.id)} — <span class="${esc(d.state)}">` +
+               `${esc(d.state)}</span> on ${esc(d.node)} ` +
+               `(${d.duration_s}s) ` +
+               `<button onclick="selAttempt=null;render()">close</button>` +
+               `</h3>`;
+          if (d.diagnostics.length)
+            h += '<pre style="color:#c0392b">' +
+                 d.diagnostics.map(esc).join('\\n') + '</pre>';
+          for (const [grp, cs] of Object.entries(d.counters)) {
+            h += `<h4>${esc(grp)}</h4><table>`;
+            for (const [k,v] of Object.entries(cs))
+              h += `<tr><td>${esc(k)}</td>` +
+                   `<td style="text-align:right">${v}</td></tr>`;
+            h += '</table>';
+          }
+        }
+      }
     }
     $('panel').innerHTML = h;
   } else if (cur === 'counters') {
-    const c = await j('/counters');
+    const names = Object.keys(s.vertices || {});
+    let h = 'scope: <select onchange="counterVertex=this.value;render()">' +
+      ['<option value="">DAG total</option>'].concat(names.map(n =>
+        `<option value="${esc(n)}" ${n===counterVertex?'selected':''}>` +
+        `${esc(n)}</option>`)).join('') + '</select>';
+    const c = await j('/counters' + (counterVertex ?
+      '?vertex=' + encodeURIComponent(counterVertex) : ''));
     if (g !== gen) return;
-    let h = '';
-    for (const [g, cs] of Object.entries(c)) {
-      h += `<h3>${esc(g)}</h3><table>`;
+    for (const [grp, cs] of Object.entries(c)) {
+      h += `<h3>${esc(grp)}</h3><table>`;
       for (const [k,v] of Object.entries(cs))
         h += `<tr><td>${esc(k)}</td><td style="text-align:right">${v}</td></tr>`;
       h += '</table>';
     }
-    $('panel').innerHTML = h || 'no counters yet';
+    $('panel').innerHTML = h;
+  } else if (cur === 'config') {
+    const c = await j('/conf');
+    if (g !== gen) return;
+    let h = '<table><tr><th>key</th><th>value</th></tr>';
+    for (const k of Object.keys(c).sort())
+      h += `<tr><td>${esc(k)}</td><td>${esc(JSON.stringify(c[k]))}</td></tr>`;
+    $('panel').innerHTML = h + '</table>';
   } else if (cur === 'swimlane') {
     $('panel').innerHTML =
       `<img src="/swimlane.svg?ts=${Date.now()}" style="max-width:100%">`;
@@ -246,9 +292,16 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self._send(200, json.dumps(self._tasks(am, name),
                                        default=str).encode())
         elif path == "/counters":
-            dag = am.current_dag
-            body = dag.counters.to_dict() if dag is not None else {}
-            self._send(200, json.dumps(body).encode())
+            vertex = (query.get("vertex") or [""])[0]
+            self._send(200, json.dumps(
+                self._counters(am, vertex)).encode())
+        elif path == "/attempt":
+            aid = (query.get("id") or [""])[0]
+            self._send(200, json.dumps(self._attempt(am, aid),
+                                       default=str).encode())
+        elif path == "/conf":
+            self._send(200, json.dumps(self._conf(am),
+                                       default=str).encode())
         elif path == "/swimlane.svg":
             from tez_tpu.tools.swimlane import render_svg
             dag = self._parsed_dag(am)
@@ -301,6 +354,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         return {"vertices": vertices, "edges": edges}
 
     @staticmethod
+    def _attempt_dict(a: Any) -> Dict[str, Any]:
+        """The one serialization of an attempt row (shared by the task
+        table and the drill-down)."""
+        end = a.finish_time or time.time()
+        return {
+            "id": str(a.attempt_id), "state": a.state.name,
+            "node": a.node_id or str(a.container_id or ""),
+            "duration_s": round(max(0.0, end - a.launch_time), 2)
+            if a.launch_time else 0.0,
+        }
+
+    @staticmethod
     def _tasks(am: Any, vertex_name: str) -> List[Dict[str, Any]]:
         dag = am.current_dag
         v = dag.vertex_by_name(vertex_name) if dag is not None else None
@@ -310,20 +375,80 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         tasks = dict(v.tasks)
         for i in sorted(tasks):
             t = tasks[i]
-            attempts = []
             task_attempts = dict(t.attempts)
-            for n in sorted(task_attempts):
-                a = task_attempts[n]
-                end = a.finish_time or time.time()
-                attempts.append({
-                    "id": str(a.attempt_id), "state": a.state.name,
-                    "node": a.node_id or str(a.container_id or ""),
-                    "duration_s": round(max(0.0, end - a.launch_time), 2)
-                    if a.launch_time else 0.0,
-                })
+            attempts = [_Handler._attempt_dict(task_attempts[n])
+                        for n in sorted(task_attempts)]
             rows.append({"index": i, "state": t.state.name,
                          "attempts": attempts})
         return rows
+
+    @staticmethod
+    def _counters(am: Any, vertex_name: str = "") -> Dict[str, Any]:
+        """DAG-total counters, or one vertex's aggregation over its
+        attempts' counters (tez-ui's per-vertex counters view)."""
+        dag = am.current_dag
+        if dag is None:
+            return {}
+        if not vertex_name:
+            return dag.counters.to_dict()
+        v = dag.vertex_by_name(vertex_name)
+        if v is None:
+            return {}
+        from tez_tpu.common.counters import TezCounters
+        agg = TezCounters()
+        for t in list(v.tasks.values()):
+            # mirror the canonical roll-up (vertex_impl._finish_succeeded):
+            # one attempt per task — the successful one when it exists,
+            # else the latest — so retries never double-count I/O
+            attempts = dict(t.attempts)
+            if not attempts:
+                continue
+            chosen = next((a for a in attempts.values()
+                           if a.state.name == "SUCCEEDED"),
+                          attempts[max(attempts)])
+            agg.aggregate(chosen.counters)
+        return agg.to_dict()
+
+    @staticmethod
+    def _attempt(am: Any, attempt_id: str) -> Dict[str, Any]:
+        """One attempt's full drill-down: counters, diagnostics, timing
+        (tez-ui attempt page)."""
+        dag = am.current_dag
+        if dag is None:
+            return {"error": "no DAG"}
+        for v in list(dag.vertices.values()):
+            for t in list(v.tasks.values()):
+                for a in list(t.attempts.values()):
+                    if str(a.attempt_id) != attempt_id:
+                        continue
+                    d = _Handler._attempt_dict(a)
+                    d.update({
+                        "vertex": v.name,
+                        "launch_time": a.launch_time,
+                        "finish_time": a.finish_time,
+                        "diagnostics": list(a.diagnostics),
+                        "counters": a.counters.to_dict(),
+                    })
+                    return d
+        return {"error": f"unknown attempt {attempt_id}"}
+
+    #: conf keys whose VALUES must never reach a browser
+    _SECRET_MARKERS = ("token", "secret", "password", "ssl.key")
+
+    @classmethod
+    def _conf(cls, am: Any) -> Dict[str, Any]:
+        """Effective DAG configuration (tez-ui configurations view),
+        secrets redacted by key pattern."""
+        dag = am.current_dag
+        conf = getattr(dag, "conf", None) if dag is not None else am.conf
+        if conf is None:
+            return {}
+        out = {}
+        for k, v in dict(conf).items():
+            lk = str(k).lower()
+            out[k] = "<redacted>" if any(m in lk for m in
+                                         cls._SECRET_MARKERS) else v
+        return out
 
     def _parsed_dag(self, am: Any) -> Optional[Any]:
         """Parse the in-memory history into the latest DagInfo, cached on the

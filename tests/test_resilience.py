@@ -655,3 +655,93 @@ def test_dag_aware_scheduler_conf_seam(tmp_staging):
     dag_id = am.submit_dag(plan)
     assert am.wait_for_dag(dag_id, timeout=30) is DAGState.SUCCEEDED
     am.stop()
+
+
+_STUCK_ONCE = {"done": False}
+
+
+class StuckOnceProcessor:
+    """Heartbeats keep flowing (the runner's heartbeat thread) but the
+    processor makes no progress on its first attempt."""
+
+    def __init__(self, context):
+        self.context = context
+
+    def initialize(self):
+        pass
+
+    def run(self, inputs, outputs):
+        import time
+        if not _STUCK_ONCE["done"]:
+            _STUCK_ONCE["done"] = True
+            time.sleep(30)    # way past the stuck interval
+
+    def close(self):
+        pass
+
+    def handle_events(self, events):
+        pass
+
+
+def test_progress_stuck_attempt_killed_and_retried(tmp_staging):
+    """tez.task.progress.stuck.interval-ms (TaskHeartbeatHandler progress
+    check): an attempt that heartbeats but makes NO progress is timed out
+    and the retry completes the task."""
+    import time
+    from tez_tpu.client.dag_client import DAGStatusState
+    from tez_tpu.client.tez_client import TezClient
+    from tez_tpu.common.payload import ProcessorDescriptor
+    from tez_tpu.dag.dag import DAG, Vertex
+
+    _STUCK_ONCE["done"] = False
+    conf = {"tez.staging-dir": tmp_staging,
+            "tez.task.progress.stuck.interval-ms": 800,
+            "tez.am.local.num-containers": 2}
+    c = TezClient.create("stuck", conf).start()
+    try:
+        c.framework_client.am.heartbeat_monitor.check_interval = 0.1
+        dag = DAG.create("stuckdag").add_vertex(Vertex.create(
+            "v", ProcessorDescriptor.create(
+                "tests.test_resilience:StuckOnceProcessor"), 1))
+        t0 = time.time()
+        st = c.submit_dag(dag).wait_for_completion(timeout=60)
+        wall = time.time() - t0
+        assert st.state is DAGStatusState.SUCCEEDED
+        assert wall < 25, f"stuck attempt not killed promptly ({wall:.0f}s)"
+        # the hung first attempt was killed for no progress
+        am = c.framework_client.am
+        diags = [
+            d for v in am.current_dag.vertices.values()
+            for t in v.tasks.values() for a in t.attempts.values()
+            for d in a.diagnostics]
+        assert any("no progress" in d for d in diags), diags
+    finally:
+        c.stop()
+
+
+def test_container_reuse_disabled_one_task_per_container(tmp_staging):
+    """tez.am.container.reuse.enabled=False: every task runs in a fresh
+    container (no reuse counter, fresh registries)."""
+    from tez_tpu.client.dag_client import DAGStatusState
+    from tez_tpu.client.tez_client import TezClient
+    from tez_tpu.common.payload import ProcessorDescriptor
+    from tez_tpu.dag.dag import DAG, Vertex
+
+    conf = {"tez.staging-dir": tmp_staging,
+            "tez.am.container.reuse.enabled": False,
+            "tez.am.local.num-containers": 2}
+    c = TezClient.create("noreuse", conf).start()
+    try:
+        dag = DAG.create("noreuse").add_vertex(Vertex.create(
+            "v", ProcessorDescriptor.create(
+                "tez_tpu.library.processors:SleepProcessor",
+                payload={"sleep_ms": 0}), 6))
+        st = c.submit_dag(dag).wait_for_completion(timeout=60)
+        assert st.state is DAGStatusState.SUCCEEDED
+        am = c.framework_client.am
+        reuse = am.dag_counters.to_dict().get("DAGCounter", {}).get(
+            "TOTAL_CONTAINER_REUSE_COUNT", 0)
+        assert reuse == 0, f"containers were reused {reuse}x with reuse off"
+        # and with reuse ON (default) the same DAG does reuse containers
+    finally:
+        c.stop()

@@ -22,6 +22,9 @@ class HeartbeatMonitor:
         self.ctx = ctx
         self.check_interval = check_interval
         self.timeout_ms = ctx.conf.get(C.TASK_HEARTBEAT_TIMEOUT_MS)
+        #: -1 = off; >0 = kill attempts whose progress/events stall this
+        #: long even though heartbeats keep arriving (hung-but-alive)
+        self.stuck_ms = ctx.conf.get(C.TASK_PROGRESS_STUCK_INTERVAL_MS)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="heartbeat-monitor")
@@ -47,15 +50,35 @@ class HeartbeatMonitor:
         backlog = self.ctx.task_scheduler.backlog()
         if backlog > 0:
             self.ctx.ensure_runners(backlog)
-        if self.timeout_ms <= 0:
-            return
         now = time.time()
-        cutoff = self.timeout_ms / 1000.0
-        for attempt_id, last in \
-                self.ctx.task_comm.sessions_snapshot().items():
-            if now - last > cutoff:
-                log.warning("attempt %s heartbeat timed out (%.1fs)",
-                            attempt_id, now - last)
-                self.ctx.dispatch(TaskAttemptEvent(
-                    TaskAttemptEventType.TA_TIMED_OUT, attempt_id,
-                    diagnostics=f"no heartbeat for {now - last:.1f}s"))
+        if self.timeout_ms > 0:
+            cutoff = self.timeout_ms / 1000.0
+            for attempt_id, last in \
+                    self.ctx.task_comm.sessions_snapshot().items():
+                if now - last > cutoff:
+                    log.warning("attempt %s heartbeat timed out (%.1fs)",
+                                attempt_id, now - last)
+                    self.ctx.dispatch(TaskAttemptEvent(
+                        TaskAttemptEventType.TA_TIMED_OUT, attempt_id,
+                        diagnostics=f"no heartbeat for {now - last:.1f}s"))
+        if self.stuck_ms and self.stuck_ms > 0:
+            # progress-stuck: heartbeats arrive but nothing moves
+            # (TaskHeartbeatHandler progress check; reference:
+            # tez.task.progress.stuck.interval-ms)
+            cutoff = self.stuck_ms / 1000.0
+            for attempt_id, last in \
+                    self.ctx.task_comm.activity_snapshot().items():
+                if now - last > cutoff:
+                    log.warning("attempt %s made no progress for %.1fs; "
+                                "killing for retry", attempt_id, now - last)
+                    # the runner is ALIVE (it heartbeats): tell it to die
+                    # so its container frees for the retry — TA_TIMED_OUT
+                    # alone only updates AM state.  kill_attempt also
+                    # drops the session from the snapshots, so this fires
+                    # once per hang, not once per tick.
+                    self.ctx.task_comm.kill_attempt(attempt_id)
+                    self.ctx.dispatch(TaskAttemptEvent(
+                        TaskAttemptEventType.TA_TIMED_OUT, attempt_id,
+                        diagnostics=f"no progress for {now - last:.1f}s "
+                                    f"(tez.task.progress.stuck.interval-ms="
+                                    f"{self.stuck_ms})"))

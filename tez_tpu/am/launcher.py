@@ -38,6 +38,12 @@ class RunnerPool:
         #: tez.am.session.min.held-containers)
         self.min_held = int(conf.get("tez.am.session.min.held-containers")
                             or 0) if conf is not None else 0
+        #: reuse off = one task per container, fresh ObjectRegistry/caches
+        #: every time (reference: tez.am.container.reuse.enabled)
+        from tez_tpu.common import config as C
+        reuse = conf.get(C.AM_CONTAINER_REUSE_ENABLED) \
+            if conf is not None else None
+        self.reuse_enabled = True if reuse is None else bool(reuse)
         self._runners: Dict[ContainerId, threading.Thread] = {}
         self._seq = itertools.count()
         self._lock = threading.Lock()
@@ -91,6 +97,12 @@ class RunnerPool:
                                     node_id=self.ctx.node_id)
                 runner.run()
                 registry.clear_scope(ObjectRegistry.VERTEX)
+                if not self.reuse_enabled:
+                    # one task per container: exit; ensure_runners spawns a
+                    # fresh one (fresh registry) while backlog remains
+                    with self._lock:
+                        self._runners.pop(container_id, None)
+                    break
         finally:
             with self._lock:
                 self._runners.pop(container_id, None)
@@ -162,13 +174,17 @@ class SubprocessRunnerPool:
                 env["PYTHONPATH"] = repo_root + (
                     os.pathsep + existing if existing else "")
                 cid = f"container_proc_{self.ctx.app_id}_{n:06d}"
-                proc = subprocess.Popen(
-                    [sys.executable, "-m", "tez_tpu.runtime.remote_runner",
-                     "--am-port", str(self.ctx.umbilical_server.port),
-                     "--node-id", node,
-                     "--container-id", cid,
-                     "--idle-timeout", str(self.idle_timeout)],
-                    env=env)
+                from tez_tpu.common import config as C
+                reuse = self.ctx.conf.get(C.AM_CONTAINER_REUSE_ENABLED)
+                cmd = [sys.executable, "-m",
+                       "tez_tpu.runtime.remote_runner",
+                       "--am-port", str(self.ctx.umbilical_server.port),
+                       "--node-id", node,
+                       "--container-id", cid,
+                       "--idle-timeout", str(self.idle_timeout)]
+                if reuse is not None and not reuse:
+                    cmd += ["--max-tasks", "1"]
+                proc = subprocess.Popen(cmd, env=env)
                 self._procs[n] = (proc, cid)
                 self.ctx.history(HistoryEvent(
                     HistoryEventType.CONTAINER_LAUNCHED,

@@ -43,13 +43,18 @@ class HeartbeatResponse:
 
 class _AttemptSession:
     __slots__ = ("edge_seqs", "killed", "last_heartbeat", "custom_events",
-                 "custom_seq")
+                 "custom_seq", "last_progress", "last_activity")
 
     def __init__(self) -> None:
         self.edge_seqs: Dict[str, int] = {}
         self.killed = False
         self.last_heartbeat = time.time()
         self.custom_events: List[TezAPIEvent] = []
+        # progress-stuck detection (TaskHeartbeatHandler progress check):
+        # an attempt that heartbeats but whose progress never moves and
+        # which generates no events is hung, not alive
+        self.last_progress = -1.0
+        self.last_activity = time.time()
 
 
 class TaskCommunicatorManager:
@@ -84,6 +89,9 @@ class TaskCommunicatorManager:
     def heartbeat(self, request: HeartbeatRequest) -> HeartbeatResponse:
         session = self._session(request.attempt_id)
         session.last_heartbeat = time.time()
+        if request.events or request.progress != session.last_progress:
+            session.last_progress = request.progress
+            session.last_activity = session.last_heartbeat
         if request.events:
             self._route_events(request.attempt_id, request.events)
         if request.counters is not None or request.progress:
@@ -145,8 +153,18 @@ class TaskCommunicatorManager:
                 s.custom_events.extend(events)
 
     def sessions_snapshot(self) -> Dict[TaskAttemptId, float]:
+        """Excludes sessions already marked to die — the monitor must not
+        re-fire on an attempt whose teardown is in flight."""
         with self._lock:
-            return {a: s.last_heartbeat for a, s in self._sessions.items()}
+            return {a: s.last_heartbeat for a, s in self._sessions.items()
+                    if not s.killed}
+
+    def activity_snapshot(self) -> Dict[TaskAttemptId, float]:
+        """attempt -> last time its progress moved or it produced events
+        (the progress-stuck detector's input); killed sessions excluded."""
+        with self._lock:
+            return {a: s.last_activity for a, s in self._sessions.items()
+                    if not s.killed}
 
     # -- internals -----------------------------------------------------------
     def _session(self, attempt_id: TaskAttemptId) -> _AttemptSession:

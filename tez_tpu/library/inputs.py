@@ -103,8 +103,11 @@ class ShuffleFetchTable:
                                                 resolve_conf)
                 ssl_ctx = client_context(resolve_conf(
                     lambda k: _conf_get(ctx, k, None)))
+                conn_to = float(_k(C.SHUFFLE_CONNECT_TIMEOUT_MS)) / 1e3
+                read_to = float(_k(C.SHUFFLE_READ_TIMEOUT_MS)) / 1e3
                 factory = lambda h, p: TcpFetchSession(  # noqa: E731
-                    self._secret, h, p, ssl_context=ssl_ctx)
+                    self._secret, h, p, connect_timeout=conn_to,
+                    ssl_context=ssl_ctx, read_timeout=read_to)
             self._scheduler = FetchScheduler(
                 deliver=self._remote_done,
                 session_factory=factory,
@@ -132,9 +135,22 @@ class ShuffleFetchTable:
 
     def _fetch_error(self, slot: int, version: int, e: Exception) -> None:
         log.warning("fetch failed for slot %d: %s", slot, e)
-        self.context.send_events([InputReadErrorEvent(
-            diagnostics=str(e), index=slot, version=version,
-            is_local_fetch=isinstance(e, ShuffleDataNotFound))])
+        from tez_tpu.common import config as C
+        if _conf_get(self.context, C.SHUFFLE_NOTIFY_READERROR.name,
+                     C.SHUFFLE_NOTIFY_READERROR.default):
+            self.context.send_events([InputReadErrorEvent(
+                diagnostics=str(e), index=slot, version=version,
+                is_local_fetch=isinstance(e, ShuffleDataNotFound))])
+        else:
+            # producer-blame suppressed (reference knob: fetch faults are
+            # presumed environmental) — the CONSUMER attempt must then
+            # fail locally; dropping the error would strand wait_all
+            # forever with heartbeats still flowing
+            with self.lock:
+                self.failed = True
+                self.diagnostics = (f"fetch failed for slot {slot} and "
+                                    f"notify.readerror is off: {e}")
+                self.lock.notify_all()
         with self._deliver_lock:
             self.context.counters.increment(
                 TaskCounter.NUM_FAILED_SHUFFLE_INPUTS)

@@ -169,15 +169,21 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                 run.empty_partition_flags()),
             spill_id=spill_id if self._pipelined else -1,
             last_event=last)
+        from tez_tpu.common import config as C
         total = run.nbytes
-        partition_sizes = [run.partition_nbytes(p)
-                           for p in range(run.num_partitions)]
+        vm_payload: Dict[str, Any] = {"output_size": total}
+        if _conf_get(self.context, C.REPORT_PARTITION_STATS.name,
+                     C.REPORT_PARTITION_STATS.default):
+            # per-partition sizes feed auto-parallelism / fair-shuffle;
+            # deployments with huge partition counts can turn the detail
+            # off and keep only the total (reference knob)
+            vm_payload["partition_sizes"] = [
+                run.partition_nbytes(p) for p in range(run.num_partitions)]
         return [
             CompositeDataMovementEvent(0, run.num_partitions, payload),
             VertexManagerEvent(
                 target_vertex_name=self.context.destination_vertex_name,
-                user_payload={"output_size": total,
-                              "partition_sizes": partition_sizes}),
+                user_payload=vm_payload),
         ]
 
     def _ship_spill(self, run: Run, spill_id: int) -> None:

@@ -207,8 +207,13 @@ class UnorderedPartitionedKVOutput(LogicalOutput):
                 0, self.num_physical_outputs,
                 self._payload(empty, self._spills_sent, True))]
         self.service.register(path, -1, run)
-        partition_sizes = [run.partition_nbytes(p)
-                           for p in range(run.num_partitions)]
+        from tez_tpu.common import config as C
+        from tez_tpu.library.util import conf_get as _conf_get
+        vm_payload = {"output_size": run.nbytes}
+        if _conf_get(self.context, C.REPORT_PARTITION_STATS.name,
+                     C.REPORT_PARTITION_STATS.default):
+            vm_payload["partition_sizes"] = [
+                run.partition_nbytes(p) for p in range(run.num_partitions)]
         return [
             CompositeDataMovementEvent(
                 0, run.num_partitions,
@@ -219,8 +224,7 @@ class UnorderedPartitionedKVOutput(LogicalOutput):
                                spill_id=-1, last_event=True)),
             VertexManagerEvent(
                 target_vertex_name=self.context.destination_vertex_name,
-                user_payload={"output_size": run.nbytes,
-                              "partition_sizes": partition_sizes}),
+                user_payload=vm_payload),
         ]
 
 

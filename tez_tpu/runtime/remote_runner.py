@@ -24,7 +24,8 @@ log = logging.getLogger(__name__)
 
 def run_loop(am_host: str, am_port: int, node_id: str, token_hex: str,
              idle_timeout: float = 5.0, work_dir: str = "",
-             container_id: str = "", advertise_host: str = "127.0.0.1") -> int:
+             container_id: str = "", advertise_host: str = "127.0.0.1",
+             max_tasks: int = 0) -> int:
     from tez_tpu.am.umbilical_server import RemoteUmbilical
     from tez_tpu.api.runtime import ObjectRegistry
     from tez_tpu.common.ids import ContainerId
@@ -101,6 +102,11 @@ def run_loop(am_host: str, am_port: int, node_id: str, token_hex: str,
             runner.run()
             registry.clear_scope(ObjectRegistry.VERTEX)
             tasks_run += 1
+            if max_tasks and tasks_run >= max_tasks:
+                # container reuse disabled: one fresh process per task
+                # (tez.am.container.reuse.enabled=False; the pool respawns
+                # while backlog remains)
+                break
     finally:
         shuffle_server.stop()
         umbilical.close()
@@ -132,6 +138,9 @@ def main() -> int:
     parser.add_argument("--container-id", default="")
     parser.add_argument("--advertise-host", default="127.0.0.1")
     parser.add_argument("--idle-timeout", type=float, default=5.0)
+    parser.add_argument("--max-tasks", type=int, default=0,
+                        help="exit after N tasks; 0 = loop until idle "
+                             "(tez.am.container.reuse.enabled=False -> 1)")
     args = parser.parse_args()
     token = os.environ.get("TEZ_TPU_JOB_TOKEN", "")
     if not token:
@@ -143,7 +152,8 @@ def main() -> int:
     return run_loop(args.am_host, args.am_port, args.node_id, token,
                     idle_timeout=args.idle_timeout,
                     container_id=args.container_id,
-                    advertise_host=args.advertise_host)
+                    advertise_host=args.advertise_host,
+                    max_tasks=args.max_tasks)
 
 
 if __name__ == "__main__":

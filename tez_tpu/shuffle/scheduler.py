@@ -72,13 +72,14 @@ class _Inflight:
 
 
 def TcpFetchSession(secrets: Any, host: str, port: int,
-                    connect_timeout: float = 5.0, ssl_context: Any = None):
+                    connect_timeout: float = 5.0, ssl_context: Any = None,
+                    read_timeout: float = 30.0):
     """Real transport session: ONE TCP connect + nonce handshake, many
     fetches (shuffle/server.py FetchSession — the server's handler loops
     per connection)."""
     from tez_tpu.shuffle.server import FetchSession
     return FetchSession(secrets, host, port, connect_timeout,
-                        ssl_context=ssl_context)
+                        ssl_context=ssl_context, read_timeout=read_timeout)
 
 
 class FetchScheduler:

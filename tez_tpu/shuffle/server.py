@@ -158,13 +158,20 @@ class FetchSession:
     the session."""
 
     def __init__(self, secrets: JobTokenSecretManager, host: str, port: int,
-                 connect_timeout: float = 5.0, ssl_context=None):
+                 connect_timeout: float = 5.0, ssl_context=None,
+                 read_timeout: float = 30.0):
         self.secrets = secrets
         self.host, self.port = host, port
         self._sk = socket.create_connection((host, port),
                                             timeout=connect_timeout)
         if ssl_context is not None:
+            # handshake still under the CONNECT budget (the socket timeout
+            # is connect_timeout until after the wrap)
             self._sk = ssl_context.wrap_socket(self._sk)
+        # distinct read deadline (reference: tez.runtime.shuffle.read.timeout
+        # vs .connect.timeout) — a server that accepts but stops answering
+        # must fail the fetch into the retry/penalty path, not hang it
+        self._sk.settimeout(read_timeout)
         self._fh = self._sk.makefile("rb")
         self._nonce = self._fh.read(16)
         if len(self._nonce) != 16:

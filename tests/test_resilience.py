@@ -387,6 +387,9 @@ def test_am_recovery_idempotent_across_three_attempts(tmp_staging, tmp_path):
     dag.add_edge(Edge.create(producer, consumer, prop))
     plan = dag.create_dag_plan()
     conf = C.TezConfiguration({"tez.staging-dir": tmp_staging,
+                               # three attempts on purpose: raise the
+                               # restart budget above the default of 2
+                               "tez.am.max.app.attempts": 3,
                                "tez.am.local.num-containers": 3})
 
     am1 = DAGAppMaster("app_1_r3", conf, attempt=1)
@@ -745,3 +748,40 @@ def test_container_reuse_disabled_one_task_per_container(tmp_staging):
         # and with reuse ON (default) the same DAG does reuse containers
     finally:
         c.stop()
+
+
+def test_max_app_attempts_refuses_restart(tmp_staging):
+    """tez.am.max.app.attempts: the AM restart budget — a supervisor
+    looping restarts of a persistently-crashing app is refused loudly."""
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging,
+                               "tez.am.max.app.attempts": 2})
+    DAGAppMaster("app_1_maxatt", conf, attempt=2).stop()  # at the budget: ok
+    with pytest.raises(RuntimeError, match="max.app.attempts"):
+        DAGAppMaster("app_1_maxatt", conf, attempt=3)
+
+
+def test_debug_artifacts_written_on_submit(tmp_staging):
+    """tez.generate.debug.artifacts: the submitted plan lands in the AM
+    work dir for postmortems."""
+    import glob as globlib
+    import os
+    from tez_tpu.client.dag_client import DAGStatusState
+    from tez_tpu.client.tez_client import TezClient
+    from tez_tpu.common.payload import ProcessorDescriptor
+    from tez_tpu.dag.dag import DAG, Vertex
+
+    conf = {"tez.staging-dir": tmp_staging,
+            "tez.generate.debug.artifacts": True}
+    with TezClient.create("dbg", conf) as c:
+        dag = DAG.create("dbgdag").add_vertex(Vertex.create(
+            "v", ProcessorDescriptor.create(
+                "tez_tpu.library.processors:SleepProcessor",
+                payload={"sleep_ms": 0}), 1))
+        st = c.submit_dag(dag).wait_for_completion(timeout=30)
+        assert st.state is DAGStatusState.SUCCEEDED
+        work = c.framework_client.am.work_dir
+    arts = globlib.glob(os.path.join(work, "*-plan-debug.json"))
+    assert arts, f"no debug artifact in {work}"
+    import json as _json
+    body = _json.load(open(arts[0]))
+    assert body["name"] == "dbgdag" and body["vertices"] == ["v"]

@@ -42,6 +42,14 @@ class DAGAppMaster:
         self.app_id = app_id
         self.attempt = attempt
         self.conf = conf
+        max_attempts = int(conf.get(C.AM_MAX_APP_ATTEMPTS) or 0)
+        if max_attempts > 0 and attempt > max_attempts:
+            # the RM-side restart budget (reference: tez.am.max.app.attempts
+            # via YARN's ApplicationSubmissionContext): a supervisor looping
+            # AM restarts must stop re-running a persistently-crashing app
+            raise RuntimeError(
+                f"AM attempt {attempt} exceeds tez.am.max.app.attempts="
+                f"{max_attempts}; refusing to restart {app_id}")
         self.node_id = "local-0"
         self.work_dir = os.path.join(
             conf.get(C.STAGING_DIR), app_id, "work")
@@ -288,12 +296,30 @@ class DAGAppMaster:
                 raise RuntimeError("a DAG is already running")
         self._dag_seq += 1
         dag_id = DAGId(self.app_id, self._dag_seq)
+        plan_hex = plan.serialize().hex()
         self.history(HistoryEvent(
             HistoryEventType.DAG_SUBMITTED, dag_id=str(dag_id),
             data={"dag_name": plan.name,
-                  "plan": plan.serialize().hex()}))
+                  "plan": plan_hex}))
         dag = DAGImpl(dag_id, plan, self, recovery_data=recovery_data)
         self.current_dag = dag
+        # DAG-scoped knob: per-DAG conf overrides the AM conf
+        if dag.conf.get(C.GENERATE_DEBUG_ARTIFACTS):
+            # reference: the AM writes the expanded dag plan text into
+            # staging for postmortems (TezUtilsInternal debug artifacts)
+            try:
+                import json as _json
+                path = os.path.join(self.work_dir,
+                                    f"{dag_id}-plan-debug.json")
+                with open(path, "w") as fh:
+                    _json.dump({"name": plan.name,
+                                "vertices": sorted(
+                                    v.name for v in plan.vertices),
+                                "plan_hex": plan_hex},
+                               fh, indent=1)
+                log.info("debug artifact: %s", path)
+            except Exception:  # noqa: BLE001 — diagnostics must not fail
+                log.exception("debug artifact write failed")
         if dag.conf.get(C.SPECULATION_ENABLED):
             from tez_tpu.am.speculation import Speculator
             dag.speculator = Speculator(dag)

@@ -13,6 +13,7 @@ import dataclasses
 import json
 import logging
 import os
+import time
 from typing import Any, Dict, List, Optional, Set
 
 from tez_tpu.am.history import HistoryEvent, HistoryEventType
@@ -31,10 +32,19 @@ class RecoveryService:
         staging = ctx.conf.get(C.STAGING_DIR)
         self.dir = os.path.join(staging, ctx.app_id, "recovery", str(attempt))
         self._fh = None
+        #: non-summary events flush at most this often WHILE EVENTS FLOW
+        #: (the check runs on each handle(); a quiet journal flushes on the
+        #: next event or stop()).  Summary events always fsync immediately.
+        #: (reference: tez.dag.recovery.flush.interval.secs,
+        #: RecoveryService.java maxUnflushedEvents/flushInterval)
+        self.flush_interval = float(
+            ctx.conf.get(C.DAG_RECOVERY_FLUSH_INTERVAL_SECS) or 0)
+        self._last_flush = 0.0
 
     def start(self) -> None:
         os.makedirs(self.dir, exist_ok=True)
         self._fh = open(os.path.join(self.dir, "journal.jsonl"), "a")
+        self._last_flush = time.time()
 
     def handle(self, event: HistoryEvent) -> None:
         if self._fh is None:
@@ -43,6 +53,12 @@ class RecoveryService:
         if event.is_summary:
             self._fh.flush()
             os.fsync(self._fh.fileno())
+            self._last_flush = time.time()
+        elif self.flush_interval > 0:
+            now = time.time()
+            if now - self._last_flush >= self.flush_interval:
+                self._fh.flush()
+                self._last_flush = now
 
     def stop(self) -> None:
         if self._fh is not None:

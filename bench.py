@@ -572,6 +572,44 @@ def main() -> int:
                    f"(info line; same {num_records} recs){suffix}"),
         "value": round(mbps, 2), "unit": "MB/s",
         "vs_baseline": round(host_s / tpu_s, 3)}), flush=True)
+
+    native_s = None
+    if cpu_fallback:
+        # what engine=auto actually RUNS on a chipless backend: the native
+        # host span sort + merge through the real sorter machinery.  On
+        # fallback this becomes the headline (measuring the XLA:CPU device
+        # pipeline as the headline would measure a path auto never picks);
+        # the device-pipeline number stays above as an info line.
+        _phase[0] = "native host engine timed runs"
+        from tez_tpu.ops.runformat import KVBatch
+        from tez_tpu.ops.sorter import DeviceSorter, merge_sorted_runs
+
+        def native_once():
+            runs = []
+            per = num_records // num_producers
+            for p in range(num_producers):
+                lo = p * per
+                hi = (p + 1) * per if p < num_producers - 1 else num_records
+                s = DeviceSorter(num_partitions=num_partitions,
+                                 engine="host", key_width=key_len)
+                m = hi - lo
+                s.write_batch(KVBatch(
+                    kb[lo * key_len:hi * key_len],
+                    np.arange(m + 1, dtype=np.int64) * key_len,
+                    vb[lo * 8:hi * 8],
+                    np.arange(m + 1, dtype=np.int64) * 8))
+                runs.append(s.flush())
+            return merge_sorted_runs(runs, num_partitions, key_len,
+                                     engine="host")
+        native_once()   # warm (native lib load, allocator)
+        t0 = time.time()
+        for _ in range(reps):
+            merged = native_once()
+        native_s = (time.time() - t0) / reps
+        mk = merged.batch.key_bytes.reshape(-1, key_len)
+        if proxy is not None:
+            assert np.array_equal(mk, proxy[1]), \
+                "host engine keys diverge from baseline"
     if proxy_s is not None:
         vs = round(proxy_s / tpu_s, 3)
         base_note = (f"baseline=PipelinedSorter-semantics C++ proxy "
@@ -579,14 +617,37 @@ def main() -> int:
     else:
         vs = round(host_s / tpu_s, 3)
         base_note = "baseline=numpy host engine (native proxy unavailable)"
-    _kernel_line[0] = {
-        "metric": (f"ordered-shuffle-sort throughput ({num_records} recs, "
-                   f"{num_partitions} partitions, HBM-resident, keys+values "
-                   f"byte-verified; {base_note})" + suffix),
-        "value": round(mbps, 2),
-        "unit": "MB/s",
-        "vs_baseline": vs,
-    }
+    if native_s is not None:
+        # CPU fallback headline: the engine auto actually picks there —
+        # native host span sort + merge.  Verification and the ratio are
+        # only claimed when the proxy actually ran (no proxy = no "byte-
+        # verified" in the label and an honest numpy-ratio fallback, never
+        # the stalled-run 0.0 sentinel).
+        if proxy_s is not None:
+            verify_note = "keys byte-verified vs baseline"
+            vs_native = round(proxy_s / native_s, 3)
+        else:
+            verify_note = "proxy unavailable: UNVERIFIED, ratio vs numpy"
+            vs_native = round(host_s / native_s, 3)
+        _kernel_line[0] = {
+            "metric": (f"ordered-shuffle-sort throughput ({num_records} "
+                       f"recs, {num_partitions} partitions, engine=auto->"
+                       f"host native span sort+merge, {verify_note}; "
+                       f"{base_note}; device-pipeline info "
+                       f"line above)" + suffix),
+            "value": round(total_mb / native_s, 2),
+            "unit": "MB/s",
+            "vs_baseline": vs_native,
+        }
+    else:
+        _kernel_line[0] = {
+            "metric": (f"ordered-shuffle-sort throughput ({num_records} "
+                       f"recs, {num_partitions} partitions, HBM-resident, "
+                       f"keys+values byte-verified; {base_note})" + suffix),
+            "value": round(mbps, 2),
+            "unit": "MB/s",
+            "vs_baseline": vs,
+        }
 
     # -- stage 3: framework E2E (second metric; BASELINE.md protocol)
     fw_line = None

@@ -128,6 +128,12 @@ def _load() -> "ctypes.CDLL | None":
                     ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                     ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32]
                 lib.tz_sort_partition_keys.restype = None
+            if hasattr(lib, "tz_merge_runs"):
+                lib.tz_merge_runs.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+                    ctypes.c_int32]
+                lib.tz_merge_runs.restype = None
             if hasattr(lib, "pipelined_sorter_proxy"):
                 lib.pipelined_sorter_proxy.argtypes = [
                     ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
@@ -387,6 +393,36 @@ def owc_proxy(text: bytes, num_producers: int, num_partitions: int,
             return float(secs), out.raw[:out_len.value]
         cap *= 4
     raise RuntimeError("owc_proxy output buffer overflow")
+
+
+def merge_runs_native(key_bytes: np.ndarray, key_offsets: np.ndarray,
+                      partitions: Optional[np.ndarray],
+                      run_bounds: np.ndarray) -> Optional[np.ndarray]:
+    """Stable merge permutation over the concatenation of k
+    (partition, key)-sorted runs — a ladder of in-place merges instead of
+    a full re-sort (GIL released).  None when the native lib is
+    unavailable."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tz_merge_runs"):
+        return None
+    key_bytes = np.ascontiguousarray(key_bytes)
+    key_offsets = np.ascontiguousarray(key_offsets, dtype=np.int64)
+    parts_ptr = None
+    if partitions is not None:
+        partitions = np.ascontiguousarray(partitions, dtype=np.int32)
+        parts_ptr = partitions.ctypes.data_as(ctypes.c_void_p)
+    run_bounds = np.ascontiguousarray(run_bounds, dtype=np.int64)
+    n = int(run_bounds[-1])
+    perm = np.empty(n, dtype=np.int64)
+    lib.tz_merge_runs(
+        key_bytes.ctypes.data_as(ctypes.c_void_p),
+        key_offsets.ctypes.data_as(ctypes.c_void_p),
+        parts_ptr,
+        run_bounds.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int32(len(run_bounds) - 1),
+        perm.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int32(min(8, os.cpu_count() or 1)))
+    return perm
 
 
 def owc_proxy_counts(corpus_path: str, num_producers: int,

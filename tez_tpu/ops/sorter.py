@@ -697,12 +697,16 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
     else:
         sort_bytes, sort_offsets = batch.key_bytes, batch.key_offsets
     if engine == "host":
-        # native index sort: full-key compares, run-order ties (= MergeQueue
-        # age order via the concat index), GIL released
-        from tez_tpu.ops.native import sort_partition_keys_native
-        perm_n = sort_partition_keys_native(
+        # native merge: the runs are ALREADY (partition, key)-sorted, so a
+        # ladder of in-place merges (O(n log k)) replaces a full re-sort;
+        # full-key compares, run-order ties (= MergeQueue age order via the
+        # concat index), GIL released
+        from tez_tpu.ops.native import merge_runs_native
+        run_bounds = np.zeros(len(runs) + 1, dtype=np.int64)
+        np.cumsum([r.batch.num_records for r in runs], out=run_bounds[1:])
+        perm_n = merge_runs_native(
             sort_bytes, sort_offsets,
-            partitions if num_partitions > 1 else None)
+            partitions if num_partitions > 1 else None, run_bounds)
         if perm_n is not None:
             sorted_batch = batch.take(perm_n)
             sorted_partitions = partitions[perm_n]

@@ -113,29 +113,10 @@ void adjacent_equal_u8(const uint8_t* data, const int64_t* offsets,
 }  // extern "C"
 
 // ---------------------------------------------------------------------------
-// Span sort (host engine): partition + stable sort over ragged keys.
-//
-// The host twin of the device hash_sort_span kernel and the C-speed
-// replacement for the numpy path (pad-to-matrix -> u32 lanes -> 6-key
-// lexsort -> host tie-break).  Sorting row indices directly against the
-// ragged key bytes needs no padded matrix, resolves ties exactly (full-key
-// memcmp), and releases the GIL for the whole call (ctypes), so concurrent
-// producer tasks in one process actually overlap — the reference gets this
-// for free from JVM threads (PipelinedSorter sortmaster); numpy never does.
+// Key partitioning (host engine).  The span sort itself lives in
+// spansort.cpp (v2: register-packed 12-byte prefixes + duplication-aware
+// dedup-rank fast path).
 // ---------------------------------------------------------------------------
-
-namespace {
-
-// Big-endian zero-padded first-8-bytes prefix: unsigned compare of prefixes
-// orders like memcmp of the first 8 bytes.
-inline uint64_t key_prefix(const uint8_t* p, int64_t len) {
-    uint64_t v = 0;
-    int64_t m = len < 8 ? len : 8;
-    for (int64_t i = 0; i < m; i++) v |= (uint64_t)p[i] << (56 - 8 * i);
-    return v;
-}
-
-}  // namespace
 
 extern "C" {
 
@@ -163,202 +144,6 @@ void tz_fnv32_partition(const uint8_t* key_bytes, const int64_t* key_offsets,
         });
     }
     for (auto& th : pool) th.join();
-}
-
-// Stable sort permutation of rows by (partition, key bytes).  partitions
-// may be null (single-partition sort, e.g. run merges).
-//
-// Shape chosen for cache behavior, which dominates on big spans: first a
-// stable COUNTING sort by partition (two O(n) passes), then per
-// partition a VALUE sort of packed 16-byte {prefix, idx} items — the hot
-// comparison touches one contiguous array instead of chasing three
-// indirections per compare; full-key compares run only on prefix ties.
-// Partition ranges sort across a thread pool (no-op on 1 core, real
-// parallelism elsewhere).
-void tz_sort_partition_keys(const uint8_t* key_bytes,
-                            const int64_t* key_offsets,
-                            const int32_t* partitions, int64_t n,
-                            int64_t* perm, int32_t n_threads) {
-    if (n <= 0) return;
-    struct Item { uint64_t prefix; int64_t idx; };
-    std::vector<Item> items((size_t)n);
-
-    // partition grouping (stable): count, prefix-sum, scatter
-    int64_t nparts = 1;
-    std::vector<int64_t> pstart;
-    if (partitions != nullptr) {
-        int32_t maxp = 0;
-        for (int64_t i = 0; i < n; i++)
-            if (partitions[i] > maxp) maxp = partitions[i];
-        nparts = (int64_t)maxp + 1;
-        pstart.assign((size_t)nparts + 1, 0);
-        for (int64_t i = 0; i < n; i++) pstart[partitions[i] + 1]++;
-        for (int64_t p = 0; p < nparts; p++) pstart[p + 1] += pstart[p];
-        std::vector<int64_t> cur(pstart.begin(), pstart.end() - 1);
-        for (int64_t i = 0; i < n; i++) {
-            items[(size_t)cur[partitions[i]]++] = {
-                key_prefix(key_bytes + key_offsets[i],
-                           key_offsets[i + 1] - key_offsets[i]), i};
-        }
-    } else {
-        pstart = {0, n};
-        for (int64_t i = 0; i < n; i++)
-            items[(size_t)i] = {
-                key_prefix(key_bytes + key_offsets[i],
-                           key_offsets[i + 1] - key_offsets[i]), i};
-    }
-
-    auto cmp = [&](const Item& a, const Item& b) {
-        if (a.prefix != b.prefix) return a.prefix < b.prefix;
-        int64_t la = key_offsets[a.idx + 1] - key_offsets[a.idx];
-        int64_t lb = key_offsets[b.idx + 1] - key_offsets[b.idx];
-        if (la > 8 && lb > 8) {
-            int64_t m = (la < lb ? la : lb) - 8;
-            int c = std::memcmp(key_bytes + key_offsets[a.idx] + 8,
-                                key_bytes + key_offsets[b.idx] + 8,
-                                (size_t)m);
-            if (c) return c < 0;
-        }
-        if (la != lb) return la < lb;
-        return a.idx < b.idx;          // total order == stable result
-    };
-    int threads = std::max(1, (int)n_threads);
-    if (threads == 1 || n < (1 << 15)) {
-        // below the threshold thread spawn/join costs more than the sort
-        for (int64_t p = 0; p < nparts; p++)
-            std::sort(items.begin() + pstart[p],
-                      items.begin() + pstart[p + 1], cmp);
-    } else {
-        // two-level parallelism: each partition range splits into
-        // ~equal chunks (so ONE dominant partition — or the
-        // single-partition run-merge case — still uses every thread),
-        // chunks sort on a pool, then each level of pairwise
-        // inplace_merges runs on the pool across all partitions.
-        struct Range { int64_t lo, hi; };
-        int64_t target = std::max<int64_t>(1 << 15,
-                                           n / threads / 2 + 1);
-        std::vector<std::vector<int64_t>> chunk_bounds((size_t)nparts);
-        std::vector<Range> jobs;
-        for (int64_t p = 0; p < nparts; p++) {
-            int64_t lo = pstart[p], hi = pstart[p + 1];
-            int64_t len = hi - lo;
-            int64_t k = std::max<int64_t>(1, (len + target - 1) / target);
-            auto& cb = chunk_bounds[(size_t)p];
-            cb.resize((size_t)k + 1);
-            for (int64_t c = 0; c <= k; c++) cb[(size_t)c] = lo + len * c / k;
-            for (int64_t c = 0; c < k; c++)
-                jobs.push_back({cb[(size_t)c], cb[(size_t)c + 1]});
-        }
-        auto run_jobs = [&](auto&& fn) {
-            std::atomic<size_t> next(0);
-            std::vector<std::thread> pool;
-            int nt = std::min<int64_t>(threads, (int64_t)jobs.size());
-            for (int t = 0; t < nt; t++)
-                pool.emplace_back([&]() {
-                    for (size_t j; (j = next.fetch_add(1)) < jobs.size();)
-                        fn(jobs[j]);
-                });
-            for (auto& th : pool) th.join();
-        };
-        run_jobs([&](const Range& r) {
-            std::sort(items.begin() + r.lo, items.begin() + r.hi, cmp);
-        });
-        // merge ladders, one level at a time across every partition
-        struct MJob { int64_t lo, mid, hi; };
-        for (int64_t step = 1;; step *= 2) {
-            std::vector<MJob> mjobs;
-            for (int64_t p = 0; p < nparts; p++) {
-                auto& cb = chunk_bounds[(size_t)p];
-                int64_t k = (int64_t)cb.size() - 1;
-                for (int64_t c = 0; c + step < k; c += 2 * step) {
-                    int64_t hi_idx = std::min<int64_t>(k, c + 2 * step);
-                    mjobs.push_back({cb[(size_t)c], cb[(size_t)(c + step)],
-                                     cb[(size_t)hi_idx]});
-                }
-            }
-            if (mjobs.empty()) break;
-            std::atomic<size_t> next(0);
-            std::vector<std::thread> pool;
-            int nt = std::min<int64_t>(threads, (int64_t)mjobs.size());
-            for (int t = 0; t < nt; t++)
-                pool.emplace_back([&]() {
-                    for (size_t j; (j = next.fetch_add(1)) < mjobs.size();)
-                        std::inplace_merge(items.begin() + mjobs[j].lo,
-                                           items.begin() + mjobs[j].mid,
-                                           items.begin() + mjobs[j].hi,
-                                           cmp);
-                });
-            for (auto& th : pool) th.join();
-        }
-    }
-    for (int64_t i = 0; i < n; i++) perm[i] = items[(size_t)i].idx;
-}
-
-// Merge k (partition, key)-sorted runs into one stable permutation.
-// Rows are the CONCATENATION of the runs; run_bounds has k+1 entries.
-// Exploits sortedness: items build in one pass, then a ladder of
-// inplace_merges over run segments — O(n log k) with cache-friendly 16-byte
-// items instead of a full O(n log n) sort (TezMerger's economics, value
-// form).  Equal (partition, key) rows keep concatenation order == run age
-// order (MergeQueue semantics).
-void tz_merge_runs(const uint8_t* key_bytes, const int64_t* key_offsets,
-                   const int32_t* partitions, const int64_t* run_bounds,
-                   int32_t num_runs, int64_t* perm, int32_t n_threads) {
-    int64_t n = run_bounds[num_runs];
-    if (n <= 0) return;
-    struct Item { uint64_t prefix; int64_t idx; };
-    std::vector<Item> items((size_t)n);
-    for (int64_t i = 0; i < n; i++)
-        items[(size_t)i] = {key_prefix(key_bytes + key_offsets[i],
-                                       key_offsets[i + 1] - key_offsets[i]),
-                            i};
-    auto cmp = [&](const Item& a, const Item& b) {
-        if (partitions != nullptr && partitions[a.idx] != partitions[b.idx])
-            return partitions[a.idx] < partitions[b.idx];
-        if (a.prefix != b.prefix) return a.prefix < b.prefix;
-        int64_t la = key_offsets[a.idx + 1] - key_offsets[a.idx];
-        int64_t lb = key_offsets[b.idx + 1] - key_offsets[b.idx];
-        if (la > 8 && lb > 8) {
-            int64_t m = (la < lb ? la : lb) - 8;
-            int c = std::memcmp(key_bytes + key_offsets[a.idx] + 8,
-                                key_bytes + key_offsets[b.idx] + 8,
-                                (size_t)m);
-            if (c) return c < 0;
-        }
-        if (la != lb) return la < lb;
-        return a.idx < b.idx;
-    };
-    int threads = std::max(1, (int)n_threads);
-    for (int64_t step = 1; step < num_runs; step *= 2) {
-        // each level's merges touch disjoint segments: run them on a pool
-        struct MJob { int64_t lo, mid, hi; };
-        std::vector<MJob> jobs;
-        for (int64_t r = 0; r + step < num_runs; r += 2 * step) {
-            int64_t hi = std::min<int64_t>(num_runs, r + 2 * step);
-            jobs.push_back({run_bounds[r], run_bounds[r + step],
-                            run_bounds[hi]});
-        }
-        int nt = std::min<int64_t>(threads, (int64_t)jobs.size());
-        if (nt <= 1 || n < (1 << 15)) {
-            for (const MJob& j : jobs)
-                std::inplace_merge(items.begin() + j.lo,
-                                   items.begin() + j.mid,
-                                   items.begin() + j.hi, cmp);
-        } else {
-            std::atomic<size_t> next(0);
-            std::vector<std::thread> pool;
-            for (int t = 0; t < nt; t++)
-                pool.emplace_back([&]() {
-                    for (size_t j; (j = next.fetch_add(1)) < jobs.size();)
-                        std::inplace_merge(items.begin() + jobs[j].lo,
-                                           items.begin() + jobs[j].mid,
-                                           items.begin() + jobs[j].hi,
-                                           cmp);
-                });
-            for (auto& th : pool) th.join();
-        }
-    }
-    for (int64_t i = 0; i < n; i++) perm[i] = items[(size_t)i].idx;
 }
 
 }  // extern "C"

@@ -24,8 +24,8 @@ log = logging.getLogger(__name__)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))), "native")
-_SOURCES = ("ragged.cpp", "shuffle_server.cpp", "baseline_proxy.cpp",
-            "Makefile")
+_SOURCES = ("ragged.cpp", "spansort.cpp", "shuffle_server.cpp",
+            "baseline_proxy.cpp", "Makefile")
 
 
 def _build_dir() -> str:
@@ -134,6 +134,30 @@ def _load() -> "ctypes.CDLL | None":
                     ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
                     ctypes.c_int32]
                 lib.tz_merge_runs.restype = None
+            if hasattr(lib, "gather_fixed_u8"):
+                lib.gather_fixed_u8.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                    ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32]
+                lib.gather_fixed_u8.restype = None
+            if hasattr(lib, "tz_span_sort_emit"):
+                lib.tz_span_sort_emit.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                    ctypes.c_void_p, ctypes.c_int32,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_int32]
+                lib.tz_span_sort_emit.restype = ctypes.c_int32
+            if hasattr(lib, "tz_merge_emit"):
+                lib.tz_merge_emit.argtypes = [
+                    ctypes.c_int32,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_int32,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_int32]
+                lib.tz_merge_emit.restype = ctypes.c_int32
             if hasattr(lib, "pipelined_sorter_proxy"):
                 lib.pipelined_sorter_proxy.argtypes = [
                     ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
@@ -190,6 +214,134 @@ def gather_ragged_native(data: np.ndarray, offsets: np.ndarray,
         out.ctypes.data_as(ctypes.c_void_p),
         ctypes.c_int32(threads))
     return out, out_offsets
+
+
+def gather_fixed_native(data: np.ndarray, row_len: int, perm: np.ndarray
+                        ) -> Optional[np.ndarray]:
+    """Permute fixed-width rows: out[i] = data[perm[i]*row_len:+row_len].
+    Skips the per-row offset lookups of the ragged gather (compile-time
+    copy sizes for the common serde widths).  None when unavailable."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "gather_fixed_u8"):
+        return None
+    n = len(perm)
+    out = np.empty(n * row_len, dtype=np.uint8)
+    data = np.ascontiguousarray(data)
+    perm64 = np.ascontiguousarray(perm, dtype=np.int64)
+    lib.gather_fixed_u8(
+        data.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(row_len),
+        perm64.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(n),
+        out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int32(min(8, os.cpu_count() or 1)))
+    return out
+
+
+def span_sort_emit_native(key_bytes: np.ndarray, key_offsets: np.ndarray,
+                          val_bytes: np.ndarray, val_offsets: np.ndarray,
+                          num_partitions: int,
+                          partitions: Optional[np.ndarray],
+                          compute_hash: bool
+                          ) -> "Optional[tuple]":
+    """Fused producer span sort: partition (optionally fnv32 in C) + stable
+    (partition, key) sort + direct materialization of the sorted batch.
+    Returns (out_kb, out_ko, out_vb, out_vo, row_index) or None when the
+    native lib / symbol is unavailable."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tz_span_sort_emit"):
+        return None
+    n = len(key_offsets) - 1
+    key_bytes = np.ascontiguousarray(key_bytes)
+    key_offsets = np.ascontiguousarray(key_offsets, dtype=np.int64)
+    val_bytes = np.ascontiguousarray(val_bytes)
+    val_offsets = np.ascontiguousarray(val_offsets, dtype=np.int64)
+    parts_ptr = None
+    if partitions is not None:
+        partitions = np.ascontiguousarray(partitions, dtype=np.int32)
+        parts_ptr = partitions.ctypes.data_as(ctypes.c_void_p)
+    out_kb = np.empty(int(key_offsets[-1]), dtype=np.uint8)
+    out_ko = np.empty(n + 1, dtype=np.int64)
+    out_vb = np.empty(int(val_offsets[-1]), dtype=np.uint8)
+    out_vo = np.empty(n + 1, dtype=np.int64)
+    part_counts = np.empty(num_partitions, dtype=np.int64)
+    rc = lib.tz_span_sort_emit(
+        key_bytes.ctypes.data_as(ctypes.c_void_p),
+        key_offsets.ctypes.data_as(ctypes.c_void_p),
+        val_bytes.ctypes.data_as(ctypes.c_void_p),
+        val_offsets.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n), ctypes.c_int32(num_partitions), parts_ptr,
+        ctypes.c_int32(1 if compute_hash else 0),
+        out_kb.ctypes.data_as(ctypes.c_void_p),
+        out_ko.ctypes.data_as(ctypes.c_void_p),
+        out_vb.ctypes.data_as(ctypes.c_void_p),
+        out_vo.ctypes.data_as(ctypes.c_void_p),
+        None,   # out_parts: derivable from row_index, nobody consumes it
+        part_counts.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int32(min(8, os.cpu_count() or 1)))
+    if rc != 0:
+        return None
+    row_index = np.zeros(num_partitions + 1, dtype=np.int64)
+    np.cumsum(part_counts, out=row_index[1:])
+    return out_kb, out_ko, out_vb, out_vo, row_index
+
+
+def merge_emit_native(runs: "list", num_partitions: int
+                      ) -> "Optional[tuple]":
+    """Fused k-run merge: group-scan each (partition, key)-sorted run,
+    k-way merge group heads, emit contiguous segment copies (no concat, no
+    row gather).  `runs` is a list of (key_bytes, key_offsets, val_bytes,
+    val_offsets, row_index) tuples.  Returns (out_kb, out_ko, out_vb,
+    out_vo, row_index) or None when unavailable."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tz_merge_emit"):
+        return None
+    k = len(runs)
+    holders = []   # keep contiguous arrays alive across the call
+    kb_ptrs = (ctypes.c_void_p * k)()
+    ko_ptrs = (ctypes.c_void_p * k)()
+    vb_ptrs = (ctypes.c_void_p * k)()
+    vo_ptrs = (ctypes.c_void_p * k)()
+    ri_ptrs = (ctypes.c_void_p * k)()
+    nrows = np.empty(k, dtype=np.int64)
+    total_rows = total_kb = total_vb = 0
+    for i, (kb, ko, vb, vo, ri) in enumerate(runs):
+        kb = np.ascontiguousarray(kb)
+        ko = np.ascontiguousarray(ko, dtype=np.int64)
+        vb = np.ascontiguousarray(vb)
+        vo = np.ascontiguousarray(vo, dtype=np.int64)
+        ri = np.ascontiguousarray(ri, dtype=np.int64)
+        holders.extend((kb, ko, vb, vo, ri))
+        kb_ptrs[i] = kb.ctypes.data
+        ko_ptrs[i] = ko.ctypes.data
+        vb_ptrs[i] = vb.ctypes.data
+        vo_ptrs[i] = vo.ctypes.data
+        ri_ptrs[i] = ri.ctypes.data
+        n = len(ko) - 1
+        nrows[i] = n
+        total_rows += n
+        total_kb += int(ko[-1])
+        total_vb += int(vo[-1])
+    out_kb = np.empty(total_kb, dtype=np.uint8)
+    out_ko = np.empty(total_rows + 1, dtype=np.int64)
+    out_vb = np.empty(total_vb, dtype=np.uint8)
+    out_vo = np.empty(total_rows + 1, dtype=np.int64)
+    part_counts = np.empty(num_partitions, dtype=np.int64)
+    rc = lib.tz_merge_emit(
+        ctypes.c_int32(k), kb_ptrs, ko_ptrs, vb_ptrs, vo_ptrs,
+        nrows.ctypes.data_as(ctypes.c_void_p), ri_ptrs,
+        ctypes.c_int32(num_partitions),
+        out_kb.ctypes.data_as(ctypes.c_void_p),
+        out_ko.ctypes.data_as(ctypes.c_void_p),
+        out_vb.ctypes.data_as(ctypes.c_void_p),
+        out_vo.ctypes.data_as(ctypes.c_void_p),
+        None,   # out_parts: derivable from row_index, nobody consumes it
+        part_counts.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int32(min(8, os.cpu_count() or 1)))
+    del holders
+    if rc != 0:
+        return None
+    row_index = np.zeros(num_partitions + 1, dtype=np.int64)
+    np.cumsum(part_counts, out=row_index[1:])
+    return out_kb, out_ko, out_vb, out_vo, row_index
 
 
 class WordCountAggregator:

@@ -90,6 +90,16 @@ def gather_ragged(data: np.ndarray, offsets: np.ndarray,
     (native/ragged.cpp); numpy fancy indexing otherwise."""
     from tez_tpu.ops.native import MIN_NATIVE_BYTES
     if data.nbytes >= MIN_NATIVE_BYTES:
+        n_src = len(offsets) - 1
+        if n_src > 0:
+            w = int(offsets[1]) - int(offsets[0])
+            if 0 < w <= 64 and int(offsets[-1]) == n_src * w and \
+                    not bool((offsets[1:] != offsets[:-1] + w).any()):
+                from tez_tpu.ops.native import gather_fixed_native
+                fixed = gather_fixed_native(data, w, perm)
+                if fixed is not None:
+                    return fixed, np.arange(len(perm) + 1,
+                                            dtype=np.int64) * w
         from tez_tpu.ops.native import gather_ragged_native
         native = gather_ragged_native(data, offsets, perm)
         if native is not None:

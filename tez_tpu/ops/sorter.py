@@ -344,6 +344,20 @@ class DeviceSorter:
     def sort_batch(self, batch: KVBatch,
                    custom_partitions: Optional[np.ndarray] = None) -> Run:
         t0 = time.time()
+        if custom_partitions is not None:
+            # validate ONCE for every engine path: a short array would read
+            # past the buffer inside the native comparator and an
+            # out-of-range id would index past num_partitions-sized native
+            # buffers (heap corruption, not a python error)
+            if len(custom_partitions) != batch.num_records:
+                raise ValueError(
+                    "custom partitions must cover every record in the span")
+            if batch.num_records and (
+                    int(custom_partitions.min()) < 0 or
+                    int(custom_partitions.max()) >= self.num_partitions):
+                raise ValueError(
+                    f"partitioner returned ids outside "
+                    f"[0, {self.num_partitions})")
         # hybrid routing: tiny spans sort faster on host than a device
         # round-trip, even under the device engine
         engine = _route_engine(self.engine, batch.num_records,
@@ -386,8 +400,6 @@ class DeviceSorter:
         mat, lengths = pad_to_matrix(sort_bytes, sort_offsets, self.key_width)
         lanes = matrix_to_lanes(mat)
         if custom_partitions is not None:
-            assert len(custom_partitions) == batch.num_records, \
-                "custom partitions must cover every record in the span"
             partitions = custom_partitions
             if engine == "host":
                 from tez_tpu.ops.host_sort import host_sort_run
@@ -446,13 +458,28 @@ class DeviceSorter:
         overlap.  None when the native lib is unavailable (numpy lexsort
         path takes over)."""
         from tez_tpu.ops.native import (fnv32_partition_native,
-                                        sort_partition_keys_native)
+                                        sort_partition_keys_native,
+                                        span_sort_emit_native)
+        if self.key_normalizer is None:
+            # fused fast path: partition + stable sort + materialization in
+            # ONE native call — sorted key bytes emit sequentially (dedup
+            # path repeats each unique key in place), values follow the
+            # stable permutation; no Python-side take().  custom_parts
+            # length/range were validated at the sort_batch boundary.
+            fused = span_sort_emit_native(
+                batch.key_bytes, batch.key_offsets,
+                batch.val_bytes, batch.val_offsets,
+                self.num_partitions, custom_parts,
+                compute_hash=(custom_parts is None and
+                              self.partitioner == "hash"))
+            if fused is not None:
+                out_kb, out_ko, out_vb, out_vo, row_index = fused
+                self.counters.find_counter(TaskCounter.DEVICE_SORT_MILLIS)\
+                    .increment(int((time.time() - t0) * 1000))
+                return Run(KVBatch(out_kb, out_ko, out_vb, out_vo),
+                           row_index)
         parts: Optional[np.ndarray]
         if custom_parts is not None:
-            # same guard as the numpy path — a short array would read past
-            # the buffer inside the C comparator, not raise
-            assert len(custom_parts) == batch.num_records, \
-                "custom partitions must cover every record in the span"
             parts = custom_parts
         elif self.partitioner == "hash" and self.num_partitions > 1:
             parts = fnv32_partition_native(batch.key_bytes,
@@ -687,6 +714,27 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
     # any host sort regardless of size
     engine = _route_engine(engine, sum(r.batch.num_records for r in runs),
                            device_min_records)
+    if engine == "host" and key_normalizer is None:
+        # fused fast path: group-scan each sorted run, k-way merge group
+        # heads, emit contiguous segment copies — no concatenation and no
+        # per-row gather.  Equal (partition, key) groups emit in `runs`
+        # order (MergeQueue age semantics).
+        live = [r for r in runs if r.batch.num_records > 0]
+        if live and all(r.num_partitions == num_partitions for r in live):
+            from tez_tpu.ops.native import merge_emit_native
+            fused = merge_emit_native(
+                [(r.batch.key_bytes, r.batch.key_offsets,
+                  r.batch.val_bytes, r.batch.val_offsets, r.row_index)
+                 for r in live], num_partitions)
+            if fused is not None:
+                out_kb, out_ko, out_vb, out_vo, row_index = fused
+                if counters is not None:
+                    counters.find_counter(TaskCounter.DEVICE_MERGE_MILLIS)\
+                        .increment(int((time.time() - t0) * 1000))
+                    counters.increment(TaskCounter.MERGED_MAP_OUTPUTS,
+                                       len(runs))
+                return Run(KVBatch(out_kb, out_ko, out_vb, out_vo),
+                           row_index)
     batch = KVBatch.concat([r.batch for r in runs])
     partitions = np.concatenate([
         np.repeat(np.arange(r.num_partitions, dtype=np.int32),

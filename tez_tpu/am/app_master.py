@@ -111,7 +111,7 @@ class DAGAppMaster:
             from tez_tpu.am.web import WebUIService
             self.web_ui = WebUIService(self, port=conf.get(C.AM_WEB_PORT))
         self.history_handler = HistoryEventHandler(
-            logging_service, self.recovery_service)
+            logging_service, self.recovery_service, conf=conf)
         self.logging_service = logging_service
         self.executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=8, thread_name_prefix=f"am-exec-{app_id}")
@@ -297,6 +297,8 @@ class DAGAppMaster:
         self._dag_seq += 1
         dag_id = DAGId(self.app_id, self._dag_seq)
         plan_hex = plan.serialize().hex()
+        # per-DAG logging switch must be known before the first dag event
+        self.history_handler.set_dag_conf(dag_id, plan.dag_conf)
         self.history(HistoryEvent(
             HistoryEventType.DAG_SUBMITTED, dag_id=str(dag_id),
             data={"dag_name": plan.name,

@@ -175,6 +175,12 @@ def main() -> int:
     print(f"READY {server.port}", flush=True)
     try:
         server.shutdown_event.wait()   # set by shutdown_session (or Ctrl-C)
+        # linger before exit so a client that just triggered the shutdown
+        # can still fetch final status/diagnostics (reference:
+        # TEZ_AM_SLEEP_TIME_BEFORE_EXIT_MILLIS, DAGAppMaster sleep on exit)
+        linger_ms = float(conf.get(C.AM_SLEEP_TIME_BEFORE_EXIT_MS))
+        if linger_ms > 0:
+            time.sleep(linger_ms / 1000.0)
     except KeyboardInterrupt:
         am.stop()
     server.stop()

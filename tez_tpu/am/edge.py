@@ -218,21 +218,26 @@ class EdgeImpl:
             return len(self._events)
 
     # -- consumer side (on-demand pull) --------------------------------------
-    def get_events_for_task(self, dest_task: int, from_seq: int
+    def get_events_for_task(self, dest_task: int, from_seq: int,
+                            max_events: int = 0
                             ) -> Tuple[List[TezAPIEvent], int]:
         """Route events [from_seq:] for one destination task.  Returns the
-        routed events and the new high-water mark."""
+        routed events and the new high-water mark.  ``max_events`` > 0
+        stops consuming log entries once that many routed events are out
+        (tez.task.max-event-backlog); the high-water mark then points at
+        the first unconsumed entry so the rest arrive on later pulls."""
         with self._lock:
             snapshot = self._events[from_seq:]
-            new_seq = len(self._events)
+        consumed = 0
         out: List[TezAPIEvent] = []
         em = self.edge_manager
         for src_task, version, ev in snapshot:
+            routed: List[TezAPIEvent] = []
             if isinstance(ev, CompositeDataMovementEvent):
                 meta = em.route_composite_data_movement_event_to_destination(
                     src_task, dest_task)
                 if meta is not None:
-                    out.append(CompositeRoutedDataMovementEvent(
+                    routed.append(CompositeRoutedDataMovementEvent(
                         source_index=meta.source, target_index_start=meta.target,
                         count=meta.count, user_payload=ev.user_payload,
                         version=version))
@@ -241,7 +246,7 @@ class EdgeImpl:
                     src_task, ev.source_index, dest_task)
                 if meta is not None:
                     for t in meta.target_indices:
-                        out.append(DataMovementEvent(
+                        routed.append(DataMovementEvent(
                             source_index=ev.source_index,
                             user_payload=ev.user_payload,
                             target_index=t, version=version))
@@ -250,11 +255,20 @@ class EdgeImpl:
                     src_task, dest_task)
                 if meta is not None:
                     for t in meta.target_indices:
-                        out.append(InputFailedEvent(target_index=t,
-                                                    version=version))
+                        routed.append(InputFailedEvent(target_index=t,
+                                                       version=version))
             else:
-                out.append(ev)
-        return out, new_seq
+                routed.append(ev)
+            # strict cap: an entry whose expansion would overshoot is NOT
+            # consumed (unless nothing is out yet — progress guarantee for
+            # a single entry that expands past the whole cap)
+            if max_events and out and len(out) + len(routed) > max_events:
+                break
+            consumed += 1
+            out.extend(routed)
+            if max_events and len(out) >= max_events:
+                break
+        return out, from_seq + consumed
 
     def route_input_error_to_source(self, dest_task: int,
                                     failed_input_index: int) -> int:

@@ -216,13 +216,38 @@ class HistoryEventHandler:
     """
 
     def __init__(self, logging_service: HistoryLoggingService,
-                 recovery_service: "Any | None" = None):
+                 recovery_service: "Any | None" = None,
+                 conf: "Any | None" = None):
         self.logging_service = logging_service
         self.recovery_service = recovery_service
+        # master switch + per-DAG switch (reference: HistoryEventHandler
+        # .shouldLogEvent — TEZ_AM_HISTORY_LOGGING_ENABLED /
+        # TEZ_DAG_HISTORY_LOGGING_ENABLED; recovery journaling is NOT
+        # affected, only the logging service)
+        self.am_logging_enabled = bool(conf.get(
+            "tez.am.history.logging.enabled", True)) if conf else True
+        self._dag_logging_disabled: "set[str]" = set()
+
+    def set_dag_conf(self, dag_id: Any, dag_conf: Any) -> None:
+        """Record the per-DAG logging switch at submission."""
+        if dag_conf is not None and not bool(dag_conf.get(
+                "tez.dag.history.logging.enabled", True)):
+            self._dag_logging_disabled.add(str(dag_id))
 
     def handle(self, event: HistoryEvent) -> None:
         if self.recovery_service is not None:
             self.recovery_service.handle(event)
+        if not self.am_logging_enabled:
+            return
+        dag_id = getattr(event, "dag_id", None) or \
+            (event.data.get("dag_id") if isinstance(
+                getattr(event, "data", None), dict) else None)
+        if dag_id is not None and str(dag_id) in self._dag_logging_disabled:
+            # DAG over: drop its switch so a session AM serving many DAGs
+            # doesn't accumulate entries forever
+            if event.event_type is HistoryEventType.DAG_FINISHED:
+                self._dag_logging_disabled.discard(str(dag_id))
+            return
         self.logging_service.handle(event)
 
     @staticmethod

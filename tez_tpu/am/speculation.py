@@ -25,10 +25,8 @@ from tez_tpu.common import config as C
 
 log = logging.getLogger(__name__)
 
-#: Attempts younger than this are never speculated (reference:
-#: SOONEST_RETRY_AFTER_NO_SPECULATE spirit).
+#: Attempts younger than this are never speculated.
 MIN_RUNTIME_BEFORE_SPECULATION = 0.5
-SPECULATION_INTERVAL = 0.25
 
 
 class Speculator:
@@ -39,6 +37,19 @@ class Speculator:
         self.dag = dag
         self.ctx = dag.ctx
         self.threshold = dag.conf.get(C.SPECULATION_SLOWTASK_THRESHOLD)
+        # concurrent-speculation budget + scan pacing (reference:
+        # LegacySpeculator.maybeScheduleASpeculation / computeSpeculations)
+        self.min_allowed = dag.conf.get(C.SPECULATION_MIN_ALLOWED_TASKS)
+        self.prop_total = dag.conf.get(C.SPECULATION_PROPORTION_TOTAL)
+        self.prop_running = dag.conf.get(C.SPECULATION_PROPORTION_RUNNING)
+        self.retry_no_spec = max(
+            dag.conf.get(C.SPECULATION_RETRY_AFTER_NO_SPECULATE_MS), 50) \
+            / 1000.0
+        self.retry_spec = max(
+            dag.conf.get(C.SPECULATION_RETRY_AFTER_SPECULATE_MS), 50) \
+            / 1000.0
+        stvt = dag.conf.get(C.SPECULATION_SINGLE_TASK_VERTEX_TIMEOUT_MS)
+        self.single_task_timeout = stvt / 1000.0 if stvt >= 0 else None
         # fail fast on a bad estimator class name — a typo must surface at
         # DAG submit, not as a logged exception every scan forever
         create_estimator(dag.conf, "<probe>")
@@ -55,11 +66,18 @@ class Speculator:
         self._stop.set()
 
     def _loop(self) -> None:
-        while not self._stop.wait(SPECULATION_INTERVAL):
+        # adaptive pacing: back off hard after launching a speculation (it
+        # needs time to prove itself), rescan on the no-speculate cadence
+        # otherwise (reference: SOONEST_RETRY_AFTER_SPECULATE /
+        # _NO_SPECULATE — the operator's value is honored as-is)
+        wait = self.retry_no_spec
+        while not self._stop.wait(wait):
+            speculated = 0
             try:
-                self._scan()
+                speculated = self._scan()
             except BaseException:  # noqa: BLE001
                 log.exception("speculator scan failed")
+            wait = self.retry_spec if speculated else self.retry_no_spec
 
     def _estimator(self, vertex: Any) -> TaskRuntimeEstimator:
         est = self.estimators.get(vertex.name)
@@ -68,12 +86,32 @@ class Speculator:
             self.estimators[vertex.name] = est
         return est
 
-    def _scan(self) -> None:
+    def _speculation_budget(self) -> int:
+        """How many NEW speculative attempts may launch now: the cap is
+        max(minimum floor, proportion of total tasks, proportion of running
+        tasks) minus speculations already in flight (reference:
+        LegacySpeculator.computeSpeculations)."""
+        total = running = in_flight = 0
+        for vertex in self.dag.vertices.values():
+            for task in vertex.tasks.values():
+                total += 1
+                if task.state is TaskState.RUNNING:
+                    running += 1
+                    if len(task.live_attempts()) > 1:
+                        in_flight += 1
+        cap = max(self.min_allowed,
+                  int(self.prop_total * total),
+                  int(self.prop_running * running))
+        return cap - in_flight
+
+    def _scan(self) -> int:
         from tez_tpu.am.dag_impl import TERMINAL_DAG_STATES
         if self.dag.state in TERMINAL_DAG_STATES:
             self._stop.set()
-            return
+            return 0
         now = time.time()
+        budget = self._speculation_budget()
+        speculated = 0
         for vertex in self.dag.vertices.values():
             est = self._estimator(vertex)
             # feed newly completed durations into the vertex statistics
@@ -84,8 +122,17 @@ class Speculator:
                     self._fed_durations.add(att.attempt_id)
                     est.attempt_succeeded(att.finish_time - att.launch_time)
                     est.forget(att.attempt_id)  # prune per-attempt state
+            if budget - speculated <= 0:
+                break      # concurrent-speculation budget exhausted
             new_runtime = est.estimated_new_attempt_runtime()
             if new_runtime is None:
+                # a single-task vertex never produces a sibling-completion
+                # estimate; the reference gates it on a wall-clock timeout
+                # instead (single.task.vertex.timeout, -1 = never)
+                if self.single_task_timeout is not None and \
+                        len(vertex.tasks) == 1:
+                    speculated += self._maybe_speculate_single_task(
+                        vertex, now)
                 continue   # nothing completed yet: no replacement estimate
             best_task = None
             best_value = 0.0
@@ -129,3 +176,26 @@ class Speculator:
                          new_runtime, att.progress)
                 self.ctx.dispatch(TaskEvent(
                     TaskEventType.T_ADD_SPEC_ATTEMPT, best_task.task_id))
+                speculated += 1
+        return speculated
+
+    def _maybe_speculate_single_task(self, vertex: Any, now: float) -> int:
+        """Wall-clock speculation for one-task vertices (reference:
+        TEZ_AM_LEGACY_SPECULATIVE_SINGLE_TASK_VERTEX_TIMEOUT)."""
+        task = next(iter(vertex.tasks.values()))
+        if task.state is not TaskState.RUNNING:
+            return 0
+        live = task.live_attempts()
+        if len(live) != 1:
+            return 0
+        att = live[0]
+        if att.state is not TaskAttemptState.RUNNING or not att.launch_time:
+            return 0
+        if now - att.launch_time <= self.single_task_timeout:
+            return 0
+        log.info("speculating single-task vertex attempt %s "
+                 "(runtime %.2fs > timeout %.2fs)", att.attempt_id,
+                 now - att.launch_time, self.single_task_timeout)
+        self.ctx.dispatch(TaskEvent(
+            TaskEventType.T_ADD_SPEC_ATTEMPT, task.task_id))
+        return 1

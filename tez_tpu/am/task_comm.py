@@ -194,7 +194,15 @@ class TaskCommunicatorManager:
             if self.ctx.current_dag else None
         if vertex is None:
             return []
-        out = vertex.get_task_events(attempt_id.task_id.id, session.edge_seqs)
+        # bound one heartbeat response (tez.task.max-event-backlog): a
+        # 10k-source fan-in must stream events across heartbeats, not ship
+        # one giant response that stalls the umbilical
+        from tez_tpu.common import config as C
+        max_events = int(self.ctx.conf.get(C.TASK_MAX_EVENT_BACKLOG)) \
+            if getattr(self.ctx, "conf", None) is not None else 0
+        out = vertex.get_task_events(attempt_id.task_id.id,
+                                     session.edge_seqs,
+                                     max_events=max_events)
         with self._lock:
             if session.custom_events:
                 out.extend(("__custom__", ev) for ev in session.custom_events)

@@ -70,7 +70,9 @@ class TaskAttemptImpl:
         self.finish_time: float = 0.0
         self.creation_time: float = time.time()
         self.is_speculative = False
+        self.is_rescheduled = False   # re-run after output loss
         self.output_failure_reports: Dict[int, int] = {}  # consumer task -> count
+        self.first_output_failure_time = 0.0
         # (edge dest vertex name, event) pairs this attempt produced — journaled
         # on success so AM recovery can re-route them without re-running the
         # task (reference: TaskAttemptFinishedEvent taGeneratedEvents).
@@ -97,10 +99,17 @@ class TaskAttemptImpl:
 
     # -- transition hooks ----------------------------------------------------
     def _on_schedule(self, event: TaskAttemptEvent) -> None:
+        # a reschedule after output loss blocks live consumers: boost it
+        # ahead of its vertex's normal work (reference:
+        # TEZ_AM_TASK_RESCHEDULE_HIGHER_PRIORITY; lower value = sooner)
+        priority = self.vertex.priority
+        if self.is_rescheduled and bool(self.vertex.conf.get(
+                "tez.am.task.reschedule.higher.priority", True)):
+            priority -= 1
         self.ctx.dispatch(SchedulerEvent(
             SchedulerEventType.S_TA_LAUNCH_REQUEST,
             attempt_id=self.attempt_id, task_spec=event.task_spec,
-            priority=self.vertex.priority))
+            priority=priority))
 
     def _on_started(self, event: TaskAttemptEvent) -> None:
         self.container_id = getattr(event, "container_id", None)
@@ -168,6 +177,8 @@ class TaskAttemptImpl:
         (or a local-fetch/source-disk error) fail the SUCCEEDED attempt so
         the task re-runs (reference: SURVEY.md §3.5 fetch-failure path)."""
         consumer = getattr(event, "consumer_task_index", -1)
+        if not self.output_failure_reports:
+            self.first_output_failure_time = time.time()
         self.output_failure_reports[consumer] = \
             self.output_failure_reports.get(consumer, 0) + 1
         max_failures = self.vertex.conf.get("tez.am.max.allowed.output.failures", 10)
@@ -176,10 +187,18 @@ class TaskAttemptImpl:
         fraction = len(self.output_failure_reports) / num_consumers
         max_fraction = self.vertex.conf.get(
             "tez.am.max.allowed.output.failures.fraction", 0.1)
+        # reports persisting past this window fail the output regardless of
+        # counts — consumers have been stuck on it for too long (reference:
+        # TEZ_AM_MAX_ALLOWED_TIME_FOR_TASK_READ_ERROR_SEC)
+        max_window = float(self.vertex.conf.get(
+            "tez.am.max.allowed.time-sec.for-read-error", 300))
+        window_expired = \
+            time.time() - self.first_output_failure_time > max_window
         local_fetch = getattr(event, "is_local_fetch", False)
         disk_error = getattr(event, "is_disk_error_at_source", False)
         total = sum(self.output_failure_reports.values())
-        if local_fetch or disk_error or total >= max_failures or fraction > max_fraction:
+        if local_fetch or disk_error or total >= max_failures or \
+                fraction > max_fraction or window_expired:
             log.info("attempt %s: output lost (%d reports) -> re-running task",
                      self.attempt_id, total)
             self.sm.force_state(TaskAttemptState.FAILED)
@@ -291,11 +310,13 @@ class TaskImpl:
         return self.commit_attempt == attempt_id
 
     # -- hooks ---------------------------------------------------------------
-    def _spawn_attempt(self, speculative: bool = False) -> TaskAttemptImpl:
+    def _spawn_attempt(self, speculative: bool = False,
+                       rescheduled: bool = False) -> TaskAttemptImpl:
         n = self.next_attempt_number
         self.next_attempt_number += 1
         att = TaskAttemptImpl(self.task_id.attempt(n), self.vertex)
         att.is_speculative = speculative
+        att.is_rescheduled = rescheduled
         self.attempts[n] = att
         spec = self.vertex.build_task_spec(att.attempt_id)
         att.handle(TaskAttemptEvent(TaskAttemptEventType.TA_SCHEDULE,
@@ -467,7 +488,7 @@ class TaskImpl:
         self.ctx.dispatch(VertexEvent(
             VertexEventType.V_TASK_RESCHEDULED, self.task_id.vertex_id,
             task_id=self.task_id, failed_version=failed_version))
-        self._spawn_attempt()
+        self._spawn_attempt(rescheduled=True)
 
     def _finish_history(self, final_state: str) -> None:
         data = {"state": final_state, "vertex_name": self.vertex.name,

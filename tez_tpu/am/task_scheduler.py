@@ -17,6 +17,7 @@ import heapq
 import itertools
 import logging
 import threading
+import time
 from typing import Any, Dict, List, Optional, Set, TYPE_CHECKING
 
 from tez_tpu.am.events import (SchedulerEvent, SchedulerEventType,
@@ -62,10 +63,13 @@ class LocalTaskSchedulerService(TaskSchedulerService):
         self._available = threading.Condition(self._lock)
         self._heap: List[Any] = []
         self._seq = itertools.count()
-        self._queued: Set[TaskAttemptId] = set()
+        self._queued: Dict[TaskAttemptId, float] = {}   # -> enqueue time
         self._priorities: Dict[TaskAttemptId, int] = {}
         self._running: Dict[TaskAttemptId, ContainerId] = {}
         self._preempting: Set[TaskAttemptId] = set()
+        self._last_preempt_round = 0.0
+        self._preempt_retry: "threading.Timer | None" = None
+        self._vertex_running: Dict[Any, int] = {}   # vertex_id -> count
         self._container_failures: Dict[Any, int] = {}
         self._blacklisted: Set[Any] = set()
         self._shutdown = False
@@ -75,7 +79,7 @@ class LocalTaskSchedulerService(TaskSchedulerService):
         with self._lock:
             heapq.heappush(self._heap,
                            (priority, next(self._seq), attempt_id, task_spec))
-            self._queued.add(attempt_id)
+            self._queued[attempt_id] = time.time()
             self._priorities[attempt_id] = priority
             self._available.notify()
         self.ctx.ensure_runners(self.backlog())
@@ -105,13 +109,46 @@ class LocalTaskSchedulerService(TaskSchedulerService):
             # best waiting priority from the heap head, lazily discarding
             # entries cancelled while queued
             best_waiting = None
+            best_att = None
             while self._heap:
                 p, _s, a, _spec = self._heap[0]
                 if a in self._queued:
                     best_waiting = p
+                    best_att = a
                     break
                 heapq.heappop(self._heap)
             if best_waiting is None:
+                return
+            # pacing (reference: heartbeats-between-preemptions x the AM-RM
+            # heartbeat period): preemption rounds keep a minimum spacing so
+            # one burst of schedule() calls doesn't serially kill a slot's
+            # whole complement — UNLESS the top request has waited past
+            # max.wait-time-ms, which forces a round
+            now = time.time()
+            hb_between = int(conf.get(C.AM_PREEMPTION_HEARTBEATS_BETWEEN)) \
+                if conf is not None else 3
+            max_wait_ms = int(conf.get(C.AM_PREEMPTION_MAX_WAIT_MS)) \
+                if conf is not None else 60_000
+            spacing = hb_between * 0.25   # 250 ms AM heartbeat period analog
+            waited = now - self._queued.get(best_att, now)
+            if self._last_preempt_round and \
+                    now - self._last_preempt_round < spacing and \
+                    waited * 1000 < max_wait_ms:
+                # paced out — but _maybe_preempt only runs from schedule(),
+                # so arm a one-shot retry or the deferred round (and the
+                # max-wait force) would never fire without new submissions
+                if self._preempt_retry is None:
+                    delay = spacing - (now - self._last_preempt_round)
+
+                    def _retry() -> None:
+                        with self._lock:
+                            self._preempt_retry = None
+                        self._maybe_preempt()
+
+                    t = threading.Timer(max(delay, 0.05), _retry)
+                    t.daemon = True
+                    self._preempt_retry = t
+                    t.start()
                 return
             self._preempting &= set(self._running)
             budget = limit - len(self._preempting)
@@ -126,6 +163,8 @@ class LocalTaskSchedulerService(TaskSchedulerService):
                  and eligible(att)),
                 key=lambda x: -x[0])[:budget]
             self._preempting.update(att for _, att in victims)
+            if victims:
+                self._last_preempt_round = now
         for prio, att in victims:
             log.info("preempting %s (priority %d) for waiting priority %d",
                      att, prio, best_waiting)
@@ -143,10 +182,20 @@ class LocalTaskSchedulerService(TaskSchedulerService):
     def deallocate(self, attempt_id: TaskAttemptId,
                    failed: bool = False) -> None:
         with self._lock:
-            self._queued.discard(attempt_id)
+            self._queued.pop(attempt_id, None)
             self._preempting.discard(attempt_id)
             self._priorities.pop(attempt_id, None)
             container = self._running.pop(attempt_id, None)
+            if container is not None:
+                vid = attempt_id.vertex_id
+                n = self._vertex_running.get(vid, 0) - 1
+                if n > 0:
+                    self._vertex_running[vid] = n
+                else:
+                    self._vertex_running.pop(vid, None)
+                # a finished attempt may unblock work deferred by the
+                # vertex concurrency cap: wake waiting runners to re-pop
+                self._available.notify_all()
             if failed and container is not None:
                 n = self._container_failures.get(container, 0) + 1
                 self._container_failures[container] = n
@@ -172,25 +221,49 @@ class LocalTaskSchedulerService(TaskSchedulerService):
         """Runner pull (the allocation point).  Returns None on idle timeout,
         shutdown, or when this container is blacklisted (the runner exits
         and the pool replaces it — container loss recovery)."""
+        conf = getattr(self.ctx, "conf", None)
+        max_conc = int(conf.get("tez.am.vertex.max-task-concurrency", -1)) \
+            if conf is not None else -1
         with self._lock:
             if container_id in self._blacklisted:
                 return None
             while True:
+                deferred: List[Any] = []
+                handout = None
                 while self._heap:
-                    prio, seq, attempt_id, spec = heapq.heappop(self._heap)
+                    entry = heapq.heappop(self._heap)
+                    prio, seq, attempt_id, spec = entry
                     if attempt_id not in self._queued:
                         continue  # cancelled while queued
-                    self._queued.discard(attempt_id)
+                    if max_conc > 0 and self._vertex_running.get(
+                            attempt_id.vertex_id, 0) >= max_conc:
+                        # vertex at its concurrency cap
+                        # (tez.am.vertex.max-task-concurrency): skip, try
+                        # the next entry, re-queue the skipped ones
+                        deferred.append(entry)
+                        continue
+                    self._queued.pop(attempt_id, None)
                     self._running[attempt_id] = container_id
-                    return spec
+                    self._vertex_running[attempt_id.vertex_id] = \
+                        self._vertex_running.get(attempt_id.vertex_id, 0) + 1
+                    handout = spec
+                    break
+                for entry in deferred:
+                    heapq.heappush(self._heap, entry)
+                if handout is not None:
+                    return handout
                 if self._shutdown:
                     return None
                 if not self._available.wait(timeout):
                     return None
 
+
     def shutdown(self) -> None:
         with self._lock:
             self._shutdown = True
+            if self._preempt_retry is not None:
+                self._preempt_retry.cancel()
+                self._preempt_retry = None
             self._available.notify_all()
 
 

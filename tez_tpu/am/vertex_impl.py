@@ -748,14 +748,22 @@ class VertexImpl:
 
     # ------------------------------------------------ consumer event pull
     def get_task_events(self, task_index: int,
-                        seqs: Dict[str, int]) -> List[tuple]:
+                        seqs: Dict[str, int],
+                        max_events: int = 0) -> List[tuple]:
         """Pull routed events for one of this vertex's tasks as
         (input_name, event) pairs.  ``seqs`` maps in-edge id -> consumed
-        high-water mark, updated in place."""
+        high-water mark, updated in place.  ``max_events`` > 0 bounds one
+        pull (tez.task.max-event-backlog): the high-water marks only
+        advance past what was returned, so the remainder arrives on later
+        heartbeats instead of one giant response."""
         out: List[tuple] = []
         for edge in self.in_edges.values():
+            if max_events and len(out) >= max_events:
+                return out
             seq = seqs.get(edge.id, 0)
-            events, new_seq = edge.get_events_for_task(task_index, seq)
+            limit = max_events - len(out) if max_events else 0
+            events, new_seq = edge.get_events_for_task(task_index, seq,
+                                                       max_events=limit)
             seqs[edge.id] = new_seq
             out.extend((edge.source_vertex.name, e) for e in events)
         # root input events, delivered once

@@ -8,6 +8,7 @@ address instead of a ZK registry).
 from __future__ import annotations
 
 import logging
+import socket
 import threading
 import time
 from typing import Any, Optional
@@ -80,8 +81,26 @@ class RemoteFrameworkClient:
         secrets = JobTokenSecretManager(bytes.fromhex(token))
         from tez_tpu.common.tls import client_context
         ssl_ctx = client_context(self.conf)
-        self.am = RemoteAMProxy(host, int(port), secrets,
-                                ssl_context=ssl_ctx)
+        # per-call RPC timeout (tez.client.timeout-ms) + a connect retry
+        # window for a session AM that is still coming up
+        # (tez.session.client.timeout.secs; reference: TezClient.start
+        # waiting for the session AM to accept connections)
+        rpc_timeout = max(
+            float(self.conf.get("tez.client.timeout-ms", 60_000)) / 1000.0,
+            1.0)
+        start_wait = float(self.conf.get(
+            "tez.session.client.timeout.secs", 120))
+        deadline = time.time() + max(start_wait, 0)
+        while True:
+            try:
+                self.am = RemoteAMProxy(host, int(port), secrets,
+                                        timeout=rpc_timeout,
+                                        ssl_context=ssl_ctx)
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.5)
         # Keepalive on its OWN connection (the main proxy is not safe for
         # interleaved calls): an idle-but-alive client must not trip the
         # AM's session expiry (reference: TezClient.sendAMHeartbeat:568).
@@ -89,6 +108,7 @@ class RemoteFrameworkClient:
             "tez.client.am.heartbeat.interval.secs", 5))
         if interval > 0:
             self._hb_proxy = RemoteAMProxy(host, int(port), secrets,
+                                           timeout=rpc_timeout,
                                            ssl_context=ssl_ctx)
 
             def _beat() -> None:
@@ -106,9 +126,34 @@ class RemoteFrameworkClient:
         if self._hb_proxy is not None:
             self._hb_proxy.close()
             self._hb_proxy = None
-        if self.am is not None:
-            self.am.close()
-            self.am = None
+        if self.am is None:
+            return
+        # session mode: stopping the client ends the session AM (reference:
+        # TezClient.stop -> shutdownSession).  asynchronous-stop (the
+        # reference default) fires the RPC and returns; synchronous stop
+        # polls until the AM port actually closes so callers can rely on
+        # the session being gone.
+        if bool(self.conf.get("tez.session.mode", False)):
+            try:
+                self.am.shutdown_session()
+            except Exception:  # noqa: BLE001 — AM already gone
+                pass
+            if not bool(self.conf.get("tez.client.asynchronous-stop", True)):
+                addr = str(self.conf.get("tez.am.address", ""))
+                host, _, port = addr.partition(":")
+                wait_ms = float(self.conf.get(
+                    "tez.client.diagnostics.wait.timeout-ms", 15_000))
+                deadline = time.time() + wait_ms / 1000.0
+                while time.time() < deadline:
+                    try:
+                        with socket.create_connection(
+                                (host, int(port)), timeout=1.0):
+                            pass
+                        time.sleep(0.2)   # still listening: AM lingering
+                    except OSError:
+                        break             # port closed: session is down
+        self.am.close()
+        self.am = None
 
     def submit_dag(self, plan: Any) -> Any:
         return self.am.submit_dag(plan)

@@ -104,6 +104,14 @@ TASK_MAX_FAILED_ATTEMPTS = _key("tez.am.task.max.failed.attempts", 4, Scope.VERT
 MAX_ALLOWED_OUTPUT_FAILURES = _key("tez.am.max.allowed.output.failures", 10, Scope.VERTEX)
 MAX_ALLOWED_OUTPUT_FAILURES_FRACTION = _key(
     "tez.am.max.allowed.output.failures.fraction", 0.1, Scope.VERTEX)
+MAX_ALLOWED_TIME_FOR_READ_ERROR_SEC = _key(
+    "tez.am.max.allowed.time-sec.for-read-error", 300, Scope.VERTEX,
+    "Output-failure reports persisting past this window fail the source "
+    "attempt regardless of counts (consumers stuck too long)")
+TASK_RESCHEDULE_HIGHER_PRIORITY = _key(
+    "tez.am.task.reschedule.higher.priority", True, Scope.VERTEX,
+    "Re-runs after output loss schedule ahead of their vertex's normal "
+    "priority (they block live consumers)")
 NODE_BLACKLISTING_ENABLED = _key("tez.am.node-blacklisting.enabled", True, Scope.AM)
 NODE_BLACKLISTING_FAILURE_THRESHOLD = _key(
     "tez.am.node-blacklisting.ignore-threshold-node-percent", 33, Scope.AM,
@@ -136,12 +144,40 @@ SPECULATION_STAGNATED_MS = _key(
 SPECULATION_SKIP_INITIALS = _key(
     "tez.am.legacy.speculative.exponential.skip.initials", 8, Scope.VERTEX,
     "progress samples to observe before trusting the smoothed estimate")
+SPECULATION_MIN_ALLOWED_TASKS = _key(
+    "tez.am.minimum.allowed.speculative.tasks", 10, Scope.VERTEX,
+    "floor of the concurrent-speculation cap (reference: "
+    "LegacySpeculator.minimumAllowedSpeculativeTasks)")
+SPECULATION_PROPORTION_TOTAL = _key(
+    "tez.am.proportion.total.tasks.speculatable", 0.01, Scope.VERTEX,
+    "cap component: this fraction of ALL tasks may speculate at once")
+SPECULATION_PROPORTION_RUNNING = _key(
+    "tez.am.proportion.running.tasks.speculatable", 0.1, Scope.VERTEX,
+    "cap component: this fraction of RUNNING tasks may speculate at once")
+SPECULATION_RETRY_AFTER_NO_SPECULATE_MS = _key(
+    "tez.am.soonest.retry.after.no.speculate", 1000, Scope.VERTEX,
+    "rescan delay when the last scan launched nothing")
+SPECULATION_RETRY_AFTER_SPECULATE_MS = _key(
+    "tez.am.soonest.retry.after.speculate", 15_000, Scope.VERTEX,
+    "rescan delay after launching a speculation (let it prove itself)")
+SPECULATION_SINGLE_TASK_VERTEX_TIMEOUT_MS = _key(
+    "tez.am.legacy.speculative.single.task.vertex.timeout", -1, Scope.VERTEX,
+    "single-task vertices have no sibling completions to estimate from; "
+    "speculate their attempt on this wall-clock timeout instead "
+    "(-1 = never, the reference default)")
 DAG_RECOVERY_ENABLED = _key("tez.dag.recovery.enabled", True, Scope.AM)
 RECOVERY_TRUSTED_STAGING = _key(
     "tez.dag.recovery.trusted-staging", False, Scope.AM,
     "allow pickle-encoded journal payloads during recovery replay (only "
     "safe when the staging dir is writable solely by the framework)")
 DAG_RECOVERY_FLUSH_INTERVAL_SECS = _key("tez.dag.recovery.flush.interval.secs", 30, Scope.AM)
+AM_HISTORY_LOGGING_ENABLED = _key(
+    "tez.am.history.logging.enabled", True, Scope.AM,
+    "Master switch for the history logging service (recovery journaling "
+    "is unaffected); reference: TEZ_AM_HISTORY_LOGGING_ENABLED")
+DAG_HISTORY_LOGGING_ENABLED = _key(
+    "tez.dag.history.logging.enabled", True, Scope.DAG,
+    "Per-DAG history-logging off switch (set in the DAG conf)")
 HISTORY_LOGGING_SERVICE_CLASS = _key(
     "tez.history.logging.service.class",
     "tez_tpu.am.history:InMemoryHistoryLoggingService", Scope.AM)
@@ -153,6 +189,18 @@ AM_COMMIT_ALL_OUTPUTS_ON_SUCCESS = _key(
     "tez.am.commit-all-outputs-on-dag-success", True, Scope.DAG,
     "Reference: commit at DAG success vs per-vertex commit (DAGImpl commit modes)")
 AM_PREEMPTION_PERCENTAGE = _key("tez.am.preemption.percentage", 10, Scope.AM)
+AM_PREEMPTION_HEARTBEATS_BETWEEN = _key(
+    "tez.am.preemption.heartbeats-between-preemptions", 3, Scope.AM,
+    "Minimum spacing between preemption rounds, in 250 ms AM-heartbeat "
+    "periods (reference: TEZ_AM_PREEMPTION_HEARTBEATS_BETWEEN_PREEMPTIONS)")
+AM_PREEMPTION_MAX_WAIT_MS = _key(
+    "tez.am.preemption.max.wait-time-ms", 60_000, Scope.AM,
+    "A top-priority request waiting longer than this forces a preemption "
+    "round regardless of pacing")
+AM_VERTEX_MAX_TASK_CONCURRENCY = _key(
+    "tez.am.vertex.max-task-concurrency", -1, Scope.AM,
+    "Cap on simultaneously RUNNING tasks per vertex (-1 = unlimited); "
+    "queued work from other vertices fills the skipped slots")
 AM_TASK_SCHEDULER_CLASS = _key(
     "tez.am.task.scheduler.class", "local", Scope.AM,
     "'local' (priority heap, unrestricted preemption), 'dag-aware' "
@@ -162,6 +210,24 @@ AM_CLIENT_HEARTBEAT_TIMEOUT_SECS = _key(
     "tez.am.client.heartbeat.timeout.secs", -1, Scope.AM,
     "Session AM shuts down after this long without any client request "
     "(-1 = never); clients keep sessions alive automatically")
+CLIENT_TIMEOUT_MS = _key(
+    "tez.client.timeout-ms", 60_000, Scope.CLIENT,
+    "Per-RPC socket timeout for remote-AM calls")
+SESSION_CLIENT_TIMEOUT_SECS = _key(
+    "tez.session.client.timeout.secs", 120, Scope.CLIENT,
+    "How long start() retries connecting to a session AM that is still "
+    "coming up (reference: TEZ_SESSION_CLIENT_TIMEOUT_SECS)")
+CLIENT_ASYNCHRONOUS_STOP = _key(
+    "tez.client.asynchronous-stop", True, Scope.CLIENT,
+    "Session stop(): fire shutdown_session and return (True, reference "
+    "default) vs poll until the AM port closes (False)")
+CLIENT_DIAGNOSTICS_WAIT_TIMEOUT_MS = _key(
+    "tez.client.diagnostics.wait.timeout-ms", 15_000, Scope.CLIENT,
+    "Bound on the synchronous-stop wait for AM exit")
+AM_SLEEP_TIME_BEFORE_EXIT_MS = _key(
+    "tez.am.sleep.time.before.exit.millis", 0, Scope.AM,
+    "Standalone AM lingers this long after session shutdown so clients "
+    "can fetch final status (reference: DAGAppMaster exit sleep)")
 CLIENT_AM_HEARTBEAT_INTERVAL_SECS = _key(
     "tez.client.am.heartbeat.interval.secs", 5, Scope.CLIENT,
     "Remote-client keepalive ping interval (0 disables); reference: "
@@ -169,6 +235,27 @@ CLIENT_AM_HEARTBEAT_INTERVAL_SECS = _key(
 DAG_SCHEDULER_CLASS = _key("tez.am.dag.scheduler.class",
                            "tez_tpu.am.dag_scheduler:DAGSchedulerNaturalOrder", Scope.AM)
 THREAD_DUMP_INTERVAL_MS = _key("tez.thread.dump.interval.ms", 0, Scope.VERTEX)
+TASK_HBM_BUDGET_BYTES = _key(
+    "tez.task.hbm.budget.bytes", 2 << 30, Scope.VERTEX,
+    "Per-task HBM budget the MemoryDistributor arbitrates (TPU delta of "
+    "the reference's JVM-heap scaling)")
+TASK_SCALE_MEMORY_RESERVE_FRACTION = _key(
+    "tez.task.scale.memory.reserve-fraction", 0.05, Scope.VERTEX,
+    "Budget fraction held back from component grants (reference: "
+    "TEZ_TASK_SCALE_MEMORY_RESERVE_FRACTION; smaller here — no JVM "
+    "overhead to reserve for)")
+TASK_SCALE_MEMORY_RATIOS = _key(
+    "tez.task.scale.memory.ratios", "", Scope.VERTEX,
+    "'TYPE=WEIGHT,...' oversubscription weights per component type "
+    "(reference: WeightedScalingMemoryDistributor ratios); '' = defaults")
+TASK_SCALE_MEMORY_ALLOCATOR = _key(
+    "tez.task.scale.memory.allocator.class", "weighted", Scope.VERTEX,
+    "'weighted' (WeightedScalingMemoryDistributor) or 'uniform' "
+    "(ScalingAllocator: every request scales by the same factor)")
+TASK_MAX_EVENT_BACKLOG = _key(
+    "tez.task.max-event-backlog", 10_000, Scope.VERTEX,
+    "Max routed events per heartbeat response; the remainder streams on "
+    "later heartbeats (reference: TezTaskAttemptListener maxEventsToGet)")
 TASK_AM_HEARTBEAT_INTERVAL_MS = _key(
     "tez.task.am.heartbeat.interval-ms", 50, Scope.VERTEX,
     "TaskReporter heartbeat period (reference: "
@@ -177,6 +264,12 @@ COUNTERS_MAX = _key("tez.counters.max", 1200, Scope.AM,
                     "Counter-per-group cap (Limits.java)")
 COUNTERS_MAX_GROUPS = _key("tez.counters.max.groups", 500, Scope.AM,
                            "Counter-group cap (Limits.java)")
+COUNTERS_COUNTER_NAME_MAX_LEN = _key(
+    "tez.counters.counter-name.max-length", 64, Scope.AM,
+    "Counter names truncate to this before lookup (Limits.java)")
+COUNTERS_GROUP_NAME_MAX_LEN = _key(
+    "tez.counters.group-name.max-length", 256, Scope.AM,
+    "Counter-group names truncate to this (Limits.java)")
 SHUFFLE_VM_AUTO_PARALLEL = _key(
     "tez.shuffle-vertex-manager.enable.auto-parallel", False, Scope.VERTEX,
     "Let ShuffleVertexManager shrink consumer parallelism from observed "

@@ -21,10 +21,12 @@ class Limits:
     tez.counters.max / tez.counters.max.groups, Limits.setConfiguration)."""
     DEFAULT_MAX_COUNTERS = 1200
     DEFAULT_MAX_GROUPS = 500
+    DEFAULT_MAX_COUNTER_NAME_LEN = 64
+    DEFAULT_MAX_GROUP_NAME_LEN = 256
     MAX_COUNTERS = DEFAULT_MAX_COUNTERS
     MAX_GROUPS = DEFAULT_MAX_GROUPS
-    MAX_COUNTER_NAME_LEN = 64
-    MAX_GROUP_NAME_LEN = 256
+    MAX_COUNTER_NAME_LEN = DEFAULT_MAX_COUNTER_NAME_LEN
+    MAX_GROUP_NAME_LEN = DEFAULT_MAX_GROUP_NAME_LEN
 
     @classmethod
     def configure(cls, conf: Any) -> None:
@@ -35,6 +37,12 @@ class Limits:
                                             cls.DEFAULT_MAX_COUNTERS))
             cls.MAX_GROUPS = int(conf.get("tez.counters.max.groups",
                                           cls.DEFAULT_MAX_GROUPS))
+            cls.MAX_COUNTER_NAME_LEN = int(conf.get(
+                "tez.counters.counter-name.max-length",
+                cls.DEFAULT_MAX_COUNTER_NAME_LEN))
+            cls.MAX_GROUP_NAME_LEN = int(conf.get(
+                "tez.counters.group-name.max-length",
+                cls.DEFAULT_MAX_GROUP_NAME_LEN))
         except (TypeError, ValueError, AttributeError):
             pass
 

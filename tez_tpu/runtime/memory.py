@@ -46,11 +46,38 @@ class _Request:
     granted: int = 0
 
 
+def parse_weight_ratios(spec: str) -> Optional[dict]:
+    """Parse 'TYPE=WEIGHT,...' overrides (reference format:
+    tez.task.scale.memory.ratios, WeightedScalingMemoryDistributor
+    .populateTypeScaleMap).  Unknown types are accepted (custom component
+    types weight themselves); malformed specs return None and the caller
+    keeps the defaults."""
+    if not spec:
+        return None
+    out = dict(DEFAULT_WEIGHTS)
+    try:
+        for part in spec.split(","):
+            name, _, w = part.strip().partition("=")
+            if not name or not w:
+                return None
+            out[name.strip()] = int(w)
+    except ValueError:
+        return None
+    return out
+
+
 class MemoryDistributor:
     def __init__(self, budget_bytes: int = DEFAULT_TASK_BUDGET,
-                 weights: Optional[dict] = None):
-        self.budget = int(budget_bytes * (1 - RESERVE_FRACTION))
-        self.weights = weights or DEFAULT_WEIGHTS
+                 weights: Optional[dict] = None,
+                 reserve_fraction: float = RESERVE_FRACTION,
+                 weighted: bool = True):
+        reserve_fraction = min(max(float(reserve_fraction), 0.0), 1.0)
+        self.budget = int(budget_bytes * (1 - reserve_fraction))
+        # weighted=False: uniform scaling (reference ScalingAllocator —
+        # every component type scales by the same factor)
+        self.weights = (weights or DEFAULT_WEIGHTS) if weighted \
+            else {k: 1 for k in DEFAULT_WEIGHTS}
+        self._weighted = weighted
         self._requests: List[_Request] = []
         self._allocated = False
 

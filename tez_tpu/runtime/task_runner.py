@@ -45,9 +45,18 @@ class TaskRunner:
         self.work_dir = work_dir
         self.node_id = node_id
         self.counters = TezCounters()
+        from tez_tpu.runtime.memory import (RESERVE_FRACTION,
+                                            parse_weight_ratios)
         self.memory = MemoryDistributor(
             int(spec.conf.get("tez.task.hbm.budget.bytes",
-                              DEFAULT_TASK_BUDGET)))
+                              DEFAULT_TASK_BUDGET)),
+            weights=parse_weight_ratios(
+                str(spec.conf.get("tez.task.scale.memory.ratios", ""))),
+            reserve_fraction=float(spec.conf.get(
+                "tez.task.scale.memory.reserve-fraction", RESERVE_FRACTION)),
+            weighted=str(spec.conf.get(
+                "tez.task.scale.memory.allocator.class",
+                "weighted")) != "uniform")
         self.progress = 0.0
         self.service_metadata: Dict[str, Any] = service_metadata or {
             "shuffle": {"host": node_id, "port": 0}}

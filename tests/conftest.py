@@ -23,3 +23,12 @@ import pytest  # noqa: E402
 @pytest.fixture()
 def tmp_staging(tmp_path):
     return str(tmp_path / "staging")
+
+
+@pytest.fixture(autouse=True)
+def _disarm_fault_plane():
+    """The fault plane is process-global; a test that leaks armed rules
+    would poison every later test in the session."""
+    yield
+    from tez_tpu.common import faults
+    faults.clear_all()

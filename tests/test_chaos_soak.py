@@ -1,0 +1,235 @@
+"""Chaos soak scenarios: compound fault storms driven by the deterministic
+fault plane, each checked bit-exact against a fault-free run.
+
+Three compound scenarios (fetch+blacklist+speculation, AM-kill+recovery
+replay, corrupt-spill+CRC-quarantine+rerun) plus a fixed-seed tier-1 smoke
+of the `python -m tez_tpu.tools.chaos` harness and a multi-seed slow soak.
+"""
+import os
+import time
+
+import pytest
+
+from tez_tpu.am.app_master import DAGAppMaster
+from tez_tpu.am.dag_impl import DAGState
+from tez_tpu.am.history import HistoryEventType
+from tez_tpu.client.dag_client import DAGStatusState
+from tez_tpu.client.tez_client import TezClient
+from tez_tpu.common import config as C
+from tez_tpu.common import faults
+from tez_tpu.common.payload import (InputDescriptor, OutputDescriptor,
+                                    ProcessorDescriptor)
+from tez_tpu.dag.dag import DAG, Edge, Vertex
+from tez_tpu.dag.edge_property import (DataMovementType, DataSourceType,
+                                       EdgeProperty, SchedulingType)
+from tez_tpu.library.processors import SimpleProcessor
+from tez_tpu.tools import chaos
+
+CONF_KV = {"tez.runtime.key.class": "bytes",
+           "tez.runtime.value.class": "long"}
+
+
+def _sg_edge(producer, consumer):
+    return Edge.create(producer, consumer, EdgeProperty.create(
+        DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create(
+            "tez_tpu.library.outputs:OrderedPartitionedKVOutput",
+            payload=CONF_KV),
+        InputDescriptor.create(
+            "tez_tpu.library.inputs:OrderedGroupedKVInput",
+            payload=CONF_KV)))
+
+
+def _emit_count_dag(name, result_path, consumer_cls=None, payload=None):
+    producer = Vertex.create("producer", ProcessorDescriptor.create(
+        chaos.ChaosEmitProcessor), 2)
+    consumer = Vertex.create("consumer", ProcessorDescriptor.create(
+        consumer_cls or chaos.ChaosCountProcessor,
+        payload=payload or {"result_path": result_path}), 1)
+    dag = DAG.create(name).add_vertex(producer).add_vertex(consumer)
+    dag.add_edge(_sg_edge(producer, consumer))
+    return dag
+
+
+def _read(path):
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+def _run_one(tmp_path, name, dag, extra_conf=None, timeout=90):
+    """Fresh client per run so counters/history are per-scenario. Returns
+    (state, am) — am outlives the stopped client for forensics."""
+    client = TezClient.create(name, {
+        "tez.staging-dir": str(tmp_path / name / "staging"),
+        "tez.am.local.num-containers": 4,
+        **(extra_conf or {})}).start()
+    try:
+        status = client.submit_dag(dag).wait_for_completion(timeout=timeout)
+        return status.state, client.framework_client.am
+    finally:
+        client.stop()
+
+
+# ---------------------------------------------------------------- tier-1
+
+def test_storm_generation_deterministic():
+    for seed in (0, 7, 1234):
+        assert chaos.make_storm(seed) == chaos.make_storm(seed)
+        for rule in chaos.make_storm(seed).split(";"):
+            assert rule in chaos.STORM_MENU
+    assert chaos.make_storm(0) != chaos.make_storm(1)
+
+
+def test_chaos_smoke_fixed_seed(tmp_path):
+    """Fast fixed-seed run of the chaos harness: one baseline DAG + one
+    storm DAG, bit-exact (the CLI equivalent:
+    `python -m tez_tpu.tools.chaos --seed 1234`)."""
+    ok, spec, detail = chaos.run_trial(1234, str(tmp_path))
+    assert ok, f"storm [{spec}] diverged: {detail}"
+
+
+def test_scenario_fetch_blacklist_speculation(tmp_path):
+    """Compound storm: a producer attempt is delayed into straggling (bait
+    for the speculator), two injected task failures blacklist the only
+    local node (which must then be force-activated), and a fetch read
+    fails once — the DAG still succeeds with bit-exact output."""
+    base_state, base_am = _run_one(
+        tmp_path, "base1", _emit_count_dag(
+            "base1", str(tmp_path / "base1.txt")))
+    assert base_state is DAGStatusState.SUCCEEDED
+    baseline = _read(str(tmp_path / "base1.txt"))
+
+    result = str(tmp_path / "storm1.txt")
+    dag = _emit_count_dag("storm1", result)
+    dag.set_conf("tez.am.speculation.enabled", True)
+    dag.set_conf("tez.am.legacy.speculative.slowtask.threshold", 1.0)
+    dag.set_conf("tez.am.soonest.retry.after.no.speculate", 200)
+    # order matters: the delay rule (scoped to producer task 0 attempt 0 by
+    # the match filter) must claim before the broad fail rule
+    dag.set_conf("tez.test.fault.spec",
+                 "task.run:delay:ms=3000,n=1,match=_00_000000_0;"
+                 "task.run:fail:n=2,exc=runtime;"
+                 "shuffle.fetch.read:fail:n=1,exc=io")
+    dag.set_conf("tez.test.fault.seed", 1)
+    state, am = _run_one(tmp_path, "storm1", dag, extra_conf={
+        "tez.am.maxtaskfailures.per.node": 2,
+        "tez.am.task.max.failed.attempts": 4})
+    assert state is DAGStatusState.SUCCEEDED
+    assert _read(result) == baseline
+    # both node-health transitions made it into the history stream
+    assert am.logging_service.of_type(HistoryEventType.NODE_BLACKLISTED)
+    assert am.logging_service.of_type(HistoryEventType.NODE_FORCED_ACTIVE)
+    d = am.dag_counters.to_dict().get("DAGCounter", {})
+    assert d.get("NUM_SPECULATIONS", 0) >= 1
+
+
+class GatedChaosCountProcessor(SimpleProcessor):
+    """ChaosCountProcessor behind a sentinel-file gate (payload: gate_path,
+    result_path) — lets the test crash the AM while the consumer holds."""
+
+    def run(self, inputs, outputs):
+        payload = self.context.user_payload.load() or {}
+        while not os.path.exists(payload["gate_path"]):
+            time.sleep(0.05)
+        reader = inputs["producer"].get_reader()
+        totals = {k: sum(vs) for k, vs in reader}
+        lines = [f"{k.decode()} {v}" for k, v in sorted(totals.items())]
+        with open(payload["result_path"], "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+
+
+def test_scenario_am_kill_recovery_replay(tmp_staging, tmp_path):
+    """Compound storm: journal appends/fsyncs are slowed and a task attempt
+    is failed while the AM is killed mid-DAG; the successor AM replays the
+    journal, short-circuits the finished producer, and the released
+    consumer produces bit-exact output."""
+    # fault-free baseline (gate pre-opened)
+    base_gate = str(tmp_path / "base_gate")
+    open(base_gate, "w").close()
+    base_result = str(tmp_path / "base2.txt")
+    base_state, _ = _run_one(tmp_path, "base2", _emit_count_dag(
+        "base2", base_result, consumer_cls=GatedChaosCountProcessor,
+        payload={"gate_path": base_gate, "result_path": base_result}))
+    assert base_state is DAGStatusState.SUCCEEDED
+    baseline = _read(base_result)
+
+    gate = str(tmp_path / "gate")
+    result = str(tmp_path / "storm2.txt")
+    dag = _emit_count_dag("storm2", result,
+                          consumer_cls=GatedChaosCountProcessor,
+                          payload={"gate_path": gate, "result_path": result})
+    dag.set_conf("tez.test.fault.spec",
+                 "am.recovery.append:delay:ms=10,n=5;"
+                 "am.recovery.fsync:delay:ms=10,n=5;"
+                 "task.run:fail:n=1,exc=runtime,match=_00_000")
+    dag.set_conf("tez.test.fault.seed", 2)
+    plan = dag.create_dag_plan()
+
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging,
+                               "tez.am.local.num-containers": 3,
+                               "tez.am.task.max.failed.attempts": 4})
+    am1 = DAGAppMaster("app_1_chaos", conf, attempt=1)
+    am1.start()
+    am1.submit_dag(plan)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = am1.current_dag.status_dict()
+        if st["vertices"].get("producer", {}).get("state") == "SUCCEEDED":
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("producer vertex never finished under storm")
+    am1.stop()               # crash while the consumer is gated
+
+    am2 = DAGAppMaster("app_1_chaos", conf, attempt=2)
+    am2.start()
+    recovered = am2.recover_and_resume()
+    assert recovered is not None
+    open(gate, "w").close()
+    assert am2.wait_for_dag(recovered, timeout=60) is DAGState.SUCCEEDED
+    assert _read(result) == baseline
+    # producer restored from the journal, not re-run
+    d = am2.dag_counters.to_dict().get("DAGCounter", {})
+    assert d.get("TOTAL_LAUNCHED_TASKS", 0) == 1
+    am2.stop()
+
+
+def test_scenario_corrupt_spill_quarantine_rerun(tmp_path):
+    """Compound storm: a fetched shuffle payload is corrupted in flight;
+    the CRC check rejects it, the consumer quarantines the source and the
+    producer re-runs — output stays bit-exact."""
+    base_state, _ = _run_one(tmp_path, "base3", _emit_count_dag(
+        "base3", str(tmp_path / "base3.txt")))
+    assert base_state is DAGStatusState.SUCCEEDED
+    baseline = _read(str(tmp_path / "base3.txt"))
+
+    result = str(tmp_path / "storm3.txt")
+    dag = _emit_count_dag("storm3", result)
+    dag.set_conf("tez.test.fault.spec", "shuffle.data:corrupt:n=1")
+    dag.set_conf("tez.test.fault.seed", 3)
+    state, am = _run_one(tmp_path, "storm3", dag)
+    assert state is DAGStatusState.SUCCEEDED
+    assert _read(result) == baseline
+    # the corruption really fired ...
+    assert any(p == "shuffle.data" and a == "corrupt"
+               for (p, _d, a) in faults.plane().journal)
+    # ... and forced a producer re-run beyond the fault-free 3 tasks
+    d = am.dag_counters.to_dict().get("DAGCounter", {})
+    assert d.get("TOTAL_LAUNCHED_TASKS", 0) >= 4
+
+
+@pytest.mark.slow
+def test_chaos_soak_multi_seed(tmp_path):
+    """Soak: consecutive seeded storms, all bit-exact vs one baseline."""
+    state, baseline = chaos._run_dag(str(tmp_path), "baseline")
+    assert state == DAGStatusState.SUCCEEDED.name and baseline
+    failures = []
+    for seed in range(10):
+        ok, spec, detail = chaos.run_trial(seed, str(tmp_path),
+                                           baseline=baseline)
+        if not ok:
+            failures.append((seed, spec, detail))
+    assert not failures, (
+        f"{failures}; repro: python -m tez_tpu.tools.chaos "
+        f"--seed {failures[0][0]}")

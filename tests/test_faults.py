@@ -1,0 +1,141 @@
+"""Unit tests for the deterministic fault plane (tez_tpu/common/faults.py)."""
+import pytest
+
+from tez_tpu.common import faults
+from tez_tpu.common.faults import format_spec, parse_spec
+
+
+def test_parse_spec_roundtrip():
+    spec = ("shuffle.fetch.read:fail:n=2,exc=io;"
+            "task.run:delay:ms=300,match=_00_000000_0;"
+            "spill.read:corrupt:n=1;"
+            "am.heartbeat:pfail:p=0.25,n=5")
+    rules = parse_spec(spec)
+    assert [r.point for r in rules] == [
+        "shuffle.fetch.read", "task.run", "spill.read", "am.heartbeat"]
+    assert rules[0].times == 2 and rules[0].exc == "io"
+    assert rules[1].delay_ms == 300 and rules[1].match == "_00_000000_0"
+    assert rules[3].prob == 0.25
+    assert format_spec(parse_spec(format_spec(rules))) == format_spec(rules)
+
+
+@pytest.mark.parametrize("bad", [
+    "task.run",                      # no mode
+    "task.run:explode",              # unknown mode
+    "task.run:fail:exc=nuclear",     # unknown exc
+    "task.run:fail:volume=11",       # unknown param
+    "task.run:fail:n=0",             # never fires
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+def test_fail_n_times_budget():
+    faults.install("t", parse_spec("p.x:fail:n=2,exc=io"), seed=1)
+    for _ in range(2):
+        with pytest.raises(IOError):
+            faults.fire("p.x")
+    faults.fire("p.x")   # budget exhausted: no-op
+    assert [a for (_, _, a) in faults.plane().journal] == ["fail", "fail"]
+
+
+def test_exc_kinds():
+    faults.install("t", parse_spec(
+        "a:fail:exc=conn,n=1;b:fail:exc=timeout,n=1;c:fail:exc=perm,n=1"))
+    with pytest.raises(ConnectionError):
+        faults.fire("a")
+    with pytest.raises(TimeoutError):
+        faults.fire("b")
+    with pytest.raises(PermissionError):
+        faults.fire("c")
+
+
+def test_match_filters_on_detail():
+    faults.install("t", parse_spec("task.run:fail:match=_00_000000_0,n=1"))
+    faults.fire("task.run", "attempt_1_x_1_00_000001_0")   # no match: no-op
+    with pytest.raises(ConnectionError):
+        faults.fire("task.run", "attempt_1_x_1_00_000000_0")
+
+
+def test_pfail_deterministic_across_installs():
+    def draw(seed):
+        faults.clear_all()
+        faults.install("t", parse_spec("p:pfail:p=0.5"), seed=seed)
+        out = []
+        for _ in range(20):
+            try:
+                faults.fire("p")
+                out.append(0)
+            except ConnectionError:
+                out.append(1)
+        return out
+
+    a, b, c = draw(7), draw(7), draw(8)
+    assert a == b                      # same (spec, seed): same schedule
+    assert a != c                      # different seed: different schedule
+    assert 0 < sum(a) < 20             # actually probabilistic
+
+
+def test_delay_sleeps(monkeypatch):
+    slept = []
+    import tez_tpu.common.faults as F
+    monkeypatch.setattr(F.time, "sleep", lambda s: slept.append(s))
+    faults.install("t", parse_spec("p:delay:ms=250,n=1"))
+    faults.fire("p")
+    assert slept == [0.25]
+    faults.fire("p")       # budget spent
+    assert slept == [0.25]
+
+
+def test_corrupt_bytes_flips_exactly_one_byte_below_lo_respected():
+    faults.install("t", parse_spec("p:corrupt:n=1"), seed=3)
+    data = bytes(range(100))
+    out = faults.corrupt_bytes("p", "d", data, lo=19)
+    diff = [i for i in range(100) if out[i] != data[i]]
+    assert len(diff) == 1 and diff[0] >= 19
+    # budget spent: second call is a no-op
+    assert faults.corrupt_bytes("p", "d", data, lo=19) is data
+
+
+def test_corrupt_position_deterministic():
+    def flip(seed):
+        faults.clear_all()
+        faults.install("t", parse_spec("p:corrupt"), seed=seed)
+        data = bytes(100)
+        out = faults.corrupt_bytes("p", "d", data)
+        return next(i for i in range(100) if out[i] != data[i])
+
+    assert flip(11) == flip(11)
+
+
+def test_scope_isolation_and_disarm():
+    faults.install("dag1", parse_spec("p:fail"))
+    faults.install("dag2", parse_spec("q:fail"))
+    assert faults.armed()
+    faults.clear("dag1")
+    assert faults.armed()              # dag2 still holds rules
+    with pytest.raises(ConnectionError):
+        faults.fire("q")
+    faults.fire("p")                   # dag1's rule is gone
+    faults.clear("dag2")
+    assert not faults.armed()          # fast path restored
+
+
+def test_disarmed_is_free():
+    faults.clear_all()
+    faults.fire("anything", "detail")          # all no-ops
+    assert not faults.should_corrupt("x")
+    data = b"abc"
+    assert faults.corrupt_bytes("x", "d", data) is data
+
+
+def test_install_from_conf():
+    from tez_tpu.common import config as C
+    conf = C.TezConfiguration({
+        "tez.test.fault.spec": "p:fail:n=1", "tez.test.fault.seed": 9})
+    assert faults.install_from_conf(conf, scope="dag_x")
+    with pytest.raises(ConnectionError):
+        faults.fire("p")
+    empty = C.TezConfiguration({})
+    assert not faults.install_from_conf(empty, scope="dag_y")

@@ -133,7 +133,13 @@ def test_penalty_box_backoff_then_recovery():
     then recovers and serves its whole queue."""
     hub, col = FakeHub(), Collector()
     hub.fail_hosts["bad"] = 2
-    sched = _mk(hub, col, num_fetchers=2)
+
+    class MaxDraw:           # pin full jitter at its envelope so the
+        @staticmethod        # elapsed-time floor below stays deterministic
+        def uniform(a, b):
+            return b
+
+    sched = _mk(hub, col, num_fetchers=2, penalty_rng=MaxDraw())
     try:
         t0 = time.time()
         for i in range(4):

@@ -100,6 +100,7 @@ class DAGAppMaster:
             if recovery_enabled else None
         from tez_tpu.am.node_map import AMNodeTracker
         self.node_tracker = AMNodeTracker(conf)
+        self.node_tracker.on_transition = self._on_node_transition
         from tez_tpu.am.heartbeat import HeartbeatMonitor
         self.heartbeat_monitor = HeartbeatMonitor(self)
         from tez_tpu.runtime.diagnostics import ThreadDumpHelper
@@ -228,6 +229,22 @@ class DAGAppMaster:
     def history(self, event: HistoryEvent) -> None:
         self.history_handler.handle(event)
 
+    def _on_node_transition(self, node_id: str, state: Any,
+                            failures: int) -> None:
+        """AMNodeTracker observer: make blacklist flaps attributable in the
+        history stream (chaos-run forensics + NodeHealthAnalyzer input)."""
+        from tez_tpu.am.node_map import NodeState
+        kind = {
+            NodeState.BLACKLISTED: HistoryEventType.NODE_BLACKLISTED,
+            NodeState.FORCED_ACTIVE: HistoryEventType.NODE_FORCED_ACTIVE,
+        }.get(state)
+        if kind is None:
+            return   # ACTIVE reverts carry no dedicated event (yet)
+        dag = self.current_dag
+        self.history(HistoryEvent(
+            kind, dag_id=str(dag.dag_id) if dag is not None else None,
+            data={"node_id": node_id, "failures": failures}))
+
     def history_vertex_configured(self, vertex: Any) -> None:
         data = {"vertex_name": vertex.name, "num_tasks": vertex.num_tasks}
         reconfig = getattr(vertex, "_reconfig_journal", None)
@@ -282,6 +299,8 @@ class DAGAppMaster:
         speculator = getattr(dag, "speculator", None)
         if speculator is not None:
             speculator.stop()
+        from tez_tpu.common import faults
+        faults.clear(str(dag.dag_id))
         with self._dag_done:
             self.completed_dags[str(dag.dag_id)] = final
             self.completed_dag_names[str(dag.dag_id)] = dag.name
@@ -326,6 +345,10 @@ class DAGAppMaster:
             from tez_tpu.am.speculation import Speculator
             dag.speculator = Speculator(dag)
             dag.speculator.start()
+        # fault plane (test/chaos only): rules arm with the DAG and disarm
+        # with it in on_dag_finished — per-DAG scoping
+        from tez_tpu.common import faults
+        faults.install_from_conf(dag.conf, scope=str(dag_id))
         self.dispatch(DAGEvent(DAGEventType.DAG_INIT, dag_id))
         self.dispatch(DAGEvent(DAGEventType.DAG_START, dag_id))
         return dag_id

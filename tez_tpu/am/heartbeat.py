@@ -13,6 +13,7 @@ from typing import Any
 
 from tez_tpu.am.events import TaskAttemptEvent, TaskAttemptEventType
 from tez_tpu.common import config as C
+from tez_tpu.common import faults
 
 log = logging.getLogger(__name__)
 
@@ -43,6 +44,10 @@ class HeartbeatMonitor:
                 log.exception("heartbeat check failed")
 
     def _check(self) -> None:
+        # delay mode stalls the liveness sweep itself (failure detection
+        # latency under chaos); the loop's BaseException guard absorbs fail
+        # mode into a logged, skipped tick
+        faults.fire("am.heartbeat.monitor")
         # Watchdog for the runner pool: a runner deciding to idle-exit still
         # counts as capacity at schedule time, so queued work could strand
         # with nothing re-triggering a spawn.  Re-examine the backlog every

@@ -42,6 +42,10 @@ class HistoryEventType(enum.Enum):
     TASK_ATTEMPT_FINISHED = enum.auto()
     CONTAINER_LAUNCHED = enum.auto()
     CONTAINER_STOPPED = enum.auto()
+    # robustness transitions (AMNodeImpl state changes): node_id rides in
+    # data — nodes are hosts, not DAG-scoped entities
+    NODE_BLACKLISTED = enum.auto()
+    NODE_FORCED_ACTIVE = enum.auto()
 
 
 #: Events whose loss recovery cannot tolerate — flushed synchronously.

@@ -17,6 +17,7 @@ from typing import Any, Dict, Optional
 
 from tez_tpu.am.history import HistoryEvent, HistoryEventType
 from tez_tpu.api.runtime import ObjectRegistry
+from tez_tpu.common import faults
 from tez_tpu.common.counters import DAGCounter
 from tez_tpu.common.ids import ContainerId
 
@@ -71,6 +72,14 @@ class RunnerPool:
         registry = ObjectRegistry()
         tasks_run = 0
         try:
+            try:
+                faults.fire("am.container.launch", detail=str(container_id))
+            except Exception as e:  # noqa: BLE001 — injected launch failure
+                # dies like a container that crashed at startup: the finally
+                # emits CONTAINER_STOPPED and the watchdog respawns while
+                # backlog remains
+                log.warning("container %s launch failed: %s", container_id, e)
+                return
             while not self._stopped:
                 spec = self.ctx.task_comm.get_task(container_id,
                                                    timeout=self.idle_timeout)
@@ -174,6 +183,11 @@ class SubprocessRunnerPool:
                 env["PYTHONPATH"] = repo_root + (
                     os.pathsep + existing if existing else "")
                 cid = f"container_proc_{self.ctx.app_id}_{n:06d}"
+                try:
+                    faults.fire("am.container.launch", detail=cid)
+                except Exception as e:  # noqa: BLE001 — injected failure
+                    log.warning("container %s launch failed: %s", cid, e)
+                    break   # retried on the watchdog's next ensure_runners
                 from tez_tpu.common import config as C
                 reuse = self.ctx.conf.get(C.AM_CONTAINER_REUSE_ENABLED)
                 cmd = [sys.executable, "-m",

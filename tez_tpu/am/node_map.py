@@ -16,7 +16,7 @@ from __future__ import annotations
 import enum
 import logging
 import threading
-from typing import Any, Dict
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
 
@@ -38,21 +38,40 @@ class AMNodeTracker:
         self._failures: Dict[str, int] = {}
         self._states: Dict[str, NodeState] = {}
         self._ignoring = False
+        #: observer for state transitions: (node_id, new_state, failures).
+        #: Invoked OUTSIDE the lock (the AM's handler emits history events,
+        #: which may re-enter tracker queries).
+        self.on_transition: Optional[
+            Callable[[str, NodeState, int], None]] = None
+
+    def _notify(self, transitions: List[Tuple[str, NodeState, int]]) -> None:
+        """Fire collected transitions after the lock is released."""
+        cb = self.on_transition
+        if cb is None:
+            return
+        for node, state, failures in transitions:
+            try:
+                cb(node, state, failures)
+            except Exception:  # noqa: BLE001 — observers must not wedge
+                log.exception("node transition observer failed")
 
     # -- bookkeeping ---------------------------------------------------------
     def node_seen(self, node_id: str) -> None:
         if not node_id:
             return
+        transitions: List[Tuple[str, NodeState, int]] = []
         with self._lock:
             if node_id not in self._states:
                 self._states[node_id] = NodeState.ACTIVE
                 # fleet grew: the blacklisted fraction changed, so a
                 # FORCED_ACTIVE node may have to revert to BLACKLISTED
-                self._recompute_ignore_locked()
+                self._recompute_ignore_locked(transitions)
+        self._notify(transitions)
 
     def on_attempt_failed(self, node_id: str) -> None:
         if not node_id or not self.enabled:
             return
+        transitions: List[Tuple[str, NodeState, int]] = []
         with self._lock:
             self._states.setdefault(node_id, NodeState.ACTIVE)
             n = self._failures.get(node_id, 0) + 1
@@ -62,7 +81,9 @@ class AMNodeTracker:
                 self._states[node_id] = NodeState.BLACKLISTED
                 log.warning("node %s blacklisted after %d task failures",
                             node_id, n)
-                self._recompute_ignore_locked()
+                transitions.append((node_id, NodeState.BLACKLISTED, n))
+                self._recompute_ignore_locked(transitions)
+        self._notify(transitions)
 
     def on_attempt_succeeded(self, node_id: str) -> None:
         """Reference semantics: success does not clear the failure count
@@ -71,12 +92,16 @@ class AMNodeTracker:
     def node_gone(self, node_id: str) -> None:
         """A node left the fleet (host decommissioned): drop its state so
         stale blacklist entries don't skew the ignore-threshold math."""
+        transitions: List[Tuple[str, NodeState, int]] = []
         with self._lock:
             self._states.pop(node_id, None)
             self._failures.pop(node_id, None)
-            self._recompute_ignore_locked()
+            self._recompute_ignore_locked(transitions)
+        self._notify(transitions)
 
-    def _recompute_ignore_locked(self) -> None:
+    def _recompute_ignore_locked(
+            self, transitions: Optional[
+                List[Tuple[str, NodeState, int]]] = None) -> None:
         total = len(self._states)
         blacklisted = sum(1 for s in self._states.values()
                           if s in (NodeState.BLACKLISTED,
@@ -92,6 +117,11 @@ class AMNodeTracker:
                 self._states[node] = NodeState.FORCED_ACTIVE
             elif not ignore and s is NodeState.FORCED_ACTIVE:
                 self._states[node] = NodeState.BLACKLISTED
+            else:
+                continue
+            if transitions is not None:
+                transitions.append((node, self._states[node],
+                                    self._failures.get(node, 0)))
 
     # -- queries -------------------------------------------------------------
     def is_usable(self, node_id: str) -> bool:

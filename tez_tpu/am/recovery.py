@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional, Set
 
 from tez_tpu.am.history import HistoryEvent, HistoryEventType
 from tez_tpu.common import config as C
+from tez_tpu.common import faults
 from tez_tpu.dag.plan import DAGPlan
 
 log = logging.getLogger(__name__)
@@ -49,8 +50,10 @@ class RecoveryService:
     def handle(self, event: HistoryEvent) -> None:
         if self._fh is None:
             return
+        faults.fire("am.recovery.append", detail=event.event_type.name)
         self._fh.write(event.to_json() + "\n")
         if event.is_summary:
+            faults.fire("am.recovery.fsync", detail=event.event_type.name)
             self._fh.flush()
             os.fsync(self._fh.fileno())
             self._last_flush = time.time()

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 from tez_tpu.api.events import TezAPIEvent, TezEvent
 from tez_tpu.am.events import (TaskAttemptEvent, TaskAttemptEventType,
                                VertexEvent, VertexEventType)
+from tez_tpu.common import faults
 from tez_tpu.common.counters import TezCounters
 from tez_tpu.common.ids import ContainerId, TaskAttemptId
 from tez_tpu.runtime.task_spec import TaskSpec
@@ -87,6 +88,10 @@ class TaskCommunicatorManager:
         return spec
 
     def heartbeat(self, request: HeartbeatRequest) -> HeartbeatResponse:
+        # delay mode here starves the liveness monitor (the runner's
+        # heartbeat thread stalls before the AM sees the beat); fail mode
+        # surfaces as an umbilical fault on the runner side
+        faults.fire("am.heartbeat", detail=str(request.attempt_id))
         session = self._session(request.attempt_id)
         session.last_heartbeat = time.time()
         if request.events or request.progress != session.last_progress:

@@ -28,6 +28,7 @@ import struct
 import threading
 from typing import Any, Optional, Tuple
 
+from tez_tpu.common import faults
 from tez_tpu.common.security import JobTokenSecretManager
 
 log = logging.getLogger(__name__)
@@ -106,6 +107,9 @@ class _Handler(socketserver.StreamRequestHandler):
                     _send_msg(self.wfile, (False, f"no method {method}"))
                     continue
                 try:
+                    # inside the try: an injected fault ships to the runner
+                    # as a failed RPC (what a dying AM thread looks like)
+                    faults.fire("am.umbilical", detail=method)
                     result = getattr(comm, method)(*args, **kwargs)
                     _send_msg(self.wfile, (True, result))
                 except BaseException as e:  # noqa: BLE001 — ship to runner

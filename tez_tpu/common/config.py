@@ -185,6 +185,17 @@ HISTORY_LOG_DIR = _key("tez.history.logging.log-dir", "", Scope.AM)
 AM_NUM_CONTAINERS = _key("tez.am.local.num-containers", 0, Scope.AM,
                          "Local-mode executor slots; 0 = cpu count")
 GENERATE_DEBUG_ARTIFACTS = _key("tez.generate.debug.artifacts", False, Scope.DAG)
+TEST_FAULT_SPEC = _key(
+    "tez.test.fault.spec", "", Scope.DAG,
+    "Fault-injection rules armed for this DAG (test/chaos only): "
+    "'point:mode[:k=v,..]' rules joined by ';' — modes fail|pfail|delay|"
+    "corrupt, params n/p/ms/exc/match.  See tez_tpu.common.faults and "
+    "docs/fault_injection.md.  Empty = fault plane disarmed (zero cost)")
+TEST_FAULT_SEED = _key(
+    "tez.test.fault.seed", 0, Scope.DAG,
+    "Seed for the fault plane's deterministic schedule; the same "
+    "(spec, seed) pair replays the identical fault storm "
+    "(python -m tez_tpu.tools.chaos --seed N prints repro seeds)")
 AM_COMMIT_ALL_OUTPUTS_ON_SUCCESS = _key(
     "tez.am.commit-all-outputs-on-dag-success", True, Scope.DAG,
     "Reference: commit at DAG success vs per-vertex commit (DAGImpl commit modes)")

@@ -22,6 +22,7 @@ from tez_tpu.api.events import (CompositeRoutedDataMovementEvent,
                                 TezAPIEvent)
 from tez_tpu.api.runtime import (KeyValueReader, KeyValuesReader,
                                  LogicalInput, MergedLogicalInput, Reader)
+from tez_tpu.common import faults
 from tez_tpu.common.counters import TaskCounter
 from tez_tpu.ops.runformat import KVBatch, adjacent_equal_rows
 from tez_tpu.ops.serde import Serde, get_serde
@@ -32,6 +33,14 @@ log = logging.getLogger(__name__)
 
 
 from tez_tpu.library.util import conf_get as _conf_get  # noqa: E402
+
+
+def _is_checksum_error(e: Exception) -> bool:
+    """Run.from_bytes signals payload damage as IOError('checksum mismatch
+    in ...' / 'bad run magic in ...') — the only OSErrors that mean the
+    bytes (not the transport) are bad."""
+    return isinstance(e, IOError) and \
+        ("checksum mismatch" in str(e) or "bad run magic" in str(e))
 
 
 class _SlotState:
@@ -128,6 +137,7 @@ class ShuffleFetchTable:
     def _fetch_local(self, payload: ShufflePayload,
                      partition: int) -> KVBatch:
         """Same-host short-circuit (Fetcher.java:288 local-disk fetch)."""
+        faults.fire("shuffle.fetch.read", detail=payload.path_component)
         batch = self.service.fetch_partition(
             payload.path_component, payload.spill_id, partition)
         self.context.counters.increment(TaskCounter.LOCAL_SHUFFLED_INPUTS)
@@ -244,7 +254,18 @@ class ShuffleFetchTable:
                             TaskCounter.SHUFFLE_BYTES_TO_MEM, batch.nbytes)
                     self.context.counters.increment(
                         TaskCounter.NUM_SHUFFLED_INPUTS)
-        except (ShuffleDataNotFound, ConnectionError, PermissionError) as e:
+        except (ShuffleDataNotFound, OSError, PermissionError) as e:
+            # OSError covers both connection faults and the checksum IOError
+            # from a corrupted payload — either way the producer output is
+            # unusable from here and must be re-fetched or re-produced
+            if _is_checksum_error(e):
+                # quarantine: drop every registered spill of this producer
+                # output so the re-fetch after producer re-run can't serve
+                # the damaged copy again (reference: fetch failure discards
+                # the MapOutput before reporting)
+                self.service.unregister_prefix(payload.path_component)
+                log.warning("quarantined corrupt shuffle output %s",
+                            payload.path_component)
             self._fetch_error(slot, version, e)
             return
         self._commit_fetch(slot, payload, version, stamp, generation, batch)

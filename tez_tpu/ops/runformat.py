@@ -20,7 +20,13 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tez_tpu.common import faults
+
 MAGIC = b"TPRUN1"
+#: MAGIC + pack("<BIQ", flag, crc32(payload), len(payload)).  The CRC covers
+#: the payload only, so corrupt-injection below the header is guaranteed to
+#: surface as the checksum IOError (not a codec decode error).
+RUN_HEADER_NBYTES = len(MAGIC) + 13
 
 
 def _zstd_codec():
@@ -404,6 +410,7 @@ class Run:
         return len(MAGIC) + 13 + size
 
     def save(self, path: str, codec: Optional[str] = None) -> None:
+        faults.fire("spill.write", detail=path)
         tmp = path + ".tmp"
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(tmp, "wb") as fh:
@@ -412,8 +419,12 @@ class Run:
 
     @staticmethod
     def load(path: str) -> "Run":
+        faults.fire("spill.read", detail=path)
         with open(path, "rb") as fh:
-            return Run.from_bytes(fh.read(), where=path)
+            data = fh.read()
+        data = faults.corrupt_bytes("spill.read", path, data,
+                                    lo=RUN_HEADER_NBYTES)
+        return Run.from_bytes(data, where=path)
 
     @staticmethod
     def from_sorted_batch(batch: KVBatch, sorted_partitions: np.ndarray,
@@ -640,12 +651,15 @@ class FileRun:
         lo, hi = int(self._byte_off[p]), int(self._byte_off[p + 1])
         if lo >= hi:
             return
+        faults.fire("spill.read", detail=self.path)
         with open(self.path, "rb") as fh:
             fh.seek(lo)
             pos = lo
             while pos < hi:
                 (n,) = struct.unpack("<Q", fh.read(8))
-                yield Run.from_bytes(fh.read(n), where=self.path).batch
+                blob = faults.corrupt_bytes("spill.read", self.path,
+                                            fh.read(n), lo=RUN_HEADER_NBYTES)
+                yield Run.from_bytes(blob, where=self.path).batch
                 pos += 8 + n
 
     def partition(self, p: int) -> KVBatch:
@@ -672,6 +686,7 @@ class FileRun:
 def save_run_partitioned(run: Run, path: str, codec: Optional[str] = None,
                         block_records: int = 65536) -> str:
     """Write a partition-sorted in-RAM Run as a partition-indexed file."""
+    faults.fire("spill.write", detail=path)
     w = PartitionedRunWriter(path, run.num_partitions, codec=codec,
                              block_records=block_records)
     w.append_run(run)

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from tez_tpu.common import faults
 from tez_tpu.ops.keycodec import matrix_to_lanes, pad_to_matrix
 from tez_tpu.ops.runformat import KVBatch
 
@@ -71,7 +72,9 @@ def _decode_rows(lanes: np.ndarray, lengths: np.ndarray, values: np.ndarray,
 
 
 class _EdgeState:
-    def __init__(self, num_producers: int, num_consumers: int):
+    def __init__(self, num_producers: int, num_consumers: int,
+                 edge_id: str = ""):
+        self.edge_id = edge_id
         self.num_producers = num_producers
         self.num_consumers = num_consumers
         self.max_rows_per_round: Optional[int] = None   # per-edge conf
@@ -178,7 +181,7 @@ class MeshExchangeCoordinator:
         vwords = _encode_values(batch, value_width)
         with self.lock:
             st = self.edges.setdefault(
-                edge_id, _EdgeState(num_producers, num_consumers))
+                edge_id, _EdgeState(num_producers, num_consumers, edge_id))
             if max_rows_per_round:
                 st.max_rows_per_round = int(max_rows_per_round)
             st.spans[task_index] = (lanes,
@@ -243,7 +246,7 @@ class MeshExchangeCoordinator:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self.lock:
             st = self.edges.setdefault(
-                edge_id, _EdgeState(num_producers, num_consumers))
+                edge_id, _EdgeState(num_producers, num_consumers, edge_id))
             while st.results is None and st.error is None:
                 # the deadline guards the PRODUCER barrier only: once every
                 # span is in (or an exchange is in flight), a slow exchange
@@ -310,6 +313,10 @@ class MeshExchangeCoordinator:
         from tez_tpu.ops.sorter import merge_sorted_runs
         from tez_tpu.ops.runformat import Run
 
+        # host-level seam: the jitted SPMD body is not instrumentable, so
+        # chaos hits the exchange at entry (the caller's error path turns
+        # this into the edge-wide failure consumers see)
+        faults.fire("mesh.exchange", detail=st.edge_id)
         W = st.num_consumers
         D = self.devices_for(W)     # devices carrying the exchange; each
         mesh = self.mesh_for(D)     # holds W/D consumer partitions

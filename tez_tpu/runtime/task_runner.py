@@ -18,6 +18,7 @@ from tez_tpu.api.events import (CustomProcessorEvent, TezAPIEvent, TezEvent)
 from tez_tpu.api.runtime import (LogicalIOProcessor, LogicalInput,
                                  LogicalOutput, MergedLogicalInput,
                                  ObjectRegistry)
+from tez_tpu.common import faults
 from tez_tpu.common.counters import TaskCounter, TezCounters
 from tez_tpu.runtime.contexts import (TaskKilledError, TezInputContext,
                                       TezOutputContext, TezProcessorContext)
@@ -203,6 +204,9 @@ class TaskRunner:
 
     def _run_processor(self) -> None:
         self.check_killed()
+        # delay mode makes this attempt a straggler (speculation bait);
+        # fail mode crashes it into the ordinary TA_FAILED retry path
+        faults.fire("task.run", detail=str(self.spec.attempt_id))
         assert self.processor is not None
         # Constituents of a group stay in self.inputs (they receive events)
         # but the processor only sees the merged input (reference:

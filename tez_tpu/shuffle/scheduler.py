@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import heapq
 import logging
+import random
 import threading
 import time
 from collections import deque
@@ -23,6 +24,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from tez_tpu.shuffle.service import ShuffleDataNotFound
+from tez_tpu.utils.backoff import ExponentialBackoff
 
 log = logging.getLogger(__name__)
 
@@ -99,13 +101,19 @@ class FetchScheduler:
                  penalty_cap: float = 10.0,
                  max_attempts: int = 4,
                  stall_timeout: float = 15.0,
-                 name: str = "shuffle"):
+                 name: str = "shuffle",
+                 penalty_rng: Optional[random.Random] = None):
         self.deliver = deliver
         self.session_factory = session_factory
         self.num_fetchers = max(1, num_fetchers)
         self.max_per_fetch = max(1, max_per_fetch)
         self.penalty_base = penalty_base
         self.penalty_cap = penalty_cap
+        # full jitter so fetchers penalized by the same bad host don't
+        # reconnect in lockstep when the box opens; penalty_rng pins the
+        # draw for deterministic tests
+        self._penalty = ExponentialBackoff(penalty_base, penalty_cap,
+                                           jitter=True, rng=penalty_rng)
         self.max_attempts = max_attempts
         self.stall_timeout = stall_timeout
 
@@ -238,8 +246,7 @@ class FetchScheduler:
         backoff; requeue the unfetched requests; return the ones whose
         retry budget is exhausted (caller delivers them lock-free)."""
         host.failures += 1
-        penalty = min(self.penalty_cap,
-                      self.penalty_base * (2 ** (host.failures - 1)))
+        penalty = self._penalty.delay(host.failures - 1)
         failed_out: List[Tuple[FetchRequest, Exception]] = []
         for req in rest:
             req.attempts += 1

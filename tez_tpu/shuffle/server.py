@@ -33,6 +33,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from tez_tpu.common import faults
 from tez_tpu.common.security import (JobTokenSecretManager,
                                      hash_from_request, shuffle_request_msg)
 from tez_tpu.ops.runformat import KVBatch, Run
@@ -77,6 +78,7 @@ class _Handler(socketserver.StreamRequestHandler):
         lo = int(req.get("partition_lo", 0))
         hi = int(req.get("partition_hi", lo + 1))
         sig = bytes.fromhex(req.get("hmac", ""))
+        faults.fire("shuffle.serve", detail=f"{path}/{spill}")
         if not server.secrets.verify_hash(
                 sig, shuffle_request_msg(path, spill, lo, hi, nonce)):
             server.auth_failures += 1   # count BEFORE replying (clients may
@@ -162,6 +164,7 @@ class FetchSession:
                  read_timeout: float = 30.0):
         self.secrets = secrets
         self.host, self.port = host, port
+        faults.fire("shuffle.fetch.connect", detail=f"{host}:{port}")
         self._sk = socket.create_connection((host, port),
                                             timeout=connect_timeout)
         if ssl_context is not None:
@@ -180,6 +183,7 @@ class FetchSession:
 
     def fetch_range(self, path: str, spill: int, lo: int,
                     hi: int) -> List[KVBatch]:
+        faults.fire("shuffle.fetch.read", detail=path)
         req = json.dumps({
             "path": path, "spill": spill,
             "partition_lo": lo, "partition_hi": hi,
@@ -246,7 +250,7 @@ class ShuffleFetcher:
             return retry_call(
                 one_try, self.retries,
                 retryable=(OSError, ValueError, struct.error),
-                backoff=ExponentialBackoff(self.backoff),
+                backoff=ExponentialBackoff(self.backoff, jitter=True),
                 fatal=(ShuffleDataNotFound, PermissionError))
         except (ShuffleDataNotFound, PermissionError):
             raise   # definitive: retrying cannot help

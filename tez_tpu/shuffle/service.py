@@ -16,7 +16,24 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
-from tez_tpu.ops.runformat import KVBatch, Run
+import numpy as np
+
+from tez_tpu.common import faults
+from tez_tpu.ops.runformat import KVBatch, Run, RUN_HEADER_NBYTES
+
+
+def _maybe_corrupt(path_component: str, spill_id: int,
+                   batch: KVBatch) -> KVBatch:
+    """shuffle.data corrupt seam: round-trip the served partition through
+    the checksummed Run wire blob with one byte flipped, so the injected
+    damage surfaces as the genuine CRC IOError on the consumer side."""
+    wire = Run(batch,
+               np.array([0, batch.num_records], dtype=np.int64)).to_bytes()
+    bad = faults.corrupt_bytes("shuffle.data", f"{path_component}/{spill_id}",
+                               wire, lo=RUN_HEADER_NBYTES)
+    if bad is wire:          # no corrupt rule claimed this fetch
+        return batch
+    return Run.from_bytes(bad, where=f"{path_component}/{spill_id}").batch
 
 
 class ShuffleDataNotFound(Exception):
@@ -79,13 +96,16 @@ class ShuffleService:
         if run is None:
             raise ShuffleDataNotFound(f"{path_component}/{spill_id}")
         try:
-            return run.partition(partition)
+            batch = run.partition(partition)
         except FileNotFoundError:
             # disk-backed run deleted by a concurrent unregister_prefix
             # (DAG teardown) between the registry lookup and the read —
             # same contract as a missing registration
             raise ShuffleDataNotFound(
                 f"{path_component}/{spill_id}") from None
+        if faults.armed():
+            batch = _maybe_corrupt(path_component, spill_id, batch)
+        return batch
 
     def fetch_partition_range(self, path_component: str, spill_id: int,
                               start: int, stop: int) -> List[KVBatch]:

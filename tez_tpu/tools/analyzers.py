@@ -474,14 +474,46 @@ class VertexLevelCriticalPathAnalyzer(Analyzer):
             f"critical path {' -> '.join(path)} = {total:.2f}s{frac}", rows)
 
 
+class NodeHealthAnalyzer(Analyzer):
+    """Node blacklist / forced-active transitions correlated with where
+    failed attempts ran (reference: SlowNodeAnalyzer's sibling for the
+    AMNodeImpl state machine; the chaos harness uses it to attribute
+    storms to node flaps)."""
+    name = "node_health"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        failed_per_node: Dict[str, int] = {}
+        for a in dag.all_attempts():
+            if a.state in ("FAILED", "KILLED") and a.node_id:
+                failed_per_node[a.node_id] = \
+                    failed_per_node.get(a.node_id, 0) + 1
+        rows = []
+        for ev in dag.node_events:
+            rows.append({
+                "node": ev["node_id"], "event": ev["event"],
+                "node_failures": ev["failures"],
+                "offset_s": round(ev["time"] - dag.start_time, 3)
+                if dag.start_time else None,
+                "failed_attempts_on_node":
+                    failed_per_node.get(ev["node_id"], 0)})
+        blacklists = sum(1 for r in rows if r["event"] == "BLACKLISTED")
+        forced = sum(1 for r in rows if r["event"] == "FORCED_ACTIVE")
+        return AnalyzerResult(
+            self.name,
+            (f"{blacklists} blacklist(s), {forced} forced-active "
+             f"transition(s)" if rows else "no node health transitions"),
+            rows)
+
+
 ALL_ANALYZERS: Sequence[Analyzer] = (
     CriticalPathAnalyzer(), ShuffleTimeAnalyzer(), SkewAnalyzer(),
     SpillAnalyzer(), SlowestVertexAnalyzer(), ContainerReuseAnalyzer(),
     SpeculationAnalyzer(), HungTaskAnalyzer(), TaskConcurrencyAnalyzer(),
     SlowTaskAttemptAnalyzer(), InputOutputRatioAnalyzer(),
     DagOverviewAnalyzer(), InputReadErrorAnalyzer(), LocalityAnalyzer(),
-    OneOnOneEdgeAnalyzer(), SlowNodeAnalyzer(), TaskAssignmentAnalyzer(),
-    TaskAttemptResultStatisticsAnalyzer(), VertexLevelCriticalPathAnalyzer())
+    OneOnOneEdgeAnalyzer(), SlowNodeAnalyzer(), NodeHealthAnalyzer(),
+    TaskAssignmentAnalyzer(), TaskAttemptResultStatisticsAnalyzer(),
+    VertexLevelCriticalPathAnalyzer())
 
 
 def analyze_dag(dag: DagInfo,

@@ -1,0 +1,197 @@
+"""Chaos soak harness: run a canned DAG under a seeded fault storm and
+check the output is bit-exact against a fault-free baseline.
+
+Every storm is derived purely from ``--seed``, so any failure is
+reproducible with the printed repro line::
+
+    python -m tez_tpu.tools.chaos --seed 1234
+
+Multiple trials (``--trials K``) walk seeds N, N+1, ... and share one
+baseline run. The storm menu only contains *recoverable* faults — ones the
+framework is expected to absorb (retries, reruns, speculation, container
+respawn) — so a divergent or failed run is always a bug, never an
+over-aggressive storm.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import shutil
+import sys
+import tempfile
+from typing import List, Optional, Tuple
+
+from tez_tpu.client.dag_client import DAGStatusState
+from tez_tpu.client.tez_client import TezClient
+from tez_tpu.common import faults
+from tez_tpu.common.payload import (InputDescriptor, OutputDescriptor,
+                                    ProcessorDescriptor)
+from tez_tpu.dag.dag import DAG, Edge, Vertex
+from tez_tpu.dag.edge_property import (DataMovementType, DataSourceType,
+                                       EdgeProperty, SchedulingType)
+from tez_tpu.library.processors import SimpleProcessor
+
+NUM_PRODUCERS = 2
+KEYS_PER_TASK = 40
+
+# Recoverable storm menu. Each entry is a single-rule spec fragment; a storm
+# is a seeded sample of these joined with ';'. Budgets are deliberately
+# small (n=1..2) so compound storms stay inside the framework's retry
+# envelopes (task.max.failed.attempts, fetch max_attempts, ...).
+STORM_MENU = (
+    "shuffle.fetch.read:fail:n=1,exc=io",
+    "shuffle.fetch.connect:fail:n=1,exc=conn",
+    "shuffle.data:corrupt:n=1",
+    "task.run:fail:n=1,exc=runtime",
+    "task.run:delay:ms=400,n=1",
+    "spill.write:delay:ms=150,n=2",
+    "am.heartbeat:delay:ms=250,n=2",
+    "am.container.launch:fail:n=1",
+)
+
+
+class ChaosEmitProcessor(SimpleProcessor):
+    """Deterministic producer: every task emits the same (key, value) set,
+    so the grouped totals downstream are a pure function of the plan."""
+
+    def run(self, inputs, outputs):
+        writer = outputs["consumer"].get_writer()
+        for i in range(KEYS_PER_TASK):
+            writer.write(f"key{i:03d}".encode(), i + 1)
+
+
+class ChaosCountProcessor(SimpleProcessor):
+    """Groups the sorted input and writes 'key total' lines (sorted, so the
+    file is bit-exact regardless of fetch interleaving) to result_path."""
+
+    def run(self, inputs, outputs):
+        payload = self.context.user_payload.load() or {}
+        reader = inputs["producer"].get_reader()
+        totals = {k: sum(vs) for k, vs in reader}
+        lines = [f"{k.decode()} {v}" for k, v in sorted(totals.items())]
+        with open(payload["result_path"], "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+
+
+def make_storm(seed: int) -> str:
+    """Seeded storm spec: 2-4 distinct recoverable faults."""
+    rng = random.Random(seed)
+    picks = rng.sample(STORM_MENU, rng.randint(2, 4))
+    return ";".join(picks)
+
+
+def _build_dag(name: str, result_path: str, fault_spec: str = "",
+               fault_seed: int = 0) -> DAG:
+    producer = Vertex.create("producer", ProcessorDescriptor.create(
+        ChaosEmitProcessor), NUM_PRODUCERS)
+    consumer = Vertex.create("consumer", ProcessorDescriptor.create(
+        ChaosCountProcessor, payload={"result_path": result_path}), 1)
+    conf = {"tez.runtime.key.class": "bytes",
+            "tez.runtime.value.class": "long"}
+    prop = EdgeProperty.create(
+        DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create(
+            "tez_tpu.library.outputs:OrderedPartitionedKVOutput",
+            payload=conf),
+        InputDescriptor.create(
+            "tez_tpu.library.inputs:OrderedGroupedKVInput", payload=conf))
+    dag = DAG.create(name).add_vertex(producer).add_vertex(consumer)
+    dag.add_edge(Edge.create(producer, consumer, prop))
+    if fault_spec:
+        dag.set_conf("tez.test.fault.spec", fault_spec)
+        dag.set_conf("tez.test.fault.seed", fault_seed)
+    return dag
+
+
+def _run_dag(workdir: str, name: str, fault_spec: str = "",
+             fault_seed: int = 0, timeout: float = 120.0,
+             ) -> Tuple[str, bytes]:
+    """One client + one DAG in a fresh staging dir. Returns (state, result
+    bytes); result is b'' if the DAG failed before writing."""
+    staging = os.path.join(workdir, name, "staging")
+    result_path = os.path.join(workdir, name, "result.txt")
+    os.makedirs(os.path.dirname(result_path), exist_ok=True)
+    client = TezClient.create(name, {
+        "tez.staging-dir": staging,
+        "tez.am.local.num-containers": 4,
+        # leave headroom for injected task failures
+        "tez.am.task.max.failed.attempts": 4,
+    }).start()
+    try:
+        dag = _build_dag(name, result_path, fault_spec, fault_seed)
+        status = client.submit_dag(dag).wait_for_completion(timeout=timeout)
+        state = status.state.name
+    finally:
+        client.stop()
+        faults.clear_all()
+    data = b""
+    if os.path.exists(result_path):
+        with open(result_path, "rb") as fh:
+            data = fh.read()
+    return state, data
+
+
+def run_trial(seed: int, workdir: str, baseline: Optional[bytes] = None,
+              timeout: float = 120.0) -> Tuple[bool, str, str]:
+    """Run one seeded storm; returns (ok, spec, detail)."""
+    if baseline is None:
+        state, baseline = _run_dag(workdir, "baseline", timeout=timeout)
+        if state != DAGStatusState.SUCCEEDED.name or not baseline:
+            return False, "", f"baseline run failed (state={state})"
+    spec = make_storm(seed)
+    state, got = _run_dag(workdir, f"storm{seed}", fault_spec=spec,
+                          fault_seed=seed, timeout=timeout)
+    if state != DAGStatusState.SUCCEEDED.name:
+        return False, spec, f"storm DAG finished {state}"
+    if got != baseline:
+        return False, spec, (f"output diverged from baseline "
+                             f"({len(got)} vs {len(baseline)} bytes)")
+    return True, spec, "bit-exact vs baseline"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tez_tpu.tools.chaos", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="first storm seed (default 0)")
+    ap.add_argument("--trials", type=int, default=1,
+                    help="number of consecutive seeds to soak (default 1)")
+    ap.add_argument("--timeout", type=float, default=120.0,
+                    help="per-DAG completion timeout in seconds")
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: fresh tempdir, removed)")
+    args = ap.parse_args(argv)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="tez-chaos-")
+    cleanup = args.workdir is None
+    failures = 0
+    try:
+        state, baseline = _run_dag(workdir, "baseline", timeout=args.timeout)
+        if state != DAGStatusState.SUCCEEDED.name or not baseline:
+            print(f"FATAL: fault-free baseline failed (state={state})")
+            return 2
+        print(f"baseline: {len(baseline)} bytes, "
+              f"{len(baseline.splitlines())} keys")
+        for seed in range(args.seed, args.seed + args.trials):
+            ok, spec, detail = run_trial(seed, workdir, baseline=baseline,
+                                         timeout=args.timeout)
+            tag = "ok  " if ok else "FAIL"
+            print(f"{tag} seed={seed} storm=[{spec}] {detail}")
+            if not ok:
+                failures += 1
+                print(f"REPRO: python -m tez_tpu.tools.chaos --seed {seed}")
+    finally:
+        if cleanup:
+            shutil.rmtree(workdir, ignore_errors=True)
+    if failures:
+        print(f"{failures}/{args.trials} trial(s) failed")
+        return 1
+    print(f"all {args.trials} trial(s) bit-exact")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

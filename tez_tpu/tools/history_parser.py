@@ -83,6 +83,10 @@ class DagInfo:
     counters: Dict = dataclasses.field(default_factory=dict)
     vertices: Dict[str, VertexInfo] = dataclasses.field(default_factory=dict)
     containers: Dict[str, Dict] = dataclasses.field(default_factory=dict)
+    # node health transitions in stream order: {"node_id", "event"
+    # (BLACKLISTED|FORCED_ACTIVE), "failures", "time"} — host-scoped like
+    # containers, attached to every dag
+    node_events: List[Dict] = dataclasses.field(default_factory=list)
     # DAG structure recovered from the journaled plan: list of
     # {"src": name, "dst": name, "movement": DataMovementType name}
     edges: List[Dict] = dataclasses.field(default_factory=list)
@@ -106,6 +110,7 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
     """Event stream -> {dag_id: DagInfo}."""
     dags: Dict[str, DagInfo] = {}
     containers: Dict[str, Dict] = {}
+    node_events: List[Dict] = []
 
     def dag(ev: HistoryEvent) -> Optional[DagInfo]:
         if ev.dag_id is None:
@@ -191,8 +196,18 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
                 ev.timestamp
             containers[ev.container_id]["tasks_run"] = \
                 ev.data.get("tasks_run", 0)
+        elif t in (HistoryEventType.NODE_BLACKLISTED,
+                   HistoryEventType.NODE_FORCED_ACTIVE):
+            node_events.append({
+                "node_id": ev.data.get("node_id", ""),
+                "event": ("BLACKLISTED"
+                          if t is HistoryEventType.NODE_BLACKLISTED
+                          else "FORCED_ACTIVE"),
+                "failures": ev.data.get("failures", 0),
+                "time": ev.timestamp})
     for d in dags.values():
         d.containers = containers
+        d.node_events = node_events
     return dags
 
 

@@ -32,3 +32,13 @@ def _disarm_fault_plane():
     yield
     from tez_tpu.common import faults
     faults.clear_all()
+
+
+@pytest.fixture(autouse=True)
+def _reset_epoch_registry():
+    """The AM-epoch registry is process-global; a test that restarted an AM
+    (attempt 2+) would otherwise fence the next test's attempt-1 AMs if an
+    app_id collided."""
+    yield
+    from tez_tpu.common import epoch
+    epoch.reset()

@@ -195,6 +195,19 @@ def test_scenario_am_kill_recovery_replay(tmp_staging, tmp_path):
     am2.stop()
 
 
+def test_scenario_commit_storm_exactly_once(tmp_path):
+    """The exactly-once commit scenario: the AM is killed between the
+    ledger's DAG_COMMIT_STARTED and DAG_COMMIT_FINISHED records (a
+    commit.publish delay fault parks the publisher in that window), the
+    successor attempt resumes the commit from the ledger, and the published
+    output is bit-exact vs a fault-free run — _SUCCESS present, no orphaned
+    _temporary tree, no double-published part file.  The parked publisher
+    wakes as a zombie from the superseded epoch and must be fenced.
+    CLI equivalent: `python -m tez_tpu.tools.chaos --commit-storm`."""
+    ok, detail = chaos.run_commit_storm(str(tmp_path))
+    assert ok, detail
+
+
 def test_scenario_corrupt_spill_quarantine_rerun(tmp_path):
     """Compound storm: a fetched shuffle payload is corrupted in flight;
     the CRC check rejects it, the consumer quarantines the source and the

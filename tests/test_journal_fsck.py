@@ -1,0 +1,119 @@
+"""journal_fsck: CRC validation, ledger pairing, ordering, terminal-state
+inference — plus an end-to-end check against a journal a real AM wrote."""
+import os
+
+from tez_tpu.am.app_master import DAGAppMaster
+from tez_tpu.am.dag_impl import DAGState
+from tez_tpu.am.history import HistoryEvent, HistoryEventType
+from tez_tpu.am.recovery import encode_journal_line
+from tez_tpu.common import config as C
+from tez_tpu.common.payload import ProcessorDescriptor
+from tez_tpu.dag.dag import DAG, Vertex
+from tez_tpu.tools import journal_fsck
+
+
+def _write_journal(path, events, tail=""):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        for ev in events:
+            fh.write(encode_journal_line(ev) + "\n")
+        if tail:
+            fh.write(tail)
+    return path
+
+
+def _ledger_events(dag_id, *types):
+    evs = [HistoryEvent(HistoryEventType.DAG_SUBMITTED, dag_id=dag_id)]
+    evs += [HistoryEvent(t, dag_id=dag_id) for t in types]
+    return evs
+
+
+def test_fsck_clean_commit_cycle(tmp_path):
+    p = _write_journal(str(tmp_path / "journal.jsonl"), _ledger_events(
+        "dag_1_a_1",
+        HistoryEventType.DAG_COMMIT_STARTED,
+        HistoryEventType.DAG_COMMIT_FINISHED) + [
+        HistoryEvent(HistoryEventType.DAG_FINISHED, dag_id="dag_1_a_1",
+                     data={"state": "SUCCEEDED"})])
+    report = journal_fsck.fsck_files([p])
+    assert report.ok and not report.torn_tail
+    assert report.dags["dag_1_a_1"].inferred_terminal == "SUCCEEDED"
+    assert journal_fsck.main([p]) == 0
+
+
+def test_fsck_torn_tail_tolerated_midstream_not(tmp_path):
+    evs = _ledger_events("dag_1_b_1")
+    # torn last record (the AM died mid-append): tolerated, still clean
+    p = _write_journal(str(tmp_path / "torn.jsonl"), evs,
+                       tail="deadbeef {truncat")
+    report = journal_fsck.fsck_files([p])
+    assert report.ok and report.torn_tail
+    # the same damage mid-stream is NOT the crash signature: error
+    with open(p, "a") as fh:
+        fh.write("\n" + encode_journal_line(evs[0]) + "\n")
+    report = journal_fsck.fsck_files([p])
+    assert not report.ok
+    assert journal_fsck.main([p]) == 1
+
+
+def test_fsck_ledger_pairing_violations(tmp_path):
+    # FINISHED without an open STARTED
+    p1 = _write_journal(str(tmp_path / "j1.jsonl"), _ledger_events(
+        "dag_1_c_1", HistoryEventType.DAG_COMMIT_FINISHED))
+    assert not journal_fsck.fsck_files([p1]).ok
+    # SUCCEEDED with the ledger still open
+    p2 = _write_journal(str(tmp_path / "j2.jsonl"), _ledger_events(
+        "dag_1_c_2", HistoryEventType.DAG_COMMIT_STARTED) + [
+        HistoryEvent(HistoryEventType.DAG_FINISHED, dag_id="dag_1_c_2",
+                     data={"state": "SUCCEEDED"})])
+    assert not journal_fsck.fsck_files([p2]).ok
+    # open ledger with no terminal record: legal (that's the recovery case)
+    p3 = _write_journal(str(tmp_path / "j3.jsonl"), _ledger_events(
+        "dag_1_c_3", HistoryEventType.DAG_COMMIT_STARTED))
+    report = journal_fsck.fsck_files([p3])
+    assert report.ok
+    assert "IN-COMMIT" in report.dags["dag_1_c_3"].inferred_terminal
+
+
+def test_fsck_ledger_threads_across_attempts(tmp_path):
+    """The resumed commit's FINISHED lands in attempt 2's journal; fsck in
+    attempt order must pair it with attempt 1's STARTED."""
+    rec = tmp_path / "recovery"
+    _write_journal(str(rec / "1" / "journal.jsonl"), _ledger_events(
+        "dag_1_d_1", HistoryEventType.DAG_COMMIT_STARTED))
+    _write_journal(str(rec / "2" / "journal.jsonl"), [
+        HistoryEvent(HistoryEventType.DAG_COMMIT_FINISHED,
+                     dag_id="dag_1_d_1"),
+        HistoryEvent(HistoryEventType.DAG_FINISHED, dag_id="dag_1_d_1",
+                     data={"state": "SUCCEEDED"})])
+    files = journal_fsck.discover_journals(str(rec))
+    assert [os.path.basename(os.path.dirname(f)) for f in files] == ["1", "2"]
+    report = journal_fsck.fsck_files(files)
+    assert report.ok
+    assert report.dags["dag_1_d_1"].inferred_terminal == "SUCCEEDED"
+
+
+def test_fsck_missing_target():
+    assert journal_fsck.main([os.path.join("/nonexistent", "x")]) == 2
+
+
+def test_fsck_real_am_journal(tmp_staging):
+    """A journal written by an actual AM run passes fsck CLEAN with the
+    right terminal state."""
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging})
+    am = DAGAppMaster("app_1_fsck", conf, attempt=1)
+    am.start()
+    v = Vertex.create("v", ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor",
+        payload={"sleep_ms": 1}), 1)
+    dag_id = am.submit_dag(DAG.create("fsck").add_vertex(v).create_dag_plan())
+    assert am.wait_for_dag(dag_id, timeout=30) is DAGState.SUCCEEDED
+    am.stop()
+    rec = os.path.join(tmp_staging, "app_1_fsck", "recovery")
+    files = journal_fsck.discover_journals(rec)
+    assert files
+    report = journal_fsck.fsck_files(files)
+    assert report.ok, report.errors
+    assert report.dags[str(dag_id)].inferred_terminal == "SUCCEEDED"
+    assert journal_fsck.main(["--staging", tmp_staging,
+                              "--app", "app_1_fsck"]) == 0

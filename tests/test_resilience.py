@@ -190,9 +190,12 @@ def test_am_recovery_finished_dag_untouched(tmp_staging):
 
 
 def test_am_recovery_commit_in_flight_fails_dag(tmp_staging):
-    """Commit started but no completion record => DAG FAILED on recovery
-    (reference: RecoveryParser commit rules, SURVEY.md §5.4)."""
-    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging})
+    """Commit started but no completion record, under the strict
+    policy="fail": DAG FAILED on recovery (reference: RecoveryParser commit
+    rules, SURVEY.md §5.4; the default "resume" policy instead re-runs the
+    idempotent committers — see the resume tests below)."""
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging,
+                               "tez.am.commit.recovery.policy": "fail"})
     am1 = DAGAppMaster("app_1_cif", conf, attempt=1)
     am1.start()
     plan = _mini_plan()
@@ -208,6 +211,180 @@ def test_am_recovery_commit_in_flight_fails_dag(tmp_staging):
     recovered = am2.recover_and_resume()
     assert recovered is not None
     assert am2.completed_dags["dag_1_cif_7"] is DAGState.FAILED
+    # the rollback decision itself is journaled (ledger ABORTED record)
+    assert am2.logging_service.of_type(HistoryEventType.DAG_COMMIT_ABORTED)
+    am2.stop()
+
+
+def _forge_commit_journal(am, plan, dag_id_str, *ledger_events):
+    """DAG_SUBMITTED (with serialized plan) + the given ledger records."""
+    am.history(HistoryEvent(
+        HistoryEventType.DAG_SUBMITTED, dag_id=dag_id_str,
+        data={"dag_name": plan.name, "plan": plan.serialize().hex()}))
+    for ev in ledger_events:
+        am.history(HistoryEvent(ev, dag_id=dag_id_str))
+
+
+def _sink_plan(name, out_dir):
+    """Single-vertex plan with a FileOutput data sink (so recovery has a
+    real committer to re-instantiate)."""
+    from tez_tpu.common.payload import OutputCommitterDescriptor
+    from tez_tpu.dag.dag import DataSinkDescriptor
+    v = Vertex.create("v", ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor",
+        payload={"sleep_ms": 1}), 1)
+    v.add_data_sink("sink", DataSinkDescriptor.create(
+        OutputDescriptor.create("tez_tpu.io.file_output:FileOutput",
+                                payload={"path": out_dir,
+                                         "key_serde": "text",
+                                         "value_serde": "text"}),
+        OutputCommitterDescriptor.create(
+            "tez_tpu.io.file_output:FileOutputCommitter",
+            payload={"path": out_dir})))
+    return DAG.create(name).add_vertex(v).create_dag_plan()
+
+
+def test_am_recovery_commit_finished_rolls_forward(tmp_staging):
+    """A journaled DAG_COMMIT_FINISHED means every committer ran to
+    completion before the crash: recovery rolls the DAG forward to
+    SUCCEEDED without touching the committers again."""
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging})
+    am1 = DAGAppMaster("app_1_cfin", conf, attempt=1)
+    am1.start()
+    _forge_commit_journal(am1, _mini_plan(), "dag_1_cfin_3",
+                          HistoryEventType.DAG_COMMIT_STARTED,
+                          HistoryEventType.DAG_COMMIT_FINISHED)
+    am1.stop()
+    am2 = DAGAppMaster("app_1_cfin", conf, attempt=2)
+    am2.start()
+    recovered = am2.recover_and_resume()
+    assert recovered is not None
+    assert am2.completed_dags["dag_1_cfin_3"] is DAGState.SUCCEEDED
+    am2.stop()
+
+
+def test_am_recovery_commit_aborted_fails_dag(tmp_staging, tmp_path):
+    """A journaled DAG_COMMIT_ABORTED is a recorded rollback decision:
+    recovery re-runs the idempotent aborts and lands on FAILED, un-publishing
+    anything a partial commit left behind."""
+    out_dir = str(tmp_path / "out")
+    plan = _sink_plan("cabort", out_dir)
+    # a partially-committed output: one published part file, manifest inside
+    # the tmp tree recording it, staged file still waiting
+    os.makedirs(os.path.join(out_dir, "_temporary", "committed"))
+    with open(os.path.join(out_dir, "part-00000"), "w") as fh:
+        fh.write("published-by-crashed-attempt")
+    with open(os.path.join(out_dir, "_temporary", "_publish_manifest"),
+              "w") as fh:
+        fh.write("part-00000\n")
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging})
+    am1 = DAGAppMaster("app_1_cab", conf, attempt=1)
+    am1.start()
+    _forge_commit_journal(am1, plan, "dag_1_cab_4",
+                          HistoryEventType.DAG_COMMIT_STARTED,
+                          HistoryEventType.DAG_COMMIT_ABORTED)
+    am1.stop()
+    am2 = DAGAppMaster("app_1_cab", conf, attempt=2)
+    am2.start()
+    recovered = am2.recover_and_resume()
+    assert recovered is not None
+    assert am2.completed_dags["dag_1_cab_4"] is DAGState.FAILED
+    am2.stop()
+    # rollback un-published the partial commit and removed the tmp tree
+    assert not os.path.exists(os.path.join(out_dir, "part-00000"))
+    assert not os.path.exists(os.path.join(out_dir, "_temporary"))
+    assert not os.path.exists(os.path.join(out_dir, "_SUCCESS"))
+
+
+def test_am_recovery_commit_in_flight_resumes(tmp_staging, tmp_path):
+    """Default policy="resume": an open ledger (COMMIT_STARTED, no
+    completion record) re-runs ONLY the idempotent committers — staged files
+    are published, the DAG rolls forward to SUCCEEDED, and the resumed
+    commit closes the ledger with DAG_COMMIT_FINISHED."""
+    out_dir = str(tmp_path / "out")
+    plan = _sink_plan("cres", out_dir)
+    # crash state: one part published (and in the manifest), one still staged
+    committed = os.path.join(out_dir, "_temporary", "committed")
+    os.makedirs(committed)
+    with open(os.path.join(out_dir, "part-00000"), "w") as fh:
+        fh.write("already-published\n")
+    with open(os.path.join(out_dir, "_temporary", "_publish_manifest"),
+              "w") as fh:
+        fh.write("part-00000\n")
+    with open(os.path.join(committed, "part-00001"), "w") as fh:
+        fh.write("still-staged\n")
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging})
+    am1 = DAGAppMaster("app_1_cres", conf, attempt=1)
+    am1.start()
+    _forge_commit_journal(am1, plan, "dag_1_cres_5",
+                          HistoryEventType.DAG_COMMIT_STARTED)
+    am1.stop()
+    am2 = DAGAppMaster("app_1_cres", conf, attempt=2)
+    am2.start()
+    recovered = am2.recover_and_resume()
+    assert recovered is not None
+    assert am2.completed_dags["dag_1_cres_5"] is DAGState.SUCCEEDED
+    assert am2.logging_service.of_type(HistoryEventType.DAG_COMMIT_FINISHED)
+    am2.stop()
+    # both parts published exactly once, marker written, tmp tree gone
+    with open(os.path.join(out_dir, "part-00000")) as fh:
+        assert fh.read() == "already-published\n"
+    with open(os.path.join(out_dir, "part-00001")) as fh:
+        assert fh.read() == "still-staged\n"
+    assert os.path.exists(os.path.join(out_dir, "_SUCCESS"))
+    assert not os.path.exists(os.path.join(out_dir, "_temporary"))
+
+
+def test_stale_epoch_fenced_at_am_seams(tmp_staging):
+    """Zombie fencing: once attempt 2 registers its epoch, messages carrying
+    attempt 1's epoch are rejected at the umbilical seams — can_commit
+    arbitration denies, heartbeat orders the runner to die — and attempt
+    1's own communicator self-fences."""
+    from tez_tpu.am.task_comm import HeartbeatRequest
+    from tez_tpu.common.ids import DAGId
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging,
+                               "tez.am.max.app.attempts": 3})
+    am1 = DAGAppMaster("app_1_fence", conf, attempt=1)
+    am2 = DAGAppMaster("app_1_fence", conf, attempt=2)   # supersedes am1
+    attempt_id = DAGId("app_1_fence", 1).vertex(0).task(0).attempt(0)
+    # a delayed pre-restart can_commit reaching the NEW AM: epoch 1 < 2.
+    # (fencing short-circuits before any DAG lookup — am2 runs no DAG.)
+    assert am2.task_comm.can_commit(attempt_id, epoch=1) is False
+    resp = am2.task_comm.heartbeat(HeartbeatRequest(attempt_id, [], epoch=1))
+    assert resp.should_die
+    # the OLD AM's communicator knows it was superseded and refuses
+    # arbitration for everyone, current-epoch callers included
+    assert am1.task_comm.can_commit(attempt_id, epoch=1) is False
+    resp = am1.task_comm.heartbeat(HeartbeatRequest(attempt_id, [], epoch=1))
+    assert resp.should_die
+    am1.stop()
+    am2.stop()
+
+
+def test_stale_epoch_fenced_at_shuffle_register(tmp_staging):
+    """A zombie producer task (spec stamped with the pre-crash epoch) must
+    not register shuffle output after the AM restarts."""
+    from tez_tpu.common.epoch import EpochFencedError
+    from tez_tpu.library.outputs import _empty_run
+    from tez_tpu.shuffle.service import local_shuffle_service
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging,
+                               "tez.am.max.app.attempts": 3})
+    am1 = DAGAppMaster("app_1_shf", conf, attempt=1)
+    service = local_shuffle_service()
+    run = _empty_run(1)
+    # current epoch registers fine (and pre-crash data stays fetchable)
+    service.register("dag_1_shf_1/zombie_probe/a", -1, run,
+                     epoch=1, app_id="app_1_shf")
+    am2 = DAGAppMaster("app_1_shf", conf, attempt=2)
+    with pytest.raises(EpochFencedError):
+        service.register("dag_1_shf_1/zombie_probe/b", -1, run,
+                         epoch=1, app_id="app_1_shf")
+    # unstamped (legacy) and current-epoch registrations still work
+    service.register("dag_1_shf_1/zombie_probe/c", -1, run)
+    service.register("dag_1_shf_1/zombie_probe/d", -1, run,
+                     epoch=2, app_id="app_1_shf")
+    service.unregister_prefix("dag_1_shf_1/")
+    am1.stop()
     am2.stop()
 
 

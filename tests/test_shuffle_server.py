@@ -58,6 +58,24 @@ def test_missing_data_not_found(served_run):
         fetcher.fetch("127.0.0.1", server.port, "nope/nope", -1, 0)
 
 
+def test_stale_epoch_fetch_fenced(served_run):
+    """A fetch request stamped with a pre-restart AM epoch gets a 'fenced'
+    reply (fatal, no retry); unstamped and current-epoch fetches still see
+    the pre-crash data."""
+    from tez_tpu.common import epoch as epoch_registry
+    server, secrets, run = served_run
+    epoch_registry.register("app_1_zfetch", 2)   # AM restarted: epoch 2 live
+    stale = ShuffleFetcher(secrets, retries=1, epoch=1, app_id="app_1_zfetch")
+    with pytest.raises(PermissionError, match="fenced"):
+        stale.fetch("127.0.0.1", server.port, "dagX/attempt_1/cons", -1, 0)
+    # pre-crash shuffle data REMAINS fetchable by live/legacy readers
+    for fetcher in (ShuffleFetcher(secrets),
+                    ShuffleFetcher(secrets, epoch=2, app_id="app_1_zfetch")):
+        got = fetcher.fetch("127.0.0.1", server.port, "dagX/attempt_1/cons",
+                            -1, 0)[0]
+        assert list(got.iter_pairs()) == list(run.partition(0).iter_pairs())
+
+
 def test_connection_refused_retries_then_raises():
     fetcher = ShuffleFetcher(JobTokenSecretManager(), retries=2,
                              backoff=0.01)

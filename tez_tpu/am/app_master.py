@@ -285,7 +285,20 @@ class DAGAppMaster:
                 self.task_comm.deliver_custom_events(
                     att.attempt_id, list(events))
 
-    def on_dag_finished(self, dag: DAGImpl, final: DAGState) -> None:
+    def on_dag_finished(self, dag: DAGImpl, final: DAGState,
+                        fenced: bool = False) -> None:
+        if fenced:
+            # this incarnation was superseded mid-flight: the dag_id (and its
+            # shuffle data, mesh edges, fault rules) now belongs to the LIVE
+            # AM — tearing any of it down here would sabotage the successor.
+            # Only release local waiters.
+            log.warning("dag %s: finished FENCED (%s); skipping "
+                        "process-global cleanup", dag.dag_id, final.name)
+            with self._dag_done:
+                self.completed_dags[str(dag.dag_id)] = final
+                self.completed_dag_names[str(dag.dag_id)] = dag.name
+                self._dag_done.notify_all()
+            return
         # deletion tracking: drop the finished DAG's shuffle data
         # (reference: ContainerLauncherManager DeletionTracker)
         from tez_tpu.shuffle.service import local_shuffle_service
@@ -371,9 +384,22 @@ class DAGAppMaster:
     def recover_and_resume(self) -> Optional[DAGId]:
         """Parse prior attempts' journals; re-run the last in-progress DAG.
 
-        Semantics kept from the reference: a finished DAG is left alone; a
-        DAG whose commit had started but not completed is FAILED (partial
-        commits can't be trusted); an in-flight DAG is resubmitted with its
+        The commit ledger (DAG_COMMIT_STARTED/FINISHED/ABORTED, all fsync'd)
+        decides what happens to a DAG that crashed during commit:
+
+        - FINISHED: the committers completed; only the terminal DAG record
+          was lost.  Roll forward to SUCCEEDED — never re-run or abort.
+        - ABORTED: the rollback was declared durable.  Re-run the idempotent
+          aborts (a crash may have interrupted the cleanup), record FAILED.
+        - STARTED with tez.am.commit.recovery.policy=resume (default):
+          re-run ONLY the idempotent committers and roll the commit forward
+          (_resume_commit).  Resubmitting the whole DAG would be unsafe —
+          a TASK_FINISHED record lost in the crash would re-run a task
+          whose output the interrupted commit may already have published.
+        - STARTED with policy=fail (reference semantics), or per-vertex /
+          group commits pending: FAILED — partial commits can't be trusted.
+
+        An in-flight DAG that never reached commit is resubmitted with its
         journaled SUCCEEDED tasks short-circuited — their generated
         DataMovementEvents replay into the edges instead of re-running
         (RecoveryParser.parseRecoveryData:658 semantics; if the restored
@@ -390,16 +416,40 @@ class DAGAppMaster:
         except (ValueError, IndexError):
             seq = self._dag_seq + 1
         dag_id = DAGId(self.app_id, seq)
+        if data.commit_state == "FINISHED":
+            log.info("dag %s: commit had FINISHED before AM crash; rolling "
+                     "forward to SUCCEEDED", data.dag_id)
+            self._dag_seq = max(self._dag_seq, seq)
+            self._finish_recovered(
+                data.dag_id, DAGState.SUCCEEDED,
+                "commit finished before AM failure; rolled forward")
+            return dag_id
+        if data.commit_state == "ABORTED":
+            log.warning("dag %s: commit had ABORTED before AM crash; "
+                        "re-running aborts -> FAILED", data.dag_id)
+            self._dag_seq = max(self._dag_seq, seq)
+            self._abort_recovered(data)
+            self._finish_recovered(data.dag_id, DAGState.FAILED,
+                                   "commit aborted before AM failure")
+            return dag_id
+        policy = str(self.conf.get(C.AM_COMMIT_RECOVERY_POLICY) or "resume")
+        if data.commit_state == "STARTED" and policy == "resume" and \
+                data.plan is not None:
+            self._dag_seq = max(self._dag_seq, seq)
+            return self._resume_commit(data, dag_id)
         if data.commit_in_flight:
-            log.warning("dag %s: commit was in flight at AM crash -> FAILED",
-                        data.dag_id)
-            self.history(HistoryEvent(
-                HistoryEventType.DAG_FINISHED, dag_id=data.dag_id,
-                data={"state": "FAILED",
-                      "diagnostics": "commit in flight during AM failure"}))
-            with self._dag_done:
-                self.completed_dags[data.dag_id] = DAGState.FAILED
-                self._dag_done.notify_all()
+            log.warning("dag %s: commit was in flight at AM crash -> FAILED "
+                        "(policy=%s)", data.dag_id, policy)
+            if data.commit_state == "STARTED":
+                # policy=fail (or no plan): declare the abort in the ledger,
+                # then roll the partial commit back so no half-published
+                # output survives
+                self.history(HistoryEvent(
+                    HistoryEventType.DAG_COMMIT_ABORTED, dag_id=data.dag_id,
+                    data={"reason": "commit in flight during AM failure"}))
+                self._abort_recovered(data)
+            self._finish_recovered(data.dag_id, DAGState.FAILED,
+                                   "commit in flight during AM failure")
             self._dag_seq = max(self._dag_seq, seq)
             return dag_id
         if data.plan is None:
@@ -414,6 +464,89 @@ class DAGAppMaster:
         data.events = []   # only task_data/vertex_num_tasks are consulted;
         # don't pin the whole prior journal in AM memory for the DAG lifetime
         return self.submit_dag(data.plan, recovery_data=data)
+
+    def _finish_recovered(self, dag_id: str, final: DAGState,
+                          diagnostics: str) -> None:
+        """Journal the terminal record for a DAG resolved during recovery
+        (it never re-instantiates as a DAGImpl), run the same deletion
+        tracking a normally-finished DAG gets — the crashed attempt's
+        shuffle registrations, mesh edges, and fault rules die with it —
+        and release waiters."""
+        self.history(HistoryEvent(
+            HistoryEventType.DAG_FINISHED, dag_id=dag_id,
+            data={"state": final.name, "diagnostics": diagnostics}))
+        from tez_tpu.shuffle.service import local_shuffle_service
+        n = local_shuffle_service().unregister_prefix(dag_id)
+        if n:
+            log.info("dag %s: released %d shuffle outputs", dag_id, n)
+        from tez_tpu.parallel.coordinator import mesh_coordinator
+        mesh_coordinator().cleanup_dag(dag_id)
+        from tez_tpu.common import faults
+        faults.clear(dag_id)
+        with self._dag_done:
+            self.completed_dags[dag_id] = final
+            self._dag_done.notify_all()
+
+    def _recovered_committers(self, plan: DAGPlan) -> List[Any]:
+        """Rebuild leaf-output committers straight from the plan (mirrors
+        VertexImpl._create_committers) for commit roll-forward/rollback.
+        setup_output is deliberately NOT called — the output trees already
+        exist from the crashed attempt and must be inspected, not reset."""
+        from tez_tpu.api.initializer import SimpleCommitterContext
+        out: List[Any] = []
+        for vplan in plan.vertices:
+            for sink in vplan.leaf_outputs:
+                if sink.committer_descriptor is None:
+                    continue
+                ctx = SimpleCommitterContext(
+                    sink.name, vplan.name, sink.committer_descriptor.payload,
+                    app_id=self.app_id, am_epoch=self.attempt)
+                committer = sink.committer_descriptor.instantiate(ctx)
+                committer.initialize()
+                out.append((f"{vplan.name}:{sink.name}", committer))
+        return out
+
+    def _abort_recovered(self, data: Any) -> None:
+        if data.plan is None:
+            return
+        for name, committer in self._recovered_committers(data.plan):
+            try:
+                committer.abort_output("FAILED")
+            except BaseException:  # noqa: BLE001
+                log.exception("recovery abort of %s failed", name)
+
+    def _resume_commit(self, data: Any, dag_id: DAGId) -> DAGId:
+        """Roll a mid-commit DAG forward (tez.am.commit.recovery.policy=
+        resume).  COMMIT_STARTED is only journaled once every vertex has
+        succeeded, so the tasks' work is complete — only the committers'
+        publish step is in doubt, and they are idempotent/resumable."""
+        committers = self._recovered_committers(data.plan)
+        log.info("dag %s: resuming interrupted commit (%d committers)",
+                 data.dag_id, len(committers))
+        try:
+            for name, committer in committers:
+                committer.commit_output()
+        except BaseException as e:  # noqa: BLE001
+            log.exception("dag %s: commit resume failed; aborting",
+                          data.dag_id)
+            self.history(HistoryEvent(
+                HistoryEventType.DAG_COMMIT_ABORTED, dag_id=data.dag_id,
+                data={"reason": f"commit resume failed: {e!r}"}))
+            for name, committer in committers:
+                try:
+                    committer.abort_output("FAILED")
+                except BaseException:  # noqa: BLE001
+                    log.exception("recovery abort of %s failed", name)
+            self._finish_recovered(data.dag_id, DAGState.FAILED,
+                                   f"commit resume failed: {e!r}")
+            return dag_id
+        self.history(HistoryEvent(
+            HistoryEventType.DAG_COMMIT_FINISHED, dag_id=data.dag_id,
+            data={"resumed": True}))
+        self._finish_recovered(
+            data.dag_id, DAGState.SUCCEEDED,
+            "commit resumed and rolled forward after AM restart")
+        return dag_id
 
     def dag_status(self, dag_id: DAGId) -> Dict[str, Any]:
         dag = self.current_dag

@@ -200,15 +200,39 @@ class DAGImpl:
             self.finish_time = time.time()
             self._finish_history(DAGState.SUCCEEDED)
             return DAGState.SUCCEEDED
+        # ledger record 1/2: COMMIT_STARTED is fsync'd (summary event,
+        # synchronous ctx.history) BEFORE any committer mutates the
+        # filesystem — the write-ahead half of the two-phase commit
         self.ctx.history(HistoryEvent(
-            HistoryEventType.DAG_COMMIT_STARTED, dag_id=str(self.dag_id)))
+            HistoryEventType.DAG_COMMIT_STARTED, dag_id=str(self.dag_id),
+            data={"dag_name": self.name}))
 
         def _commit() -> None:
+            from tez_tpu.common import epoch as epoch_registry
+            from tez_tpu.common import faults
+            from tez_tpu.common.epoch import EpochFencedError
+            app_id = getattr(self.ctx, "app_id", "")
+            my_epoch = int(getattr(self.ctx, "attempt", 0) or 0)
             try:
                 for name, committer in committers:
+                    # a zombie commit thread (its AM superseded while this
+                    # ran, or while a delay fault held it) must stop before
+                    # each publish, not after the damage
+                    if my_epoch > 0 and \
+                            epoch_registry.is_stale(app_id, my_epoch):
+                        faults.fire("fence.stale_epoch",
+                                    detail=f"dag_commit {name}")
+                        raise EpochFencedError(
+                            f"AM epoch {my_epoch} superseded by "
+                            f"{epoch_registry.current(app_id)} mid-commit")
                     committer.commit_output()
                 self.ctx.dispatch(DAGEvent(DAGEventType.DAG_COMMIT_COMPLETED,
                                            self.dag_id, succeeded=True))
+            except EpochFencedError as e:
+                log.warning("dag %s: commit fenced: %s", self.name, e)
+                self.ctx.dispatch(DAGEvent(DAGEventType.DAG_COMMIT_COMPLETED,
+                                           self.dag_id, succeeded=False,
+                                           fenced=True, diagnostics=repr(e)))
             except BaseException as e:  # noqa: BLE001
                 log.exception("dag %s: commit failed", self.name)
                 self.ctx.dispatch(DAGEvent(DAGEventType.DAG_COMMIT_COMPLETED,
@@ -229,18 +253,43 @@ class DAGImpl:
 
     def _on_commit_completed(self, event: DAGEvent) -> DAGState:
         self.finish_time = time.time()
+        if getattr(event, "fenced", False):
+            # A superseded incarnation owns nothing anymore: it must not
+            # journal to the ledger (the live AM writes it), must not abort
+            # committers (the live AM may be publishing right now), and must
+            # not tear down process-global services the live AM is using.
+            self.diagnostics.append(
+                f"commit fenced: {getattr(event, 'diagnostics', '')}")
+            self.ctx.on_dag_finished(self, DAGState.FAILED, fenced=True)
+            return DAGState.FAILED
         if self._kill_requested:
+            self._ledger_abort("kill requested during commit")
             self._abort_committers()
             self._finish_history(DAGState.KILLED)
             return DAGState.KILLED
         if event.succeeded:
+            # ledger record 2/2: committers are done and durable — fsync'd
+            # before the DAG's terminal record so a crash after this point
+            # rolls FORWARD to SUCCEEDED, never re-runs or aborts
+            self.ctx.history(HistoryEvent(
+                HistoryEventType.DAG_COMMIT_FINISHED,
+                dag_id=str(self.dag_id), data={"dag_name": self.name}))
             self._finish_history(DAGState.SUCCEEDED)
             return DAGState.SUCCEEDED
         self.diagnostics.append(
             f"commit failed: {getattr(event, 'diagnostics', '')}")
+        self._ledger_abort(getattr(event, "diagnostics", ""))
         self._abort_committers()
         self._finish_history(DAGState.FAILED)
         return DAGState.FAILED
+
+    def _ledger_abort(self, reason: str) -> None:
+        """COMMIT_ABORTED is written (and fsync'd) BEFORE the rollback runs:
+        once durable, recovery never rolls this commit forward — it re-runs
+        the idempotent aborts instead."""
+        self.ctx.history(HistoryEvent(
+            HistoryEventType.DAG_COMMIT_ABORTED, dag_id=str(self.dag_id),
+            data={"dag_name": self.name, "reason": reason}))
 
     def _abort_committers(self) -> None:
         for name, committer in self._collect_committers():

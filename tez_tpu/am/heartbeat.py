@@ -48,6 +48,15 @@ class HeartbeatMonitor:
         # latency under chaos); the loop's BaseException guard absorbs fail
         # mode into a logged, skipped tick
         faults.fire("am.heartbeat.monitor")
+        # a superseded AM's monitor must not keep dispatching TA_TIMED_OUT
+        # or respawning runners against the live incarnation's resources
+        from tez_tpu.common import epoch as epoch_registry
+        my_epoch = int(getattr(self.ctx, "attempt", 0) or 0)
+        if my_epoch > 0 and epoch_registry.is_stale(
+                getattr(self.ctx, "app_id", ""), my_epoch):
+            faults.fire("fence.stale_epoch", detail="heartbeat.monitor")
+            self._stop.set()
+            return
         # Watchdog for the runner pool: a runner deciding to idle-exit still
         # counts as capacity at schedule time, so queued work could strand
         # with nothing re-triggering a spawn.  Re-examine the backlog every

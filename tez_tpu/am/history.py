@@ -27,6 +27,12 @@ class HistoryEventType(enum.Enum):
     DAG_INITIALIZED = enum.auto()
     DAG_STARTED = enum.auto()
     DAG_COMMIT_STARTED = enum.auto()
+    # commit-ledger terminals: STARTED..FINISHED bracket the window where
+    # committers may have mutated the filesystem; ABORTED records that the
+    # partial commit was rolled back.  All three are summary events (fsync'd
+    # before the next ledger state can be reached).
+    DAG_COMMIT_FINISHED = enum.auto()
+    DAG_COMMIT_ABORTED = enum.auto()
     DAG_FINISHED = enum.auto()
     DAG_KILL_REQUEST = enum.auto()
     VERTEX_INITIALIZED = enum.auto()
@@ -54,6 +60,8 @@ SUMMARY_EVENT_TYPES = frozenset({
     HistoryEventType.DAG_SUBMITTED,
     HistoryEventType.DAG_STARTED,
     HistoryEventType.DAG_COMMIT_STARTED,
+    HistoryEventType.DAG_COMMIT_FINISHED,
+    HistoryEventType.DAG_COMMIT_ABORTED,
     HistoryEventType.VERTEX_COMMIT_STARTED,
     HistoryEventType.VERTEX_GROUP_COMMIT_STARTED,
     HistoryEventType.VERTEX_GROUP_COMMIT_FINISHED,

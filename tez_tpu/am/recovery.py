@@ -14,6 +14,7 @@ import json
 import logging
 import os
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Set
 
 from tez_tpu.am.history import HistoryEvent, HistoryEventType
@@ -22,6 +23,52 @@ from tez_tpu.common import faults
 from tez_tpu.dag.plan import DAGPlan
 
 log = logging.getLogger(__name__)
+
+#: Commit-ledger records: the write-ahead log of the DAG commit protocol.
+#: STARTED is fsync'd BEFORE any committer mutates the filesystem;
+#: FINISHED/ABORTED are fsync'd before the DAG reaches its terminal record.
+COMMIT_LEDGER_TYPES = frozenset({
+    HistoryEventType.DAG_COMMIT_STARTED,
+    HistoryEventType.DAG_COMMIT_FINISHED,
+    HistoryEventType.DAG_COMMIT_ABORTED,
+})
+
+
+class JournalLineError(ValueError):
+    """A journal line failed CRC validation or JSON/event decoding."""
+
+
+def encode_journal_line(event: HistoryEvent) -> str:
+    """`crc32-hex SP json` — the CRC covers the JSON bytes so a torn or
+    bit-flipped record is detected instead of silently replayed."""
+    payload = event.to_json()
+    crc = zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF
+    return "%08x %s" % (crc, payload)
+
+
+def decode_journal_line(line: str) -> HistoryEvent:
+    """Inverse of encode_journal_line.  Legacy raw-JSON lines (pre-CRC
+    journals) decode too.  Raises JournalLineError on corruption."""
+    if line.startswith("{"):
+        try:
+            return HistoryEvent.from_json(line)
+        except Exception as e:  # noqa: BLE001
+            raise JournalLineError(f"bad legacy record: {e}") from e
+    if len(line) < 10 or line[8] != " ":
+        raise JournalLineError("malformed record framing")
+    try:
+        want = int(line[:8], 16)
+    except ValueError as e:
+        raise JournalLineError("malformed CRC prefix") from e
+    payload = line[9:]
+    got = zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF
+    if got != want:
+        raise JournalLineError(
+            f"CRC mismatch (recorded {want:08x}, computed {got:08x})")
+    try:
+        return HistoryEvent.from_json(payload)
+    except Exception as e:  # noqa: BLE001
+        raise JournalLineError(f"bad event JSON: {e}") from e
 
 
 class RecoveryService:
@@ -51,8 +98,14 @@ class RecoveryService:
         if self._fh is None:
             return
         faults.fire("am.recovery.append", detail=event.event_type.name)
-        self._fh.write(event.to_json() + "\n")
+        self._fh.write(encode_journal_line(event) + "\n")
         if event.is_summary:
+            if event.event_type in COMMIT_LEDGER_TYPES:
+                # fail mode here IS the mid-commit AM crash: the ledger
+                # record may or may not have reached disk, and recovery
+                # must cope with either
+                faults.fire("commit.ledger.fsync",
+                            detail=event.event_type.name)
             faults.fire("am.recovery.fsync", detail=event.event_type.name)
             self._fh.flush()
             os.fsync(self._fh.fileno())
@@ -80,6 +133,12 @@ class DAGRecoveryData:
     completed_vertices: Dict[str, Dict[str, Any]]   # vertex name -> finish data
     succeeded_tasks: Set[str]                 # task id strings
     events: List[HistoryEvent]
+    # Commit-ledger state at crash time: None (commit never started),
+    # "STARTED" (in the mutation window — roll forward or abort per
+    # tez.am.commit.recovery.policy), "FINISHED" (committers completed; only
+    # the terminal DAG record was lost — roll forward to SUCCEEDED),
+    # "ABORTED" (partial commit rolled back — DAG is FAILED).
+    commit_state: Optional[str] = None
     # task id string -> {"attempt": attempt id str, "generated_events": wire,
     # "counters": dict} — only for tasks whose final state was SUCCEEDED and
     # whose successful attempt journaled its generated events.
@@ -207,20 +266,36 @@ class RecoveryParser:
                 out.append(p)
         return out
 
+    def read_events(self) -> List[HistoryEvent]:
+        """Decode every journal record across all attempts.  A corrupt LAST
+        line of the LAST journal is a torn tail write (the AM died mid-
+        append) — tolerated quietly; corruption anywhere else means the
+        journal was damaged at rest and is logged loudly."""
+        events: List[HistoryEvent] = []
+        files = self.journal_files()
+        for fi, path in enumerate(files):
+            with open(path) as fh:
+                lines = [ln.strip() for ln in fh]
+            while lines and not lines[-1]:
+                lines.pop()
+            for li, line in enumerate(lines):
+                if not line:
+                    continue
+                try:
+                    events.append(decode_journal_line(line))
+                except JournalLineError as e:
+                    if fi == len(files) - 1 and li == len(lines) - 1:
+                        log.info("tolerating torn trailing journal record "
+                                 "in %s: %s", path, e)
+                    else:
+                        log.warning("skipping corrupt journal record "
+                                    "(%s line %d): %s", path, li + 1, e)
+        return events
+
     def parse(self) -> Optional[DAGRecoveryData]:
         """Returns recovery data for the last in-progress DAG, or None when
         there is nothing to recover (no DAG, or last DAG finished)."""
-        events: List[HistoryEvent] = []
-        for path in self.journal_files():
-            with open(path) as fh:
-                for line in fh:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        events.append(HistoryEvent.from_json(line))
-                    except Exception:  # noqa: BLE001 — torn tail write
-                        log.warning("skipping corrupt journal line")
+        events = self.read_events()
         if not events:
             return None
         # find last submitted DAG
@@ -235,7 +310,7 @@ class RecoveryParser:
             return None
         dag_events = [e for e in events if e.dag_id == last_dag_id]
         dag_state = None
-        commit_started = False
+        commit_state: Optional[str] = None
         # per-vertex commits are in flight only until that vertex's
         # VERTEX_FINISHED lands — a long-finished vertex commit must not
         # poison recovery of a DAG that crashed hours later
@@ -252,7 +327,11 @@ class RecoveryParser:
             if t is HistoryEventType.DAG_FINISHED:
                 dag_state = ev.data.get("state")
             elif t is HistoryEventType.DAG_COMMIT_STARTED:
-                commit_started = True
+                commit_state = "STARTED"
+            elif t is HistoryEventType.DAG_COMMIT_FINISHED:
+                commit_state = "FINISHED"
+            elif t is HistoryEventType.DAG_COMMIT_ABORTED:
+                commit_state = "ABORTED"
             elif t is HistoryEventType.VERTEX_COMMIT_STARTED:
                 pending_vertex_commits.add(ev.vertex_id)
             elif t is HistoryEventType.VERTEX_GROUP_COMMIT_STARTED:
@@ -297,9 +376,11 @@ class RecoveryParser:
             }
         return DAGRecoveryData(
             dag_id=last_dag_id, plan=plan, dag_state=dag_state,
-            commit_in_flight=(commit_started or bool(pending_vertex_commits)
+            commit_in_flight=(commit_state == "STARTED"
+                              or bool(pending_vertex_commits)
                               or bool(pending_group_commits))
             and dag_state is None,
+            commit_state=commit_state if dag_state is None else None,
             completed_vertices=completed_vertices,
             succeeded_tasks=succeeded_tasks, events=dag_events,
             task_data=task_data, vertex_num_tasks=vertex_num_tasks,

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 from tez_tpu.api.events import TezAPIEvent, TezEvent
 from tez_tpu.am.events import (TaskAttemptEvent, TaskAttemptEventType,
                                VertexEvent, VertexEventType)
+from tez_tpu.common import epoch as epoch_registry
 from tez_tpu.common import faults
 from tez_tpu.common.counters import TezCounters
 from tez_tpu.common.ids import ContainerId, TaskAttemptId
@@ -34,6 +35,8 @@ class HeartbeatRequest:
     events: List[TezEvent]
     counters: Optional[TezCounters] = None
     progress: float = 0.0
+    #: AM epoch stamped into the runner's TaskSpec (0 = unstamped/legacy)
+    epoch: int = 0
 
 
 @dataclasses.dataclass
@@ -65,6 +68,33 @@ class TaskCommunicatorManager:
         self.ctx = ctx
         self._sessions: Dict[TaskAttemptId, _AttemptSession] = {}
         self._lock = threading.Lock()
+        # epoch fencing: this comm serves exactly one AM incarnation; it
+        # rejects messages stamped with an older epoch AND stops arbitrating
+        # once a newer incarnation registers (zombie-AM self-fencing)
+        self.epoch = int(getattr(ctx, "attempt", 0) or 0)
+        from tez_tpu.common import config as C
+        conf = getattr(ctx, "conf", None)
+        self._fencing = bool(conf.get(C.AM_EPOCH_FENCING_ENABLED)) \
+            if conf is not None else True
+        if self.epoch > 0:
+            epoch_registry.register(getattr(ctx, "app_id", ""), self.epoch)
+
+    def _fenced(self, msg_epoch: int, detail: str) -> bool:
+        """True when the caller (or this AM itself) is from a stale epoch."""
+        if not self._fencing or self.epoch <= 0:
+            return False
+        app_id = getattr(self.ctx, "app_id", "")
+        if 0 < msg_epoch < self.epoch:
+            faults.fire("fence.stale_epoch", detail=detail)
+            log.warning("fenced stale-epoch message (epoch %d < %d): %s",
+                        msg_epoch, self.epoch, detail)
+            return True
+        if epoch_registry.is_stale(app_id, self.epoch):
+            faults.fire("fence.stale_epoch", detail=detail)
+            log.warning("AM epoch %d superseded by %d; refusing: %s",
+                        self.epoch, epoch_registry.current(app_id), detail)
+            return True
+        return False
 
     # -- runner-facing API (called from runner threads) ----------------------
     def get_task(self, container_id: ContainerId, timeout: float = 1.0,
@@ -92,6 +122,11 @@ class TaskCommunicatorManager:
         # heartbeat thread stalls before the AM sees the beat); fail mode
         # surfaces as an umbilical fault on the runner side
         faults.fire("am.heartbeat", detail=str(request.attempt_id))
+        if self._fenced(getattr(request, "epoch", 0),
+                        f"heartbeat {request.attempt_id}"):
+            # a zombie runner must stop, not keep feeding a dead (or wrong)
+            # incarnation's state machines
+            return HeartbeatResponse(events=[], should_die=True)
         session = self._session(request.attempt_id)
         session.last_heartbeat = time.time()
         if request.events or request.progress != session.last_progress:
@@ -106,7 +141,11 @@ class TaskCommunicatorManager:
         events = self._pull_events(request.attempt_id, session)
         return HeartbeatResponse(events=events, should_die=session.killed)
 
-    def can_commit(self, attempt_id: TaskAttemptId) -> bool:
+    def can_commit(self, attempt_id: TaskAttemptId, epoch: int = 0) -> bool:
+        # commit arbitration is the last line of exactly-once defense: a
+        # zombie attempt (or this comm itself, once superseded) never wins
+        if self._fenced(epoch, f"can_commit {attempt_id}"):
+            return False
         vertex = self.ctx.current_dag.vertex_by_id(attempt_id.vertex_id)
         if vertex is None:
             return False
@@ -117,7 +156,9 @@ class TaskCommunicatorManager:
             return task.can_commit(attempt_id)
 
     def task_done(self, attempt_id: TaskAttemptId, events: List[TezEvent],
-                  counters: Optional[TezCounters]) -> None:
+                  counters: Optional[TezCounters], epoch: int = 0) -> None:
+        if self._fenced(epoch, f"task_done {attempt_id}"):
+            return
         if events:
             self._route_events(attempt_id, events)
         self.ctx.dispatch(TaskAttemptEvent(
@@ -127,12 +168,16 @@ class TaskCommunicatorManager:
     def task_failed(self, attempt_id: TaskAttemptId, diagnostics: str,
                     fatal: bool = False,
                     counters: Optional[TezCounters] = None) -> None:
+        if self._fenced(0, f"task_failed {attempt_id}"):
+            return
         self.ctx.dispatch(TaskAttemptEvent(
             TaskAttemptEventType.TA_FAILED, attempt_id,
             diagnostics=diagnostics, fatal=fatal, counters=counters))
         self._drop_session(attempt_id)
 
     def task_killed(self, attempt_id: TaskAttemptId, diagnostics: str) -> None:
+        if self._fenced(0, f"task_killed {attempt_id}"):
+            return
         self.ctx.dispatch(TaskAttemptEvent(
             TaskAttemptEventType.TA_KILL_REQUEST, attempt_id,
             diagnostics=diagnostics))

@@ -207,11 +207,12 @@ class RemoteUmbilical(FramedClient):
     def heartbeat(self, request: Any) -> Any:
         return self._call("heartbeat", request)
 
-    def can_commit(self, attempt_id: Any) -> bool:
-        return self._call("can_commit", attempt_id)
+    def can_commit(self, attempt_id: Any, epoch: int = 0) -> bool:
+        return self._call("can_commit", attempt_id, epoch=epoch)
 
-    def task_done(self, attempt_id: Any, events: Any, counters: Any) -> None:
-        self._call("task_done", attempt_id, events, counters)
+    def task_done(self, attempt_id: Any, events: Any, counters: Any,
+                  epoch: int = 0) -> None:
+        self._call("task_done", attempt_id, events, counters, epoch=epoch)
 
     def task_failed(self, attempt_id: Any, diagnostics: str,
                     fatal: bool = False, counters: Any = None) -> None:

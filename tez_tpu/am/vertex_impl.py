@@ -235,29 +235,15 @@ class VertexImpl:
         VertexImpl OutputCommitter handling; commit itself runs at DAG
         success in the default commit mode)."""
         self.committers: Dict[str, Any] = {}
-        from tez_tpu.api.initializer import OutputCommitterContext
-
-        class _Ctx(OutputCommitterContext):
-            def __init__(self, output_name: str, vertex_name: str, payload: Any):
-                self._o, self._v, self._p = output_name, vertex_name, payload
-
-            @property
-            def output_name(self) -> str:
-                return self._o
-
-            @property
-            def vertex_name(self) -> str:
-                return self._v
-
-            @property
-            def user_payload(self) -> Any:
-                return self._p
+        from tez_tpu.api.initializer import SimpleCommitterContext
 
         for sink in self.plan.leaf_outputs:
             if sink.committer_descriptor is None:
                 continue
-            ctx = _Ctx(sink.name, self.name,
-                       sink.committer_descriptor.payload)
+            ctx = SimpleCommitterContext(
+                sink.name, self.name, sink.committer_descriptor.payload,
+                app_id=getattr(self.dag.ctx, "app_id", ""),
+                am_epoch=getattr(self.dag.ctx, "attempt", 0))
             committer = sink.committer_descriptor.instantiate(ctx)
             committer.initialize()
             committer.setup_output()
@@ -813,6 +799,7 @@ class VertexImpl:
             outputs=tuple(outputs),
             group_inputs=tuple(self.group_input_specs),
             conf=dict(self.conf),
+            am_epoch=getattr(self.dag.ctx, "attempt", 0),
         )
 
     def status_dict(self) -> Dict[str, Any]:

@@ -105,6 +105,31 @@ class OutputCommitterContext(abc.ABC):
     def user_payload(self) -> UserPayload: ...
 
 
+class SimpleCommitterContext(OutputCommitterContext):
+    """Concrete committer identity used by the AM (and by recovery, which
+    rebuilds committers straight from the plan).  Carries the owning AM
+    incarnation so committers can fence filesystem mutations against a
+    restarted AM (0 = unstamped/legacy)."""
+
+    def __init__(self, output_name: str, vertex_name: str, payload: Any,
+                 app_id: str = "", am_epoch: int = 0):
+        self._o, self._v, self._p = output_name, vertex_name, payload
+        self.app_id = app_id
+        self.am_epoch = am_epoch
+
+    @property
+    def output_name(self) -> str:
+        return self._o
+
+    @property
+    def vertex_name(self) -> str:
+        return self._v
+
+    @property
+    def user_payload(self) -> Any:
+        return self._p
+
+
 class OutputCommitter(abc.ABC):
     """Reference: OutputCommitter.java."""
 

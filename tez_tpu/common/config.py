@@ -171,6 +171,17 @@ RECOVERY_TRUSTED_STAGING = _key(
     "allow pickle-encoded journal payloads during recovery replay (only "
     "safe when the staging dir is writable solely by the framework)")
 DAG_RECOVERY_FLUSH_INTERVAL_SECS = _key("tez.dag.recovery.flush.interval.secs", 30, Scope.AM)
+AM_EPOCH_FENCING_ENABLED = _key(
+    "tez.am.epoch.fencing.enabled", True, Scope.AM,
+    "Reject umbilical/commit/shuffle traffic stamped with an older AM "
+    "attempt epoch, and stop acting once this AM is itself superseded "
+    "(zombie fencing across AM restarts; see docs/recovery.md)")
+AM_COMMIT_RECOVERY_POLICY = _key(
+    "tez.am.commit.recovery.policy", "resume", Scope.AM,
+    "What recovery does with a DAG whose commit ledger shows "
+    "COMMIT_STARTED without COMMIT_FINISHED/ABORTED: 'resume' re-runs the "
+    "idempotent committers and rolls the commit forward; 'fail' keeps the "
+    "reference semantics (partial commits fail the DAG)")
 AM_HISTORY_LOGGING_ENABLED = _key(
     "tez.am.history.logging.enabled", True, Scope.AM,
     "Master switch for the history logging service (recovery journaling "

@@ -1,0 +1,61 @@
+"""Process-global AM attempt-epoch registry: zombie fencing's source of truth.
+
+Reference lineage: the reference fences stale task attempts with the AM
+attempt number baked into the YARN container/token identity; a restarted AM
+implicitly invalidates its predecessor because the RM kills the old
+containers.  In-process and multi-runner deployments here have no RM to do
+that killing, so zombie threads of a crashed AM incarnation can keep
+running — this registry is how every shared seam (commit arbitration,
+umbilical, shuffle registration, output publish) discovers it has been
+superseded.
+
+The epoch IS the AM attempt number: monotonically increasing per app across
+incarnations.  Every ``DAGAppMaster`` registers ``(app_id, attempt)`` at
+construction; components compare their own stamped epoch against
+``current(app_id)`` before acting on shared state.
+
+Stamping convention: epoch 0 means "unstamped" (legacy callers, standalone
+tests) and is never fenced — fencing only rejects a *known-older* epoch.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_current: Dict[str, int] = {}
+
+
+class EpochFencedError(RuntimeError):
+    """An actor from a superseded AM incarnation touched a fenced seam."""
+
+
+def register(app_id: str, epoch: int) -> int:
+    """Record ``epoch`` as a live incarnation of ``app_id``; keeps the max
+    (a late-starting old attempt cannot roll the fence back).  Returns the
+    current epoch after registration."""
+    with _lock:
+        cur = max(_current.get(app_id, 0), int(epoch))
+        _current[app_id] = cur
+        return cur
+
+
+def current(app_id: str) -> int:
+    """The newest registered epoch for ``app_id`` (0 = never registered)."""
+    with _lock:
+        return _current.get(app_id, 0)
+
+
+def is_stale(app_id: str, epoch: int) -> bool:
+    """True when ``epoch`` is a *known-older* incarnation of ``app_id``.
+    Unstamped (<= 0) epochs are never stale."""
+    if epoch <= 0:
+        return False
+    with _lock:
+        return epoch < _current.get(app_id, 0)
+
+
+def reset() -> None:
+    """Test hook: drop all registrations (the registry is process-global)."""
+    with _lock:
+        _current.clear()

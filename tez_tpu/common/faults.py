@@ -85,6 +85,15 @@ KNOWN_POINTS: Dict[str, str] = {
     "task.run":
         "runtime/task_runner.py processor invocation (detail = attempt id; "
         "delay mode makes an attempt a straggler, fail mode crashes it)",
+    "commit.ledger.fsync":
+        "am/recovery.py fsync of a commit-ledger record (DAG_COMMIT_STARTED/"
+        "FINISHED/ABORTED) — fail mode crashes the AM between ledger states",
+    "commit.publish":
+        "io/file_output.py per-part-file publish inside commit_output "
+        "(detail = part filename; delay mode holds the commit mid-publish)",
+    "fence.stale_epoch":
+        "observability point fired wherever a stale-epoch actor is rejected "
+        "(task_comm, shuffle service/server, committer publish fence)",
 }
 
 _EXC_KINDS = {

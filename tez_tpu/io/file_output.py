@@ -13,10 +13,17 @@ from typing import Any, List, Sequence
 from tez_tpu.api.events import TezAPIEvent
 from tez_tpu.api.initializer import OutputCommitter
 from tez_tpu.api.runtime import KeyValueWriter, LogicalOutput, Writer
+from tez_tpu.common import epoch as epoch_registry
+from tez_tpu.common import faults
 from tez_tpu.common.counters import FileSystemCounter, TaskCounter
+from tez_tpu.common.epoch import EpochFencedError
 from tez_tpu.ops.serde import get_serde
 
 TMP_SUBDIR = "_temporary"
+#: Publish journal inside the tmp tree: each part filename is appended (and
+#: fsync'd) BEFORE its rename into the output dir, so abort after a partial
+#: commit can un-publish exactly the files that made it out.
+PUBLISH_MANIFEST = "_publish_manifest"
 
 
 class _PartWriter(KeyValueWriter):
@@ -97,8 +104,15 @@ class FileOutput(LogicalOutput):
 
 
 class FileOutputCommitter(OutputCommitter):
-    """Publishes committed part files to the output dir; abort removes
-    temporaries."""
+    """Publishes committed part files to the output dir.
+
+    Idempotent and resumable: re-entering commit_output after a crash (the
+    recovery roll-forward path) publishes only what is still staged, and a
+    crash at any point leaves a state this committer can finish or that
+    abort_output can fully roll back.  Every publish is (1) preceded by an
+    epoch fence check — a committer owned by a superseded AM incarnation
+    must not touch the output — and (2) journaled to the publish manifest
+    before the rename, so abort can un-publish a partial commit."""
 
     def initialize(self) -> None:
         payload = self.context.user_payload.load() or {}
@@ -107,17 +121,68 @@ class FileOutputCommitter(OutputCommitter):
     def setup_output(self) -> None:
         os.makedirs(os.path.join(self.out_dir, TMP_SUBDIR), exist_ok=True)
 
+    def _fence(self, detail: str) -> None:
+        app_id = str(getattr(self.context, "app_id", "") or "")
+        my_epoch = int(getattr(self.context, "am_epoch", 0) or 0)
+        if my_epoch > 0 and epoch_registry.is_stale(app_id, my_epoch):
+            faults.fire("fence.stale_epoch", detail=f"commit.publish {detail}")
+            raise EpochFencedError(
+                f"committer epoch {my_epoch} superseded by "
+                f"{epoch_registry.current(app_id)}; refusing to publish "
+                f"{detail}")
+
     def commit_output(self) -> None:
-        committed = os.path.join(self.out_dir, TMP_SUBDIR, "committed")
+        tmp = os.path.join(self.out_dir, TMP_SUBDIR)
+        success = os.path.join(self.out_dir, "_SUCCESS")
+        if not os.path.isdir(tmp):
+            # tmp tree already gone: a prior incarnation finished publishing
+            # and was interrupted at (or after) the _SUCCESS marker — roll
+            # forward by (re)writing the marker, nothing else to do
+            self._fence("_SUCCESS")
+            with open(success, "w"):
+                pass
+            return
+        committed = os.path.join(tmp, "committed")
         if os.path.isdir(committed):
-            for f in sorted(os.listdir(committed)):
-                os.replace(os.path.join(committed, f),
-                           os.path.join(self.out_dir, f))
-        shutil.rmtree(os.path.join(self.out_dir, TMP_SUBDIR),
-                      ignore_errors=True)
-        with open(os.path.join(self.out_dir, "_SUCCESS"), "w"):
+            with open(os.path.join(tmp, PUBLISH_MANIFEST), "a") as mf:
+                for f in sorted(os.listdir(committed)):
+                    # fault point FIRST (delay mode parks the commit right
+                    # here), so a zombie held mid-commit re-checks the fence
+                    # when it wakes
+                    faults.fire("commit.publish", detail=f)
+                    self._fence(f)
+                    mf.write(f + "\n")
+                    mf.flush()
+                    os.fsync(mf.fileno())
+                    os.replace(os.path.join(committed, f),
+                               os.path.join(self.out_dir, f))
+        self._fence("_SUCCESS")
+        shutil.rmtree(tmp, ignore_errors=True)
+        with open(success, "w"):
             pass
 
     def abort_output(self, final_state: str) -> None:
-        shutil.rmtree(os.path.join(self.out_dir, TMP_SUBDIR),
-                      ignore_errors=True)
+        """Roll back a (possibly partial) commit: un-publish every file the
+        manifest records, then remove the whole tmp tree.  Idempotent — a
+        re-entrant abort (recovery re-runs it after a crash mid-abort) finds
+        progressively less to do.  A fully-committed output (tmp gone) is
+        left intact: there is nothing staged left to roll back."""
+        tmp = os.path.join(self.out_dir, TMP_SUBDIR)
+        if not os.path.isdir(tmp):
+            return
+        manifest = os.path.join(tmp, PUBLISH_MANIFEST)
+        if os.path.exists(manifest):
+            with open(manifest) as fh:
+                for line in fh:
+                    name = line.strip()
+                    if not name:
+                        continue
+                    try:
+                        os.remove(os.path.join(self.out_dir, name))
+                    except FileNotFoundError:
+                        pass   # crash between manifest append and rename
+        try:
+            os.remove(os.path.join(self.out_dir, "_SUCCESS"))
+        except FileNotFoundError:
+            pass   # a partial commit never reached the marker
+        shutil.rmtree(tmp, ignore_errors=True)

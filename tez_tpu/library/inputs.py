@@ -116,7 +116,9 @@ class ShuffleFetchTable:
                 read_to = float(_k(C.SHUFFLE_READ_TIMEOUT_MS)) / 1e3
                 factory = lambda h, p: TcpFetchSession(  # noqa: E731
                     self._secret, h, p, connect_timeout=conn_to,
-                    ssl_context=ssl_ctx, read_timeout=read_to)
+                    ssl_context=ssl_ctx, read_timeout=read_to,
+                    epoch=getattr(ctx, "am_epoch", 0),
+                    app_id=getattr(ctx, "app_id", ""))
             self._scheduler = FetchScheduler(
                 deliver=self._remote_done,
                 session_factory=factory,

@@ -213,7 +213,8 @@ class OrderedPartitionedKVOutput(LogicalOutput):
             ctr.increment(TaskCounter.HOST_SPILL_BYTES, written)
             run = FileRun(path)
         self.service.register(output_path_component(self.context), spill_id,
-                              run)
+                              run, epoch=getattr(self.context, "am_epoch", 0),
+                              app_id=getattr(self.context, "app_id", ""))
         # last=False; close() sends the final marker
         self.context.send_events(self._events_for_run(run, spill_id, False))
         self._spills_sent += 1
@@ -231,12 +232,16 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                 spill_id=self._spills_sent, last_event=True)
             self.service.register(output_path_component(self.context),
                                   self._spills_sent,
-                                  _empty_run(self.num_physical_outputs))
+                                  _empty_run(self.num_physical_outputs),
+                                  epoch=getattr(self.context, "am_epoch", 0),
+                                  app_id=getattr(self.context, "app_id", ""))
             return [CompositeDataMovementEvent(0, self.num_physical_outputs,
                                                payload)]
         assert final_run is not None
         self.service.register(output_path_component(self.context), -1,
-                              final_run)
+                              final_run,
+                              epoch=getattr(self.context, "am_epoch", 0),
+                              app_id=getattr(self.context, "app_id", ""))
         self.context.counters.increment(
             TaskCounter.OUTPUT_BYTES_PHYSICAL, final_run.nbytes)
         return self._events_for_run(final_run, -1, True)

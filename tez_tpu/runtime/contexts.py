@@ -58,6 +58,16 @@ class _BaseContext:
         return self._runner.spec.vertex_parallelism
 
     @property
+    def am_epoch(self) -> int:
+        """AM incarnation that issued this task's spec (0 = unstamped)."""
+        return getattr(self._runner.spec, "am_epoch", 0)
+
+    @property
+    def app_id(self) -> str:
+        """Application the task belongs to (epoch fencing is per-app)."""
+        return self._runner.spec.attempt_id.dag_id.app_id
+
+    @property
     def counters(self) -> TezCounters:
         return self._runner.counters
 
@@ -103,8 +113,11 @@ class _BaseContext:
     def can_commit(self) -> bool:
         """Commit arbitration with the AM.  Available on every context so
         leaf outputs can gate publishing (reference: canCommit flows through
-        the processor, but output commit also honors it)."""
-        return self._runner.umbilical.can_commit(self._runner.spec.attempt_id)
+        the processor, but output commit also honors it).  The spec's AM
+        epoch rides along so a zombie attempt from a pre-crash incarnation
+        is fenced at the arbitration seam."""
+        return self._runner.umbilical.can_commit(
+            self._runner.spec.attempt_id, epoch=self.am_epoch)
 
     @property
     def work_dirs(self) -> List[str]:
@@ -153,4 +166,5 @@ class TezProcessorContext(_BaseContext, ProcessorContext):
         super().__init__(runner, payload, "PROCESSOR")
 
     def can_commit(self) -> bool:
-        return self._runner.umbilical.can_commit(self._runner.spec.attempt_id)
+        return self._runner.umbilical.can_commit(
+            self._runner.spec.attempt_id, epoch=self.am_epoch)

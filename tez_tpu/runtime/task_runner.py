@@ -129,7 +129,8 @@ class TaskRunner:
             .set_value(int((time.time() - start) * 1000))
         if state == "SUCCEEDED":
             self.umbilical.task_done(self.spec.attempt_id,
-                                     self._drain_events(), self.counters)
+                                     self._drain_events(), self.counters,
+                                     epoch=getattr(self.spec, "am_epoch", 0))
         elif state == "KILLED":
             self.umbilical.task_killed(self.spec.attempt_id,
                                        "killed during execution")
@@ -269,7 +270,8 @@ class TaskRunner:
     def _heartbeat_once(self) -> None:
         from tez_tpu.am.task_comm import HeartbeatRequest
         req = HeartbeatRequest(self.spec.attempt_id, self._drain_events(),
-                               counters=None, progress=self.progress)
+                               counters=None, progress=self.progress,
+                               epoch=getattr(self.spec, "am_epoch", 0))
         resp = self.umbilical.heartbeat(req)
         if resp.should_die:
             self._killed.set()

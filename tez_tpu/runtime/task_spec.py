@@ -50,6 +50,10 @@ class TaskSpec:
     outputs: Tuple[OutputSpec, ...]
     group_inputs: Tuple[GroupInputSpec, ...] = ()
     conf: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    #: AM incarnation (attempt number) that issued this spec.  Stamped into
+    #: umbilical calls and shuffle registrations so a zombie attempt from a
+    #: pre-crash AM is rejected at every seam (0 = unstamped/legacy).
+    am_epoch: int = 0
 
     @property
     def task_index(self) -> int:

@@ -75,13 +75,16 @@ class _Inflight:
 
 def TcpFetchSession(secrets: Any, host: str, port: int,
                     connect_timeout: float = 5.0, ssl_context: Any = None,
-                    read_timeout: float = 30.0):
+                    read_timeout: float = 30.0, epoch: int = 0,
+                    app_id: str = ""):
     """Real transport session: ONE TCP connect + nonce handshake, many
     fetches (shuffle/server.py FetchSession — the server's handler loops
-    per connection)."""
+    per connection).  epoch/app_id stamp each request so the server can
+    fence consumers from a superseded AM incarnation."""
     from tez_tpu.shuffle.server import FetchSession
     return FetchSession(secrets, host, port, connect_timeout,
-                        ssl_context=ssl_context, read_timeout=read_timeout)
+                        ssl_context=ssl_context, read_timeout=read_timeout,
+                        epoch=epoch, app_id=app_id)
 
 
 class FetchScheduler:

@@ -84,6 +84,18 @@ class _Handler(socketserver.StreamRequestHandler):
             server.auth_failures += 1   # count BEFORE replying (clients may
             self._reply({"status": "forbidden"}, [])  # observe immediately)
             return
+        # epoch fencing (correctness, not auth — deliberately outside the
+        # HMAC): a consumer stamped with a pre-restart AM epoch must not be
+        # served; its inputs may be re-runs the zombie doesn't know about.
+        # Unstamped requests (epoch 0 / legacy clients) are never fenced.
+        epoch = int(req.get("epoch", 0) or 0)
+        if epoch > 0:
+            from tez_tpu.common import epoch as epoch_registry
+            if epoch_registry.is_stale(str(req.get("app", "") or ""), epoch):
+                faults.fire("fence.stale_epoch",
+                            detail=f"shuffle.serve {path}/{spill}")
+                self._reply({"status": "fenced"}, [])
+                return
         try:
             blobs = [
                 _run_blob(server.service.fetch_partition(path, spill, p))
@@ -161,9 +173,14 @@ class FetchSession:
 
     def __init__(self, secrets: JobTokenSecretManager, host: str, port: int,
                  connect_timeout: float = 5.0, ssl_context=None,
-                 read_timeout: float = 30.0):
+                 read_timeout: float = 30.0, epoch: int = 0,
+                 app_id: str = ""):
         self.secrets = secrets
         self.host, self.port = host, port
+        # AM-epoch stamp for fetch requests (0 = unstamped): lets the server
+        # fence consumers from a superseded AM incarnation
+        self.epoch = epoch
+        self.app_id = app_id
         faults.fire("shuffle.fetch.connect", detail=f"{host}:{port}")
         self._sk = socket.create_connection((host, port),
                                             timeout=connect_timeout)
@@ -187,6 +204,7 @@ class FetchSession:
         req = json.dumps({
             "path": path, "spill": spill,
             "partition_lo": lo, "partition_hi": hi,
+            "epoch": self.epoch, "app": self.app_id,
             "hmac": hash_from_request(self.secrets, path, spill, lo, hi,
                                       self._nonce).hex(),
         }).encode()
@@ -220,7 +238,7 @@ class ShuffleFetcher:
 
     def __init__(self, secrets: JobTokenSecretManager, retries: int = 3,
                  backoff: float = 0.2, connect_timeout: float = 5.0,
-                 ssl_context=None):
+                 ssl_context=None, epoch: int = 0, app_id: str = ""):
         self.secrets = secrets
         # clamp here: retry_call's retries<1 ValueError would otherwise be
         # misread by fetch() as a retryable fetch fault
@@ -228,6 +246,8 @@ class ShuffleFetcher:
         self.backoff = backoff
         self.connect_timeout = connect_timeout
         self.ssl_context = ssl_context
+        self.epoch = epoch
+        self.app_id = app_id
 
     def fetch(self, host: str, port: int, path: str, spill: int,
               partition_lo: int, partition_hi: int = -1) -> List[KVBatch]:
@@ -237,7 +257,8 @@ class ShuffleFetcher:
         def one_try() -> List[KVBatch]:
             session = FetchSession(self.secrets, host, port,
                                    self.connect_timeout,
-                                   ssl_context=self.ssl_context)
+                                   ssl_context=self.ssl_context,
+                                   epoch=self.epoch, app_id=self.app_id)
             try:
                 return session.fetch_range(path, spill, partition_lo,
                                            partition_hi)

@@ -18,7 +18,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from tez_tpu.common import epoch as epoch_registry
 from tez_tpu.common import faults
+from tez_tpu.common.epoch import EpochFencedError
 from tez_tpu.ops.runformat import KVBatch, Run, RUN_HEADER_NBYTES
 
 
@@ -59,7 +61,20 @@ class ShuffleService:
         return self._store is not None
 
     # -- producer side -------------------------------------------------------
-    def register(self, path_component: str, spill_id: int, run: Run) -> None:
+    def register(self, path_component: str, spill_id: int, run: Run,
+                 epoch: int = 0, app_id: str = "") -> None:
+        """Producers stamped with an AM epoch are fenced: a zombie task from
+        a pre-restart incarnation must not (re-)register outputs the live
+        AM's re-runs now own.  Unstamped registrations (epoch 0, e.g. direct
+        test callers) are never fenced.  Pre-crash data already registered
+        stays fetchable — recovery's short-circuited consumers read it."""
+        if epoch > 0 and epoch_registry.is_stale(app_id, epoch):
+            faults.fire("fence.stale_epoch",
+                        detail=f"shuffle.register {path_component}")
+            raise EpochFencedError(
+                f"shuffle register from stale epoch {epoch} "
+                f"(current {epoch_registry.current(app_id)}): "
+                f"{path_component}/{spill_id}")
         with self._lock:
             self._runs[(path_component, spill_id)] = run
         if self._store is not None:

@@ -11,6 +11,15 @@ baseline run. The storm menu only contains *recoverable* faults — ones the
 framework is expected to absorb (retries, reruns, speculation, container
 respawn) — so a divergent or failed run is always a bug, never an
 over-aggressive storm.
+
+``--commit-storm`` runs the exactly-once commit scenario instead: a DAG
+with a FileOutput data sink is killed between the ledger's
+DAG_COMMIT_STARTED and DAG_COMMIT_FINISHED records (a delay fault parks
+the publisher mid-commit), a successor AM attempt resumes the ledger, and
+the published output must be bit-exact vs a fault-free run — no orphaned
+``_temporary`` tree, no double-published part file, ``_SUCCESS`` present.
+On divergence the recovery journal is fsck'd so a corrupt ledger is
+distinguished from a replay bug.
 """
 from __future__ import annotations
 
@@ -20,14 +29,16 @@ import random
 import shutil
 import sys
 import tempfile
-from typing import List, Optional, Tuple
+import time
+from typing import Dict, List, Optional, Tuple
 
 from tez_tpu.client.dag_client import DAGStatusState
 from tez_tpu.client.tez_client import TezClient
 from tez_tpu.common import faults
-from tez_tpu.common.payload import (InputDescriptor, OutputDescriptor,
-                                    ProcessorDescriptor)
-from tez_tpu.dag.dag import DAG, Edge, Vertex
+from tez_tpu.common.payload import (InputDescriptor,
+                                    OutputCommitterDescriptor,
+                                    OutputDescriptor, ProcessorDescriptor)
+from tez_tpu.dag.dag import DAG, DataSinkDescriptor, Edge, Vertex
 from tez_tpu.dag.edge_property import (DataMovementType, DataSourceType,
                                        EdgeProperty, SchedulingType)
 from tez_tpu.library.processors import SimpleProcessor
@@ -151,6 +162,165 @@ def run_trial(seed: int, workdir: str, baseline: Optional[bytes] = None,
     return True, spec, "bit-exact vs baseline"
 
 
+# ----------------------------------------------------------- commit storm
+
+class ChaosSinkCountProcessor(SimpleProcessor):
+    """ChaosCountProcessor variant that emits through the vertex's FileOutput
+    data sink, so the result is published by the commit protocol (two-phase
+    ledger + rename-on-commit) rather than written directly by the task."""
+
+    def run(self, inputs, outputs):
+        reader = inputs["producer"].get_reader()
+        totals = {k: sum(vs) for k, vs in reader}
+        writer = outputs["sink"].get_writer()
+        for k, v in sorted(totals.items()):
+            writer.write(k.decode(), str(v))
+
+
+def _build_sink_dag(name: str, out_dir: str, fault_spec: str = "",
+                    fault_seed: int = 0) -> DAG:
+    producer = Vertex.create("producer", ProcessorDescriptor.create(
+        ChaosEmitProcessor), NUM_PRODUCERS)
+    consumer = Vertex.create("consumer", ProcessorDescriptor.create(
+        ChaosSinkCountProcessor), 1)
+    consumer.add_data_sink("sink", DataSinkDescriptor.create(
+        OutputDescriptor.create("tez_tpu.io.file_output:FileOutput",
+                                payload={"path": out_dir,
+                                         "key_serde": "text",
+                                         "value_serde": "text"}),
+        OutputCommitterDescriptor.create(
+            "tez_tpu.io.file_output:FileOutputCommitter",
+            payload={"path": out_dir})))
+    conf = {"tez.runtime.key.class": "bytes",
+            "tez.runtime.value.class": "long"}
+    prop = EdgeProperty.create(
+        DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create(
+            "tez_tpu.library.outputs:OrderedPartitionedKVOutput",
+            payload=conf),
+        InputDescriptor.create(
+            "tez_tpu.library.inputs:OrderedGroupedKVInput", payload=conf))
+    dag = DAG.create(name).add_vertex(producer).add_vertex(consumer)
+    dag.add_edge(Edge.create(producer, consumer, prop))
+    if fault_spec:
+        dag.set_conf("tez.test.fault.spec", fault_spec)
+        dag.set_conf("tez.test.fault.seed", fault_seed)
+    return dag
+
+
+def read_published(out_dir: str) -> Dict[str, bytes]:
+    """Published output-dir contents: {filename: bytes} for every regular
+    file (part files + _SUCCESS). Subdirs (e.g. a leftover _temporary tree)
+    are reported with a b'<DIR>' sentinel so they always diverge."""
+    out: Dict[str, bytes] = {}
+    if not os.path.isdir(out_dir):
+        return out
+    for name in sorted(os.listdir(out_dir)):
+        p = os.path.join(out_dir, name)
+        if os.path.isfile(p):
+            with open(p, "rb") as fh:
+                out[name] = fh.read()
+        else:
+            out[name] = b"<DIR>"
+    return out
+
+
+def _fsck_summary(staging: str, app_id: str) -> str:
+    from tez_tpu.tools import journal_fsck
+    files = journal_fsck.discover_journals(
+        os.path.join(staging, app_id, "recovery"))
+    if not files:
+        return "no recovery journal found"
+    report = journal_fsck.fsck_files(files)
+    dags = {d: led.inferred_terminal for d, led in report.dags.items()}
+    return (f"journal fsck: {'CLEAN' if report.ok else report.errors}; "
+            f"terminal states {dags}")
+
+
+def run_commit_storm(workdir: str, timeout: float = 120.0,
+                     delay_ms: int = 4000,
+                     app_id: str = "app_1_cstorm") -> Tuple[bool, str]:
+    """The exactly-once commit scenario. Returns (ok, detail).
+
+    A ``commit.publish`` delay fault parks attempt 1's publisher after the
+    COMMIT_STARTED ledger record; the AM is killed inside that window, so
+    attempt 2 finds an open ledger and must resume the commit — and the
+    parked publisher, now a zombie from a superseded epoch, must be fenced
+    when it wakes instead of double-publishing."""
+    from tez_tpu.am.app_master import DAGAppMaster
+    from tez_tpu.am.dag_impl import DAGState
+    from tez_tpu.am.history import HistoryEventType
+    from tez_tpu.common import config as C
+
+    # fault-free baseline
+    base_out = os.path.join(workdir, "commit_base", "out")
+    client = TezClient.create("commitbase", {
+        "tez.staging-dir": os.path.join(workdir, "commit_base", "staging"),
+        "tez.am.local.num-containers": 4}).start()
+    try:
+        status = client.submit_dag(
+            _build_sink_dag("commitbase", base_out)).wait_for_completion(
+                timeout=timeout)
+    finally:
+        client.stop()
+        faults.clear_all()
+    if status.state.name != DAGStatusState.SUCCEEDED.name:
+        return False, f"baseline sink DAG failed (state={status.state.name})"
+    baseline = read_published(base_out)
+    if "_SUCCESS" not in baseline:
+        return False, "baseline published no _SUCCESS marker"
+
+    # storm: kill the AM between COMMIT_STARTED and COMMIT_FINISHED
+    out_dir = os.path.join(workdir, "commit_storm", "out")
+    staging = os.path.join(workdir, "commit_storm", "staging")
+    dag = _build_sink_dag(
+        "commitstorm", out_dir,
+        fault_spec=f"commit.publish:delay:ms={delay_ms},n=1", fault_seed=1)
+    plan = dag.create_dag_plan()
+    conf = C.TezConfiguration({"tez.staging-dir": staging,
+                               "tez.am.local.num-containers": 4})
+    am1 = DAGAppMaster(app_id, conf, attempt=1)
+    am1.start()
+    am1.submit_dag(plan)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if am1.logging_service.of_type(HistoryEventType.DAG_COMMIT_STARTED):
+            break
+        time.sleep(0.02)
+    else:
+        am1.stop()
+        return False, "DAG_COMMIT_STARTED never observed"
+    am1.stop()   # crash inside the COMMIT_STARTED..COMMIT_FINISHED window
+
+    am2 = DAGAppMaster(app_id, conf, attempt=2)
+    am2.start()
+    try:
+        recovered = am2.recover_and_resume()
+        if recovered is None:
+            return False, "successor AM recovered nothing"
+        final = am2.wait_for_dag(recovered, timeout=timeout)
+        finished = am2.logging_service.of_type(
+            HistoryEventType.DAG_COMMIT_FINISHED)
+    finally:
+        am2.stop()
+    if final is not DAGState.SUCCEEDED:
+        return False, (f"recovered DAG finished {final}; "
+                       f"{_fsck_summary(staging, app_id)}")
+    if not finished:
+        return False, "resumed commit never journaled DAG_COMMIT_FINISHED"
+    got = read_published(out_dir)
+    if "_temporary" in got:
+        return False, (f"orphaned _temporary tree left in output dir; "
+                       f"{_fsck_summary(staging, app_id)}")
+    if got != baseline:
+        return False, (f"published output diverged from baseline "
+                       f"({sorted(got)} vs {sorted(baseline)}); "
+                       f"{_fsck_summary(staging, app_id)}")
+    return True, (f"bit-exact after mid-commit AM kill "
+                  f"({len(got) - 1} part file(s) + _SUCCESS)")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tez_tpu.tools.chaos", description=__doc__,
@@ -163,10 +333,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="per-DAG completion timeout in seconds")
     ap.add_argument("--workdir", default=None,
                     help="scratch dir (default: fresh tempdir, removed)")
+    ap.add_argument("--commit-storm", action="store_true",
+                    help="run the mid-commit AM-kill exactly-once scenario "
+                         "instead of the seeded storm soak")
     args = ap.parse_args(argv)
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="tez-chaos-")
     cleanup = args.workdir is None
+    if args.commit_storm:
+        try:
+            ok, detail = run_commit_storm(workdir, timeout=args.timeout)
+        finally:
+            if cleanup:
+                shutil.rmtree(workdir, ignore_errors=True)
+        print(("ok   " if ok else "FAIL ") + f"commit-storm: {detail}")
+        if not ok:
+            print("REPRO: python -m tez_tpu.tools.chaos --commit-storm")
+        return 0 if ok else 1
     failures = 0
     try:
         state, baseline = _run_dag(workdir, "baseline", timeout=args.timeout)

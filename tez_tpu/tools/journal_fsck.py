@@ -1,0 +1,220 @@
+"""Recovery-journal fsck: validate a journal's record CRCs, event ordering,
+and commit-ledger pairing, then print the terminal state recovery would
+infer for each DAG.
+
+Point it at one or more journal files, at an app's ``recovery/`` directory
+(all attempts are checked in order), or at a staging dir + app id::
+
+    python -m tez_tpu.tools.journal_fsck <journal.jsonl | recovery-dir> ...
+    python -m tez_tpu.tools.journal_fsck --staging /path/staging --app app_x
+
+Exit code 0 means the journal is consistent (a torn trailing record — the
+AM died mid-append — is tolerated, exactly as recovery tolerates it);
+1 means structural damage or ledger violations; 2 means no journal found.
+The chaos harness runs this on every divergent trial so a corrupt-journal
+root cause is distinguished from a replay bug.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from tez_tpu.am.history import HistoryEvent, HistoryEventType
+from tez_tpu.am.recovery import JournalLineError, decode_journal_line
+
+#: Events whose arrival after a DAG's terminal record is a bug (lifecycle
+#: and ledger records; incidental events like NODE_BLACKLISTED may straggle).
+_LIFECYCLE = frozenset({
+    HistoryEventType.DAG_SUBMITTED, HistoryEventType.DAG_INITIALIZED,
+    HistoryEventType.DAG_STARTED, HistoryEventType.DAG_COMMIT_STARTED,
+    HistoryEventType.DAG_COMMIT_FINISHED, HistoryEventType.DAG_COMMIT_ABORTED,
+    HistoryEventType.DAG_FINISHED,
+})
+
+
+@dataclasses.dataclass
+class DagLedger:
+    """Per-DAG fsck state."""
+    submitted: bool = False
+    commit_state: Optional[str] = None      # None/STARTED/FINISHED/ABORTED
+    terminal: Optional[str] = None          # DAG_FINISHED state, if journaled
+    events: int = 0
+
+    @property
+    def inferred_terminal(self) -> str:
+        """What recovery would conclude for this DAG."""
+        if self.terminal is not None:
+            return self.terminal
+        if self.commit_state == "FINISHED":
+            return "SUCCEEDED (ledger roll-forward)"
+        if self.commit_state == "ABORTED":
+            return "FAILED (ledger rollback)"
+        if self.commit_state == "STARTED":
+            return "IN-COMMIT (policy decides: resume or fail)"
+        return "IN-FLIGHT (resubmit with task short-circuit)"
+
+
+@dataclasses.dataclass
+class FsckReport:
+    files: List[str] = dataclasses.field(default_factory=list)
+    records: int = 0
+    errors: List[str] = dataclasses.field(default_factory=list)
+    warnings: List[str] = dataclasses.field(default_factory=list)
+    torn_tail: bool = False
+    dags: Dict[str, DagLedger] = dataclasses.field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+
+def _check_event(report: FsckReport, ev: HistoryEvent, where: str) -> None:
+    report.records += 1
+    dag_id = ev.dag_id
+    if dag_id is None:
+        return
+    led = report.dags.setdefault(dag_id, DagLedger())
+    led.events += 1
+    t = ev.event_type
+    if led.terminal is not None and t in _LIFECYCLE:
+        report.errors.append(
+            f"{where}: {t.name} for {dag_id} after its terminal "
+            f"DAG_FINISHED({led.terminal})")
+        return
+    if t is HistoryEventType.DAG_SUBMITTED:
+        led.submitted = True
+    elif not led.submitted and t in _LIFECYCLE:
+        report.errors.append(
+            f"{where}: {t.name} for {dag_id} before DAG_SUBMITTED")
+    if t is HistoryEventType.DAG_COMMIT_STARTED:
+        if led.commit_state == "STARTED":
+            report.errors.append(
+                f"{where}: duplicate DAG_COMMIT_STARTED for {dag_id}")
+        elif led.commit_state in ("FINISHED", "ABORTED"):
+            report.errors.append(
+                f"{where}: DAG_COMMIT_STARTED for {dag_id} after ledger "
+                f"already {led.commit_state}")
+        led.commit_state = "STARTED"
+    elif t is HistoryEventType.DAG_COMMIT_FINISHED:
+        if led.commit_state != "STARTED":
+            report.errors.append(
+                f"{where}: DAG_COMMIT_FINISHED for {dag_id} without an open "
+                f"DAG_COMMIT_STARTED (ledger was {led.commit_state})")
+        led.commit_state = "FINISHED"
+    elif t is HistoryEventType.DAG_COMMIT_ABORTED:
+        if led.commit_state != "STARTED":
+            report.errors.append(
+                f"{where}: DAG_COMMIT_ABORTED for {dag_id} without an open "
+                f"DAG_COMMIT_STARTED (ledger was {led.commit_state})")
+        led.commit_state = "ABORTED"
+    elif t is HistoryEventType.DAG_FINISHED:
+        state = ev.data.get("state")
+        led.terminal = state
+        if state == "SUCCEEDED" and led.commit_state == "STARTED":
+            report.errors.append(
+                f"{where}: {dag_id} finished SUCCEEDED with commit ledger "
+                f"still open (STARTED without FINISHED)")
+        if state == "SUCCEEDED" and led.commit_state == "ABORTED":
+            report.errors.append(
+                f"{where}: {dag_id} finished SUCCEEDED after "
+                f"DAG_COMMIT_ABORTED")
+
+
+def fsck_files(paths: List[str]) -> FsckReport:
+    """Validate journals in the given order (attempt order matters: the
+    ledger threads across AM incarnations)."""
+    report = FsckReport(files=list(paths))
+    for fi, path in enumerate(paths):
+        with open(path) as fh:
+            lines = [ln.strip() for ln in fh]
+        while lines and not lines[-1]:
+            lines.pop()
+        for li, line in enumerate(lines):
+            if not line:
+                continue
+            where = f"{os.path.basename(os.path.dirname(path))}/" \
+                    f"{os.path.basename(path)}:{li + 1}"
+            try:
+                ev = decode_journal_line(line)
+            except JournalLineError as e:
+                if fi == len(paths) - 1 and li == len(lines) - 1:
+                    report.torn_tail = True
+                    report.warnings.append(
+                        f"{where}: torn trailing record (tolerated): {e}")
+                else:
+                    report.errors.append(f"{where}: corrupt record: {e}")
+                continue
+            _check_event(report, ev, where)
+    return report
+
+
+def discover_journals(target: str) -> List[str]:
+    """A journal file itself, or a directory scanned for per-attempt
+    ``<n>/journal.jsonl`` children (an app's ``recovery/`` dir), sorted by
+    attempt number."""
+    if os.path.isfile(target):
+        return [target]
+    if not os.path.isdir(target):
+        return []
+    out: List[Tuple[int, str]] = []
+    for name in os.listdir(target):
+        p = os.path.join(target, name, "journal.jsonl")
+        if os.path.isfile(p):
+            try:
+                out.append((int(name), p))
+            except ValueError:
+                out.append((1 << 30, p))
+    direct = os.path.join(target, "journal.jsonl")
+    if os.path.isfile(direct):
+        out.append((0, direct))
+    return [p for _, p in sorted(out)]
+
+
+def print_report(report: FsckReport, verbose: bool = False) -> None:
+    print(f"checked {len(report.files)} journal file(s), "
+          f"{report.records} record(s)")
+    for w in report.warnings:
+        print(f"warn: {w}")
+    for e in report.errors:
+        print(f"ERROR: {e}")
+    for dag_id, led in sorted(report.dags.items()):
+        commit = led.commit_state or "none"
+        print(f"dag {dag_id}: {led.events} record(s), commit-ledger={commit}"
+              f" -> terminal: {led.inferred_terminal}")
+    print("fsck: " + ("CLEAN" if report.ok else
+                      f"{len(report.errors)} error(s)"))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tez_tpu.tools.journal_fsck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("targets", nargs="*",
+                    help="journal.jsonl file(s) or recovery dir(s)")
+    ap.add_argument("--staging", default=None,
+                    help="staging dir (with --app: checks "
+                         "<staging>/<app>/recovery)")
+    ap.add_argument("--app", default=None, help="app id under --staging")
+    args = ap.parse_args(argv)
+
+    targets = list(args.targets)
+    if args.staging and args.app:
+        targets.append(os.path.join(args.staging, args.app, "recovery"))
+    files: List[str] = []
+    for t in targets:
+        found = discover_journals(t)
+        if not found:
+            print(f"no journal found at {t}")
+        files.extend(found)
+    if not files:
+        return 2
+    report = fsck_files(files)
+    print_report(report)
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

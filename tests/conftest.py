@@ -35,6 +35,16 @@ def _disarm_fault_plane():
 
 
 @pytest.fixture(autouse=True)
+def _disarm_trace_plane():
+    """The tracing plane and metrics registry are process-global; spans or
+    gauges leaked by one test must not bleed into the next one's exports."""
+    yield
+    from tez_tpu.common import metrics, tracing
+    tracing.clear_all()
+    metrics.registry().reset()
+
+
+@pytest.fixture(autouse=True)
 def _reset_epoch_registry():
     """The AM-epoch registry is process-global; a test that restarted an AM
     (attempt 2+) would otherwise fence the next test's attempt-1 AMs if an

@@ -1,0 +1,339 @@
+"""Tracing-plane tests: span runtime, carrier propagation, Perfetto export,
+latency histograms, /metrics surface, and the span critical-path analyzer."""
+import json
+import threading
+import typing
+import urllib.request
+
+import pytest
+
+from tests.trace_schema import check_trace
+from tez_tpu.common import metrics, tracing
+from tez_tpu.common.counters import TezCounters
+
+
+# ------------------------------------------------------------- span runtime
+
+def test_disarmed_is_noop():
+    """The disarmed fast path: no spans, no allocations, NOOP singleton."""
+    assert not tracing.armed()
+    s = tracing.span("anything", cat="x", k=1)
+    assert s is tracing.NOOP_SPAN
+    with s as inner:
+        inner.annotate(a=1)
+        inner.event("e")
+    tracing.event("standalone")
+    assert tracing.snapshot() == []
+    assert tracing.current_span() is None
+    assert tracing.current_carrier() == ""
+
+
+def test_armed_records_nested_spans():
+    tracing.arm(scope="t")
+    with tracing.span("outer", cat="task", vertex="v1") as outer:
+        assert tracing.current_span() is outer
+        with tracing.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+            inner.event("tick", n=1)
+    spans = tracing.snapshot()
+    assert [s.name for s in spans] == ["inner", "outer"]  # finish order
+    assert all(s.end is not None and s.end >= s.start for s in spans)
+    assert spans[0].events and spans[0].events[0][1] == "tick"
+
+
+def test_span_error_capture():
+    tracing.arm(scope="t")
+    with pytest.raises(ValueError):
+        with tracing.span("boom"):
+            raise ValueError("no")
+    (sp,) = tracing.snapshot()
+    assert sp.args.get("error", "").startswith("ValueError")
+
+
+def test_carrier_round_trip_and_attach():
+    tracing.arm(scope="t")
+    with tracing.span("root") as root:
+        carrier = tracing.current_carrier()
+    ctx = tracing.parse_carrier(carrier)
+    assert ctx == (root.trace_id, root.span_id)
+    assert tracing.parse_carrier("") is None
+    assert tracing.parse_carrier("00-zz-xx-01") is None
+    # a "remote" worker attaches the carrier and parents off it
+    with tracing.attached(carrier):
+        with tracing.span("remote") as rm:
+            assert rm.trace_id == root.trace_id
+            assert rm.parent_id == root.span_id
+
+
+def test_cross_thread_explicit_parent():
+    """Fetch-style spans: parent captured on one thread, span on another."""
+    tracing.arm(scope="t")
+    captured = {}
+    with tracing.span("attempt") as att:
+        captured["ctx"] = tracing.current_context()
+
+    def fetcher():
+        with tracing.span("shuffle.fetch", parent=captured["ctx"]) as f:
+            captured["fetch"] = (f.trace_id, f.parent_id)
+
+    th = threading.Thread(target=fetcher)
+    th.start()
+    th.join()
+    assert captured["fetch"] == (att.trace_id, att.span_id)
+
+
+def test_buffer_survives_disarm_and_is_bounded():
+    tracing.arm(scope="t", capacity=8)
+    for i in range(20):
+        with tracing.span(f"s{i}"):
+            pass
+    assert len(tracing.snapshot()) == 8              # ring buffer bound
+    tracing.clear("t")
+    assert not tracing.armed()
+    assert len(tracing.snapshot()) == 8              # survives disarm
+    assert tracing.span("late") is tracing.NOOP_SPAN  # but records nothing
+    tracing.clear_all()
+    assert tracing.snapshot() == []
+
+
+def test_install_from_conf_refcounted():
+    from tez_tpu.common import config as C
+    conf = C.TezConfiguration({"tez.trace.enabled": True})
+    assert tracing.install_from_conf(conf, scope="dag1")
+    assert tracing.install_from_conf(conf, scope="dag2")
+    tracing.clear("dag1")
+    assert tracing.armed()                            # dag2 still holds it
+    tracing.clear("dag2")
+    assert not tracing.armed()
+    off = C.TezConfiguration({})
+    assert not tracing.install_from_conf(off, scope="dag3")
+    assert not tracing.armed()
+
+
+# ---------------------------------------------------------- perfetto export
+
+def test_spans_export_valid_trace_event_json():
+    from tez_tpu.tools import trace_export
+    tracing.arm(scope="t")
+    with tracing.span("outer", cat="task", vertex="v"):
+        with tracing.span("inner"):
+            pass
+        tracing.event("fence.stale_epoch", seam="umbilical")
+    trace = trace_export.spans_to_trace(tracing.snapshot())
+    n = check_trace(json.loads(json.dumps(trace)))
+    assert n >= 4  # 2 X spans + 1 instant + >=1 thread_name metadata
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert {"outer", "inner", "fence.stale_epoch", "thread_name"} <= set(names)
+    x = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert all(e["args"]["trace_id"] for e in x)
+
+
+def test_critical_path_picks_dominant():
+    from tez_tpu.tools.trace_export import (critical_path,
+                                            critical_path_report)
+    tracing.arm(scope="t")
+    with tracing.span("dag", cat="dag") as root:
+        with tracing.span("fast", vertex="a"):
+            pass
+        with tracing.span("slow", vertex="b") as slow:
+            slow.start -= 0.5                          # fake 500ms of work
+    spans = tracing.snapshot()
+    path = critical_path(spans)
+    assert [s.name for s in path] == ["dag", "slow"]
+    assert path[0].trace_id == root.trace_id
+    rep = critical_path_report(spans)
+    assert rep["dominant"]["name"] == "slow"
+    assert rep["dominant"]["vertex"] == "b"
+    assert rep["chain"][0]["name"] == "dag"
+
+
+# ------------------------------------------------------- latency histograms
+
+def test_histogram_buckets_and_quantiles():
+    h = metrics.Histogram("x")
+    for ms in (0.5, 3, 3, 700, 1e9):
+        h.observe(ms)
+    d = h.to_dict()
+    assert d["count"] == 5
+    assert sum(d["counts"]) == 5
+    assert d["counts"][-1] == 1                       # 1e9 ms -> overflow
+    assert metrics.bucket_index(0.5) == 0
+    assert metrics.bucket_index(1.0) == 0
+    assert metrics.bucket_index(1.5) == 1
+    assert metrics.bucket_index(65536.0) == 16
+    assert metrics.bucket_index(65537.0) == 17
+    assert 0 < h.quantile(0.5) <= 4.0
+    assert h.quantile(0.95) >= 512.0
+
+
+def test_observe_mirrors_into_counters_and_aggregates():
+    """Bucket counters roll up task->vertex->DAG through plain aggregate()."""
+    c1, c2 = TezCounters(), TezCounters()
+    metrics.observe("shuffle.fetch.rtt", 3.0, counters=c1)
+    metrics.observe("shuffle.fetch.rtt", 100.0, counters=c2)
+    agg = TezCounters()
+    agg.aggregate(c1)
+    agg.aggregate(c2)
+    hists = metrics.histograms_from_counters(agg.to_dict())
+    h = hists["shuffle.fetch.rtt"]
+    assert h["count"] == 2
+    assert h["sum_us"] == 103000
+    assert h["max_ms"] == 128.0
+
+
+def test_prometheus_render_is_well_formed():
+    metrics.observe("spill.write", 12.0)
+    metrics.set_gauge("running_tasks", 3)
+    text = metrics.render_prometheus(metrics.registry().histograms(),
+                                     metrics.registry().gauges())
+    lines = text.splitlines()
+    assert text.endswith("\n")
+    hist = [ln for ln in lines if ln.startswith("tez_latency_spill_write_ms")]
+    assert any('le="+Inf"' in ln for ln in hist)
+    assert any(ln.startswith("tez_latency_spill_write_ms_sum") for ln in hist)
+    assert any(ln.startswith("tez_latency_spill_write_ms_count 1") for ln in hist)
+    # cumulative buckets never decrease
+    vals = [int(ln.rsplit(" ", 1)[1]) for ln in hist if "_bucket" in ln]
+    assert vals == sorted(vals)
+    assert "tez_running_tasks 3" in text
+    # every sample line is "name{labels} value" or "name value"
+    for ln in lines:
+        if ln.startswith("#") or not ln:
+            continue
+        assert len(ln.rsplit(" ", 1)) == 2, ln
+
+
+def test_counter_diff_histogram_regression():
+    from tez_tpu.tools.counter_diff import diff_histograms, flatten
+    a, b = TezCounters(), TezCounters()
+    for _ in range(20):
+        metrics.observe("shuffle.fetch.rtt", 10.0, counters=a)
+        metrics.observe("shuffle.fetch.rtt", 300.0, counters=b)
+    rows = diff_histograms(a.to_dict(), b.to_dict())
+    (name, sa, sb, regressed) = rows[0]
+    assert name == "shuffle.fetch.rtt" and regressed
+    assert sb["p95"] > sa["p95"]
+    # same distribution -> no regression flag
+    rows = diff_histograms(a.to_dict(), a.to_dict())
+    assert not rows[0][3]
+    # histogram groups are kept out of the plain counter diff
+    assert flatten(a.to_dict()) == {}
+
+
+def test_limits_configure_annotations_resolve():
+    """Regression: Limits.configure used 'Any' without importing it, which
+    blew up only when annotations were evaluated."""
+    from tez_tpu.common import counters as counters_mod
+    hints = typing.get_type_hints(counters_mod.Limits.configure.__func__,
+                                  vars(counters_mod))
+    assert hints["conf"] is typing.Any
+
+
+# --------------------------------------------------- swimlane / history r-t
+
+def test_swimlane_history_round_trip(tmp_path):
+    """History JSONL -> DagInfo -> swimlane SVG: lane count matches the
+    containers used, every attempt renders one bar, bar geometry is
+    monotonic with attempt duration."""
+    import re
+    from tez_tpu.client.tez_client import TezClient
+    from tez_tpu.common.payload import ProcessorDescriptor
+    from tez_tpu.dag.dag import DAG, Vertex
+    from tez_tpu.tools.history_parser import parse_jsonl_files
+    from tez_tpu.tools.swimlane import LEFT, render_svg
+    hist = str(tmp_path / "hist")
+    c = TezClient.create("lane", {
+        "tez.staging-dir": str(tmp_path / "s"),
+        "tez.history.logging.service.class":
+            "tez_tpu.am.history:JsonlHistoryLoggingService",
+        "tez.history.logging.log-dir": hist}).start()
+    try:
+        dag = DAG.create("lanedag").add_vertex(Vertex.create(
+            "v", ProcessorDescriptor.create(
+                "tez_tpu.library.processors:SleepProcessor",
+                payload={"sleep_ms": 5}), 3))
+        st = c.submit_dag(dag).wait_for_completion(timeout=30)
+        assert st.state.name == "SUCCEEDED"
+    finally:
+        c.stop()
+    dag_info = list(parse_jsonl_files([hist]).values())[0]
+    attempts = [a for a in dag_info.all_attempts() if a.start_time]
+    assert len(attempts) == 3
+    containers = {a.container_id for a in attempts}
+    svg = render_svg(dag_info)
+    bars = re.findall(r'<rect x="([\d.]+)" y="\d+" width="([\d.]+)"[^>]*>'
+                      r'<title>(attempt_\S+)', svg)
+    assert len(bars) == len(attempts)                 # one bar per attempt
+    assert len(re.findall(r'<text x="4" y="\d+">', svg)) - 1 \
+        == len(containers)                            # one label per lane
+    by_id = {a.attempt_id: a for a in attempts}
+    for x, w, aid in bars:
+        a = by_id[aid]
+        assert float(x) >= LEFT                        # bars start in-lane
+        assert float(w) >= 2.0                         # min visible width
+        # longer attempts never render narrower than much-shorter ones
+    durs = sorted((by_id[aid].duration, float(w)) for x, w, aid in bars)
+    for (d0, w0), (d1, w1) in zip(durs, durs[1:]):
+        if d1 - d0 > 0.05:                             # beyond min-width blur
+            assert w1 >= w0
+
+
+# ----------------------------------------------------------- e2e trace plane
+
+def test_e2e_trace_and_metrics(tmp_path):
+    """A real DAG with tez.trace.enabled: one trace id links dag, attempt,
+    and shuffle spans; /metrics and /trace serve from the same run; the
+    span critical-path analyzer names the dominant vertex."""
+    from tez_tpu.client.tez_client import TezClient
+    from tez_tpu.tools import trace_export
+    from tez_tpu.tools.analyzers import SpanCriticalPathAnalyzer
+    from tez_tpu.tools.chaos import _build_dag
+    result = str(tmp_path / "result.txt")
+    c = TezClient.create("traced", {
+        "tez.staging-dir": str(tmp_path / "s"),
+        "tez.am.web.enabled": True}).start()
+    try:
+        dag = _build_dag("traced", result, trace=True)
+        st = c.submit_dag(dag).wait_for_completion(timeout=60)
+        assert st.state.name == "SUCCEEDED"
+        url = c.framework_client.am.web_ui.url
+        prom = urllib.request.urlopen(url + "metrics").read().decode()
+        trace_json = json.loads(
+            urllib.request.urlopen(url + "trace").read())
+        dag_impl = c.framework_client.am.current_dag
+    finally:
+        c.stop()
+
+    spans = tracing.snapshot()
+    assert spans, "no spans recorded with tez.trace.enabled"
+    by_cat = {}
+    for s in spans:
+        by_cat.setdefault(s.cat, []).append(s)
+    (dag_span,) = by_cat["dag"]
+    assert dag_span.end is not None                    # finished on dag end
+    attempts = by_cat["task"]
+    assert any(s.name.startswith("attempt:") for s in attempts)
+    fetches = [s for s in by_cat.get("shuffle", [])
+               if s.name == "shuffle.fetch"]
+    assert fetches, "no shuffle.fetch spans"
+    # causality: every attempt and fetch span shares the DAG's trace id
+    for s in attempts + fetches:
+        assert s.trace_id == dag_span.trace_id, s.name
+
+    # exported trace validates against the trace_event schema
+    check_trace(trace_export.spans_to_trace(spans))
+    assert trace_json["traceEvents"], "GET /trace returned an empty trace"
+    check_trace(trace_json)
+
+    # /metrics: valid-ish prometheus with the two acceptance histograms
+    assert "# TYPE tez_latency_shuffle_fetch_rtt_ms histogram" in prom
+    assert "# TYPE tez_latency_spill_write_ms histogram" in prom
+    assert "tez_running_tasks" in prom
+    assert "tez_am_epoch" in prom
+
+    # analyzer names the dominant vertex of the scatter-gather DAG
+    res = SpanCriticalPathAnalyzer().analyze(dag_impl)
+    assert "dominant vertex:" in res.headline, res.headline
+    assert ("producer" in res.headline) or ("consumer" in res.headline), \
+        res.headline

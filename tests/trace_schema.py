@@ -1,0 +1,50 @@
+"""Minimal Chrome/Perfetto ``trace_event`` JSON schema checker.
+
+Not a full validator — just the invariants the Perfetto UI and
+chrome://tracing actually require to load a "JSON Array Format" trace:
+a ``traceEvents`` list whose members carry the right fields per phase.
+Raises AssertionError with a pointed message on the first violation so a
+failing test names the bad event.
+"""
+from typing import Any, Dict
+
+# phases we emit; "X"=complete, "i"=instant, "M"=metadata
+_KNOWN_PHASES = {"X", "i", "M", "B", "E"}
+
+
+def check_event(ev: Dict[str, Any], idx: int) -> None:
+    assert isinstance(ev, dict), f"event[{idx}] is not an object: {ev!r}"
+    ph = ev.get("ph")
+    assert ph in _KNOWN_PHASES, f"event[{idx}] bad phase {ph!r}"
+    assert isinstance(ev.get("name"), str) and ev["name"], \
+        f"event[{idx}] missing name"
+    assert isinstance(ev.get("pid"), int), f"event[{idx}] missing int pid"
+    assert isinstance(ev.get("tid"), int), f"event[{idx}] missing int tid"
+    if ph == "M":
+        assert ev["name"] in ("thread_name", "process_name"), \
+            f"event[{idx}] unknown metadata {ev['name']!r}"
+        assert isinstance(ev.get("args", {}).get("name"), str), \
+            f"event[{idx}] metadata without args.name"
+        return
+    ts = ev.get("ts")
+    assert isinstance(ts, int) and ts >= 0, \
+        f"event[{idx}] ts must be a non-negative int (µs), got {ts!r}"
+    if ph == "X":
+        dur = ev.get("dur")
+        assert isinstance(dur, int) and dur > 0, \
+            f"event[{idx}] complete event needs positive int dur, got {dur!r}"
+    if ph == "i":
+        assert ev.get("s", "t") in ("t", "p", "g"), \
+            f"event[{idx}] bad instant scope {ev.get('s')!r}"
+    args = ev.get("args", {})
+    assert isinstance(args, dict), f"event[{idx}] args not an object"
+
+
+def check_trace(trace: Dict[str, Any]) -> int:
+    """Validate a trace dict; returns the number of events checked."""
+    assert isinstance(trace, dict), "trace root must be an object"
+    events = trace.get("traceEvents")
+    assert isinstance(events, list), "traceEvents must be a list"
+    for i, ev in enumerate(events):
+        check_event(ev, i)
+    return len(events)

@@ -314,6 +314,12 @@ class DAGAppMaster:
             speculator.stop()
         from tez_tpu.common import faults
         faults.clear(str(dag.dag_id))
+        from tez_tpu.common import tracing
+        sp = getattr(dag, "trace_span", None)
+        if sp is not None:
+            sp.annotate(final_state=final.name)
+            sp.finish()
+        tracing.clear(str(dag.dag_id))
         with self._dag_done:
             self.completed_dags[str(dag.dag_id)] = final
             self.completed_dag_names[str(dag.dag_id)] = dag.name
@@ -362,6 +368,16 @@ class DAGAppMaster:
         # with it in on_dag_finished — per-DAG scoping
         from tez_tpu.common import faults
         faults.install_from_conf(dag.conf, scope=str(dag_id))
+        # tracing plane: armed with the DAG like faults; the DAG root span
+        # stays open until on_dag_finished and every TaskSpec carries its
+        # context so attempt/fetch spans land on the same trace id
+        from tez_tpu.common import tracing
+        if tracing.install_from_conf(dag.conf, scope=str(dag_id)):
+            sp = tracing.start_span(
+                f"dag:{plan.name}", cat="dag",
+                dag_id=str(dag_id), am_epoch=self.attempt)
+            dag.trace_span = sp
+            dag.trace_carrier = sp.context.carrier()
         self.dispatch(DAGEvent(DAGEventType.DAG_INIT, dag_id))
         self.dispatch(DAGEvent(DAGEventType.DAG_START, dag_id))
         return dag_id

@@ -107,8 +107,16 @@ class RecoveryService:
                 faults.fire("commit.ledger.fsync",
                             detail=event.event_type.name)
             faults.fire("am.recovery.fsync", detail=event.event_type.name)
+            from tez_tpu.common import metrics, tracing
+            t0 = time.perf_counter()
             self._fh.flush()
             os.fsync(self._fh.fileno())
+            fsync_ms = (time.perf_counter() - t0) * 1000.0
+            metrics.observe("commit.ledger.fsync", fsync_ms)
+            if event.event_type in COMMIT_LEDGER_TYPES:
+                tracing.event("commit.ledger.fsync",
+                              record=event.event_type.name,
+                              dag_id=event.dag_id, ms=round(fsync_ms, 3))
             self._last_flush = time.time()
         elif self.flush_interval > 0:
             now = time.time()

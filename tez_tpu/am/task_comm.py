@@ -21,7 +21,7 @@ from tez_tpu.api.events import TezAPIEvent, TezEvent
 from tez_tpu.am.events import (TaskAttemptEvent, TaskAttemptEventType,
                                VertexEvent, VertexEventType)
 from tez_tpu.common import epoch as epoch_registry
-from tez_tpu.common import faults
+from tez_tpu.common import faults, tracing
 from tez_tpu.common.counters import TezCounters
 from tez_tpu.common.ids import ContainerId, TaskAttemptId
 from tez_tpu.runtime.task_spec import TaskSpec
@@ -86,11 +86,18 @@ class TaskCommunicatorManager:
         app_id = getattr(self.ctx, "app_id", "")
         if 0 < msg_epoch < self.epoch:
             faults.fire("fence.stale_epoch", detail=detail)
+            tracing.event("fence.stale_epoch", seam="umbilical",
+                          reason="stale_sender", msg_epoch=msg_epoch,
+                          am_epoch=self.epoch, detail=detail)
             log.warning("fenced stale-epoch message (epoch %d < %d): %s",
                         msg_epoch, self.epoch, detail)
             return True
         if epoch_registry.is_stale(app_id, self.epoch):
             faults.fire("fence.stale_epoch", detail=detail)
+            tracing.event("fence.stale_epoch", seam="umbilical",
+                          reason="superseded_am", am_epoch=self.epoch,
+                          current=epoch_registry.current(app_id),
+                          detail=detail)
             log.warning("AM epoch %d superseded by %d; refusing: %s",
                         self.epoch, epoch_registry.current(app_id), detail)
             return True

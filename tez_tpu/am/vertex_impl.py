@@ -800,6 +800,7 @@ class VertexImpl:
             group_inputs=tuple(self.group_input_specs),
             conf=dict(self.conf),
             am_epoch=getattr(self.dag.ctx, "attempt", 0),
+            trace_context=getattr(self.dag, "trace_carrier", ""),
         )
 
     def status_dict(self) -> Dict[str, Any]:

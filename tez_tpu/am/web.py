@@ -20,6 +20,10 @@ Endpoints:
   GET /history          JSON recent history events (in-memory logger only)
   GET /analyzers        JSON analyzer suite run over live history
   GET /swimlane.svg     container swimlane SVG
+  GET /trace            Chrome/Perfetto trace_event JSON (span buffer, or
+                        history-derived when tracing was disarmed)
+  GET /metrics          Prometheus text: counters, latency histograms,
+                        running-task/queued-fetch/epoch gauges
 """
 from __future__ import annotations
 
@@ -321,6 +325,16 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         elif path == "/analyzers":
             self._send(200, json.dumps(self._analyzers(am),
                                        default=str).encode())
+        elif path == "/trace":
+            self._send(200, json.dumps(self._trace(am)).encode())
+        elif path == "/metrics":
+            from tez_tpu.common import config as C
+            conf = getattr(am, "conf", None)
+            if conf is not None and not bool(conf.get(C.METRICS_ENABLED)):
+                self._send(404, b'{"error": "tez.metrics.enabled is off"}')
+            else:
+                self._send(200, self._metrics(am).encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
         else:
             self._send(404, b'{"error": "not found"}')
 
@@ -408,6 +422,46 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                           attempts[max(attempts)])
             agg.aggregate(chosen.counters)
         return agg.to_dict()
+
+    @staticmethod
+    def _trace(am: Any) -> Dict[str, Any]:
+        """Perfetto trace_event JSON: live span buffer when the tracing
+        plane has recorded anything, else a post-mortem trace derived from
+        history events (works even for a DAG traced by a crashed AM whose
+        journal was replayed)."""
+        from tez_tpu.common import tracing
+        from tez_tpu.tools import trace_export
+        spans = tracing.snapshot()
+        if spans:
+            return trace_export.spans_to_trace(spans)
+        events = getattr(am.logging_service, "events", [])
+        if events:
+            from tez_tpu.tools.history_parser import parse_history_events
+            dags = parse_history_events(events)
+            if dags:
+                dag = dags[sorted(dags)[-1]]
+                return trace_export.history_to_trace(dag)
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+
+    @staticmethod
+    def _metrics(am: Any) -> str:
+        """Prometheus text scrape: process-global latency histograms +
+        running-task/queued-fetch/epoch gauges + DAG counters."""
+        from tez_tpu.common import metrics
+        dag = am.current_dag
+        running = 0
+        counters_dict: Dict[str, Dict[str, int]] = {}
+        if dag is not None:
+            for v in list(dag.vertices.values()):
+                running += sum(1 for t in list(v.tasks.values())
+                               if t.state.name == "RUNNING")
+            counters_dict = dag.counters.to_dict()
+        gauges = metrics.registry().gauges()
+        gauges["running_tasks"] = float(running)
+        gauges["am_epoch"] = float(getattr(am, "attempt", 0) or 0)
+        gauges.setdefault("shuffle.queued_fetches", 0.0)
+        return metrics.render_prometheus(
+            metrics.registry().histograms(), gauges, counters_dict)
 
     @staticmethod
     def _attempt(am: Any, attempt_id: str) -> Dict[str, Any]:

@@ -207,6 +207,23 @@ TEST_FAULT_SEED = _key(
     "Seed for the fault plane's deterministic schedule; the same "
     "(spec, seed) pair replays the identical fault storm "
     "(python -m tez_tpu.tools.chaos --seed N prints repro seeds)")
+TRACE_ENABLED = _key(
+    "tez.trace.enabled", False, Scope.DAG,
+    "Arm the distributed tracing plane for this DAG: causal spans across "
+    "AM submit -> task attempt -> shuffle fetch land in a bounded ring "
+    "buffer exportable as Chrome/Perfetto trace_event JSON (GET /trace, "
+    "tools/trace_export.py, chaos --trace-out).  Disarmed = single boolean "
+    "check per call site, zero allocation (see docs/observability.md)")
+TRACE_BUFFER_SPANS = _key(
+    "tez.trace.buffer.spans", 32768, Scope.DAG,
+    "Ring-buffer capacity of the span plane; oldest spans are evicted "
+    "first once full")
+METRICS_ENABLED = _key(
+    "tez.metrics.enabled", True, Scope.AM,
+    "Serve GET /metrics (Prometheus text: counters, latency histograms, "
+    "running-task/queued-fetch/epoch gauges) on the AM web UI.  Histogram "
+    "recording itself is always on — it is a few bucket increments per "
+    "IO-sized operation")
 AM_COMMIT_ALL_OUTPUTS_ON_SUCCESS = _key(
     "tez.am.commit-all-outputs-on-dag-success", True, Scope.DAG,
     "Reference: commit at DAG success vs per-vertex commit (DAGImpl commit modes)")

@@ -9,7 +9,7 @@ from __future__ import annotations
 import enum
 import threading
 from collections import defaultdict
-from typing import Dict, Iterator, Mapping
+from typing import Any, Dict, Iterator, Mapping
 
 
 class CounterLimitExceeded(Exception):

@@ -22,7 +22,7 @@ from tez_tpu.api.events import (CompositeRoutedDataMovementEvent,
                                 TezAPIEvent)
 from tez_tpu.api.runtime import (KeyValueReader, KeyValuesReader,
                                  LogicalInput, MergedLogicalInput, Reader)
-from tez_tpu.common import faults
+from tez_tpu.common import faults, metrics, tracing
 from tez_tpu.common.counters import TaskCounter
 from tez_tpu.ops.runformat import KVBatch, adjacent_equal_rows
 from tez_tpu.ops.serde import Serde, get_serde
@@ -84,6 +84,11 @@ class ShuffleFetchTable:
         # counters document a single-writer rule; fetch-pool deliveries come
         # from many threads, so the table serializes ITS counter writes
         self._deliver_lock = threading.Lock()
+        # Fetch deliveries arrive on heartbeat/fetcher threads where no span
+        # is active, so the task's trace context is captured HERE (the table
+        # is built on the processor thread inside the attempt span) and every
+        # fetch span parents under it explicitly.
+        self._trace = tracing.current_context()
 
     def _is_local(self, payload: ShufflePayload) -> bool:
         return payload.port == 0 or (payload.host, payload.port) == \
@@ -139,14 +144,26 @@ class ShuffleFetchTable:
     def _fetch_local(self, payload: ShufflePayload,
                      partition: int) -> KVBatch:
         """Same-host short-circuit (Fetcher.java:288 local-disk fetch)."""
-        faults.fire("shuffle.fetch.read", detail=payload.path_component)
-        batch = self.service.fetch_partition(
-            payload.path_component, payload.spill_id, partition)
+        import time as _time
+        t0 = _time.perf_counter()
+        with tracing.span("shuffle.fetch", cat="shuffle",
+                          parent=self._trace, mode="local",
+                          src=payload.path_component,
+                          spill=payload.spill_id, partition=partition):
+            faults.fire("shuffle.fetch.read", detail=payload.path_component)
+            batch = self.service.fetch_partition(
+                payload.path_component, payload.spill_id, partition)
+        metrics.observe("shuffle.fetch.rtt",
+                        (_time.perf_counter() - t0) * 1000.0,
+                        counters=self.context.counters)
         self.context.counters.increment(TaskCounter.LOCAL_SHUFFLED_INPUTS)
         return batch
 
     def _fetch_error(self, slot: int, version: int, e: Exception) -> None:
         log.warning("fetch failed for slot %d: %s", slot, e)
+        tracing.event("shuffle.fetch.retry_requested", parent=self._trace,
+                      slot=slot, version=version,
+                      error=f"{type(e).__name__}: {e}")
         from tez_tpu.common import config as C
         if _conf_get(self.context, C.SHUFFLE_NOTIFY_READERROR.name,
                      C.SHUFFLE_NOTIFY_READERROR.default):
@@ -176,6 +193,9 @@ class ShuffleFetchTable:
             self._fetch_error(slot, version, error)
             return
         with self._deliver_lock:
+            if getattr(req, "rtt_ms", 0.0) > 0.0:
+                metrics.observe("shuffle.fetch.rtt", req.rtt_ms,
+                                counters=self.context.counters)
             self.context.counters.increment(TaskCounter.SHUFFLE_BYTES,
                                             batch.nbytes)
             self.context.counters.increment(
@@ -213,7 +233,8 @@ class ShuffleFetchTable:
                 payload.host, payload.port, payload.path_component,
                 payload.spill_id, partition,
                 cookie=(slot, partition, payload, version, stamp,
-                        generation)))
+                        generation),
+                trace=self._trace))
             return
         try:
             if payload.is_empty(partition):
@@ -436,11 +457,16 @@ class OrderedGroupedKVInput(LogicalInput):
         if self._merged is None and self._stream_plan is None:
             import time
             t0 = time.time()
-            self.table.wait_all()
+            with tracing.span("shuffle.wait", cat="shuffle"):
+                self.table.wait_all()
             self.context.counters.find_counter(TaskCounter.SHUFFLE_PHASE_TIME)\
                 .increment(int((time.time() - t0) * 1000))
             t1 = time.time()
-            result = self.merge_manager.finish()
+            with tracing.span("shuffle.merge", cat="shuffle"):
+                result = self.merge_manager.finish()
+            metrics.observe("shuffle.merge",
+                            (time.time() - t1) * 1000.0,
+                            counters=self.context.counters)
             if result.is_streaming:
                 # partition exceeds the memory budget: records stream from
                 # chunked disk runs with bounded resident memory

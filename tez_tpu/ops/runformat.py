@@ -410,12 +410,14 @@ class Run:
         return len(MAGIC) + 13 + size
 
     def save(self, path: str, codec: Optional[str] = None) -> None:
+        from tez_tpu.common import metrics
         faults.fire("spill.write", detail=path)
-        tmp = path + ".tmp"
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(tmp, "wb") as fh:
-            self.write_to(fh, codec)
-        os.replace(tmp, path)
+        with metrics.timer("spill.write"):
+            tmp = path + ".tmp"
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(tmp, "wb") as fh:
+                self.write_to(fh, codec)
+            os.replace(tmp, path)
 
     @staticmethod
     def load(path: str) -> "Run":
@@ -686,8 +688,10 @@ class FileRun:
 def save_run_partitioned(run: Run, path: str, codec: Optional[str] = None,
                         block_records: int = 65536) -> str:
     """Write a partition-sorted in-RAM Run as a partition-indexed file."""
+    from tez_tpu.common import metrics
     faults.fire("spill.write", detail=path)
-    w = PartitionedRunWriter(path, run.num_partitions, codec=codec,
-                             block_records=block_records)
-    w.append_run(run)
-    return w.close()
+    with metrics.timer("spill.write"):
+        w = PartitionedRunWriter(path, run.num_partitions, codec=codec,
+                                 block_records=block_records)
+        w.append_run(run)
+        return w.close()

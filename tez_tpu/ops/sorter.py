@@ -341,6 +341,13 @@ class DeviceSorter:
         else:
             self._store_run(run)
 
+    def _record_sort_ms(self, t0: float) -> None:
+        ms = (time.time() - t0) * 1000.0
+        self.counters.find_counter(TaskCounter.DEVICE_SORT_MILLIS)\
+            .increment(int(ms))
+        from tez_tpu.common import metrics
+        metrics.observe("device.sort", ms, counters=self.counters)
+
     def sort_batch(self, batch: KVBatch,
                    custom_partitions: Optional[np.ndarray] = None) -> Run:
         t0 = time.time()
@@ -383,8 +390,7 @@ class DeviceSorter:
                                                    self.num_partitions)
                 sorted_batch = batch.take(perm)
                 sorted_batch.dev_keys = dev
-                self.counters.find_counter(TaskCounter.DEVICE_SORT_MILLIS)\
-                    .increment(int((time.time() - t0) * 1000))
+                self._record_sort_ms(t0)
                 return Run.from_sorted_batch(sorted_batch, sorted_partitions,
                                              self.num_partitions)
         if self.key_normalizer is not None:
@@ -443,8 +449,7 @@ class DeviceSorter:
             keyfn)
         if refinement is not None:
             sorted_batch = sorted_batch.take(refinement)
-        self.counters.find_counter(TaskCounter.DEVICE_SORT_MILLIS)\
-            .increment(int((time.time() - t0) * 1000))
+        self._record_sort_ms(t0)
         return Run.from_sorted_batch(sorted_batch, sorted_partitions,
                                      self.num_partitions)
 
@@ -474,8 +479,7 @@ class DeviceSorter:
                               self.partitioner == "hash"))
             if fused is not None:
                 out_kb, out_ko, out_vb, out_vo, row_index = fused
-                self.counters.find_counter(TaskCounter.DEVICE_SORT_MILLIS)\
-                    .increment(int((time.time() - t0) * 1000))
+                self._record_sort_ms(t0)
                 return Run(KVBatch(out_kb, out_ko, out_vb, out_vo),
                            row_index)
         parts: Optional[np.ndarray]
@@ -497,8 +501,7 @@ class DeviceSorter:
             sorted_partitions = np.zeros(batch.num_records, dtype=np.int32)
         else:
             sorted_partitions = parts[perm]
-        self.counters.find_counter(TaskCounter.DEVICE_SORT_MILLIS)\
-            .increment(int((time.time() - t0) * 1000))
+        self._record_sort_ms(t0)
         return Run.from_sorted_batch(sorted_batch, sorted_partitions,
                                      self.num_partitions)
 

@@ -18,7 +18,7 @@ from tez_tpu.api.events import (CustomProcessorEvent, TezAPIEvent, TezEvent)
 from tez_tpu.api.runtime import (LogicalIOProcessor, LogicalInput,
                                  LogicalOutput, MergedLogicalInput,
                                  ObjectRegistry)
-from tez_tpu.common import faults
+from tez_tpu.common import faults, metrics, tracing
 from tez_tpu.common.counters import TaskCounter, TezCounters
 from tez_tpu.runtime.contexts import (TaskKilledError, TezInputContext,
                                       TezOutputContext, TezProcessorContext)
@@ -106,10 +106,22 @@ class TaskRunner:
         reporter.start()
         from tez_tpu.common import ndc
         try:
-            with ndc.context(str(self.spec.attempt_id)):
-                self._initialize()
-                self._run_processor()
-                self._close()
+            # adopt the AM's trace context (TaskSpec carrier) so the
+            # attempt span — and everything under it, including shuffle
+            # fetches delivered on other threads — shares the DAG trace id
+            with ndc.context(str(self.spec.attempt_id)), \
+                    tracing.attached(getattr(self.spec, "trace_context", "")), \
+                    tracing.span(f"attempt:{self.spec.attempt_id}",
+                                 cat="task",
+                                 vertex=self.spec.vertex_name,
+                                 task_index=self.spec.task_index,
+                                 attempt=self.spec.attempt_number):
+                with tracing.span("initialize", cat="task"):
+                    self._initialize()
+                with tracing.span("run", cat="task"):
+                    self._run_processor()
+                with tracing.span("close", cat="task"):
+                    self._close()
             state = "SUCCEEDED"
         except TaskKilledError:
             # fatal_error() funnels through the kill flag; report it as a
@@ -272,7 +284,11 @@ class TaskRunner:
         req = HeartbeatRequest(self.spec.attempt_id, self._drain_events(),
                                counters=None, progress=self.progress,
                                epoch=getattr(self.spec, "am_epoch", 0))
+        t0 = time.perf_counter()
         resp = self.umbilical.heartbeat(req)
+        metrics.observe("am.heartbeat.rtt",
+                        (time.perf_counter() - t0) * 1000.0,
+                        counters=self.counters)
         if resp.should_die:
             self._killed.set()
         if resp.events:

@@ -54,6 +54,10 @@ class TaskSpec:
     #: umbilical calls and shuffle registrations so a zombie attempt from a
     #: pre-crash AM is rejected at every seam (0 = unstamped/legacy).
     am_epoch: int = 0
+    #: W3C-style trace-context carrier ("00-<trace>-<span>-01") linking this
+    #: attempt to the DAG's root span when the tracing plane is armed
+    #: ("" = tracing disarmed; the runner then starts no spans).
+    trace_context: str = ""
 
     @property
     def task_index(self) -> int:

@@ -23,6 +23,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+from tez_tpu.common import metrics, tracing
 from tez_tpu.shuffle.service import ShuffleDataNotFound
 from tez_tpu.utils.backoff import ExponentialBackoff
 
@@ -43,6 +44,11 @@ class FetchRequest:
     cookie: Any = None
     attempts: int = 0
     speculative: bool = False
+    #: caller's trace context (tracing.TraceContext | None): fetch spans,
+    #: penalty-box holds and retry events parent under the consuming task
+    trace: Any = None
+    #: measured wire RTT of the successful fetch, stamped before delivery
+    rtt_ms: float = 0.0
 
     @property
     def key(self) -> Tuple[str, int, int]:
@@ -127,6 +133,7 @@ class FetchScheduler:
         self.inflight: Dict[int, _Inflight] = {}           # worker id -> batch
         self.done_keys: Set[Tuple[str, int, int]] = set()  # delivered once
         self.speculated: Set[Tuple[str, int, int]] = set()
+        self._outstanding = 0      # enqueued keys not yet delivered (gauge)
         self._stopped = False
         self._workers = [
             threading.Thread(target=self._worker, args=(i,), daemon=True,
@@ -148,6 +155,10 @@ class FetchScheduler:
             if host is None:
                 host = self.hosts[key] = _Host(key)
             host.pending.append(req)
+            if not req.speculative:
+                self._outstanding += 1
+                metrics.set_gauge("shuffle.queued_fetches",
+                                  self._outstanding)
             self._make_ready(host)
             self.lock.notify()
 
@@ -194,8 +205,16 @@ class FetchScheduler:
         try:
             session = self.session_factory(*host.key)
             for i, req in enumerate(reqs):
+                sp = tracing.span(
+                    "shuffle.fetch", cat="shuffle", parent=req.trace,
+                    mode="remote", host=f"{req.host}:{req.port}",
+                    src=req.path, spill=req.spill, partition=req.partition,
+                    attempt=req.attempts, speculative=req.speculative)
+                t0 = time.perf_counter()
                 try:
-                    batch = session.fetch(req.path, req.spill, req.partition)
+                    with sp:
+                        batch = session.fetch(req.path, req.spill,
+                                              req.partition)
                 except (ShuffleDataNotFound, PermissionError) as e:
                     # definitive per-input miss: deliver, connection is fine
                     self._deliver_once(req, None, e)
@@ -205,6 +224,8 @@ class FetchScheduler:
                     failed_conn = e
                     completed = i
                     break
+                req.rtt_ms = (time.perf_counter() - t0) * 1000.0
+                metrics.observe("shuffle.fetch.rtt", req.rtt_ms)
                 self._deliver_once(req, batch, None)
                 completed = i + 1
         except BaseException as e:  # noqa: BLE001 — session open failed
@@ -237,6 +258,8 @@ class FetchScheduler:
             if req.key in self.done_keys:
                 return      # speculative duplicate lost the race
             self.done_keys.add(req.key)
+            self._outstanding = max(0, self._outstanding - 1)
+            metrics.set_gauge("shuffle.queued_fetches", self._outstanding)
         try:
             self.deliver(req, batch, error)
         except BaseException:  # noqa: BLE001 — a callback fault must not
@@ -266,6 +289,12 @@ class FetchScheduler:
             host.penalized = True
             heapq.heappush(self.penalties,
                            (time.time() + penalty, host.key))
+            tracing.event("shuffle.penalty_box",
+                          parent=rest[0].trace if rest else None,
+                          host=f"{host.key[0]}:{host.key[1]}",
+                          penalty_s=round(penalty, 4),
+                          failures=host.failures,
+                          error=f"{type(error).__name__}: {error}")
             log.info("penalty box: %s:%s for %.2fs (%d failures)",
                      host.key[0], host.key[1], penalty, host.failures)
         return failed_out
@@ -304,7 +333,11 @@ class FetchScheduler:
                                            req.spill, req.partition,
                                            cookie=req.cookie,
                                            attempts=req.attempts,
-                                           speculative=True)
+                                           speculative=True,
+                                           trace=req.trace)
+                        tracing.event("shuffle.speculative_refetch",
+                                      parent=req.trace, key=str(req.key),
+                                      host=f"{req.host}:{req.port}")
                         host.pending.append(dup)
                         added += 1
                         log.info("speculative refetch of %s from %s:%s",

@@ -94,6 +94,10 @@ class _Handler(socketserver.StreamRequestHandler):
             if epoch_registry.is_stale(str(req.get("app", "") or ""), epoch):
                 faults.fire("fence.stale_epoch",
                             detail=f"shuffle.serve {path}/{spill}")
+                from tez_tpu.common import tracing
+                tracing.event("fence.stale_epoch", seam="shuffle.serve",
+                              reason="stale_consumer", msg_epoch=epoch,
+                              src=f"{path}/{spill}")
                 self._reply({"status": "fenced"}, [])
                 return
         try:

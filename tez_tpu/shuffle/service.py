@@ -71,12 +71,19 @@ class ShuffleService:
         if epoch > 0 and epoch_registry.is_stale(app_id, epoch):
             faults.fire("fence.stale_epoch",
                         detail=f"shuffle.register {path_component}")
+            from tez_tpu.common import tracing
+            tracing.event("fence.stale_epoch", seam="shuffle.register",
+                          reason="stale_producer", msg_epoch=epoch,
+                          src=f"{path_component}/{spill_id}")
             raise EpochFencedError(
                 f"shuffle register from stale epoch {epoch} "
                 f"(current {epoch_registry.current(app_id)}): "
                 f"{path_component}/{spill_id}")
         with self._lock:
             self._runs[(path_component, spill_id)] = run
+        from tez_tpu.common import tracing
+        tracing.event("shuffle.register", src=f"{path_component}/{spill_id}",
+                      nbytes=getattr(run, "nbytes", 0))
         if self._store is not None:
             self._store.register(path_component, spill_id, run)
             # a concurrent unregister_prefix between the RAM insert and the

@@ -505,7 +505,55 @@ class NodeHealthAnalyzer(Analyzer):
             rows)
 
 
+class SpanCriticalPathAnalyzer(Analyzer):
+    """Span-based critical path over the live tracing buffer: the longest
+    causal chain through the recorded spans (tracing plane, this PR's
+    tentpole), naming which vertex/fetch/commit span dominates wall clock.
+    Unlike CriticalPathAnalyzer (history timestamps, vertex granularity)
+    this sees intra-attempt structure — a fetch stall or merge dominating a
+    vertex shows up by name.  Empty when the DAG ran with tracing disarmed."""
+    name = "span_critical_path"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        from tez_tpu.common import tracing
+        from tez_tpu.tools.trace_export import critical_path_report
+        spans = tracing.snapshot()
+        # scope to this DAG's trace when its root span is in the buffer
+        # (the buffer is process-global and may hold several DAGs)
+        dag_traces = {sp.trace_id for sp in spans
+                      if sp.cat == "dag" and
+                      sp.args.get("dag_id") == str(dag.dag_id)}
+        if dag_traces:
+            spans = [sp for sp in spans if sp.trace_id in dag_traces]
+        if not spans:
+            return AnalyzerResult(
+                self.name,
+                "no spans recorded (run with tez.trace.enabled=True)", [])
+        report = critical_path_report(spans)
+        dom = report["dominant"]
+        chain = report["chain"]
+        # dominant VERTEX: attribute each chain span's self time to the
+        # nearest enclosing span that names a vertex (the attempt span),
+        # then take the vertex holding the most on-path time.  The dag
+        # root's own self time (AM scheduling overhead) stays unattributed.
+        per_vertex: Dict[str, float] = {}
+        cur = ""
+        for c in chain:
+            cur = c.get("vertex") or cur
+            if cur:
+                per_vertex[cur] = per_vertex.get(cur, 0) + c.get("self_ms", 0)
+        headline = "no dominant span"
+        if dom:
+            headline = (f"critical chain of {len(chain)} span(s); dominant: "
+                        f"{dom['name']} ({dom['duration_ms']:.1f}ms)")
+            if per_vertex:
+                v, ms = max(per_vertex.items(), key=lambda kv: kv[1])
+                headline += f"; dominant vertex: {v} ({ms:.1f}ms on path)"
+        return AnalyzerResult(self.name, headline, chain)
+
+
 ALL_ANALYZERS: Sequence[Analyzer] = (
+    SpanCriticalPathAnalyzer(),
     CriticalPathAnalyzer(), ShuffleTimeAnalyzer(), SkewAnalyzer(),
     SpillAnalyzer(), SlowestVertexAnalyzer(), ContainerReuseAnalyzer(),
     SpeculationAnalyzer(), HungTaskAnalyzer(), TaskConcurrencyAnalyzer(),

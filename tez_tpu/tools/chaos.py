@@ -93,7 +93,7 @@ def make_storm(seed: int) -> str:
 
 
 def _build_dag(name: str, result_path: str, fault_spec: str = "",
-               fault_seed: int = 0) -> DAG:
+               fault_seed: int = 0, trace: bool = False) -> DAG:
     producer = Vertex.create("producer", ProcessorDescriptor.create(
         ChaosEmitProcessor), NUM_PRODUCERS)
     consumer = Vertex.create("consumer", ProcessorDescriptor.create(
@@ -113,12 +113,14 @@ def _build_dag(name: str, result_path: str, fault_spec: str = "",
     if fault_spec:
         dag.set_conf("tez.test.fault.spec", fault_spec)
         dag.set_conf("tez.test.fault.seed", fault_seed)
+    if trace:
+        dag.set_conf("tez.trace.enabled", True)
     return dag
 
 
 def _run_dag(workdir: str, name: str, fault_spec: str = "",
              fault_seed: int = 0, timeout: float = 120.0,
-             ) -> Tuple[str, bytes]:
+             trace: bool = False) -> Tuple[str, bytes]:
     """One client + one DAG in a fresh staging dir. Returns (state, result
     bytes); result is b'' if the DAG failed before writing."""
     staging = os.path.join(workdir, name, "staging")
@@ -131,7 +133,8 @@ def _run_dag(workdir: str, name: str, fault_spec: str = "",
         "tez.am.task.max.failed.attempts": 4,
     }).start()
     try:
-        dag = _build_dag(name, result_path, fault_spec, fault_seed)
+        dag = _build_dag(name, result_path, fault_spec, fault_seed,
+                         trace=trace)
         status = client.submit_dag(dag).wait_for_completion(timeout=timeout)
         state = status.state.name
     finally:
@@ -145,7 +148,8 @@ def _run_dag(workdir: str, name: str, fault_spec: str = "",
 
 
 def run_trial(seed: int, workdir: str, baseline: Optional[bytes] = None,
-              timeout: float = 120.0) -> Tuple[bool, str, str]:
+              timeout: float = 120.0, trace: bool = False,
+              ) -> Tuple[bool, str, str]:
     """Run one seeded storm; returns (ok, spec, detail)."""
     if baseline is None:
         state, baseline = _run_dag(workdir, "baseline", timeout=timeout)
@@ -153,7 +157,7 @@ def run_trial(seed: int, workdir: str, baseline: Optional[bytes] = None,
             return False, "", f"baseline run failed (state={state})"
     spec = make_storm(seed)
     state, got = _run_dag(workdir, f"storm{seed}", fault_spec=spec,
-                          fault_seed=seed, timeout=timeout)
+                          fault_seed=seed, timeout=timeout, trace=trace)
     if state != DAGStatusState.SUCCEEDED.name:
         return False, spec, f"storm DAG finished {state}"
     if got != baseline:
@@ -178,7 +182,7 @@ class ChaosSinkCountProcessor(SimpleProcessor):
 
 
 def _build_sink_dag(name: str, out_dir: str, fault_spec: str = "",
-                    fault_seed: int = 0) -> DAG:
+                    fault_seed: int = 0, trace: bool = False) -> DAG:
     producer = Vertex.create("producer", ProcessorDescriptor.create(
         ChaosEmitProcessor), NUM_PRODUCERS)
     consumer = Vertex.create("consumer", ProcessorDescriptor.create(
@@ -206,6 +210,8 @@ def _build_sink_dag(name: str, out_dir: str, fault_spec: str = "",
     if fault_spec:
         dag.set_conf("tez.test.fault.spec", fault_spec)
         dag.set_conf("tez.test.fault.seed", fault_seed)
+    if trace:
+        dag.set_conf("tez.trace.enabled", True)
     return dag
 
 
@@ -239,8 +245,8 @@ def _fsck_summary(staging: str, app_id: str) -> str:
 
 
 def run_commit_storm(workdir: str, timeout: float = 120.0,
-                     delay_ms: int = 4000,
-                     app_id: str = "app_1_cstorm") -> Tuple[bool, str]:
+                     delay_ms: int = 4000, app_id: str = "app_1_cstorm",
+                     trace: bool = False) -> Tuple[bool, str]:
     """The exactly-once commit scenario. Returns (ok, detail).
 
     A ``commit.publish`` delay fault parks attempt 1's publisher after the
@@ -276,7 +282,8 @@ def run_commit_storm(workdir: str, timeout: float = 120.0,
     staging = os.path.join(workdir, "commit_storm", "staging")
     dag = _build_sink_dag(
         "commitstorm", out_dir,
-        fault_spec=f"commit.publish:delay:ms={delay_ms},n=1", fault_seed=1)
+        fault_spec=f"commit.publish:delay:ms={delay_ms},n=1", fault_seed=1,
+        trace=trace)
     plan = dag.create_dag_plan()
     conf = C.TezConfiguration({"tez.staging-dir": staging,
                                "tez.am.local.num-containers": 4})
@@ -321,6 +328,17 @@ def run_commit_storm(workdir: str, timeout: float = 120.0,
                   f"({len(got) - 1} part file(s) + _SUCCESS)")
 
 
+def _export_trace(path: str) -> None:
+    """Write whatever the span buffer holds (it survives per-DAG disarm) as
+    Perfetto trace_event JSON, then drop the buffer."""
+    from tez_tpu.common import tracing
+    from tez_tpu.tools import trace_export
+    spans = tracing.snapshot()
+    trace_export.write_trace(trace_export.spans_to_trace(spans), path)
+    print(f"trace: {len(spans)} span(s) -> {path}")
+    tracing.clear_all()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tez_tpu.tools.chaos", description=__doc__,
@@ -336,14 +354,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--commit-storm", action="store_true",
                     help="run the mid-commit AM-kill exactly-once scenario "
                          "instead of the seeded storm soak")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="arm the tracing plane (tez.trace.enabled) on the "
+                         "storm DAGs and write a Perfetto trace_event JSON "
+                         "of the recorded spans to PATH")
     args = ap.parse_args(argv)
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="tez-chaos-")
     cleanup = args.workdir is None
     if args.commit_storm:
         try:
-            ok, detail = run_commit_storm(workdir, timeout=args.timeout)
+            ok, detail = run_commit_storm(workdir, timeout=args.timeout,
+                                          trace=bool(args.trace_out))
         finally:
+            if args.trace_out:
+                _export_trace(args.trace_out)
             if cleanup:
                 shutil.rmtree(workdir, ignore_errors=True)
         print(("ok   " if ok else "FAIL ") + f"commit-storm: {detail}")
@@ -360,13 +385,16 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{len(baseline.splitlines())} keys")
         for seed in range(args.seed, args.seed + args.trials):
             ok, spec, detail = run_trial(seed, workdir, baseline=baseline,
-                                         timeout=args.timeout)
+                                         timeout=args.timeout,
+                                         trace=bool(args.trace_out))
             tag = "ok  " if ok else "FAIL"
             print(f"{tag} seed={seed} storm=[{spec}] {detail}")
             if not ok:
                 failures += 1
                 print(f"REPRO: python -m tez_tpu.tools.chaos --seed {seed}")
     finally:
+        if args.trace_out:
+            _export_trace(args.trace_out)
         if cleanup:
             shutil.rmtree(workdir, ignore_errors=True)
     if failures:

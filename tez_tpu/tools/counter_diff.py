@@ -1,19 +1,54 @@
-"""Compare counters between two DAG runs.
+"""Compare counters — and latency histograms — between two DAG runs.
 
 Reference parity: tez-tools counter-diff.  Usage:
   python -m tez_tpu.tools.counter_diff <history_a.jsonl> <history_b.jsonl>
+
+Plain counters are diffed value-by-value.  ``LatencyHistogram.*`` counter
+groups (written by tez_tpu.common.metrics when the tracing/metrics plane is
+on) are decoded back into bucket distributions and compared on p50/p95/max,
+so a latency regression shows up as "shuffle.fetch.rtt p95 12ms -> 48ms"
+rather than an opaque bucket-count delta.
 """
 from __future__ import annotations
 
 import sys
-from typing import Dict
+from typing import Dict, List, Tuple
 
+from tez_tpu.common.metrics import HIST_GROUP_PREFIX, histograms_from_counters
 from tez_tpu.tools.history_parser import parse_jsonl_files
+
+# p95 ratio above which a histogram line is flagged as a regression; bucket
+# resolution is powers-of-2 ms, so anything under 2x is within quantisation.
+REGRESSION_RATIO = 2.0
 
 
 def flatten(counters: Dict) -> Dict[str, int]:
     return {f"{g}.{name}": v for g, cs in counters.items()
+            if not g.startswith(HIST_GROUP_PREFIX)
             for name, v in cs.items()}
+
+
+def diff_histograms(counters_a: Dict, counters_b: Dict,
+                    ) -> List[Tuple[str, Dict, Dict, bool]]:
+    """[(name, summary_a|{}, summary_b|{}, regressed)] for every histogram
+    present in either run; regressed means B's p95 is REGRESSION_RATIO x
+    A's (only meaningful when both runs recorded the histogram)."""
+    ha = histograms_from_counters(counters_a)
+    hb = histograms_from_counters(counters_b)
+    out = []
+    for name in sorted(set(ha) | set(hb)):
+        a, b = ha.get(name, {}), hb.get(name, {})
+        regressed = bool(
+            a and b and a["p95"] > 0 and b["p95"] >= REGRESSION_RATIO * a["p95"])
+        out.append((name, a, b, regressed))
+    return out
+
+
+def _fmt_hist(s: Dict) -> str:
+    if not s:
+        return f"{'-':>26}"
+    return (f"n={s['count']:<6d} p50={s['p50']:>8.1f} "
+            f"p95={s['p95']:>8.1f} max={s['max_ms']:>8.1f}")
 
 
 def main() -> int:
@@ -34,9 +69,20 @@ def main() -> int:
         va, vb = fa.get(key, 0), fb.get(key, 0)
         if va != vb:
             print(f"{key:60} {va:14d} {vb:14d} {vb - va:+14d}")
+    hists = diff_histograms(a.counters, b.counters)
+    regressions = 0
+    if hists:
+        print(f"\n{'latency histogram (ms)':32} {'A':>44} {'B':>44}")
+        for name, sa, sb, regressed in hists:
+            flag = "  << REGRESSION" if regressed else ""
+            print(f"{name:32} {_fmt_hist(sa):>44} {_fmt_hist(sb):>44}{flag}")
+            regressions += int(regressed)
     print(f"\nA: {a.dag_id} ({a.state}, {a.duration:.2f}s)  "
           f"B: {b.dag_id} ({b.state}, {b.duration:.2f}s)  "
           f"wall delta {b.duration - a.duration:+.2f}s")
+    if regressions:
+        print(f"{regressions} histogram regression(s) (p95 >= "
+              f"{REGRESSION_RATIO}x baseline)")
     return 0
 
 

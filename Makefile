@@ -1,0 +1,33 @@
+# Developer entry points.  Everything runs on XLA:CPU unless a TPU is
+# attached; bench.py probes the device itself and falls back with honest
+# labels.
+
+PY ?= python
+OLD ?= BENCH_r05.json
+NEW ?= /tmp/bench_new.json
+
+.PHONY: test bench bench-new bench-diff chaos chaos-device-ooo docs
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+bench:
+	$(PY) bench.py
+
+# capture a fresh bench run in the same shape the driver archives
+bench-new:
+	$(PY) bench.py | tee /tmp/bench_stdout.txt
+	$(PY) -c "import json; print(json.dumps({'tail': open('/tmp/bench_stdout.txt').read()}))" > $(NEW)
+
+# gate: nonzero exit when NEW drops >20% below OLD on any shared metric
+bench-diff:
+	$(PY) -m tez_tpu.tools.bench_diff $(OLD) $(NEW)
+
+chaos:
+	$(PY) -m tez_tpu.tools.chaos --trials 3
+
+chaos-device-ooo:
+	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --device-ooo --trials 3
+
+docs:
+	$(PY) -m tez_tpu.tools.gen_config_docs > docs/configuration.md

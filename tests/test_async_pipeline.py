@@ -1,0 +1,288 @@
+"""Async double-buffered device plane (ops/async_stage.py,
+ops/device_pipeline.py, DeviceSorter pipeline integration).
+
+The scheduler's contract is asserted against a FAKE clock and thread
+events, never wall time: overlap (span k+1's encode starts before span k
+completes), the dispatch-ahead depth bound, deterministic coalescing, and
+out-of-order completion under the device.dispatch.delay fault point.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from tez_tpu.common import faults
+from tez_tpu.common.faults import parse_spec
+from tez_tpu.ops.async_stage import AsyncSpanPipeline, overlap_pairs
+
+
+class LogicalClock:
+    """Thread-safe monotone counter: every _mark gets a unique tick, so
+    event ordering is exact and wall-time free."""
+
+    def __init__(self):
+        self._t = 0
+        self._lock = threading.Lock()
+
+    def __call__(self):
+        with self._lock:
+            self._t += 1
+            return self._t
+
+
+def test_overlap_witness_fake_clock():
+    """span 1's encode must start while span 0 is still in flight: span 0's
+    readback is held on an event that only span 1's encode sets."""
+    span1_encoding = threading.Event()
+
+    def encode(p):
+        if p == 1:
+            span1_encoding.set()
+        return p
+
+    def readback(inflight, ids):
+        if ids == (0,):
+            assert span1_encoding.wait(timeout=10.0), \
+                "span 1 never started encoding while span 0 was in flight"
+        return inflight
+
+    pipe = AsyncSpanPipeline(
+        dispatch_fn=lambda s: s, readback_fn=readback, encode_fn=encode,
+        depth=2, readback_workers=2, clock=LogicalClock(), instrument=True)
+    for i in range(3):
+        pipe.submit(i, i)
+    res = pipe.drain()
+    assert res == {0: 0, 1: 1, 2: 2}
+    pairs = overlap_pairs(pipe.events)
+    assert ((0,), (1,)) in pairs, f"no overlap witnessed: {pipe.events}"
+    assert pipe.stats.max_in_flight <= 2
+
+
+def test_depth_bound_never_exceeded():
+    """depth=1 serializes groups: in-flight never exceeds the bound and no
+    encode starts while an earlier group is in flight."""
+    release = threading.Event()
+    seen = []
+
+    def readback(inflight, ids):
+        seen.append(ids)
+        if len(seen) == 1:
+            release.wait(timeout=10.0)
+        return inflight
+
+    pipe = AsyncSpanPipeline(
+        dispatch_fn=lambda s: s, readback_fn=readback,
+        depth=1, readback_workers=2, clock=LogicalClock(), instrument=True)
+    for i in range(4):
+        pipe.submit(i, i)
+    release.set()
+    pipe.drain()
+    assert pipe.stats.max_in_flight == 1
+    assert overlap_pairs(pipe.events) == []   # depth=1: no overlap possible
+
+
+def test_paused_coalesce_deterministic():
+    dispatched = []
+
+    def dispatch(staged):
+        dispatched.append(staged)
+        return staged
+
+    pipe = AsyncSpanPipeline(
+        dispatch_fn=dispatch, readback_fn=lambda s, ids: sum(s),
+        coalesce_fn=lambda staged: [x for s in staged for x in s],
+        records_fn=len, coalesce_records=100, paused=True)
+    for i in range(4):
+        pipe.submit(i, [i] * 10, coalesce=True)
+    pipe.resume()
+    res = pipe.drain()
+    assert len(dispatched) == 1          # every span in ONE dispatch
+    assert pipe.stats.coalesced_groups == 1
+    assert res == {i: sum([0] * 10 + [1] * 10 + [2] * 10 + [3] * 10)
+                   for i in range(4)}
+
+
+def test_coalesce_budget_respected():
+    pipe = AsyncSpanPipeline(
+        dispatch_fn=lambda s: s, readback_fn=lambda s, ids: len(ids),
+        coalesce_fn=lambda staged: staged, records_fn=len,
+        coalesce_records=20, paused=True)
+    for i in range(4):
+        pipe.submit(i, [i] * 10, coalesce=True)
+    pipe.resume()
+    pipe.drain()
+    assert pipe.stats.dispatched == 2    # 4 x 10 records under a 20 budget
+    assert pipe.stats.coalesced_groups == 2
+
+
+def test_stage_error_propagates_and_poisons():
+    def dispatch(staged):
+        raise ValueError("boom at dispatch")
+
+    pipe = AsyncSpanPipeline(dispatch_fn=dispatch,
+                             readback_fn=lambda s, ids: s)
+    pipe.submit(0, 0)
+    with pytest.raises(ValueError, match="boom at dispatch"):
+        pipe.drain()
+    with pytest.raises(RuntimeError, match="pipeline failed"):
+        pipe.submit(1, 1)
+
+
+# -- device scheduler (needs jax; tier-1 runs with JAX_PLATFORMS=cpu) -------
+
+def _mk_ragged(n, key_len, seed):
+    rng = np.random.default_rng(seed)
+    kb = rng.integers(0, 256, n * key_len, dtype=np.int64).astype(np.uint8)
+    ko = np.arange(n + 1, dtype=np.int64) * key_len
+    vb = rng.integers(0, 256, n * 8, dtype=np.int64).astype(np.uint8)
+    return kb, ko, vb
+
+
+def test_scheduler_matches_sync_kernel():
+    """submit_ragged through the async plane == the sync device_shuffle_sort
+    over the concatenated spans (stable concat-sort == merge of span sorts)."""
+    from tez_tpu.ops.device_pipeline import (DeviceSpanScheduler,
+                                             device_shuffle_sort)
+    from tez_tpu.ops.keycodec import matrix_to_lanes, pad_to_matrix
+    key_len, nspans, per = 8, 3, 400
+    spans = [_mk_ragged(per, key_len, s) for s in range(nspans)]
+    sched = DeviceSpanScheduler(num_partitions=3, key_width=key_len,
+                                coalesce_records=nspans * per,
+                                paused=True)
+    for sid, (kb, ko, vb) in enumerate(spans):
+        sched.submit_ragged(sid, kb, ko, vb, 8)
+    sched.resume()
+    res = sched.results()
+    assert all(res[i] is res[0] for i in range(nspans))
+    sp_a, lanes_a, vals_a, perm_a, counts_a, n_a = res[0]
+
+    kb = np.concatenate([s[0] for s in spans])
+    ko = np.arange(nspans * per + 1, dtype=np.int64) * key_len
+    vb = np.concatenate([s[2] for s in spans])
+    n = nspans * per
+    mat, lengths = pad_to_matrix(kb, ko, key_len)
+    lanes = matrix_to_lanes(mat)
+    hash_w = 1 << max(2, (key_len - 1).bit_length())
+    hmat, hlens = pad_to_matrix(kb, ko, hash_w)
+    vals = np.ascontiguousarray(vb.reshape(n, 8)).view(np.uint32)
+    out = device_shuffle_sort(lanes, lengths.astype(np.int64), vals, hmat,
+                              hlens.astype(np.int32), 3)
+    sp_s, lanes_s, vals_s, perm_s, counts_s = [np.asarray(x) for x in out]
+    assert n_a == n
+    np.testing.assert_array_equal(counts_a, counts_s)
+    np.testing.assert_array_equal(perm_a[:n], perm_s[:n])
+    np.testing.assert_array_equal(lanes_a[:n], lanes_s[:n])
+    np.testing.assert_array_equal(vals_a[:n], vals_s[:n])
+
+
+def test_recompile_count_bounded_within_bucket():
+    """Varying span sizes inside one padding bucket must reuse ONE compiled
+    program — the jit cache may grow by at most one entry."""
+    from tez_tpu.ops.device_pipeline import (DeviceSpanScheduler,
+                                             _fused_pipeline)
+    key_len = 8
+
+    def run(n, seed):
+        kb, ko, vb = _mk_ragged(n, key_len, seed)
+        sched = DeviceSpanScheduler(num_partitions=2, key_width=key_len)
+        sched.submit_ragged(0, kb, ko, vb, 8)
+        return sched.results()
+
+    run(600, 0)                          # bucket warm (and maybe compile)
+    cache0 = _fused_pipeline._cache_size()
+    for i, n in enumerate((520, 700, 1000, 1024)):   # same padding bucket
+        run(n, i + 1)
+    assert _fused_pipeline._cache_size() - cache0 <= 1, \
+        "same-bucket spans recompiled the fused pipeline"
+
+
+def _mk_batch(n, seed):
+    from tez_tpu.ops.runformat import KVBatch
+    rng = np.random.default_rng(seed)
+    keys = [b"k%08d" % i for i in rng.integers(0, 500, n)]
+    vals = [b"v%06d" % i for i in rng.integers(0, 999999, n)]
+    kb = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    ko = np.cumsum([0] + [len(k) for k in keys]).astype(np.int64)
+    vb = np.frombuffer(b"".join(vals), dtype=np.uint8)
+    vo = np.cumsum([0] + [len(v) for v in vals]).astype(np.int64)
+    return KVBatch(kb, ko, vb, vo)
+
+
+def _spill_sorter(depth):
+    from tez_tpu.ops.sorter import DeviceSorter
+    spills = {}
+    s = DeviceSorter(num_partitions=4, engine="device",
+                     device_min_records=0, key_width=16,
+                     span_budget_bytes=20_000, pipeline_depth=depth)
+    s.on_spill = lambda run, sid: spills.update(
+        {sid: (run.batch.key_bytes.tobytes(), run.batch.val_bytes.tobytes(),
+               run.row_index.tobytes())})
+    return s, spills
+
+
+def test_out_of_order_completion_spills_bit_exact():
+    """device.dispatch.delay holds span 0's completion while later spans
+    drain past it: completion is out of order, yet every spill carries its
+    correct spill id and payload — bit-exact vs the fault-free sync engine."""
+    sync, sync_spills = _spill_sorter(depth=0)
+    for i in range(4):
+        sync.write_batch(_mk_batch(1000, i))
+    assert sync.flush_run() is None
+    assert sorted(sync_spills) == [0, 1, 2, 3]
+
+    faults.install("t", parse_spec(
+        "device.dispatch.delay:delay:ms=400,n=1,match=span=0"))
+    try:
+        apipe, aspills = _spill_sorter(depth=2)
+        for i in range(4):
+            apipe.write_batch(_mk_batch(1000, i))
+        assert apipe.flush_run() is None
+        # on_spill fires in completion order; dict insertion order keeps it
+        order = list(aspills)
+    finally:
+        faults.install("t", [])
+    assert order[-1] == 0, f"span 0 was not delayed past the rest: {order}"
+    assert aspills == sync_spills
+
+
+def test_flush_reassembles_async_runs_in_spill_order():
+    """Non-pipelined flush: runs complete out of order under the delay
+    fault but the final merged output is bit-exact vs the sync engine."""
+    from tez_tpu.ops.sorter import DeviceSorter
+
+    def flush(depth, with_fault):
+        if with_fault:
+            faults.install("t", parse_spec(
+                "device.dispatch.delay:delay:ms=400,n=1,match=span=0"))
+        try:
+            s = DeviceSorter(num_partitions=4, engine="device",
+                             device_min_records=0, key_width=16,
+                             span_budget_bytes=20_000, pipeline_depth=depth,
+                             pipeline_coalesce_records=0)
+            for i in range(4):
+                s.write_batch(_mk_batch(1000, i))
+            r = s.flush_run()
+        finally:
+            if with_fault:
+                faults.install("t", [])
+        return (r.batch.key_bytes.tobytes(), r.batch.val_bytes.tobytes(),
+                r.row_index.tobytes())
+
+    assert flush(2, True) == flush(0, False)
+
+
+def test_engine_auto_width_routing():
+    from tez_tpu.ops.sorter import _route_engine
+    # narrow spans fall back to host ONLY when the caller opted in by
+    # passing key bytes (auto engines)
+    assert _route_engine("device", 10_000, 0, key_nbytes=100,
+                         min_key_bytes=1 << 20) == "host"
+    assert _route_engine("device", 10_000, 0, key_nbytes=1 << 21,
+                         min_key_bytes=1 << 20) == "device"
+    # explicit device engine never passes key_nbytes: no width rerouting
+    assert _route_engine("device", 10_000, 0, key_nbytes=-1,
+                         min_key_bytes=1 << 20) == "device"
+    # record floor still applies first
+    assert _route_engine("device", 10, 100, key_nbytes=1 << 21,
+                         min_key_bytes=1 << 20) == "host"
+    assert _route_engine("host", 10_000, 0) == "host"

@@ -482,6 +482,26 @@ DEVICE_SORT_MIN_RECORDS = _key(
     "tez.runtime.tpu.device.sort.min.records", 1 << 16, Scope.VERTEX,
     "Spans smaller than this sort on host even under the device engine "
     "(dispatch + transfer overhead exceeds the sort); 0 = always device")
+SORT_ENGINE_MIN_BYTES = _key(
+    "tez.runtime.sort.engine.min-bytes", 1 << 20, Scope.VERTEX,
+    "auto-engine floor on a span's total SORT-KEY bytes for the device "
+    "path: wide-VALUE spans can clear the record-count bar while carrying "
+    "few key bytes, where a device dispatch buys almost no device work; "
+    "such spans sort on host.  Only applies when tez.runtime.sorter.class "
+    "is 'auto' (an explicit 'device' is never rerouted by width); 0 = off")
+SORT_PIPELINE_DEPTH = _key(
+    "tez.runtime.sort.pipeline.depth", 2, Scope.VERTEX,
+    "async device data plane: max spans past the staging gate at once "
+    "(encoded/uploaded/dispatched but not read back).  2 = double "
+    "buffering — span k+1 stages while span k is in flight and span k-1 "
+    "drains.  0 = synchronous spans.  Only takes effect when the engine "
+    "resolves to 'device'")
+SORT_PIPELINE_COALESCE_RECORDS = _key(
+    "tez.runtime.sort.pipeline.coalesce.records", -1, Scope.VERTEX,
+    "span-batching budget for the async device plane: adjacent small "
+    "spans coalesce into ONE bucketed dispatch while their total records "
+    "fit this budget (amortizes per-dispatch overhead).  -1 = auto "
+    "(tez.runtime.tpu.device.sort.min.records), 0 = off")
 HOST_SPILL_DIR = _key("tez.runtime.tpu.host.spill.dir", "", Scope.VERTEX,
                       "Where device buffers spill when HBM budget is exceeded; "
                       "'' = <staging>/spill")

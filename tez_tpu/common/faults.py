@@ -94,6 +94,11 @@ KNOWN_POINTS: Dict[str, str] = {
     "fence.stale_epoch":
         "observability point fired wherever a stale-epoch actor is rejected "
         "(task_comm, shuffle service/server, committer publish fence)",
+    "device.dispatch.delay":
+        "ops/async_stage.py readback completion (detail = span=<id>); delay "
+        "mode holds one span's completion while later spans drain past it — "
+        "the deterministic out-of-order-completion lever for the async "
+        "device pipeline",
 }
 
 _EXC_KINDS = {

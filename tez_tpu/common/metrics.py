@@ -114,7 +114,12 @@ def max_bound_from_buckets(counts: List[int]) -> float:
 # and dashboards don't grow holes when a run happens not to spill or commit.
 WELL_KNOWN_HISTOGRAMS = ("shuffle.fetch.rtt", "spill.write", "shuffle.merge",
                          "am.heartbeat.rtt", "device.sort",
-                         "commit.ledger.fsync")
+                         "commit.ledger.fsync",
+                         # async device pipeline stages (ops/async_stage.py):
+                         # host encode, H2D staging, dispatch->host-visible
+                         # latency, D2H readback
+                         "device.encode", "device.h2d",
+                         "device.dispatch_wait", "device.d2h")
 
 
 class MetricsRegistry:

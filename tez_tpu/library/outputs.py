@@ -140,6 +140,17 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                 ctx, "tez.runtime.tpu.resident.keys", True)),
             device_min_records=int(_conf_get(
                 ctx, "tez.runtime.tpu.device.sort.min.records", 1 << 16)),
+            engine_min_bytes=int(_conf_get(
+                ctx, "tez.runtime.sort.engine.min-bytes", 1 << 20)),
+            # async double-buffered device plane; DeviceSorter keeps it off
+            # unless the engine resolves to 'device'.  Spill / pipelined-
+            # shuffle emission hooks the completion callback (on_spill runs
+            # from the pipeline's readback workers, out of order but with
+            # correct spill ids) instead of blocking the collector.
+            pipeline_depth=int(_conf_get(
+                ctx, "tez.runtime.sort.pipeline.depth", 2)),
+            pipeline_coalesce_records=int(_conf_get(
+                ctx, "tez.runtime.sort.pipeline.coalesce.records", -1)),
         )
         ctx.request_initial_memory(sort_mb << 20, None,
                            component_type="PARTITIONED_SORTED_OUTPUT")

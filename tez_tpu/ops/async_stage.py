@@ -1,0 +1,402 @@
+"""Asynchronous double-buffered device staging pipeline.
+
+The device data plane used to pay every span serially: host ragged->lane
+encode, H2D staging, sort dispatch, partition-index readback — one span at a
+time, the chip idle during host work and the host idle during device work.
+This module is the overlap engine (Exoshuffle / pipelined-TF lesson: at data-
+plane scale *staging overlap*, not kernel speed, is the dominant lever):
+
+  submit(span k+2) ... -> [encode+stage span k+1]   (staging thread)
+                          [dispatch span k]         (device in flight)
+                          [readback span k-1]       (readback workers)
+
+Design points:
+
+* **Bounded dispatch-ahead.**  At most ``depth`` spans are past the staging
+  gate at once (encoded/staged/dispatched but not yet fully read back).
+  ``depth=2`` is classic double buffering: one span on the device, one
+  staged and ready to go the moment the device frees.  The submit side is
+  *not* blocked by the gate — spans queue host-side as raw payloads (cheap:
+  the collector's own buffers) and the staging thread pulls them through.
+* **Out-of-order completion.**  Readback runs on a small worker pool, so a
+  span stalled in D2H (or delayed by the ``device.dispatch.delay`` fault
+  point) does not block the span behind it.  Completion callbacks therefore
+  fire in *completion* order; callers that need submission order key their
+  results by span id (DeviceSorter keys runs by spill id).
+* **Span batching.**  Spans submitted with ``coalesce=True`` are merged by
+  the staging thread into one bucketed dispatch while their combined record
+  count fits ``coalesce_records`` — many small spans amortize one
+  dispatch's trace/compile-cache/launch overhead (the chatter killer for
+  small-span workloads).
+* **Deterministic instrumentation.**  The clock is injectable and every
+  stage transition lands in ``events`` when ``instrument=True`` — the
+  scheduler's overlap contract (span k+1's encode starts before span k's
+  dispatch completes; in-flight depth never exceeds the bound) is asserted
+  by unit tests against a fake clock, not by eyeballing wall time.
+
+Every stage emits ``common/tracing.py`` spans (``device.encode`` /
+``device.h2d`` / ``device.dispatch`` / ``device.d2h``) and the matching
+``common/metrics.py`` histograms (``device.encode``, ``device.h2d``,
+``device.dispatch_wait``, ``device.d2h``), so the overlap is visible in a
+Perfetto export and regressions show up in ``tools/counter_diff.py``.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from tez_tpu.common import faults, metrics, tracing
+
+#: Stage names, in pipeline order (also the tracing span names).
+STAGE_ENCODE = "device.encode"
+STAGE_H2D = "device.h2d"
+STAGE_DISPATCH = "device.dispatch"
+STAGE_D2H = "device.d2h"
+
+#: Histogram fed by the dispatch->readback-complete interval: how long a
+#: dispatched program was in flight before its results were host-visible.
+DISPATCH_WAIT_HIST = "device.dispatch_wait"
+
+
+class PipelineStats:
+    """Counters the scheduler maintains under its lock; snapshot freely."""
+
+    __slots__ = ("submitted", "dispatched", "completed", "coalesced_groups",
+                 "max_in_flight")
+
+    def __init__(self) -> None:
+        self.submitted = 0
+        self.dispatched = 0
+        self.completed = 0
+        self.coalesced_groups = 0
+        self.max_in_flight = 0
+
+    def to_dict(self) -> Dict[str, int]:
+        return {s: getattr(self, s) for s in self.__slots__}
+
+
+class _Group:
+    """One dispatch unit: one span, or several coalesced small spans."""
+
+    __slots__ = ("ids", "payloads", "staged", "inflight", "t_dispatch")
+
+    def __init__(self, ids: List[Any], payloads: List[Any]) -> None:
+        self.ids = ids
+        self.payloads = payloads
+        self.staged: Any = None
+        self.inflight: Any = None
+        self.t_dispatch = 0.0
+
+
+class AsyncSpanPipeline:
+    """Bounded dispatch-ahead scheduler over caller-provided stage functions.
+
+    Parameters
+    ----------
+    encode_fn(payload) -> staged
+        Host-side work (ragged->lane encode, precombine).  Runs on the
+        staging thread; overlaps in-flight device work.
+    stage_fn(staged) -> staged'
+        H2D staging: uploads host arrays, returns device handles.  Runs on
+        the staging thread right after encode (its cost is histogrammed
+        separately).  May be None (encode_fn already staged).
+    dispatch_fn(staged) -> inflight
+        Launches the device program.  Must be *asynchronous* (JAX dispatch
+        semantics: returns futures-backed arrays immediately).
+    readback_fn(inflight, ids) -> result
+        Blocks until device results are host-visible and builds the final
+        result.  Runs on readback workers; may complete out of order.
+    coalesce_fn(list_of_staged) -> staged
+        Merges several staged spans into one dispatch unit.  Required only
+        when callers submit with ``coalesce=True``.
+    records_fn(payload) -> int
+        Span size in records, used by the coalescing budget.
+    on_complete(ids, result)
+        Completion callback; ids is the tuple of span ids the dispatch
+        covered (len 1 unless coalesced).  May fire out of submission
+        order; the pipeline serializes calls (one at a time) but makes no
+        ordering promise.
+    depth
+        Max groups past the staging gate (staged or in flight).  2 =
+        double buffering.
+    """
+
+    def __init__(self,
+                 dispatch_fn: Callable[[Any], Any],
+                 readback_fn: Callable[[Any, Tuple[Any, ...]], Any],
+                 encode_fn: Optional[Callable[[Any], Any]] = None,
+                 stage_fn: Optional[Callable[[Any], Any]] = None,
+                 coalesce_fn: Optional[Callable[[List[Any]], Any]] = None,
+                 records_fn: Optional[Callable[[Any], int]] = None,
+                 on_complete: Optional[Callable[[Tuple[Any, ...], Any],
+                                                None]] = None,
+                 depth: int = 2,
+                 coalesce_records: int = 0,
+                 readback_workers: int = 2,
+                 counters: Any = None,
+                 clock: Callable[[], float] = time.perf_counter,
+                 instrument: bool = False,
+                 paused: bool = False,
+                 name: str = "device-pipeline") -> None:
+        if depth < 1:
+            raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+        self._encode_fn = encode_fn or (lambda p: p)
+        self._stage_fn = stage_fn
+        self._dispatch_fn = dispatch_fn
+        self._readback_fn = readback_fn
+        self._coalesce_fn = coalesce_fn
+        self._records_fn = records_fn or (lambda p: 1)
+        self._on_complete = on_complete
+        self.depth = depth
+        self.coalesce_records = coalesce_records
+        self._counters = counters
+        self._clock = clock
+        self._name = name
+        self.stats = PipelineStats()
+        #: (span_id_or_ids, stage, edge, t) when instrument=True
+        self.events: List[Tuple[Any, str, str, float]] = []
+        self._instrument = instrument
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending: "collections.deque[Tuple[Any, Any, bool]]" = \
+            collections.deque()
+        self._in_flight = 0          # groups past the staging gate
+        self._open_spans = 0         # submitted, not yet completed
+        self._results: Dict[Any, Any] = {}
+        self._completion_order: List[Any] = []
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        #: paused=True holds the staging thread until resume(): callers that
+        #: want DETERMINISTIC coalescing submit every span first, then
+        #: resume — otherwise the staging thread races the submit loop and
+        #: group boundaries depend on scheduling
+        self._paused = paused
+        self._complete_lock = threading.Lock()
+
+        self._staging = threading.Thread(
+            target=self._staging_loop, name=f"{name}-staging", daemon=True)
+        self._staging.start()
+        import concurrent.futures
+        self._readback = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, readback_workers),
+            thread_name_prefix=f"{name}-readback")
+
+    # -- instrumentation -----------------------------------------------------
+    def _mark(self, ids: Any, stage: str, edge: str) -> float:
+        t = self._clock()
+        if self._instrument:
+            with self._lock:
+                self.events.append((ids, stage, edge, t))
+        return t
+
+    def _observe(self, hist: str, t0: float, t1: float) -> None:
+        metrics.observe(hist, max(0.0, (t1 - t0) * 1000.0),
+                        counters=self._counters)
+
+    # -- submit side ---------------------------------------------------------
+    def submit(self, span_id: Any, payload: Any,
+               coalesce: bool = False) -> None:
+        """Queue a span.  Never blocks on the dispatch-ahead gate (raw
+        payloads are the collector's own buffers); raises the pipeline's
+        first stage error if one already occurred."""
+        with self._cv:
+            if self._error is not None:
+                raise RuntimeError(
+                    f"{self._name}: pipeline failed") from self._error
+            if self._closed:
+                raise RuntimeError(f"{self._name}: submit after drain")
+            self._pending.append((span_id, payload, coalesce))
+            self._open_spans += 1
+            self.stats.submitted += 1
+            self._cv.notify_all()
+
+    def resume(self) -> None:
+        """Release a pipeline constructed with paused=True."""
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    def drain(self) -> Dict[Any, Any]:
+        """Block until every submitted span completed; stop the staging
+        thread; re-raise the first stage error.  Returns {span_id: result}
+        (completion order preserved in ``completion_order``)."""
+        with self._cv:
+            self._paused = False
+            self._closed = True
+            self._cv.notify_all()
+            while self._open_spans > 0 and self._error is None:
+                self._cv.wait(timeout=0.5)
+            error = self._error
+        self._staging.join(timeout=30.0)
+        self._readback.shutdown(wait=True)
+        if error is not None:
+            raise error
+        return dict(self._results)
+
+    @property
+    def completion_order(self) -> List[Any]:
+        with self._lock:
+            return list(self._completion_order)
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._error
+
+    # -- staging thread ------------------------------------------------------
+    def _next_group(self) -> Optional[_Group]:
+        """Pop the next dispatch unit, coalescing greedily while allowed.
+        Returns None when closed and empty."""
+        with self._cv:
+            while True:
+                if self._error is not None:
+                    return None
+                if self._pending and not self._paused:
+                    break
+                if self._closed:
+                    return None
+                self._cv.wait(timeout=0.5)
+            span_id, payload, coalesce = self._pending.popleft()
+            ids, payloads = [span_id], [payload]
+            if coalesce and self._coalesce_fn is not None and \
+                    self.coalesce_records > 0:
+                total = self._records_fn(payload)
+                while self._pending:
+                    nid, npay, nco = self._pending[0]
+                    if not nco:
+                        break
+                    nrec = self._records_fn(npay)
+                    if total + nrec > self.coalesce_records:
+                        break
+                    self._pending.popleft()
+                    ids.append(nid)
+                    payloads.append(npay)
+                    total += nrec
+                if len(ids) > 1:
+                    self.stats.coalesced_groups += 1
+            return _Group(ids, payloads)
+
+    def _gate_acquire(self) -> None:
+        """The dispatch-ahead bound: wait until fewer than ``depth`` groups
+        are past the staging gate."""
+        with self._cv:
+            while self._in_flight >= self.depth and self._error is None:
+                self._cv.wait(timeout=0.5)
+            self._in_flight += 1
+            self.stats.max_in_flight = max(self.stats.max_in_flight,
+                                           self._in_flight)
+
+    def _gate_release(self) -> None:
+        with self._cv:
+            self._in_flight -= 1
+            self._cv.notify_all()
+
+    def _fail(self, exc: BaseException) -> None:
+        with self._cv:
+            if self._error is None:
+                self._error = exc
+            self._cv.notify_all()
+
+    def _staging_loop(self) -> None:
+        while True:
+            group = self._next_group()
+            if group is None:
+                return
+            ids = tuple(group.ids)
+            try:
+                # The gate is taken BEFORE encode: depth bounds everything
+                # past raw payloads, so host staging memory (padded
+                # matrices + lane arrays) is bounded by depth spans too.
+                self._gate_acquire()
+                if self._error is not None:
+                    self._gate_release()
+                    return
+                t0 = self._mark(ids, STAGE_ENCODE, "start")
+                with tracing.span(STAGE_ENCODE, cat="device",
+                                  spans=repr(list(ids))):
+                    staged = [self._encode_fn(p) for p in group.payloads]
+                t1 = self._mark(ids, STAGE_ENCODE, "end")
+                self._observe(STAGE_ENCODE, t0, t1)
+                one = staged[0] if len(staged) == 1 else \
+                    self._coalesce_fn(staged)
+                t0 = self._mark(ids, STAGE_H2D, "start")
+                with tracing.span(STAGE_H2D, cat="device",
+                                  spans=repr(list(ids))):
+                    if self._stage_fn is not None:
+                        one = self._stage_fn(one)
+                t1 = self._mark(ids, STAGE_H2D, "end")
+                self._observe(STAGE_H2D, t0, t1)
+                t_d = self._mark(ids, STAGE_DISPATCH, "start")
+                with tracing.span(STAGE_DISPATCH, cat="device",
+                                  spans=repr(list(ids))):
+                    inflight = self._dispatch_fn(one)
+                self._mark(ids, STAGE_DISPATCH, "end")
+                group.staged = None
+                group.inflight = inflight
+                group.t_dispatch = t_d
+                with self._lock:
+                    self.stats.dispatched += 1
+                self._readback.submit(self._readback_one, group, ids)
+            except BaseException as e:  # noqa: BLE001 — surfaces via drain
+                self._gate_release()
+                self._fail(e)
+                return
+
+    # -- readback workers ----------------------------------------------------
+    def _readback_one(self, group: _Group, ids: Tuple[Any, ...]) -> None:
+        try:
+            t0 = self._mark(ids, STAGE_D2H, "start")
+            with tracing.span(STAGE_D2H, cat="device",
+                              spans=repr(list(ids))):
+                result = self._readback_fn(group.inflight, ids)
+            t1 = self._mark(ids, STAGE_D2H, "end")
+            self._observe(STAGE_D2H, t0, t1)
+            self._observe(DISPATCH_WAIT_HIST, group.t_dispatch, t1)
+            # deterministic completion-reorder hook (chaos/test plane):
+            # a delay rule here holds THIS span's completion while later
+            # spans drain through the other workers
+            if faults.armed():
+                for sid in ids:
+                    faults.fire("device.dispatch.delay", f"span={sid}")
+            self._gate_release()
+            with self._complete_lock:
+                if self._on_complete is not None:
+                    self._on_complete(ids, result)
+                with self._cv:
+                    for sid in ids:
+                        self._results[sid] = result
+                        self._completion_order.append(sid)
+                    self.stats.completed += len(ids)
+                    self._open_spans -= len(ids)
+                    self._cv.notify_all()
+        except BaseException as e:  # noqa: BLE001 — surfaces via drain
+            self._gate_release()
+            self._fail(e)
+
+
+def overlap_pairs(events: Sequence[Tuple[Any, str, str, float]]
+                  ) -> List[Tuple[Any, Any]]:
+    """Instrumentation helper: pairs (a, b) where span-group b's encode
+    started strictly before span-group a's dispatch COMPLETED (its readback
+    finished — the dispatch call itself returns immediately under JAX's
+    async dispatch, so D2H end is the completion edge).  This is the
+    pipeline's overlap witness; with the injectable clock it is
+    deterministic under a fake clock."""
+    complete: Dict[Any, float] = {}
+    encode_start: Dict[Any, float] = {}
+    order: List[Any] = []
+    for ids, stage, edge, t in events:
+        if stage == STAGE_D2H and edge == "end":
+            complete[ids] = t
+        elif stage == STAGE_ENCODE and edge == "start":
+            encode_start[ids] = t
+            order.append(ids)
+    out = []
+    for i, a in enumerate(order):
+        for b in order[i + 1:]:
+            if a in complete and b in encode_start and \
+                    encode_start[b] < complete[a]:
+                out.append((a, b))
+    return out

@@ -152,18 +152,38 @@ Combiner = Callable[[Run], Run]
 #: accelerate the big batches, keep the chatter off the chip.
 DEVICE_SORT_MIN_RECORDS = 1 << 16
 
+#: Auto-engine floor on a span's total SORT-KEY bytes for the device path
+#: (tez.runtime.sort.engine.min-bytes).  The device sorts key lanes only —
+#: wide-VALUE spans clear the record-count bar while carrying few key bytes,
+#: so the dispatch+transfer overhead buys almost no device work and the
+#: host gather of the wide values dominates either way.  Only consulted
+#: when the engine was requested as `auto`; an explicit engine=device is
+#: never silently rerouted by width.
+ENGINE_MIN_KEY_BYTES = 1 << 20
+
 
 def resolve_engine(engine: str) -> str:
     """Resolve the `auto` engine: device kernels when an accelerator
     backend answers, host kernels on the CPU fallback (where an XLA:CPU
-    sort + dispatch round-trip loses to numpy/native outright)."""
+    sort + dispatch round-trip loses to numpy/native outright).  Per-span
+    width/count routing happens later (DeviceSorter._span_engine)."""
     if engine == "auto":
         return "device" if device.accelerator_present() else "host"
     return engine
 
 
-def _route_engine(engine: str, n: int, min_records: int) -> str:
-    return "host" if engine == "device" and n < min_records else engine
+def _route_engine(engine: str, n: int, min_records: int,
+                  key_nbytes: int = -1, min_key_bytes: int = 0) -> str:
+    """Per-span engine routing: host below the record-count floor and —
+    when the caller opts in by passing key_nbytes >= 0 (auto engines) —
+    host below the key-byte floor too."""
+    if engine != "device":
+        return engine
+    if n < min_records:
+        return "host"
+    if min_key_bytes > 0 and 0 <= key_nbytes < min_key_bytes:
+        return "host"
+    return engine
 
 
 class DeviceSorter:
@@ -182,12 +202,37 @@ class DeviceSorter:
                  key_normalizer: Optional[Callable[[bytes], bytes]] = None,
                  spill_codec: Optional[str] = None,
                  resident_keys: bool = True,
-                 device_min_records: int = DEVICE_SORT_MIN_RECORDS):
+                 device_min_records: int = DEVICE_SORT_MIN_RECORDS,
+                 engine_min_bytes: int = ENGINE_MIN_KEY_BYTES,
+                 pipeline_depth: int = 0,
+                 pipeline_coalesce_records: int = -1):
         self.num_partitions = num_partitions
         self.key_width = max(4, key_width)
         # 'device' (TPU kernels) | 'host' (np.lexsort/native) | 'auto'
         self.engine = resolve_engine(engine)
+        #: width-aware auto routing: a span only takes the device path when
+        #: its total key bytes clear this floor TOO (never applied to an
+        #: explicitly requested device engine)
+        self._auto_engine = engine == "auto"
+        self.engine_min_bytes = engine_min_bytes
         self.device_min_records = device_min_records
+        #: async double-buffered device plane (ops/async_stage.py): spans
+        #: submit to a bounded dispatch-ahead pipeline — span k+1's host
+        #: encode/H2D overlaps span k's in-flight sort while span k-1's
+        #: readback drains; completed runs collect out-of-order and are
+        #: reassembled in spill-id order at flush (bit-exact vs sync).
+        #: 0 = synchronous spans (host engines: the pipeline only helps
+        #: when a dispatch actually leaves the host, so it stays off).
+        self.pipeline_depth = pipeline_depth if self.engine == "device" else 0
+        #: span-batching budget (records): small adjacent spans coalesce
+        #: into ONE bucketed dispatch while their sum fits.  -1 = auto
+        #: (device_min_records: exactly the spans too small to be worth a
+        #: dispatch each), 0 = off.
+        self.pipeline_coalesce_records = (
+            device_min_records if pipeline_coalesce_records < 0
+            else pipeline_coalesce_records)
+        self._pipeline = None
+        self._async_store_ids: List[int] = []
         #: keep sorted key lanes in HBM for downstream device merges.  The
         #: pinned HBM (~(key width + 4) B/row per registered output, freed
         #: at DAG deletion) is OUTSIDE the host memory budgets — operators
@@ -305,8 +350,145 @@ class DeviceSorter:
         self.num_spills += 1
         return run
 
+    # -- async double-buffered span plane ------------------------------------
+    def _ensure_pipeline(self):
+        if self._pipeline is None:
+            from tez_tpu.ops.async_stage import AsyncSpanPipeline
+            self._pipeline = AsyncSpanPipeline(
+                encode_fn=self._async_encode,
+                stage_fn=self._async_h2d,
+                dispatch_fn=self._async_dispatch,
+                readback_fn=self._async_readback,
+                coalesce_fn=self._async_coalesce,
+                records_fn=lambda p: p["batch"].num_records,
+                on_complete=self._async_complete,
+                depth=self.pipeline_depth,
+                coalesce_records=self.pipeline_coalesce_records,
+                counters=self.counters,
+                name="sorter-pipeline")
+        return self._pipeline
+
+    def _submit_span_async(self) -> None:
+        batch = self._span.to_batch()
+        custom_parts = np.asarray(self._span.parts, dtype=np.int32) \
+            if self._span.parts else None
+        skip_pre = self._span.all_pre_combined and \
+            len(self._span.batches) == 1
+        self._span = SpanBuffer()
+        spill_id = self.num_spills
+        self.num_spills += 1
+        # pipelined mode keeps one span per spill_id (consumers track spill
+        # ids); store mode may coalesce — the joint stable sort of adjacent
+        # spans equals the merge of their individual sorts (ties keep
+        # arrival order), so the flush-time merge output is unchanged
+        coalesce = self.on_spill is None and custom_parts is None
+        self._ensure_pipeline().submit(
+            spill_id,
+            {"batch": batch, "custom_parts": custom_parts,
+             "skip_pre": skip_pre},
+            coalesce=coalesce)
+
+    def _async_encode(self, payload: dict) -> dict:
+        """Staging thread: precombine + host ragged->lane encode (the
+        resident fast path's host work), overlapped with in-flight sorts."""
+        batch = self._precombine(payload["batch"], payload["custom_parts"],
+                                 skip=payload["skip_pre"])
+        custom_parts = payload["custom_parts"]
+        engine = self._span_engine(batch)
+        if custom_parts is None and self.partitioner == "hash" and \
+                engine != "host" and self.key_normalizer is None and \
+                self.resident_keys and batch.num_records > 0:
+            klens = batch.key_offsets[1:] - batch.key_offsets[:-1]
+            wmax = int(klens.max(initial=1))
+            if wmax <= self.key_width:
+                eff = ((max(wmax, 1) + 3) // 4) * 4
+                mat, lengths = pad_to_matrix(batch.key_bytes,
+                                             batch.key_offsets, eff)
+                return {"kind": "resident", "batch": batch,
+                        "lanes": matrix_to_lanes(mat), "lengths": lengths}
+        return {"kind": "generic", "batch": batch,
+                "custom_parts": custom_parts}
+
+    def _async_coalesce(self, staged_list: List[dict]) -> dict:
+        batch = KVBatch.concat([s["batch"] for s in staged_list])
+        if all(s["kind"] == "resident" for s in staged_list):
+            width = max(s["lanes"].shape[1] for s in staged_list)
+            # widening narrower views with ZERO lanes preserves order:
+            # bytes beyond a key's length are zero in the lane encoding
+            lanes = np.concatenate([
+                s["lanes"] if s["lanes"].shape[1] == width else
+                np.pad(s["lanes"], ((0, 0), (0, width - s["lanes"].shape[1])))
+                for s in staged_list])
+            lengths = np.concatenate([s["lengths"] for s in staged_list])
+            return {"kind": "resident", "batch": batch,
+                    "lanes": lanes, "lengths": lengths}
+        return {"kind": "generic", "batch": batch, "custom_parts": None}
+
+    def _async_h2d(self, staged: dict) -> dict:
+        if staged["kind"] == "resident":
+            staged["staged_dev"] = device.stage_resident_span(
+                staged["lanes"], staged["lengths"])
+        return staged
+
+    def _async_dispatch(self, staged: dict) -> dict:
+        t0 = time.time()
+        if staged["kind"] == "resident":
+            inflight = device.dispatch_resident_span(staged["staged_dev"],
+                                                     self.num_partitions)
+            return {"kind": "resident", "batch": staged["batch"],
+                    "inflight": inflight, "t0": t0}
+        # generic spans (normalizer / custom partitioner / host-routed /
+        # over-width keys): the full sync span sort runs here on the staging
+        # thread — still overlapped against other spans' readback
+        run = self.sort_batch(staged["batch"],
+                              custom_partitions=staged["custom_parts"])
+        return {"kind": "generic", "run": run, "t0": t0}
+
+    def _async_readback(self, inflight: dict, ids) -> Run:
+        if inflight["kind"] == "resident":
+            sp, perm, dev = device.readback_resident_span(
+                inflight["inflight"])
+            sorted_batch = inflight["batch"].take(perm)
+            sorted_batch.dev_keys = dev
+            self._record_sort_ms(inflight["t0"])
+            run = Run.from_sorted_batch(sorted_batch, sp,
+                                        self.num_partitions)
+        else:
+            run = inflight["run"]
+        if self.combiner is not None:
+            run = self.combiner(run)
+        return run
+
+    def _async_complete(self, ids, run: Run) -> None:
+        """Completion callback — fires in COMPLETION order (out-of-order
+        under delays); coalesced groups complete under their first spill
+        id."""
+        sid = min(ids)
+        if self.on_spill is not None:
+            self.on_spill(run, sid)
+        else:
+            with self._store_lock:
+                self._store_run(run)
+                self._async_store_ids.append(sid)
+
+    def _drain_async(self) -> None:
+        """Block until every submitted span completed, then restore spill-id
+        order over the stored runs so the flush merge sees the same run
+        sequence as the synchronous engine (stable ties = run order)."""
+        pipe, self._pipeline = self._pipeline, None
+        if pipe is not None:
+            pipe.drain()
+        if self._async_store_ids:
+            order = sorted(range(len(self._async_store_ids)),
+                           key=lambda i: self._async_store_ids[i])
+            self._runs = [self._runs[i] for i in order]
+            self._async_store_ids = []
+
     def _sort_span(self) -> None:
         if self._span.num_records == 0:
+            return
+        if self.pipeline_depth > 0:
+            self._submit_span_async()
             return
         if self._executor is not None:
             # hand the full span to the sortmaster; keep collecting
@@ -341,6 +523,16 @@ class DeviceSorter:
         else:
             self._store_run(run)
 
+    def _span_engine(self, batch: KVBatch) -> str:
+        """Per-span routing: record-count floor always; key-byte floor only
+        for auto-resolved device engines (wide-value small-key spans carry
+        too little device work to pay a dispatch)."""
+        key_nbytes = int(batch.key_offsets[-1]) if self._auto_engine else -1
+        return _route_engine(self.engine, batch.num_records,
+                             self.device_min_records,
+                             key_nbytes=key_nbytes,
+                             min_key_bytes=self.engine_min_bytes)
+
     def _record_sort_ms(self, t0: float) -> None:
         ms = (time.time() - t0) * 1000.0
         self.counters.find_counter(TaskCounter.DEVICE_SORT_MILLIS)\
@@ -367,8 +559,7 @@ class DeviceSorter:
                     f"[0, {self.num_partitions})")
         # hybrid routing: tiny spans sort faster on host than a device
         # round-trip, even under the device engine
-        engine = _route_engine(self.engine, batch.num_records,
-                               self.device_min_records)
+        engine = self._span_engine(batch)
         if custom_partitions is None and self.partitioner == "hash" and \
                 engine != "host" and self.key_normalizer is None and \
                 self.resident_keys:
@@ -573,17 +764,27 @@ class DeviceSorter:
         merge -> TezMerger.java:76)."""
         assert not self._closed
         self._closed = True
-        if self.on_spill is not None:
+        if self.pipeline_depth > 0:
+            # async plane: the trailing span submits like any other, then
+            # the drain barrier collects out-of-order completions and
+            # restores spill-id order
+            self._sort_span()
+            self._drain_async()
+            self._drain_pending(store=True)   # no-op unless sortmaster ran
+            if self.on_spill is not None:
+                return None
+        elif self.on_spill is not None:
             if self._span.num_records > 0:
                 self._sort_span()
             self._drain_pending(store=False)
             return None
-        if self._span.num_records > 0 and not self._runs and \
-                not self._pending:
-            # common fast path: everything fit one span
-            return self._finalize_span()
-        self._sort_span()
-        self._drain_pending(store=True)
+        else:
+            if self._span.num_records > 0 and not self._runs and \
+                    not self._pending:
+                # common fast path: everything fit one span
+                return self._finalize_span()
+            self._sort_span()
+            self._drain_pending(store=True)
         runs = list(self._runs)
         self._runs = []
         if not runs:
@@ -661,6 +862,45 @@ class DeviceSorter:
         return FileRun(path)
 
 
+def _merge_resident_partitioned(live: Sequence[Run], num_partitions: int
+                                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Multi-partition device-resident merge: each run's HBM key columns are
+    (partition, key)-sorted, so partition p occupies the contiguous rows
+    [row_index[p], row_index[p+1]) of its device view — merge those slices
+    per partition and emit partitions in order.  Within a partition, slices
+    merge in run order (stable ties = MergeQueue age semantics), so the
+    result is bit-identical to the generic concat+sort merge.  Returns
+    (permutation into the concat of live runs' batches, row_index)."""
+    offs = np.zeros(len(live), dtype=np.int64)
+    if len(live) > 1:
+        np.cumsum([r.batch.num_records for r in live[:-1]], out=offs[1:])
+    pieces: List[np.ndarray] = []
+    counts = np.zeros(num_partitions, dtype=np.int64)
+    for p in range(num_partitions):
+        slices, bases = [], []
+        for r, off in zip(live, offs):
+            lo, hi = int(r.row_index[p]), int(r.row_index[p + 1])
+            if hi > lo:
+                lanes_dev, lens_dev, _lo0, _n = r.batch.dev_keys
+                slices.append((lanes_dev, lens_dev, lo, hi))
+                bases.append(off + lo)
+        if not slices:
+            continue
+        perm = device.merge_resident_slices(slices)
+        cnts = np.asarray([hi - lo for (_l, _n, lo, hi) in slices],
+                          dtype=np.int64)
+        bounds = np.zeros(len(cnts) + 1, dtype=np.int64)
+        np.cumsum(cnts, out=bounds[1:])
+        sl = np.searchsorted(bounds[1:], perm, side="right")
+        pieces.append(np.asarray(bases, dtype=np.int64)[sl] +
+                      (perm - bounds[sl]))
+        counts[p] = len(perm)
+    row_index = np.zeros(num_partitions + 1, dtype=np.int64)
+    np.cumsum(counts, out=row_index[1:])
+    total = np.concatenate(pieces) if pieces else np.zeros(0, np.int64)
+    return total, row_index
+
+
 def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
                       key_width: int,
                       counters: Optional[TezCounters] = None,
@@ -694,24 +934,30 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
             level = nxt
         runs = level
     t0 = time.time()
-    if engine != "host" and key_normalizer is None and num_partitions == 1:
-        views = [r.batch.dev_keys for r in runs if r.batch.num_records > 0]
-        if views and all(v is not None for v in views):
+    if engine != "host" and key_normalizer is None:
+        live = [r for r in runs if r.batch.num_records > 0]
+        views = [r.batch.dev_keys for r in live]
+        if live and all(v is not None for v in views):
             # mixed lane widths are fine: narrower views widen with zero
             # lanes on device (zero = absent bytes in the lane encoding)
             # device-resident merge: key columns are already in HBM from
             # the producers' span sorts — only the permutation comes back
             # (VERDICT r1 item 4; TezMerger semantics preserved)
-            perm = device.merge_resident_slices(views)
-            batch = KVBatch.concat(
-                [r.batch for r in runs if r.batch.num_records > 0])
+            if num_partitions == 1:
+                perm = device.merge_resident_slices(views)
+                row_index = None
+            else:
+                perm, row_index = _merge_resident_partitioned(
+                    live, num_partitions)
+            batch = KVBatch.concat([r.batch for r in live])
             sorted_batch = batch.take(perm)
             if counters is not None:
                 counters.find_counter(TaskCounter.DEVICE_MERGE_MILLIS)\
                     .increment(int((time.time() - t0) * 1000))
                 counters.increment(TaskCounter.MERGED_MAP_OUTPUTS, len(runs))
-            return Run(sorted_batch,
-                       np.array([0, sorted_batch.num_records], np.int64))
+            if row_index is None:
+                row_index = np.array([0, sorted_batch.num_records], np.int64)
+            return Run(sorted_batch, row_index)
     # hybrid routing for the generic path only — when producer key lanes
     # are already device-resident the resident merge above is cheaper than
     # any host sort regardless of size

@@ -1,0 +1,147 @@
+"""Compare two bench runs metric-by-metric and fail on regressions.
+
+Usage:
+  python -m tez_tpu.tools.bench_diff OLD NEW [--threshold 0.20]
+
+OLD/NEW are either the driver's ``BENCH_*.json`` wrappers
+(``{"tail": ..., "parsed": ...}``: every JSON metric line is recovered
+from the captured stdout tail) or raw ``bench.py`` stdout saved to a file.
+Metrics are matched across runs by the text up to the first ``(`` —
+parenthesised qualifiers (record counts, fallback labels) change between
+revisions, the headline name does not.
+
+All bench metrics are throughputs (higher is better): a metric REGRESSES
+when NEW's value drops more than ``--threshold`` (default 20%) below
+OLD's, and any regression makes the exit status nonzero — wire this into
+CI as ``make bench-diff OLD=... NEW=...``.  A 0.0 value is the bench's
+"stage unavailable" sentinel and is reported but never compared.  When
+both runs carry the device pipeline's ``stage_ms`` breakdown the
+per-stage deltas are printed too (informational: stage attribution shifts
+between backends; the gate is the end-to-end value).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+DEFAULT_THRESHOLD = 0.20
+
+
+def normalize(metric: str) -> str:
+    """Match key: the metric text up to the first parenthesis."""
+    return metric.split("(", 1)[0].strip()
+
+
+def _metric_lines(text: str) -> List[Dict]:
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            out.append(rec)
+    return out
+
+
+def load_metrics(path: str) -> Dict[str, Dict]:
+    """{normalized_name: metric_record} from a wrapper or raw stdout file.
+    Later lines win on a normalized-name collision (the bench prints the
+    headline last)."""
+    with open(path) as f:
+        text = f.read()
+    recs: List[Dict] = []
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "tail" in doc:
+        recs = _metric_lines(doc.get("tail") or "")
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and "metric" in parsed and \
+                not any(r["metric"] == parsed["metric"] for r in recs):
+            recs.append(parsed)
+    elif isinstance(doc, dict) and "metric" in doc:
+        recs = [doc]
+    elif isinstance(doc, list):
+        recs = [r for r in doc
+                if isinstance(r, dict) and "metric" in r and "value" in r]
+    else:
+        recs = _metric_lines(text)
+    return {normalize(r["metric"]): r for r in recs}
+
+
+def _stage_diff(old: Dict, new: Dict) -> List[str]:
+    so, sn = old.get("stage_ms"), new.get("stage_ms")
+    if not (isinstance(so, dict) and isinstance(sn, dict)):
+        return []
+    lines = []
+    for stage in sorted(set(so) | set(sn)):
+        a, b = float(so.get(stage, 0.0)), float(sn.get(stage, 0.0))
+        lines.append(f"    stage {stage:14} {a:10.1f} {b:10.1f} "
+                     f"{b - a:+10.1f} ms")
+    return lines
+
+
+def diff(old_path: str, new_path: str,
+         threshold: float = DEFAULT_THRESHOLD) -> int:
+    old, new = load_metrics(old_path), load_metrics(new_path)
+    if not old or not new:
+        print(f"no metrics parsed from "
+              f"{old_path if not old else new_path}", file=sys.stderr)
+        return 2
+    shared = [k for k in old if k in new]
+    regressions = 0
+    print(f"{'metric':52} {'OLD':>10} {'NEW':>10} {'ratio':>7}")
+    for key in shared:
+        a, b = old[key], new[key]
+        va, vb = float(a["value"]), float(b["value"])
+        unit = b.get("unit", a.get("unit", ""))
+        if va <= 0.0 or vb <= 0.0:
+            print(f"{key:52} {va:10.2f} {vb:10.2f}    skip "
+                  f"(unavailable sentinel)")
+            continue
+        ratio = vb / va
+        flag = ""
+        if ratio < 1.0 - threshold:
+            flag = f"  << REGRESSION (>{threshold:.0%} drop)"
+            regressions += 1
+        print(f"{key:52} {va:10.2f} {vb:10.2f} {ratio:6.2f}x "
+              f"{unit}{flag}")
+        for line in _stage_diff(a, b):
+            print(line)
+    for key in sorted(set(old) - set(new)):
+        print(f"{key:52} {float(old[key]['value']):10.2f} "
+              f"{'-':>10}    (metric dropped)")
+    for key in sorted(set(new) - set(old)):
+        print(f"{key:52} {'-':>10} {float(new[key]['value']):10.2f}"
+              f"    (metric added)")
+    if regressions:
+        print(f"\n{regressions} metric(s) regressed more than "
+              f"{threshold:.0%}")
+        return 1
+    print(f"\nno regression beyond {threshold:.0%} across "
+          f"{len(shared)} shared metric(s)")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tez_tpu.tools.bench_diff", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("old", help="baseline run (BENCH_*.json or raw stdout)")
+    ap.add_argument("new", help="candidate run")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="relative drop that counts as a regression "
+                         "(default 0.20 = 20%%)")
+    args = ap.parse_args(argv)
+    return diff(args.old, args.new, threshold=args.threshold)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

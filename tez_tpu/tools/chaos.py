@@ -328,6 +328,81 @@ def run_commit_storm(workdir: str, timeout: float = 120.0,
                   f"({len(got) - 1} part file(s) + _SUCCESS)")
 
 
+def run_device_ooo(seed: int, spans: int = 4,
+                   records: int = 1500) -> Tuple[bool, str]:
+    """Out-of-order device-completion scenario: the async double-buffered
+    plane (ops/async_stage.py) runs under a ``device.dispatch.delay`` fault
+    that holds one seeded span's completion while later spans drain past it
+    on the readback workers.  Every spill must still carry its correct
+    spill id and payload — bit-exact vs the fault-free SYNCHRONOUS engine —
+    and the final flush-merge must be bit-exact too."""
+    import numpy as np
+
+    from tez_tpu.ops.runformat import KVBatch
+    from tez_tpu.ops.sorter import DeviceSorter
+
+    def make_batch(i: int) -> "KVBatch":
+        rng = np.random.default_rng(seed * 1000 + i)
+        keys = [b"k%08d" % k for k in rng.integers(0, 500, records)]
+        vals = [b"v%06d" % v for v in rng.integers(0, 999999, records)]
+        kb = np.frombuffer(b"".join(keys), dtype=np.uint8)
+        ko = np.cumsum([0] + [len(k) for k in keys]).astype(np.int64)
+        vb = np.frombuffer(b"".join(vals), dtype=np.uint8)
+        vo = np.cumsum([0] + [len(v) for v in vals]).astype(np.int64)
+        return KVBatch(kb, ko, vb, vo)
+
+    def run(depth: int, spec: str):
+        if spec:
+            faults.install("chaos", faults.parse_spec(spec), seed=seed)
+        try:
+            spills: Dict[int, tuple] = {}
+            s = DeviceSorter(num_partitions=4, engine="device",
+                             device_min_records=0, key_width=16,
+                             span_budget_bytes=20_000, pipeline_depth=depth)
+            s.on_spill = lambda run_, sid: spills.update(
+                {sid: (run_.batch.key_bytes.tobytes(),
+                       run_.batch.val_bytes.tobytes(),
+                       run_.row_index.tobytes())})
+            for i in range(spans):
+                s.write_batch(make_batch(i))
+            s.flush_run()
+        finally:
+            faults.install("chaos", [])
+        return spills, list(spills)
+
+    def run_merged(depth: int, spec: str) -> tuple:
+        if spec:
+            faults.install("chaos", faults.parse_spec(spec), seed=seed)
+        try:
+            s = DeviceSorter(num_partitions=4, engine="device",
+                             device_min_records=0, key_width=16,
+                             span_budget_bytes=20_000, pipeline_depth=depth)
+            for i in range(spans):
+                s.write_batch(make_batch(i))
+            r = s.flush_run()
+        finally:
+            faults.install("chaos", [])
+        return (r.batch.key_bytes.tobytes(), r.batch.val_bytes.tobytes(),
+                r.row_index.tobytes())
+
+    delayed = random.Random(seed).randrange(spans)
+    spec = f"device.dispatch.delay:delay:ms=400,n=1,match=span={delayed}"
+    sync_spills, _ = run(0, "")
+    async_spills, order = run(2, spec)
+    if order and order[-1] != delayed:
+        return False, (f"delayed span {delayed} was not last to complete "
+                       f"(order {order}) — delay fault did not bite")
+    if async_spills != sync_spills:
+        bad = [k for k in sync_spills
+               if async_spills.get(k) != sync_spills[k]]
+        return False, (f"spill payloads diverge (spill ids {bad}); "
+                       f"completion order {order}")
+    if run_merged(2, spec) != run_merged(0, ""):
+        return False, "flush-merged output diverges from sync engine"
+    return True, (f"delayed span {delayed}; completion order {order}; "
+                  f"{spans} spills + merged run bit-exact")
+
+
 def _export_trace(path: str) -> None:
     """Write whatever the span buffer holds (it survives per-DAG disarm) as
     Perfetto trace_event JSON, then drop the buffer."""
@@ -354,12 +429,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--commit-storm", action="store_true",
                     help="run the mid-commit AM-kill exactly-once scenario "
                          "instead of the seeded storm soak")
+    ap.add_argument("--device-ooo", action="store_true",
+                    help="run the out-of-order device-completion scenario: "
+                         "the async device pipeline under a seeded "
+                         "device.dispatch.delay fault, spills + merged "
+                         "output bit-exact vs the sync engine")
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="arm the tracing plane (tez.trace.enabled) on the "
                          "storm DAGs and write a Perfetto trace_event JSON "
                          "of the recorded spans to PATH")
     args = ap.parse_args(argv)
 
+    if args.device_ooo:
+        failures = 0
+        for seed in range(args.seed, args.seed + args.trials):
+            ok, detail = run_device_ooo(seed)
+            print(("ok   " if ok else "FAIL ") +
+                  f"device-ooo seed={seed}: {detail}")
+            if not ok:
+                failures += 1
+                print(f"REPRO: python -m tez_tpu.tools.chaos --device-ooo "
+                      f"--seed {seed}")
+        return 1 if failures else 0
     workdir = args.workdir or tempfile.mkdtemp(prefix="tez-chaos-")
     cleanup = args.workdir is None
     if args.commit_storm:

@@ -21,6 +21,13 @@ from tez_tpu.tools.history_parser import parse_jsonl_files
 # resolution is powers-of-2 ms, so anything under 2x is within quantisation.
 REGRESSION_RATIO = 2.0
 
+#: The async device plane's stage histograms (ops/async_stage.py), in
+#: pipeline order.  Diffed as cumulative wall ms per stage: stage SUMS say
+#: where the plane's time moved (p95 alone can hide a stage whose every
+#: span got uniformly slower).
+DEVICE_STAGE_HISTS = ("device.encode", "device.h2d", "device.dispatch_wait",
+                      "device.d2h")
+
 
 def flatten(counters: Dict) -> Dict[str, int]:
     return {f"{g}.{name}": v for g, cs in counters.items()
@@ -41,6 +48,25 @@ def diff_histograms(counters_a: Dict, counters_b: Dict,
         regressed = bool(
             a and b and a["p95"] > 0 and b["p95"] >= REGRESSION_RATIO * a["p95"])
         out.append((name, a, b, regressed))
+    return out
+
+
+def diff_device_stages(counters_a: Dict, counters_b: Dict,
+                       ) -> List[Tuple[str, float, float, bool]]:
+    """[(stage, sum_ms_a, sum_ms_b, regressed)] for the async device
+    plane's stage histograms present in either run; regressed when B spent
+    REGRESSION_RATIO x A's total wall in that stage."""
+    ha = histograms_from_counters(counters_a)
+    hb = histograms_from_counters(counters_b)
+    out = []
+    for name in DEVICE_STAGE_HISTS:
+        if name not in ha and name not in hb:
+            continue
+        ms_a = ha.get(name, {}).get("sum_us", 0) / 1000.0
+        ms_b = hb.get(name, {}).get("sum_us", 0) / 1000.0
+        regressed = name in ha and name in hb and ms_a > 0 and \
+            ms_b >= REGRESSION_RATIO * ms_a
+        out.append((name, ms_a, ms_b, regressed))
     return out
 
 
@@ -76,6 +102,18 @@ def main() -> int:
         for name, sa, sb, regressed in hists:
             flag = "  << REGRESSION" if regressed else ""
             print(f"{name:32} {_fmt_hist(sa):>44} {_fmt_hist(sb):>44}{flag}")
+            regressions += int(regressed)
+    stages = diff_device_stages(a.counters, b.counters)
+    if stages:
+        tot_a = sum(ms for _, ms, _, _ in stages) or 1.0
+        tot_b = sum(ms for _, _, ms, _ in stages) or 1.0
+        print(f"\n{'device pipeline stage (wall ms)':32} "
+              f"{'A':>16} {'B':>16} {'delta':>12}")
+        for name, ms_a, ms_b, regressed in stages:
+            flag = "  << REGRESSION" if regressed else ""
+            print(f"{name:32} {ms_a:10.1f} {100 * ms_a / tot_a:4.0f}% "
+                  f"{ms_b:10.1f} {100 * ms_b / tot_b:4.0f}% "
+                  f"{ms_b - ms_a:+12.1f}{flag}")
             regressions += int(regressed)
     print(f"\nA: {a.dag_id} ({a.state}, {a.duration:.2f}s)  "
           f"B: {b.dag_id} ({b.state}, {b.duration:.2f}s)  "

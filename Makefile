@@ -6,7 +6,7 @@ PY ?= python
 OLD ?= BENCH_r05.json
 NEW ?= /tmp/bench_new.json
 
-.PHONY: test bench bench-new bench-diff chaos chaos-device-ooo docs
+.PHONY: test bench bench-new bench-diff chaos chaos-device-ooo chaos-device docs
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -28,6 +28,10 @@ chaos:
 
 chaos-device-ooo:
 	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --device-ooo --trials 3
+
+# failure-containment soak: hung dispatch + OOM storm + reorder, all bit-exact
+chaos-device:
+	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --device-ooo --device-hang --device-oom-storm --trials 3
 
 docs:
 	$(PY) -m tez_tpu.tools.gen_config_docs > docs/configuration.md

@@ -45,6 +45,16 @@ def _disarm_trace_plane():
 
 
 @pytest.fixture(autouse=True)
+def _reset_device_breaker():
+    """The device circuit breaker is a sticky process singleton; a test
+    that tripped it (injected device faults) must not leave the device
+    engine short-circuited to host for every later test."""
+    yield
+    from tez_tpu.ops.async_stage import reset_process_breaker
+    reset_process_breaker()
+
+
+@pytest.fixture(autouse=True)
 def _reset_epoch_registry():
     """The AM-epoch registry is process-global; a test that restarted an AM
     (attempt 2+) would otherwise fence the next test's attempt-1 AMs if an

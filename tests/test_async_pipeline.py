@@ -271,6 +271,290 @@ def test_flush_reassembles_async_runs_in_spill_order():
     assert flush(2, True) == flush(0, False)
 
 
+# -- failure containment: watchdog / failover / breaker / OOM ladder --------
+
+import time  # noqa: E402
+
+from tez_tpu.common.counters import TezCounters  # noqa: E402
+from tez_tpu.ops.async_stage import (COUNTER_GROUP,  # noqa: E402
+                                     CircuitBreaker)
+
+
+class SettableClock:
+    """Manually-advanced fake clock: watchdog deadlines are compared on the
+    pipeline's injectable clock, so tests blow a deadline by advancing it —
+    never by sleeping it out."""
+
+    def __init__(self):
+        self._t = 0.0
+        self._lock = threading.Lock()
+
+    def __call__(self):
+        with self._lock:
+            return self._t
+
+    def advance(self, dt):
+        with self._lock:
+            self._t += dt
+
+
+def test_failover_on_device_exception():
+    """A device exception mid-dispatch re-routes JUST that group through
+    failover_fn; the other spans stay on the device path and the pipeline
+    never poisons."""
+    def dispatch(staged):
+        if staged == 1:
+            raise ValueError("chip fault on span 1")
+        return staged
+
+    counters = TezCounters()
+    pipe = AsyncSpanPipeline(
+        dispatch_fn=dispatch, readback_fn=lambda s, ids: ("device", s),
+        failover_fn=lambda ids, payloads: ("host", payloads[0]),
+        breaker=CircuitBreaker(failures=100), counters=counters)
+    for i in range(3):
+        pipe.submit(i, i)
+    res = pipe.drain()
+    assert res == {0: ("device", 0), 1: ("host", 1), 2: ("device", 2)}
+    assert pipe.stats.failovers == 1
+    fo = counters.group(COUNTER_GROUP)
+    assert fo.find_counter("device.failover.spans").value == 1
+    assert fo.find_counter("device.failover.groups").value == 1
+
+
+def test_watchdog_abandons_hung_readback_fake_clock():
+    """A readback that never returns: the watchdog (deadline on the FAKE
+    clock) abandons the attempt, fails the span over, and drain() returns
+    in bounded wall time with every result present."""
+    clock = SettableClock()
+    hang = threading.Event()
+    in_hang = threading.Event()
+    failed_over = threading.Event()
+
+    def readback(inflight, ids):
+        if ids == (0,):
+            in_hang.set()
+            hang.wait(timeout=30.0)   # a hung D2H nobody will release
+        return ("device", inflight)
+
+    def failover(ids, payloads):
+        failed_over.set()
+        return ("host", payloads[0])
+
+    pipe = AsyncSpanPipeline(
+        dispatch_fn=lambda s: s, readback_fn=readback,
+        failover_fn=failover, breaker=CircuitBreaker(failures=100),
+        clock=clock, watchdog_readback_ms=1000)
+    t_wall = time.monotonic()
+    pipe.submit(0, 0)
+    assert in_hang.wait(timeout=10.0)
+    clock.advance(2.0)                # blow the 1000ms readback deadline
+    assert failed_over.wait(timeout=10.0), "watchdog never fired"
+    pipe.submit(1, 1)
+    pipe.submit(2, 2)
+    res = pipe.drain()
+    wall = time.monotonic() - t_wall
+    try:
+        assert res == {0: ("host", 0), 1: ("device", 1), 2: ("device", 2)}
+        assert pipe.stats.watchdog_fires == 1
+        assert wall < 15.0, f"flush() not bounded by the watchdog: {wall:.1f}s"
+    finally:
+        hang.set()                    # release the abandoned daemon worker
+
+
+def test_watchdog_abandons_hung_dispatch_and_drains_pending():
+    """A dispatch that never returns wedges the staging thread itself: the
+    watchdog must claim the hung group AND take over the queue, draining
+    every not-yet-staged span through failover — drain() stays bounded."""
+    clock = SettableClock()
+    hang = threading.Event()
+    in_hang = threading.Event()
+
+    def dispatch(staged):
+        if staged == 0:
+            in_hang.set()
+            hang.wait(timeout=30.0)   # staging thread stuck inside XLA
+        return staged
+
+    counters = TezCounters()
+    pipe = AsyncSpanPipeline(
+        dispatch_fn=dispatch, readback_fn=lambda s, ids: ("device", s),
+        failover_fn=lambda ids, payloads: ("host", payloads[0]),
+        breaker=CircuitBreaker(failures=100), counters=counters,
+        clock=clock, watchdog_dispatch_ms=1000, paused=True)
+    t_wall = time.monotonic()
+    for i in range(4):
+        pipe.submit(i, i)
+    pipe.resume()
+    assert in_hang.wait(timeout=10.0)
+    clock.advance(2.0)                # blow the 1000ms dispatch deadline
+    res = pipe.drain()
+    wall = time.monotonic() - t_wall
+    try:
+        assert res == {i: ("host", i) for i in range(4)}
+        assert pipe.stats.watchdog_fires == 1
+        fo = counters.group(COUNTER_GROUP)
+        assert fo.find_counter("device.watchdog.dispatch_fires").value == 1
+        assert fo.find_counter("device.failover.drained").value == 3
+        assert wall < 15.0, f"flush() not bounded when wedged: {wall:.1f}s"
+    finally:
+        hang.set()                    # release the abandoned staging thread
+
+
+def test_breaker_trips_and_half_open_recovers_fake_clock():
+    clock = SettableClock()
+    br = CircuitBreaker(failures=2, cooldown_ms=1000, clock=clock)
+    assert br.allow_device() and br.state == "closed"
+    br.record_failure()
+    assert br.state == "closed"       # below the consecutive threshold
+    br.record_failure()
+    assert br.state == "open" and br.trips == 1
+    assert not br.allow_device()      # cooldown not elapsed
+    clock.advance(1.1)
+    assert br.allow_device()          # the half-open probe slot
+    assert br.state == "half-open"
+    assert not br.allow_device()      # only ONE probe at a time
+    br.record_success()
+    assert br.state == "closed" and br.recoveries == 1
+    # a probe FAILURE re-opens immediately for another full cooldown
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "open" and br.trips == 2
+    clock.advance(1.1)
+    assert br.allow_device()
+    br.record_failure()
+    assert br.state == "open" and br.trips == 3
+    assert not br.allow_device()
+
+
+def test_breaker_open_short_circuits_before_device():
+    """With the breaker open every group routes straight to the host
+    engine — the dispatch fn (the chip) is never touched."""
+    br = CircuitBreaker(failures=1, cooldown_ms=10_000,
+                        clock=SettableClock())
+    br.record_failure()               # open; fake clock never elapses it
+    dispatched = []
+    counters = TezCounters()
+    pipe = AsyncSpanPipeline(
+        dispatch_fn=lambda s: dispatched.append(s) or s,
+        readback_fn=lambda s, ids: ("device", s),
+        failover_fn=lambda ids, payloads: ("host", payloads[0]),
+        breaker=br, counters=counters)
+    for i in range(3):
+        pipe.submit(i, i)
+    res = pipe.drain()
+    assert dispatched == []
+    assert res == {i: ("host", i) for i in range(3)}
+    assert counters.group(COUNTER_GROUP).find_counter(
+        "device.breaker.short_circuits").value == 3
+
+
+def test_oom_split_retry_before_host_failover():
+    """RESOURCE_EXHAUSTED takes the split ladder FIRST: oom_retry_fn's
+    (on-device) result completes the group, failover_fn is never called,
+    and the split success re-arms the breaker."""
+    failover_calls = []
+
+    def dispatch(staged):
+        if staged == 0:
+            raise MemoryError("RESOURCE_EXHAUSTED: span too large")
+        return staged
+
+    br = CircuitBreaker(failures=2)
+    counters = TezCounters()
+    pipe = AsyncSpanPipeline(
+        dispatch_fn=dispatch, readback_fn=lambda s, ids: ("device", s),
+        failover_fn=lambda ids, payloads:
+            failover_calls.append(ids) or ("host", payloads[0]),
+        oom_retry_fn=lambda ids, payloads: ("split", payloads[0]),
+        breaker=br, counters=counters)
+    pipe.submit(0, 0)
+    pipe.submit(1, 1)
+    res = pipe.drain()
+    assert res == {0: ("split", 0), 1: ("device", 1)}
+    assert failover_calls == []       # the ladder stopped on-device
+    assert pipe.stats.oom_splits == 1
+    fo = counters.group(COUNTER_GROUP)
+    assert fo.find_counter("device.oom.split_attempts").value == 1
+    assert fo.find_counter("device.oom.split_success").value == 1
+    assert br.state == "closed" and br.trips == 0
+
+
+def test_oom_split_floor_falls_back_to_host():
+    """When the split retry declines (floor reached — it raises), the
+    group continues down the ladder to host failover."""
+    def retry(ids, payloads):
+        raise MemoryError("split floor reached")
+
+    counters = TezCounters()
+    pipe = AsyncSpanPipeline(
+        dispatch_fn=lambda s: (_ for _ in ()).throw(
+            MemoryError("RESOURCE_EXHAUSTED")),
+        readback_fn=lambda s, ids: s,
+        failover_fn=lambda ids, payloads: ("host", payloads[0]),
+        oom_retry_fn=retry, breaker=CircuitBreaker(failures=100),
+        counters=counters)
+    pipe.submit(0, 0)
+    res = pipe.drain()
+    assert res == {0: ("host", 0)}
+    fo = counters.group(COUNTER_GROUP)
+    assert fo.find_counter("device.oom.split_attempts").value == 1
+    assert fo.find_counter("device.oom.split_success").value == 0
+    assert fo.find_counter("device.failover.spans").value == 1
+
+
+def _flush_merged(depth, spec, **sorter_kw):
+    """flush_run() a 4-span DeviceSorter under an optional fault spec;
+    returns (merged-run bytes, counters)."""
+    from tez_tpu.ops.sorter import DeviceSorter
+    if spec:
+        faults.install("t", parse_spec(spec))
+    try:
+        s = DeviceSorter(num_partitions=4, engine="device",
+                         device_min_records=0, key_width=16,
+                         span_budget_bytes=20_000, pipeline_depth=depth,
+                         pipeline_coalesce_records=0, **sorter_kw)
+        for i in range(4):
+            s.write_batch(_mk_batch(1000, i))
+        r = s.flush_run()
+    finally:
+        if spec:
+            faults.install("t", [])
+    return (r.batch.key_bytes.tobytes(), r.batch.val_bytes.tobytes(),
+            r.row_index.tobytes()), s.counters
+
+
+def test_sorter_oom_split_on_device_bit_exact():
+    """One injected RESOURCE_EXHAUSTED dispatch (budget n=1): the span
+    retries split in half ON DEVICE (the budget is spent, so the halves
+    sort clean), the stable split-merge is bit-exact vs the fault-free
+    sync engine, and host failover is never taken."""
+    base, _ = _flush_merged(0, "")
+    br = CircuitBreaker(failures=100)
+    got, counters = _flush_merged(
+        2, "device.dispatch.oom:fail:n=1,exc=runtime,match=span=0",
+        split_min_bytes=1_000, breaker=br)
+    assert got == base
+    fo = counters.group(COUNTER_GROUP)
+    assert fo.find_counter("device.oom.split_attempts").value == 1
+    assert fo.find_counter("device.oom.split_success").value == 1
+    assert fo.find_counter("device.failover.spans").value == 0
+    assert br.trips == 0
+
+
+def test_sorter_readback_failure_fails_over_bit_exact():
+    """An injected readback crash re-sorts that span through the host
+    engine; the merged flush stays bit-exact vs the sync engine."""
+    base, _ = _flush_merged(0, "")
+    br = CircuitBreaker(failures=100)
+    got, counters = _flush_merged(
+        2, "device.readback.fail:fail:n=1,exc=io,match=span=0", breaker=br)
+    assert got == base
+    fo = counters.group(COUNTER_GROUP)
+    assert fo.find_counter("device.failover.spans").value == 1
+    assert br.trips == 0
+
+
 def test_engine_auto_width_routing():
     from tez_tpu.ops.sorter import _route_engine
     # narrow spans fall back to host ONLY when the caller opted in by

@@ -232,6 +232,28 @@ def test_scenario_corrupt_spill_quarantine_rerun(tmp_path):
     assert d.get("TOTAL_LAUNCHED_TASKS", 0) >= 4
 
 
+def test_scenario_device_hang():
+    """Device-plane hang scenario: one span's XLA dispatch hangs for longer
+    than the whole test budget; the dispatch watchdog abandons it, the span
+    is re-sorted through the host engine, flush() returns in bounded time,
+    and every spill is bit-exact vs the synchronous run.  CLI equivalent:
+    `python -m tez_tpu.tools.chaos --device-hang`."""
+    ok, detail = chaos.run_device_hang(0)
+    assert ok, detail
+
+
+def test_scenario_device_oom_storm():
+    """Device-plane OOM storm: repeated RESOURCE_EXHAUSTED dispatches drive
+    the containment ladder end to end — split retry on device first, host
+    failover at the floor, breaker trip after the configured consecutive
+    failures, short-circuit of the remaining spans, then half-open probe
+    recovery after the cooldown — with both the storm run and the recovery
+    run bit-exact.  CLI equivalent:
+    `python -m tez_tpu.tools.chaos --device-oom-storm`."""
+    ok, detail = chaos.run_device_oom_storm(0)
+    assert ok, detail
+
+
 @pytest.mark.slow
 def test_chaos_soak_multi_seed(tmp_path):
     """Soak: consecutive seeded storms, all bit-exact vs one baseline."""

@@ -243,6 +243,33 @@ def test_preemption_max_wait_forces_round():
     assert len(_kills(ctx)) >= 2       # pacing bypassed
 
 
+def test_preemption_noop_after_shutdown():
+    """A preemption retry Timer that fires after shutdown() must not kill
+    anything: Timer.cancel cannot stop a callback already in flight, so
+    _maybe_preempt itself has to early-return once the scheduler is down."""
+    from tez_tpu.am.task_scheduler import LocalTaskSchedulerService
+    ctx = _SchedCtx(C.TezConfiguration({
+        "tez.am.preemption.percentage": 100,
+        "tez.am.preemption.heartbeats-between-preemptions": 40,
+        "tez.am.preemption.max.wait-time-ms": 50,
+    }))
+    sched = LocalTaskSchedulerService(ctx, num_slots=1)
+    vid = DAGId("app_1_p", 1).vertex(0)
+    sched.schedule(vid.task(0).attempt(0), "a", priority=20)
+    assert sched.get_task("c0", timeout=0.1) == "a"
+    high = DAGId("app_1_p", 1).vertex(1)
+    sched.schedule(high.task(0).attempt(0), "h0", priority=5)
+    assert len(_kills(ctx)) == 1
+    # same arrangement that forces a round in the max-wait test above —
+    # except the scheduler is shut down, so nothing may be preempted
+    sched._preempting.clear()
+    sched._running[vid.task(1).attempt(0)] = "c0"
+    time.sleep(0.08)
+    sched.shutdown()
+    sched._maybe_preempt()             # the late Timer callback
+    assert len(_kills(ctx)) == 1
+
+
 def test_vertex_max_task_concurrency_caps_handout():
     from tez_tpu.am.task_scheduler import LocalTaskSchedulerService
     ctx = _SchedCtx(C.TezConfiguration(
@@ -277,3 +304,25 @@ def test_history_logging_switches():
     h2.handle(HistoryEvent(HistoryEventType.DAG_SUBMITTED, dag_id="dag_7"))
     h2.handle(HistoryEvent(HistoryEventType.DAG_SUBMITTED, dag_id="dag_8"))
     assert len(svc2.events) == 2       # AM event + dag_8 only
+
+
+def test_history_dag_switch_discarded_on_finish():
+    """The per-DAG logging switch must be dropped at DAG_FINISHED even when
+    the MASTER switch short-circuits handle() — a session AM running with
+    am-logging off would otherwise leak one switch entry per suppressed
+    DAG, forever."""
+    from tez_tpu.am.history import (HistoryEvent, HistoryEventHandler,
+                                    HistoryEventType,
+                                    InMemoryHistoryLoggingService)
+    for master in (True, False):
+        svc = InMemoryHistoryLoggingService()
+        h = HistoryEventHandler(svc, conf=C.TezConfiguration(
+            {"tez.am.history.logging.enabled": master}))
+        h.set_dag_conf("dag_9", {"tez.dag.history.logging.enabled": False})
+        h.handle(HistoryEvent(HistoryEventType.DAG_STARTED, dag_id="dag_9"))
+        assert "dag_9" in h._dag_logging_disabled
+        h.handle(HistoryEvent(HistoryEventType.DAG_FINISHED,
+                              dag_id="dag_9"))
+        assert "dag_9" not in h._dag_logging_disabled, \
+            f"switch leaked with am_logging_enabled={master}"
+        assert len(svc.events) == 0    # dag_9 suppressed either way

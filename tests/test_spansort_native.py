@@ -186,3 +186,41 @@ def test_merge_sorted_runs_host_uses_fused_path_and_verifies():
     gk, gv, _ = _golden_sort(all_keys, all_vals, parts)
     assert _rows(merged.batch.key_bytes, merged.batch.key_offsets) == gk
     assert _rows(merged.batch.val_bytes, merged.batch.val_offsets) == gv
+
+
+def test_emit_rejects_nonpositive_partitions():
+    """tz_span_sort_emit must reject num_partitions <= 0 with rc -1 (the
+    partition-count pass would otherwise index an empty/negative
+    part_counts array); the python wrapper surfaces the rejection as None
+    so callers take the host fallback."""
+    import ctypes
+
+    from tez_tpu.ops import native
+    lib = native._load()
+    kb, ko = _ragged([b"a", b"bb"])
+    vb, vo = _ragged([b"x", b"yy"])
+    out_kb = np.empty(int(ko[-1]), dtype=np.uint8)
+    out_ko = np.empty(3, dtype=np.int64)
+    out_vb = np.empty(int(vo[-1]), dtype=np.uint8)
+    out_vo = np.empty(3, dtype=np.int64)
+    part_counts = np.empty(1, dtype=np.int64)
+
+    def rc_for(p):
+        return lib.tz_span_sort_emit(
+            kb.ctypes.data_as(ctypes.c_void_p),
+            ko.ctypes.data_as(ctypes.c_void_p),
+            vb.ctypes.data_as(ctypes.c_void_p),
+            vo.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int64(2), ctypes.c_int32(p), None,
+            ctypes.c_int32(1),
+            out_kb.ctypes.data_as(ctypes.c_void_p),
+            out_ko.ctypes.data_as(ctypes.c_void_p),
+            out_vb.ctypes.data_as(ctypes.c_void_p),
+            out_vo.ctypes.data_as(ctypes.c_void_p),
+            None, part_counts.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int32(1))
+
+    assert rc_for(0) == -1
+    assert rc_for(-4) == -1
+    assert rc_for(1) == 0              # the guard is exact, not off-by-one
+    assert span_sort_emit_native(kb, ko, vb, vo, 0, None, True) is None

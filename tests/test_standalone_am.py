@@ -131,3 +131,53 @@ def test_remote_kill(standalone_am):
         assert status.state is DAGStatusState.KILLED
     finally:
         client.stop()
+
+
+def test_remote_stop_synchronous_reaches_close():
+    """Synchronous stop() (tez.client.asynchronous-stop=False) must poll
+    the (host, port) captured at start() — not re-read tez.am.address,
+    which may be cleared or portless by then — and must reach am.close()
+    even when no address is available at all."""
+    import socket
+
+    from tez_tpu.client.remote import RemoteFrameworkClient
+    from tez_tpu.common import config as C
+
+    class FakeAM:
+        def __init__(self):
+            self.closed = False
+            self.shutdowns = 0
+
+        def shutdown_session(self):
+            self.shutdowns += 1
+
+        def close(self):
+            self.closed = True
+
+    # grab a port with nothing listening: the liveness poll must exit on
+    # the first refused connect, not wait out the 15s default
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    conf = C.TezConfiguration({
+        "tez.session.mode": True,
+        "tez.client.asynchronous-stop": False,
+        "tez.am.address": "cleared-no-port",   # unparseable at stop time
+    })
+    c = RemoteFrameworkClient(conf)
+    am = FakeAM()
+    c.am = am
+    c._am_addr = ("127.0.0.1", port)   # as captured by start()
+    t0 = time.time()
+    c.stop()
+    assert time.time() - t0 < 5.0
+    assert am.shutdowns == 1 and am.closed and c.am is None
+
+    # never start()ed AND the conf address is portless: the guarded
+    # re-parse degrades to skipping the poll — close() still runs
+    c2 = RemoteFrameworkClient(conf)
+    am2 = FakeAM()
+    c2.am = am2
+    c2.stop()
+    assert am2.shutdowns == 1 and am2.closed and c2.am is None

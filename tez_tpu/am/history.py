@@ -249,16 +249,18 @@ class HistoryEventHandler:
     def handle(self, event: HistoryEvent) -> None:
         if self.recovery_service is not None:
             self.recovery_service.handle(event)
-        if not self.am_logging_enabled:
-            return
         dag_id = getattr(event, "dag_id", None) or \
             (event.data.get("dag_id") if isinstance(
                 getattr(event, "data", None), dict) else None)
-        if dag_id is not None and str(dag_id) in self._dag_logging_disabled:
-            # DAG over: drop its switch so a session AM serving many DAGs
-            # doesn't accumulate entries forever
-            if event.event_type is HistoryEventType.DAG_FINISHED:
-                self._dag_logging_disabled.discard(str(dag_id))
+        suppressed = dag_id is not None and \
+            str(dag_id) in self._dag_logging_disabled
+        # DAG over: drop its switch so a session AM serving many DAGs
+        # doesn't accumulate entries forever — BEFORE the master-switch
+        # early return, which otherwise leaks one entry per DAG when
+        # am_logging_enabled is off
+        if suppressed and event.event_type is HistoryEventType.DAG_FINISHED:
+            self._dag_logging_disabled.discard(str(dag_id))
+        if not self.am_logging_enabled or suppressed:
             return
         self.logging_service.handle(event)
 

@@ -92,6 +92,11 @@ class LocalTaskSchedulerService(TaskSchedulerService):
         respawn and re-queue — reference: YarnTaskSchedulerService
         preemption (lower priority VALUE = more important, heap order)."""
         with self._lock:
+            # a _preempt_retry Timer can fire after shutdown() cancelled it
+            # (cancel() does not stop a Timer already past its wait): never
+            # dispatch TA_KILL_REQUEST into a stopping AM
+            if self._shutdown:
+                return
             # cheap common-path exit BEFORE any heap scan: a free slot (or
             # empty queue) means nothing to preempt — schedule() stays O(1)
             if len(self._running) < self.num_slots or not self._queued:
@@ -104,7 +109,7 @@ class LocalTaskSchedulerService(TaskSchedulerService):
             return   # preemption disabled
         limit = max(1, self.num_slots * pct // 100)
         with self._lock:
-            if len(self._running) < self.num_slots:
+            if self._shutdown or len(self._running) < self.num_slots:
                 return
             # best waiting priority from the heap head, lazily discarding
             # entries cancelled while queued

@@ -11,7 +11,7 @@ import logging
 import socket
 import threading
 import time
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 from tez_tpu.am.umbilical_server import FramedClient
 from tez_tpu.common.security import JobTokenSecretManager
@@ -70,6 +70,9 @@ class RemoteFrameworkClient:
         self.am: Optional[RemoteAMProxy] = None
         self._hb_stop = threading.Event()
         self._hb_proxy: Optional[RemoteAMProxy] = None
+        #: (host, port) captured at start(): synchronous stop() must not
+        #: depend on tez.am.address still being present/parseable then
+        self._am_addr: Optional[Tuple[str, int]] = None
 
     def start(self) -> None:
         addr = self.conf.get("tez.am.address")
@@ -78,6 +81,7 @@ class RemoteFrameworkClient:
             raise ValueError("remote mode needs tez.am.address and "
                              "tez.job.token")
         host, _, port = addr.partition(":")
+        self._am_addr = (host, int(port))
         secrets = JobTokenSecretManager(bytes.fromhex(token))
         from tez_tpu.common.tls import client_context
         ssl_ctx = client_context(self.conf)
@@ -139,15 +143,23 @@ class RemoteFrameworkClient:
             except Exception:  # noqa: BLE001 — AM already gone
                 pass
             if not bool(self.conf.get("tez.client.asynchronous-stop", True)):
-                addr = str(self.conf.get("tez.am.address", ""))
-                host, _, port = addr.partition(":")
+                # prefer the host/port captured at start(); fall back to a
+                # GUARDED re-parse — a missing/cleared :port must degrade
+                # to skipping the poll, never raise before self.am.close()
+                target = self._am_addr
+                if target is None:
+                    addr = str(self.conf.get("tez.am.address", ""))
+                    host, _, port = addr.partition(":")
+                    try:
+                        target = (host, int(port))
+                    except (TypeError, ValueError):
+                        target = None
                 wait_ms = float(self.conf.get(
                     "tez.client.diagnostics.wait.timeout-ms", 15_000))
                 deadline = time.time() + wait_ms / 1000.0
-                while time.time() < deadline:
+                while target is not None and time.time() < deadline:
                     try:
-                        with socket.create_connection(
-                                (host, int(port)), timeout=1.0):
+                        with socket.create_connection(target, timeout=1.0):
                             pass
                         time.sleep(0.2)   # still listening: AM lingering
                     except OSError:

@@ -502,6 +502,34 @@ SORT_PIPELINE_COALESCE_RECORDS = _key(
     "spans coalesce into ONE bucketed dispatch while their total records "
     "fit this budget (amortizes per-dispatch overhead).  -1 = auto "
     "(tez.runtime.tpu.device.sort.min.records), 0 = off")
+DEVICE_WATCHDOG_DISPATCH_MS = _key(
+    "tez.runtime.device.watchdog.dispatch-ms", 60_000, Scope.VERTEX,
+    "deadline for one device dispatch attempt in the async data plane; a "
+    "dispatch still in flight past this is abandoned by the watchdog "
+    "monitor thread and the span re-sorts through the host engine "
+    "(bit-exact).  0 = dispatch unwatched")
+DEVICE_WATCHDOG_READBACK_MS = _key(
+    "tez.runtime.device.watchdog.readback-ms", 60_000, Scope.VERTEX,
+    "deadline for one D2H readback attempt in the async data plane; a "
+    "hung readback is abandoned and the span fails over to the host "
+    "engine instead of wedging flush().  0 = readback unwatched")
+DEVICE_BREAKER_FAILURES = _key(
+    "tez.runtime.device.breaker.failures", 3, Scope.VERTEX,
+    "consecutive device-attempt failures (watchdog fires, device "
+    "exceptions) that trip the sticky per-process circuit breaker; while "
+    "open, new spans route straight to the host engine without touching "
+    "the device")
+DEVICE_BREAKER_COOLDOWN_MS = _key(
+    "tez.runtime.device.breaker.cooldown-ms", 5_000, Scope.VERTEX,
+    "how long an open device breaker waits before letting ONE probe span "
+    "try the device again (half-open); the probe's success re-arms the "
+    "device engine, its failure re-opens the breaker for another cooldown")
+DEVICE_SPLIT_MIN_BYTES = _key(
+    "tez.runtime.device.split.min-bytes", 1 << 20, Scope.VERTEX,
+    "floor for OOM-adaptive span splitting: a RESOURCE_EXHAUSTED device "
+    "attempt retries on-device with the span halved (recursively) while "
+    "the half is still above this many key+value bytes; below it the "
+    "span goes to the host engine instead")
 HOST_SPILL_DIR = _key("tez.runtime.tpu.host.spill.dir", "", Scope.VERTEX,
                       "Where device buffers spill when HBM budget is exceeded; "
                       "'' = <staging>/spill")

@@ -99,6 +99,19 @@ KNOWN_POINTS: Dict[str, str] = {
         "mode holds one span's completion while later spans drain past it — "
         "the deterministic out-of-order-completion lever for the async "
         "device pipeline",
+    "device.dispatch.hang":
+        "ops/async_stage.py device dispatch entry (detail = span=<id>); "
+        "delay mode simulates a hung XLA dispatch so the watchdog abandons "
+        "the attempt and the span fails over to the host engine",
+    "device.dispatch.oom":
+        "ops/async_stage.py device dispatch entry and ops/sorter.py split "
+        "retries (detail = span=<id>[:split[lo:hi)]); fail mode raises a "
+        "RESOURCE_EXHAUSTED-classified error driving the split-then-"
+        "fallback ladder and the circuit breaker",
+    "device.readback.fail":
+        "ops/async_stage.py D2H readback entry (detail = span=<id>); fail "
+        "mode crashes the readback worker's attempt so the span re-sorts "
+        "through the host engine",
 }
 
 _EXC_KINDS = {

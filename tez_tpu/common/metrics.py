@@ -119,7 +119,10 @@ WELL_KNOWN_HISTOGRAMS = ("shuffle.fetch.rtt", "spill.write", "shuffle.merge",
                          # host encode, H2D staging, dispatch->host-visible
                          # latency, D2H readback
                          "device.encode", "device.h2d",
-                         "device.dispatch_wait", "device.d2h")
+                         "device.dispatch_wait", "device.d2h",
+                         # host-engine failover re-sorts (failure
+                         # containment, ops/async_stage.py)
+                         "device.failover.host_sort")
 
 
 class MetricsRegistry:

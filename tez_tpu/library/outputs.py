@@ -151,6 +151,18 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                 ctx, "tez.runtime.sort.pipeline.depth", 2)),
             pipeline_coalesce_records=int(_conf_get(
                 ctx, "tez.runtime.sort.pipeline.coalesce.records", -1)),
+            # failure containment for the async plane: watchdog deadlines,
+            # host-engine failover breaker, OOM split floor
+            watchdog_dispatch_ms=float(_conf_get(
+                ctx, "tez.runtime.device.watchdog.dispatch-ms", 60_000)),
+            watchdog_readback_ms=float(_conf_get(
+                ctx, "tez.runtime.device.watchdog.readback-ms", 60_000)),
+            breaker_failures=int(_conf_get(
+                ctx, "tez.runtime.device.breaker.failures", 3)),
+            breaker_cooldown_ms=float(_conf_get(
+                ctx, "tez.runtime.device.breaker.cooldown-ms", 5_000)),
+            split_min_bytes=int(_conf_get(
+                ctx, "tez.runtime.device.split.min-bytes", 1 << 20)),
         )
         ctx.request_initial_memory(sort_mb << 20, None,
                            component_type="PARTITIONED_SORTED_OUTPUT")

@@ -562,6 +562,10 @@ int32_t tz_span_sort_emit(
         uint8_t* out_kb, int64_t* out_ko,
         uint8_t* out_vb, int64_t* out_vo,
         int32_t* out_parts, int64_t* part_counts, int32_t n_threads) {
+    // mirror the n > INT32_MAX-2 fallback: with num_partitions <= 0 the
+    // part_counts buffer is zero-length and every row would emit through
+    // part_counts[0] — reject before touching any output buffer
+    if (num_partitions <= 0) return -1;
     for (int32_t p = 0; p < num_partitions; p++) part_counts[p] = 0;
     out_ko[0] = 0;
     out_vo[0] = 0;

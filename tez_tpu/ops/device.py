@@ -71,6 +71,24 @@ def accelerator_present() -> bool:
         return False
 
 
+#: Substrings marking a device failure as an out-of-memory class.  XLA
+#: surfaces HBM exhaustion as a RuntimeError/XlaRuntimeError whose message
+#: carries the gRPC-style status name, not a dedicated exception type, so
+#: classification is message-based; the fault plane's injected
+#: `device.dispatch.oom` errors match the same way.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Resource exhausted",
+                "out of memory", "OOM", "device.dispatch.oom")
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """True when a device-attempt failure should take the OOM ladder
+    (retry on-device with the span split) rather than plain host failover."""
+    if isinstance(exc, MemoryError):
+        return True
+    msg = str(exc)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
 def uniform_clamped_lengths(lengths: np.ndarray, width_cap: int):
     """(is_uniform, pad_value) over CLAMPED lengths — the shared uniformity
     test for the skip-length-pass optimization (clamp first: all-long keys

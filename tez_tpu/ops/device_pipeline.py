@@ -122,7 +122,11 @@ class DeviceSpanScheduler:
                  coalesce_records: int = 0, readback_workers: int = 2,
                  key_width: int = 0, counters: Any = None,
                  clock: Callable[[], float] = time.perf_counter,
-                 instrument: bool = False, paused: bool = False) -> None:
+                 instrument: bool = False, paused: bool = False,
+                 contain_failures: bool = False,
+                 watchdog_dispatch_ms: float = 0.0,
+                 watchdog_readback_ms: float = 0.0,
+                 breaker: Any = None) -> None:
         from tez_tpu.ops.async_stage import AsyncSpanPipeline
         self.num_partitions = num_partitions
         # key_width only matters for submit_ragged(); every ragged key must
@@ -141,7 +145,13 @@ class DeviceSpanScheduler:
             coalesce_records=coalesce_records,
             readback_workers=readback_workers,
             counters=counters, clock=clock, instrument=instrument,
-            paused=paused, name="device-span")
+            paused=paused, name="device-span",
+            # failure containment: a failed/hung device attempt re-sorts
+            # through the numpy twin of _fused_pipeline (bit-exact)
+            failover_fn=self._host_failover if contain_failures else None,
+            breaker=breaker,
+            watchdog_dispatch_ms=watchdog_dispatch_ms,
+            watchdog_readback_ms=watchdog_readback_ms)
 
     def submit(self, span_id, lanes, lengths, vals, key_mat, hash_lengths,
                coalesce: bool = True) -> None:
@@ -213,7 +223,11 @@ class DeviceSpanScheduler:
         # assignment).
         return {"_spans": staged}
 
-    def _h2d(self, s: Dict) -> Dict:
+    def _bucketize(self, s: Dict) -> Dict:
+        """Host-side half of H2D staging: merge the (possibly coalesced)
+        spans into bucket-padded numpy buffers with the device kernels' tail
+        sentinels.  Shared by the device upload (_h2d) and the host failover
+        twin (_host_failover) so padding semantics can never diverge."""
         spans = s["_spans"] if "_spans" in s else [s]
         first = spans[0]
         nlanes = first["lanes"].shape[1]
@@ -241,12 +255,20 @@ class DeviceSpanScheduler:
             uniform_clamped_lengths(lengths[:n], width_cap)[0]
         slen = np.minimum(lengths, width_cap).astype(np.uint32)
         return {
-            "key_mat": jnp.asarray(key_mat),
-            "hash_lengths": jnp.asarray(hash_lengths, dtype=jnp.int32),
-            "lanes": jnp.asarray(lanes),
-            "sort_lengths": jnp.asarray(slen),
-            "vals": jnp.asarray(vals),
+            "key_mat": key_mat, "hash_lengths": hash_lengths,
+            "lanes": lanes, "sort_lengths": slen, "vals": vals,
             "uniform": uniform, "n": n,
+        }
+
+    def _h2d(self, s: Dict) -> Dict:
+        h = self._bucketize(s)
+        return {
+            "key_mat": jnp.asarray(h["key_mat"]),
+            "hash_lengths": jnp.asarray(h["hash_lengths"], dtype=jnp.int32),
+            "lanes": jnp.asarray(h["lanes"]),
+            "sort_lengths": jnp.asarray(h["sort_lengths"]),
+            "vals": jnp.asarray(h["vals"]),
+            "uniform": h["uniform"], "n": h["n"],
         }
 
     def _dispatch(self, s: Dict):
@@ -259,3 +281,28 @@ class DeviceSpanScheduler:
         sp, out_lanes, out_vals, perm, counts, n = inflight
         return (np.asarray(sp), np.asarray(out_lanes), np.asarray(out_vals),
                 np.asarray(perm), np.asarray(counts), n)
+
+    # -- failure containment -------------------------------------------------
+    def _host_failover(self, ids, payloads) -> Tuple:
+        """Numpy twin of _fused_pipeline over the RAW payloads: the same
+        bucketed staging buffers, FNV hash-partition (padding rows carry
+        partition INT32_MAX like _hash_to_partitions), stable
+        (partition, lanes, length) sort, gather, and searchsorted counts —
+        bit-exact with the device result, never touches the device."""
+        from tez_tpu.ops.host_sort import host_hash_partition, host_sort_run
+        staged = [self._encode(p) for p in payloads]
+        one = staged[0] if len(staged) == 1 else self._coalesce(staged)
+        s = self._bucketize(one)
+        n = s["n"]
+        parts = np.full(s["key_mat"].shape[0],
+                        np.iinfo(np.int32).max, dtype=np.int32)
+        if n > 0:
+            parts[:n] = host_hash_partition(
+                s["key_mat"][:n], s["hash_lengths"][:n], self.num_partitions)
+        sp, perm = host_sort_run(parts, s["lanes"], s["sort_lengths"])
+        sp32 = sp.astype(np.int32)
+        bounds = np.searchsorted(
+            sp32, np.arange(self.num_partitions + 1, dtype=np.int32))
+        counts = (bounds[1:] - bounds[:-1]).astype(np.int32)
+        return (sp32, s["lanes"][perm], s["vals"][perm],
+                perm.astype(np.int32), counts, n)

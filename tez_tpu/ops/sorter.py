@@ -161,6 +161,14 @@ DEVICE_SORT_MIN_RECORDS = 1 << 16
 #: never silently rerouted by width.
 ENGINE_MIN_KEY_BYTES = 1 << 20
 
+#: Failure-containment defaults for the async device plane (overridden by
+#: the tez.runtime.device.* knobs via library/outputs.py).
+DEVICE_WATCHDOG_DISPATCH_MS = 60_000.0
+DEVICE_WATCHDOG_READBACK_MS = 60_000.0
+DEVICE_BREAKER_FAILURES = 3
+DEVICE_BREAKER_COOLDOWN_MS = 5_000.0
+DEVICE_SPLIT_MIN_BYTES = 1 << 20
+
 
 def resolve_engine(engine: str) -> str:
     """Resolve the `auto` engine: device kernels when an accelerator
@@ -205,7 +213,13 @@ class DeviceSorter:
                  device_min_records: int = DEVICE_SORT_MIN_RECORDS,
                  engine_min_bytes: int = ENGINE_MIN_KEY_BYTES,
                  pipeline_depth: int = 0,
-                 pipeline_coalesce_records: int = -1):
+                 pipeline_coalesce_records: int = -1,
+                 watchdog_dispatch_ms: float = DEVICE_WATCHDOG_DISPATCH_MS,
+                 watchdog_readback_ms: float = DEVICE_WATCHDOG_READBACK_MS,
+                 breaker_failures: int = DEVICE_BREAKER_FAILURES,
+                 breaker_cooldown_ms: float = DEVICE_BREAKER_COOLDOWN_MS,
+                 split_min_bytes: int = DEVICE_SPLIT_MIN_BYTES,
+                 breaker=None):
         self.num_partitions = num_partitions
         self.key_width = max(4, key_width)
         # 'device' (TPU kernels) | 'host' (np.lexsort/native) | 'auto'
@@ -233,6 +247,16 @@ class DeviceSorter:
             else pipeline_coalesce_records)
         self._pipeline = None
         self._async_store_ids: List[int] = []
+        #: failure containment for the async plane (ops/async_stage.py):
+        #: watchdog deadlines, host-engine failover via the circuit
+        #: breaker, and the OOM split floor.  breaker=None = the sticky
+        #: per-process breaker (a sick chip is a process property).
+        self.watchdog_dispatch_ms = watchdog_dispatch_ms
+        self.watchdog_readback_ms = watchdog_readback_ms
+        self.breaker_failures = breaker_failures
+        self.breaker_cooldown_ms = breaker_cooldown_ms
+        self.split_min_bytes = split_min_bytes
+        self._breaker = breaker
         #: keep sorted key lanes in HBM for downstream device merges.  The
         #: pinned HBM (~(key width + 4) B/row per registered output, freed
         #: at DAG deletion) is OUTSIDE the host memory budgets — operators
@@ -353,7 +377,13 @@ class DeviceSorter:
     # -- async double-buffered span plane ------------------------------------
     def _ensure_pipeline(self):
         if self._pipeline is None:
-            from tez_tpu.ops.async_stage import AsyncSpanPipeline
+            from tez_tpu.ops.async_stage import (AsyncSpanPipeline,
+                                                 process_breaker)
+            breaker = self._breaker
+            if breaker is None:
+                breaker = process_breaker()
+                breaker.configure(failures=self.breaker_failures,
+                                  cooldown_ms=self.breaker_cooldown_ms)
             self._pipeline = AsyncSpanPipeline(
                 encode_fn=self._async_encode,
                 stage_fn=self._async_h2d,
@@ -365,8 +395,88 @@ class DeviceSorter:
                 depth=self.pipeline_depth,
                 coalesce_records=self.pipeline_coalesce_records,
                 counters=self.counters,
-                name="sorter-pipeline")
+                name="sorter-pipeline",
+                failover_fn=self._async_failover,
+                oom_retry_fn=self._async_oom_retry,
+                breaker=breaker,
+                watchdog_dispatch_ms=self.watchdog_dispatch_ms,
+                watchdog_readback_ms=self.watchdog_readback_ms)
         return self._pipeline
+
+    def _group_batch(self, ids, payloads) -> Tuple[KVBatch,
+                                                   Optional[np.ndarray]]:
+        """Rebuild one dispatch group's span from its RAW payloads (the
+        failover/retry paths re-run precombine — the device attempt's
+        encode results died with the attempt)."""
+        batches = [self._precombine(p["batch"], p["custom_parts"],
+                                    skip=p["skip_pre"]) for p in payloads]
+        batch = batches[0] if len(batches) == 1 else KVBatch.concat(batches)
+        # coalesced groups never carry custom partitions (_submit_span_async
+        # excludes them from coalescing)
+        custom_parts = payloads[0]["custom_parts"] if len(payloads) == 1 \
+            else None
+        return batch, custom_parts
+
+    def _async_failover(self, ids, payloads) -> Run:
+        """Host-engine failover for a failed device attempt (watchdog fire,
+        device exception, breaker short-circuit): bit-exact with the device
+        path by the host/device golden contract (tests/test_device_parity)."""
+        batch, custom_parts = self._group_batch(ids, payloads)
+        run = self.sort_batch(batch, custom_partitions=custom_parts,
+                              engine="host")
+        if self.combiner is not None:
+            run = self.combiner(run)
+        return run
+
+    def _async_oom_retry(self, ids, payloads) -> Run:
+        """RESOURCE_EXHAUSTED ladder: retry ON DEVICE with the span halved
+        (recursively, down to split_min_bytes) before the host engine takes
+        over.  Merging the stably-sorted halves with run-age tie order
+        equals the stable sort of the whole span — bit-exact."""
+        batch, custom_parts = self._group_batch(ids, payloads)
+        run = self._split_device_sort(batch, custom_parts,
+                                      detail=f"span={min(ids)}")
+        if self.combiner is not None:
+            run = self.combiner(run)
+        return run
+
+    def _split_device_sort(self, batch: KVBatch,
+                           custom_parts: Optional[np.ndarray],
+                           detail: str) -> Run:
+        from tez_tpu.common import faults
+        from tez_tpu.ops.device import is_resource_exhausted
+        n = batch.num_records
+        nbytes = int(batch.key_offsets[-1]) + int(batch.val_offsets[-1])
+        if n < 2 or nbytes <= self.split_min_bytes:
+            # at the floor: decline the retry — the caller's ladder sends
+            # the span to the host engine
+            raise MemoryError(
+                f"span at OOM-split floor ({nbytes}B <= "
+                f"{self.split_min_bytes}B, n={n})")
+        h = n // 2
+        runs: List[Run] = []
+        for lo, hi in ((0, h), (h, n)):
+            half = batch.take(np.arange(lo, hi, dtype=np.int64))
+            parts_half = custom_parts[lo:hi] if custom_parts is not None \
+                else None
+            try:
+                if faults.armed():
+                    faults.fire("device.dispatch.oom",
+                                f"{detail}:split[{lo}:{hi})")
+                runs.append(self.sort_batch(half,
+                                            custom_partitions=parts_half,
+                                            engine="device"))
+            except BaseException as e:  # noqa: BLE001 — recurse on OOM only
+                if not is_resource_exhausted(e):
+                    raise
+                runs.append(self._split_device_sort(half, parts_half,
+                                                    detail))
+        # run-age tie order makes the merge of the stably-sorted halves
+        # identical to the stable sort of the concatenated span
+        return merge_sorted_runs(runs, self.num_partitions, self.key_width,
+                                 counters=self.counters, engine="device",
+                                 key_normalizer=self.key_normalizer,
+                                 device_min_records=self.device_min_records)
 
     def _submit_span_async(self) -> None:
         batch = self._span.to_batch()
@@ -541,7 +651,11 @@ class DeviceSorter:
         metrics.observe("device.sort", ms, counters=self.counters)
 
     def sort_batch(self, batch: KVBatch,
-                   custom_partitions: Optional[np.ndarray] = None) -> Run:
+                   custom_partitions: Optional[np.ndarray] = None,
+                   engine: Optional[str] = None) -> Run:
+        """engine overrides the per-span routing: the containment plane
+        forces 'host' (failover re-sort) or 'device' (OOM split retry);
+        None = normal routing."""
         t0 = time.time()
         if custom_partitions is not None:
             # validate ONCE for every engine path: a short array would read
@@ -559,7 +673,8 @@ class DeviceSorter:
                     f"[0, {self.num_partitions})")
         # hybrid routing: tiny spans sort faster on host than a device
         # round-trip, even under the device engine
-        engine = self._span_engine(batch)
+        if engine is None:
+            engine = self._span_engine(batch)
         if custom_partitions is None and self.partitioner == "hash" and \
                 engine != "host" and self.key_normalizer is None and \
                 self.resident_keys:

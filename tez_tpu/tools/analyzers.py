@@ -505,6 +505,43 @@ class NodeHealthAnalyzer(Analyzer):
             rows)
 
 
+class DeviceHealthAnalyzer(Analyzer):
+    """Device-plane failure containment per vertex: host failovers, breaker
+    trips/short-circuits, watchdog fires, and OOM split retries from the
+    DeviceFailover counter group (async pipeline containment ladder).  A
+    vertex with failovers but zero breaker trips rode out isolated faults;
+    short-circuits mean the breaker held the device offline for part of
+    the run and host-path capacity planning applies."""
+    name = "device_health"
+
+    def analyze(self, dag: DagInfo) -> AnalyzerResult:
+        rows = []
+        for v in dag.vertices.values():
+            df = v.counters.get("DeviceFailover", {})
+            if not df:
+                continue
+            rows.append({
+                "vertex": v.name,
+                "failover_spans": df.get("device.failover.spans", 0),
+                "failover_groups": df.get("device.failover.groups", 0),
+                "drained_on_wedge": df.get("device.failover.drained", 0),
+                "watchdog_fires": df.get("device.watchdog.fires", 0),
+                "breaker_trips": df.get("device.breaker.trips", 0),
+                "breaker_short_circuits":
+                    df.get("device.breaker.short_circuits", 0),
+                "breaker_recoveries": df.get("device.breaker.recoveries", 0),
+                "oom_split_attempts": df.get("device.oom.split_attempts", 0),
+                "oom_split_success": df.get("device.oom.split_success", 0),
+            })
+        spans = sum(r["failover_spans"] for r in rows)
+        trips = sum(r["breaker_trips"] for r in rows)
+        fires = sum(r["watchdog_fires"] for r in rows)
+        headline = "device plane healthy (no containment events)" if not rows \
+            else (f"{spans} span(s) failed over to host; "
+                  f"{trips} breaker trip(s), {fires} watchdog fire(s)")
+        return AnalyzerResult(self.name, headline, rows)
+
+
 class SpanCriticalPathAnalyzer(Analyzer):
     """Span-based critical path over the live tracing buffer: the longest
     causal chain through the recorded spans (tracing plane, this PR's
@@ -560,6 +597,7 @@ ALL_ANALYZERS: Sequence[Analyzer] = (
     SlowTaskAttemptAnalyzer(), InputOutputRatioAnalyzer(),
     DagOverviewAnalyzer(), InputReadErrorAnalyzer(), LocalityAnalyzer(),
     OneOnOneEdgeAnalyzer(), SlowNodeAnalyzer(), NodeHealthAnalyzer(),
+    DeviceHealthAnalyzer(),
     TaskAssignmentAnalyzer(), TaskAttemptResultStatisticsAnalyzer(),
     VertexLevelCriticalPathAnalyzer())
 

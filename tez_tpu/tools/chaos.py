@@ -403,6 +403,152 @@ def run_device_ooo(seed: int, spans: int = 4,
                   f"{spans} spills + merged run bit-exact")
 
 
+def _chaos_batch(seed: int, i: int, records: int) -> "object":
+    """Deterministic ragged KVBatch shared by the device containment
+    scenarios (same recipe as run_device_ooo's make_batch)."""
+    import numpy as np
+
+    from tez_tpu.ops.runformat import KVBatch
+    rng = np.random.default_rng(seed * 1000 + i)
+    keys = [b"k%08d" % k for k in rng.integers(0, 500, records)]
+    vals = [b"v%06d" % v for v in rng.integers(0, 999999, records)]
+    kb = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    ko = np.cumsum([0] + [len(k) for k in keys]).astype(np.int64)
+    vb = np.frombuffer(b"".join(vals), dtype=np.uint8)
+    vo = np.cumsum([0] + [len(v) for v in vals]).astype(np.int64)
+    return KVBatch(kb, ko, vb, vo)
+
+
+def run_device_hang(seed: int, spans: int = 4,
+                    records: int = 1500) -> Tuple[bool, str]:
+    """Hung-dispatch containment scenario: one seeded span's device
+    dispatch hangs (``device.dispatch.hang`` delay fault, well past the
+    watchdog deadline).  The watchdog must abandon the attempt, fail the
+    span over to the host engine, and drain the remaining spans — every
+    spill bit-exact vs the fault-free SYNCHRONOUS engine, flush() bounded,
+    and the breaker untouched (one hang is containment's job, not the
+    breaker's)."""
+    from tez_tpu.ops.async_stage import CircuitBreaker
+    from tez_tpu.ops.sorter import DeviceSorter
+
+    def run(depth: int, spec: str, breaker=None):
+        if spec:
+            faults.install("chaos", faults.parse_spec(spec), seed=seed)
+        try:
+            spills: Dict[int, tuple] = {}
+            s = DeviceSorter(num_partitions=4, engine="device",
+                             device_min_records=0, key_width=16,
+                             span_budget_bytes=20_000, pipeline_depth=depth,
+                             watchdog_dispatch_ms=250,
+                             watchdog_readback_ms=250,
+                             breaker=breaker)
+            s.on_spill = lambda run_, sid: spills.update(
+                {sid: (run_.batch.key_bytes.tobytes(),
+                       run_.batch.val_bytes.tobytes(),
+                       run_.row_index.tobytes())})
+            for i in range(spans):
+                s.write_batch(_chaos_batch(seed, i, records))
+            s.flush_run()
+        finally:
+            faults.install("chaos", [])
+        return spills, s.counters
+
+    hung = random.Random(seed).randrange(spans)
+    spec = f"device.dispatch.hang:delay:ms=2000,n=1,match=span={hung}"
+    sync_spills, _ = run(0, "")
+    # a scenario-local breaker with a high threshold: one hang must be
+    # contained WITHOUT degrading the engine (and without poisoning the
+    # process singleton for later scenarios)
+    br = CircuitBreaker(failures=100)
+    t0 = time.time()
+    hang_spills, counters = run(2, spec, breaker=br)
+    wall = time.time() - t0
+    fo = counters.group("DeviceFailover")
+    fires = fo.find_counter("device.watchdog.fires").value
+    failed_over = fo.find_counter("device.failover.spans").value
+    if fires < 1:
+        return False, "watchdog never fired under the hang fault"
+    if failed_over < 1:
+        return False, "hung span did not fail over to the host engine"
+    if br.trips != 0:
+        return False, f"breaker tripped ({br.trips}) on a single hang"
+    if wall > 30.0:
+        return False, f"flush took {wall:.1f}s — watchdog did not bound it"
+    if hang_spills != sync_spills:
+        bad = [k for k in sync_spills
+               if hang_spills.get(k) != sync_spills[k]]
+        return False, (f"spill payloads diverge after hang failover "
+                       f"(spill ids {bad})")
+    return True, (f"hung span {hung} abandoned after {fires} watchdog "
+                  f"fire(s); {failed_over} span(s) failed over; "
+                  f"{spans} spills bit-exact in {wall:.1f}s")
+
+
+def run_device_oom_storm(seed: int, spans: int = 4,
+                         records: int = 1500) -> Tuple[bool, str]:
+    """OOM-storm containment scenario: every device dispatch raises a
+    RESOURCE_EXHAUSTED-classified error (``device.dispatch.oom`` fail
+    fault, budget 4).  Span 0 must first retry split on-device (the split
+    halves are under the floor, so the ladder lands on host), span 1
+    likewise — tripping the 2-failure breaker — and the remaining spans
+    short-circuit straight to host.  A second fault-free sorter sharing the
+    breaker then recovers it through a half-open probe after the cooldown.
+    Both runs bit-exact vs the fault-free sync engine."""
+    from tez_tpu.ops.async_stage import CircuitBreaker
+    from tez_tpu.ops.sorter import DeviceSorter
+
+    def run_merged(depth: int, spec: str, breaker=None) -> tuple:
+        if spec:
+            faults.install("chaos", faults.parse_spec(spec), seed=seed)
+        try:
+            s = DeviceSorter(num_partitions=4, engine="device",
+                             device_min_records=0, key_width=16,
+                             span_budget_bytes=20_000, pipeline_depth=depth,
+                             pipeline_coalesce_records=0,
+                             # whole span (~24KB) splits once; the ~12KB
+                             # halves sit under the floor -> host ladder
+                             split_min_bytes=15_000,
+                             breaker_failures=2,
+                             breaker=breaker)
+            for i in range(spans):
+                s.write_batch(_chaos_batch(seed, i, records))
+            r = s.flush_run()
+        finally:
+            faults.install("chaos", [])
+        return (r.batch.key_bytes.tobytes(), r.batch.val_bytes.tobytes(),
+                r.row_index.tobytes()), s.counters
+
+    baseline, _ = run_merged(0, "")
+    br = CircuitBreaker(failures=2, cooldown_ms=300)
+    spec = "device.dispatch.oom:fail:n=4,exc=runtime"
+    stormed, counters = run_merged(2, spec, breaker=br)
+    fo = counters.group("DeviceFailover")
+    split_attempts = fo.find_counter("device.oom.split_attempts").value
+    failed_over = fo.find_counter("device.failover.spans").value
+    shorted = fo.find_counter("device.breaker.short_circuits").value
+    if stormed != baseline:
+        return False, "merged output diverges under the OOM storm"
+    if split_attempts < 1:
+        return False, "no on-device split retry before host failover"
+    if br.trips < 1:
+        return False, (f"breaker never tripped (consecutive failures "
+                       f"threshold 2; {failed_over} failovers)")
+    if shorted < 1:
+        return False, "no span short-circuited while the breaker was open"
+    # recovery leg: cooldown elapses, a fault-free sorter sharing the
+    # breaker probes half-open and re-arms the device engine
+    time.sleep(0.35)
+    recovered, _ = run_merged(2, "", breaker=br)
+    if recovered != baseline:
+        return False, "merged output diverges after breaker recovery"
+    if br.recoveries < 1 or br.state != "closed":
+        return False, (f"breaker did not recover via half-open probe "
+                       f"(state={br.state}, recoveries={br.recoveries})")
+    return True, (f"{split_attempts} split retr(ies), {failed_over} span(s) "
+                  f"failed over, {shorted} short-circuited; breaker tripped "
+                  f"{br.trips}x and recovered via probe; both runs bit-exact")
+
+
 def _export_trace(path: str) -> None:
     """Write whatever the span buffer holds (it survives per-DAG disarm) as
     Perfetto trace_event JSON, then drop the buffer."""
@@ -434,22 +580,40 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "the async device pipeline under a seeded "
                          "device.dispatch.delay fault, spills + merged "
                          "output bit-exact vs the sync engine")
+    ap.add_argument("--device-hang", action="store_true",
+                    help="run the hung-dispatch containment scenario: a "
+                         "seeded device.dispatch.hang fault wedges one "
+                         "span's dispatch; the watchdog abandons it and "
+                         "the span fails over to the host engine bit-exact")
+    ap.add_argument("--device-oom-storm", action="store_true",
+                    help="run the OOM-storm containment scenario: seeded "
+                         "device.dispatch.oom faults drive the split-then-"
+                         "fallback ladder; the breaker trips and recovers "
+                         "through a half-open probe, output bit-exact")
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="arm the tracing plane (tez.trace.enabled) on the "
                          "storm DAGs and write a Perfetto trace_event JSON "
                          "of the recorded spans to PATH")
     args = ap.parse_args(argv)
 
-    if args.device_ooo:
+    device_scenarios = [
+        (args.device_ooo, "device-ooo", run_device_ooo),
+        (args.device_hang, "device-hang", run_device_hang),
+        (args.device_oom_storm, "device-oom-storm", run_device_oom_storm),
+    ]
+    if any(on for on, _, _ in device_scenarios):
         failures = 0
-        for seed in range(args.seed, args.seed + args.trials):
-            ok, detail = run_device_ooo(seed)
-            print(("ok   " if ok else "FAIL ") +
-                  f"device-ooo seed={seed}: {detail}")
-            if not ok:
-                failures += 1
-                print(f"REPRO: python -m tez_tpu.tools.chaos --device-ooo "
-                      f"--seed {seed}")
+        for on, tag, fn in device_scenarios:
+            if not on:
+                continue
+            for seed in range(args.seed, args.seed + args.trials):
+                ok, detail = fn(seed)
+                print(("ok   " if ok else "FAIL ") +
+                      f"{tag} seed={seed}: {detail}")
+                if not ok:
+                    failures += 1
+                    print(f"REPRO: python -m tez_tpu.tools.chaos --{tag} "
+                          f"--seed {seed}")
         return 1 if failures else 0
     workdir = args.workdir or tempfile.mkdtemp(prefix="tez-chaos-")
     cleanup = args.workdir is None

@@ -28,6 +28,19 @@ REGRESSION_RATIO = 2.0
 DEVICE_STAGE_HISTS = ("device.encode", "device.h2d", "device.dispatch_wait",
                       "device.d2h")
 
+#: Failure-containment counters (ops/async_stage.py COUNTER_GROUP): a run
+#: that silently started leaning on host failover — or tripping the breaker
+#: — is a health regression even when wall clock barely moves, so these get
+#: their own section instead of drowning in the flat counter diff.
+DEVICE_FAILOVER_GROUP = "DeviceFailover"
+DEVICE_FAILOVER_COUNTERS = (
+    "device.failover.spans", "device.failover.groups",
+    "device.failover.drained", "device.watchdog.fires",
+    "device.watchdog.dispatch_fires", "device.watchdog.readback_fires",
+    "device.breaker.trips", "device.breaker.short_circuits",
+    "device.breaker.recoveries", "device.oom.split_attempts",
+    "device.oom.split_success")
+
 
 def flatten(counters: Dict) -> Dict[str, int]:
     return {f"{g}.{name}": v for g, cs in counters.items()
@@ -67,6 +80,23 @@ def diff_device_stages(counters_a: Dict, counters_b: Dict,
         regressed = name in ha and name in hb and ms_a > 0 and \
             ms_b >= REGRESSION_RATIO * ms_a
         out.append((name, ms_a, ms_b, regressed))
+    return out
+
+
+def diff_device_failover(counters_a: Dict, counters_b: Dict,
+                         ) -> List[Tuple[str, int, int, bool]]:
+    """[(counter, a, b, regressed)] over the device.failover containment
+    counters present in either run; regressed when B recorded MORE
+    containment events than A (any growth — these should be zero on a
+    healthy fault-free run, so a ratio threshold would hide 0 -> n)."""
+    ga = counters_a.get(DEVICE_FAILOVER_GROUP, {})
+    gb = counters_b.get(DEVICE_FAILOVER_GROUP, {})
+    out = []
+    for name in DEVICE_FAILOVER_COUNTERS:
+        if name not in ga and name not in gb:
+            continue
+        va, vb = int(ga.get(name, 0)), int(gb.get(name, 0))
+        out.append((name, va, vb, vb > va))
     return out
 
 
@@ -115,12 +145,20 @@ def main() -> int:
                   f"{ms_b:10.1f} {100 * ms_b / tot_b:4.0f}% "
                   f"{ms_b - ms_a:+12.1f}{flag}")
             regressions += int(regressed)
+    failover = diff_device_failover(a.counters, b.counters)
+    if failover:
+        print(f"\n{'device.failover (containment)':60} "
+              f"{'A':>14} {'B':>14}")
+        for name, va, vb, regressed in failover:
+            flag = "  << REGRESSION" if regressed else ""
+            print(f"{name:60} {va:14d} {vb:14d}{flag}")
+            regressions += int(regressed)
     print(f"\nA: {a.dag_id} ({a.state}, {a.duration:.2f}s)  "
           f"B: {b.dag_id} ({b.state}, {b.duration:.2f}s)  "
           f"wall delta {b.duration - a.duration:+.2f}s")
     if regressions:
-        print(f"{regressions} histogram regression(s) (p95 >= "
-              f"{REGRESSION_RATIO}x baseline)")
+        print(f"{regressions} regression(s) (latency p95 >= "
+              f"{REGRESSION_RATIO}x baseline, or containment event growth)")
     return 0
 
 

@@ -6,7 +6,7 @@ PY ?= python
 OLD ?= BENCH_r05.json
 NEW ?= /tmp/bench_new.json
 
-.PHONY: test bench bench-new bench-diff chaos chaos-device-ooo chaos-device docs
+.PHONY: test bench bench-new bench-diff bench-merge chaos chaos-device-ooo chaos-device chaos-merge docs
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -23,6 +23,11 @@ bench-new:
 bench-diff:
 	$(PY) -m tez_tpu.tools.bench_diff $(OLD) $(NEW)
 
+# reduce-side merge-path micro-bench only: prints the info-line JSON with
+# the min_vs_baseline ratio floor bench-diff enforces
+bench-merge:
+	JAX_PLATFORMS=cpu TEZ_BENCH_MERGE_ONLY=1 $(PY) bench.py
+
 chaos:
 	$(PY) -m tez_tpu.tools.chaos --trials 3
 
@@ -32,6 +37,11 @@ chaos-device-ooo:
 # failure-containment soak: hung dispatch + OOM storm + reorder, all bit-exact
 chaos-device:
 	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --device-ooo --device-hang --device-oom-storm --trials 3
+
+# reduce-side merge-lane containment: OOM storm on async merge dispatches,
+# breaker trip + short-circuit + half-open recovery, drained output bit-exact
+chaos-merge:
+	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --merge-storm --trials 3
 
 docs:
 	$(PY) -m tez_tpu.tools.gen_config_docs > docs/configuration.md

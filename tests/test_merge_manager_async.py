@@ -1,0 +1,254 @@
+"""Async reduce-side merge plane (tez.runtime.merge.async.depth > 0):
+background merges submit through an AsyncSpanPipeline merge lane instead of
+running inline on the merger thread.
+
+Contracts under test:
+- drained output is BYTE-identical to the synchronous merger (depth=0) for
+  identical commit sequences — mem->disk merges, disk cascades, and the
+  streaming final merge included;
+- the overlap witness: merge k's chunked-run disk write (readback stage)
+  runs while merge k+1's dispatch is in flight (overlap_pairs over the
+  instrumented event stream, gated on thread events — no wall-clock);
+- PR-5 containment covers merge dispatches: injected device.dispatch.oom
+  and device.dispatch.hang faults recover through the split/failover ladder
+  (watchdog + breaker) with bit-exact output.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tez_tpu.common import faults
+from tez_tpu.common.counters import TezCounters
+from tez_tpu.common.faults import parse_spec
+from tez_tpu.library.merge_manager import ShuffleMergeManager
+from tez_tpu.ops.async_stage import (COUNTER_GROUP, CircuitBreaker,
+                                     overlap_pairs)
+
+from test_merge_manager import drain, reference_merge, sorted_batch
+
+
+def _wait_for(pred, what, timeout=20.0):
+    """Deadline-poll an internal progress predicate: the merger thread runs
+    asynchronously, so tests that need 'merge k submitted before commit
+    k+1' must observe it rather than race it."""
+    deadline = time.time() + timeout
+    while not pred():
+        assert time.time() < deadline, what
+        time.sleep(0.005)
+
+
+def _run_manager(tmp_path, batches, tag, async_depth, engine="host",
+                 mm_cls=ShuffleMergeManager, budget=None,
+                 merge_threshold=0.5, **kw):
+    spill = tmp_path / f"spill_{tag}"
+    spill.mkdir()
+    counters = TezCounters()
+    total = sum(b.nbytes for b in batches)
+    mm = mm_cls(counters, total // 4 if budget is None else budget,
+                str(spill), engine=engine,
+                merge_threshold=merge_threshold, max_single_fraction=2.0,
+                block_records=256, async_depth=async_depth,
+                device_min_records=0, **kw)
+    for slot, b in enumerate(batches):
+        mm.commit(slot, b)
+    return mm, drain(mm), counters
+
+
+@pytest.mark.parametrize("engine", ["host", "device"])
+def test_async_matches_sync_bit_exact(tmp_path, engine):
+    batches = [sorted_batch(i, 1500) for i in range(8)]
+    _, sync, _ = _run_manager(tmp_path, batches, f"sync_{engine}", 0,
+                              engine=engine)
+    mm, got, _ = _run_manager(tmp_path, batches, f"async_{engine}", 2,
+                              engine=engine)
+    assert mm._mem_to_disk >= 1        # the async lane actually merged
+    assert got == sync == reference_merge(batches)
+
+
+def test_async_disk_cascade_matches_sync(tmp_path):
+    """Everything lands on disk (tiny max_single): the async lane runs
+    disk->disk cascades through the pipeline; age order (and therefore the
+    equal-key tie order of the final streaming merge) must match the
+    synchronous merger exactly."""
+    batches = [sorted_batch(i, 600) for i in range(6)]
+
+    def run(tag, depth):
+        spill = tmp_path / f"spill_{tag}"
+        spill.mkdir()
+        counters = TezCounters()
+        mm = ShuffleMergeManager(counters, 10 << 20, str(spill),
+                                 engine="host", merge_factor=2,
+                                 max_single_fraction=0.0001,
+                                 block_records=128, async_depth=depth)
+        for slot, b in enumerate(batches):
+            mm.commit(slot, b)
+        out = drain(mm)
+        return mm, out
+
+    _, sync = run("sync", 0)
+    mm, got = run("async", 2)
+    assert mm._disk_to_disk >= 1
+    assert got == sync == reference_merge(batches)
+
+
+class _GatedManager(ShuffleMergeManager):
+    """Holds merge 0's disk write (readback stage) until a LATER merge's
+    dispatch has started — the deterministic overlap handshake."""
+
+    def __init__(self, *a, **kw):
+        self.later_dispatched = threading.Event()
+        self.dispatch_count = 0
+        super().__init__(*a, **kw)
+
+    def _pipe_dispatch(self, payload):
+        out = super()._pipe_dispatch(payload)
+        self.dispatch_count += 1
+        if self.dispatch_count >= 2:
+            self.later_dispatched.set()
+        return out
+
+    def _pipe_readback(self, inflight, ids):
+        if ids == (0,):
+            assert self.later_dispatched.wait(timeout=30.0), \
+                "merge 1 never dispatched while merge 0's write was held"
+        return super()._pipe_readback(inflight, ids)
+
+
+def test_async_overlap_witness(tmp_path):
+    """Instrument-mode proof that the merge lane overlaps: a later merge's
+    pipeline entry (encode mark) starts before merge 0's readback (the
+    chunked-run write) ends."""
+    batches = [sorted_batch(i, 1200) for i in range(10)]
+    # a budget far above the data keeps commits from stalling on the held
+    # disk write; the tiny threshold keeps the merger claiming eagerly.
+    # Commits land in two waves with an observed dispatch between them:
+    # without the poll the merger thread can lose the race to finish() and
+    # fold everything in-RAM without ever submitting to the pipeline.
+    total = sum(b.nbytes for b in batches)
+    spill = tmp_path / "spill_overlap"
+    spill.mkdir()
+    counters = TezCounters()
+    mm = _GatedManager(counters, total * 4, str(spill), engine="host",
+                       merge_threshold=0.02, max_single_fraction=2.0,
+                       block_records=256, async_depth=2,
+                       device_min_records=0, instrument=True)
+    for slot in range(5):
+        mm.commit(slot, batches[slot])
+    _wait_for(lambda: mm.dispatch_count >= 1, "merge 0 never dispatched")
+    for slot in range(5, 10):
+        mm.commit(slot, batches[slot])
+    _wait_for(lambda: mm.dispatch_count >= 2, "merge 1 never dispatched")
+    got = drain(mm)
+    assert mm.dispatch_count >= 2
+    assert got == reference_merge(batches)
+    pairs = overlap_pairs(mm.pipeline_events())
+    assert any(a == (0,) for a, _b in pairs), \
+        f"no overlap witnessed: {mm.pipeline_events()}"
+
+
+def _chaos_run(tmp_path, batches, tag, depth, spec, budget_div=4, **kw):
+    if spec:
+        faults.install("t", parse_spec(spec))
+    try:
+        spill = tmp_path / f"spill_{tag}"
+        spill.mkdir()
+        counters = TezCounters()
+        total = sum(b.nbytes for b in batches)
+        mm = ShuffleMergeManager(counters, total // budget_div, str(spill),
+                                 engine="device", device_min_records=0,
+                                 merge_threshold=0.5, max_single_fraction=2.0,
+                                 block_records=256, async_depth=depth, **kw)
+        for slot, b in enumerate(batches):
+            mm.commit(slot, b)
+        return drain(mm), counters
+    finally:
+        if spec:
+            faults.install("t", [])
+
+
+def test_async_oom_split_ladder_bit_exact(tmp_path):
+    """An injected RESOURCE_EXHAUSTED on the first merge dispatch drives
+    the OOM ladder: the run set halves and re-merges on device (composed
+    merge bit-identical); no host failover, breaker untouched."""
+    batches = [sorted_batch(i, 1500) for i in range(8)]
+    # budget_div=2 with threshold 0.5 puts the merge trigger at TWO
+    # batches: every claim holds >= 2 live runs, so the OOM split retry
+    # always has a halving point (never declines to the failover floor)
+    sync, _ = _chaos_run(tmp_path, batches, "sync", 0, "", budget_div=2)
+    br = CircuitBreaker(failures=100)
+    got, counters = _chaos_run(
+        tmp_path, batches, "oom", 2,
+        "device.dispatch.oom:fail:n=1,exc=runtime,match=span=0",
+        budget_div=2, breaker=br)
+    assert got == sync == reference_merge(batches)
+    fo = counters.group(COUNTER_GROUP)
+    assert fo.find_counter("device.oom.split_attempts").value == 1
+    assert fo.find_counter("device.oom.split_success").value == 1
+    assert br.trips == 0
+
+
+def test_async_hang_watchdog_failover_bit_exact(tmp_path):
+    """An injected hung merge dispatch (well past the watchdog deadline):
+    the watchdog abandons the attempt, the merge fails over to the host
+    engine from its raw payload, and the drained output stays bit-exact."""
+    batches = [sorted_batch(i, 1500) for i in range(8)]
+    sync, _ = _chaos_run(tmp_path, batches, "sync_h", 0, "")
+    br = CircuitBreaker(failures=100)
+    got, counters = _chaos_run(
+        tmp_path, batches, "hang", 2,
+        "device.dispatch.hang:delay:ms=1500,n=1,match=span=0",
+        breaker=br, watchdog_dispatch_ms=200, watchdog_readback_ms=200)
+    assert got == sync == reference_merge(batches)
+    fo = counters.group(COUNTER_GROUP)
+    assert fo.find_counter("device.watchdog.fires").value >= 1
+    assert fo.find_counter("device.failover.spans").value >= 1
+    assert br.trips == 0
+
+
+def test_async_breaker_short_circuit_bit_exact(tmp_path):
+    """A storm of merge-dispatch OOMs trips the breaker; later merges
+    short-circuit straight to the host engine without touching the device —
+    drained output still bit-exact.
+
+    Commits are paced one batch per merge claim: a single-run claim makes
+    the OOM split retry decline (no halving point), so the failure falls
+    through to host failover and the breaker STAYS open — a multi-run claim
+    would split successfully on device and close the breaker again."""
+    batches = [sorted_batch(i, 900) for i in range(4)]
+    sync, _ = _chaos_run(tmp_path, batches, "sync_b", 0, "")
+    br = CircuitBreaker(failures=1, cooldown_ms=60_000)
+    faults.install("t", parse_spec("device.dispatch.oom:fail:n=99,exc=runtime"))
+    try:
+        spill = tmp_path / "spill_storm"
+        spill.mkdir()
+        counters = TezCounters()
+        total = sum(b.nbytes for b in batches)
+        mm = ShuffleMergeManager(counters, total * 4, str(spill),
+                                 engine="device", device_min_records=0,
+                                 merge_threshold=0.02,
+                                 max_single_fraction=2.0, block_records=256,
+                                 async_depth=2, breaker=br)
+        for slot, b in enumerate(batches):
+            mm.commit(slot, b)
+            _wait_for(lambda: mm._pipe_seq >= slot + 1,
+                      f"merge {slot} never claimed")
+        got = drain(mm)
+    finally:
+        faults.install("t", [])
+    assert got == sync == reference_merge(batches)
+    assert br.trips >= 1
+    fo = counters.group(COUNTER_GROUP)
+    assert fo.find_counter("device.breaker.short_circuits").value >= 1
+    assert fo.find_counter("device.failover.spans").value >= 2
+
+
+def test_async_depth_zero_has_no_pipeline(tmp_path):
+    counters = TezCounters()
+    mm = ShuffleMergeManager(counters, 1 << 20, str(tmp_path),
+                             engine="host", async_depth=0)
+    assert mm._pipeline is None
+    assert mm.pipeline_events() == []
+    mm.commit(0, sorted_batch(0, 50))
+    assert drain(mm) == reference_merge([sorted_batch(0, 50)])

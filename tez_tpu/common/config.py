@@ -423,6 +423,12 @@ SHUFFLE_SPECULATIVE_FETCH_WAIT_MS = _key(
     "tez.runtime.shuffle.speculative.fetch.wait-ms", 15_000, Scope.VERTEX,
     "an in-flight fetch older than this gets a duplicate on a fresh "
     "connection; first delivery wins")
+SHUFFLE_FETCH_SESSION_TTL_MS = _key(
+    "tez.runtime.shuffle.fetch.session.ttl-ms", 30_000, Scope.VERTEX,
+    "keep-alive cache for fetch sessions: a healthy per-host connection is "
+    "reused across batches and closed after this idle time; open sessions "
+    "(cached + in use) never exceed the fetcher pool size; 0 = close after "
+    "every batch (the historical behavior)")
 SHUFFLE_FETCHER_CLASS = _key(
     "tez.runtime.shuffle.fetcher.class", "", Scope.VERTEX,
     "injectable fetch-session factory (tests: FetcherWithInjectableErrors "
@@ -530,6 +536,24 @@ DEVICE_SPLIT_MIN_BYTES = _key(
     "attempt retries on-device with the span halved (recursively) while "
     "the half is still above this many key+value bytes; below it the "
     "span goes to the host engine instead")
+MERGE_ENGINE = _key(
+    "tez.runtime.merge.engine", "", Scope.VERTEX,
+    "engine for the reduce-side merge plane (ShuffleMergeManager / "
+    "merge_sorted_runs on the consumer): device|host|auto; '' = follow "
+    "tez.runtime.sorter.class.  The device engine merges pre-sorted runs "
+    "with the O(N) merge-path ladder over HBM-resident key lanes")
+MERGE_ENGINE_MIN_RECORDS = _key(
+    "tez.runtime.merge.engine.min-records", 0, Scope.VERTEX,
+    "merges smaller than this many records run on host even under the "
+    "device merge engine (dispatch + transfer overhead exceeds the merge); "
+    "0 = follow tez.runtime.tpu.device.sort.min.records")
+MERGE_ASYNC_DEPTH = _key(
+    "tez.runtime.merge.async.depth", 2, Scope.VERTEX,
+    "async reduce-side merge plane: max background merges past the staging "
+    "gate at once (device merge in flight + chunked-run disk write "
+    "draining).  2 = double buffering — merge k's disk write overlaps "
+    "merge k+1's dispatch, both overlap in-flight fetch commits.  "
+    "0 = synchronous background merger (the historical behavior)")
 HOST_SPILL_DIR = _key("tez.runtime.tpu.host.spill.dir", "", Scope.VERTEX,
                       "Where device buffers spill when HBM budget is exceeded; "
                       "'' = <staging>/spill")

@@ -133,7 +133,9 @@ class ShuffleFetchTable:
                 penalty_cap=float(_k(C.SHUFFLE_HOST_PENALTY_CAP_MS)) / 1e3,
                 max_attempts=int(_k(C.SHUFFLE_FETCH_ATTEMPTS)),
                 stall_timeout=float(
-                    _k(C.SHUFFLE_SPECULATIVE_FETCH_WAIT_MS)) / 1e3)
+                    _k(C.SHUFFLE_SPECULATIVE_FETCH_WAIT_MS)) / 1e3,
+                session_ttl=float(
+                    _k(C.SHUFFLE_FETCH_SESSION_TTL_MS)) / 1e3)
         return self._scheduler
 
     def shutdown(self) -> None:
@@ -394,17 +396,27 @@ class OrderedGroupedKVInput(LogicalInput):
             codec = _conf_get(ctx, "tez.runtime.compress.codec", "zlib")
         engine = _conf_get(ctx, "tez.runtime.sorter.class", "auto")
         factor = int(_conf_get(ctx, "tez.runtime.io.sort.factor", 64))
+        # reduce-side merge plane knobs: engine / min-records default to the
+        # sort plane's routing so a plain deployment tunes ONE engine choice
+        merge_engine = _conf_get(ctx, "tez.runtime.merge.engine", "") or \
+            engine
+        merge_min = int(_conf_get(
+            ctx, "tez.runtime.merge.engine.min-records", 0)) or \
+            int(_conf_get(
+                ctx, "tez.runtime.tpu.device.sort.min.records", 1 << 16))
 
         self._mm_budget = budget_mb << 20
         self._mm_kwargs = dict(
-            key_width=self.key_width, engine=engine, merge_factor=factor,
-            device_min_records=int(_conf_get(
-                ctx, "tez.runtime.tpu.device.sort.min.records", 1 << 16)),
+            key_width=self.key_width, engine=merge_engine,
+            merge_factor=factor,
+            device_min_records=merge_min,
             merge_threshold=float(_conf_get(
                 ctx, "tez.runtime.shuffle.merge.percent", 0.9)),
             max_single_fraction=float(_conf_get(
                 ctx, "tez.runtime.shuffle.memory.limit.percent", 0.25)),
-            key_normalizer=self._key_normalizer, codec=codec)
+            key_normalizer=self._key_normalizer, codec=codec,
+            async_depth=int(_conf_get(
+                ctx, "tez.runtime.merge.async.depth", 2)))
         self._spill_dir = spill_dir
 
         from tez_tpu.api.runtime import MemoryUpdateCallback

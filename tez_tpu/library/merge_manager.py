@@ -78,7 +78,12 @@ class ShuffleMergeManager:
                  max_single_fraction: float = 0.25,
                  key_normalizer: Optional[Callable[[bytes], bytes]] = None,
                  codec: Optional[str] = None,
-                 block_records: int = 65536):
+                 block_records: int = 65536,
+                 async_depth: int = 0,
+                 instrument: bool = False,
+                 breaker: Any = None,
+                 watchdog_dispatch_ms: Optional[float] = None,
+                 watchdog_readback_ms: Optional[float] = None):
         self.counters = counters
         self.budget = int(budget_bytes)
         self.spill_dir = spill_dir
@@ -117,12 +122,57 @@ class ShuffleMergeManager:
         self._poisoned: Optional[str] = None
         self._closed = False
         self._error: Optional[BaseException] = None
+        # --- async merge plane (tez.runtime.merge.async.depth > 0) ---
+        # background merges submit through an AsyncSpanPipeline instead of
+        # running inline on the merger thread: the chunked-run disk write of
+        # merge k (readback stage) overlaps the device dispatch of merge
+        # k+1, and in-flight fetch commits overlap both.  async_depth=0 is
+        # byte-for-byte the historical synchronous merger.
+        self.async_depth = max(0, int(async_depth))
+        self._instrument = instrument
+        self._pipe_seq = 0              # submission order (= fold order)
+        self._pending_out: dict = {}    # seq -> completed, not yet folded
+        self._next_out = 0
+        self._disk_claim: Optional[List[str]] = None
+        self._pipeline = None
+        if self.budget > 0 and self.async_depth > 0:
+            self._pipeline = self._build_pipeline(
+                breaker, watchdog_dispatch_ms, watchdog_readback_ms)
         self._merger: Optional[threading.Thread] = None
         if self.budget > 0:
             self._merger = threading.Thread(target=self._merge_loop,
                                             daemon=True,
                                             name="shuffle-merger")
             self._merger.start()
+
+    def _build_pipeline(self, breaker: Any,
+                        watchdog_dispatch_ms: Optional[float],
+                        watchdog_readback_ms: Optional[float]):
+        """The merge dispatch lane: same AsyncSpanPipeline (and the same
+        PR-5 containment ladder — watchdog, circuit breaker, OOM
+        span-halving, host failover from raw payloads) that serves the
+        producer sort side, pointed at merge work.  Dispatch-wait latency
+        lands in the "device.merge" histogram instead of the sort plane's
+        device.dispatch_wait."""
+        from tez_tpu.ops import async_stage
+        from tez_tpu.ops import sorter as _sorter
+        return async_stage.AsyncSpanPipeline(
+            dispatch_fn=self._pipe_dispatch,
+            readback_fn=self._pipe_readback,
+            on_complete=self._pipe_complete,
+            depth=self.async_depth,
+            readback_workers=1,
+            counters=self.counters,
+            instrument=self._instrument,
+            name="merge-pipeline",
+            failover_fn=self._pipe_failover,
+            oom_retry_fn=self._pipe_oom_retry,
+            breaker=breaker,
+            watchdog_dispatch_ms=_sorter.DEVICE_WATCHDOG_DISPATCH_MS
+            if watchdog_dispatch_ms is None else watchdog_dispatch_ms,
+            watchdog_readback_ms=_sorter.DEVICE_WATCHDOG_READBACK_MS
+            if watchdog_readback_ms is None else watchdog_readback_ms,
+            dispatch_wait_hist="device.merge")
 
     # ------------------------------------------------------------- admission
     def slot_generation(self, slot: int) -> int:
@@ -162,6 +212,10 @@ class ShuffleMergeManager:
                     return False
                 self._disk_runs.append(path)
                 self._disk_slots.add(slot)
+                if len(self._disk_runs) >= self.merge_factor:
+                    # wake the merger the moment the cascade trigger
+                    # crosses instead of up to a poll period later
+                    self.lock.notify_all()
             self.counters.increment(TaskCounter.SHUFFLE_BYTES_TO_DISK,
                                     batch.nbytes)
             return True
@@ -252,13 +306,25 @@ class ShuffleMergeManager:
         return self._mem_bytes >= self.budget * self.merge_threshold or \
             self._stalled > 0
 
+    def _disk_merge_due_locked(self) -> bool:
+        """Under lock: a disk cascade is runnable — the trigger crossed and
+        no cascade is already in flight (at most one at a time keeps the
+        run-age bookkeeping trivial and bounds disk-write fan-out)."""
+        return self._disk_claim is None and \
+            len(self._disk_runs) >= self.merge_factor
+
     def _merge_loop(self) -> None:
+        if self._pipeline is not None:
+            return self._merge_loop_async()
         while True:
             with self.lock:
                 while not self._closed and self._poisoned is None and \
                         not self._mem_merge_due() and \
                         len(self._disk_runs) < self.merge_factor:
-                    self.lock.wait(0.2)
+                    # every trigger crossing notifies (commit threshold,
+                    # disk-run registration, stall, close): the wait is a
+                    # backstop, not the wake mechanism
+                    self.lock.wait(2.0)
                 if self._closed or self._poisoned is not None:
                     return
                 work = None
@@ -282,15 +348,164 @@ class ShuffleMergeManager:
                     self.lock.notify_all()
                 return
 
-    def _do_mem_to_disk(self, items: List[Tuple[int, int, KVBatch]]) -> None:
-        items = sorted(items)               # slot-major, then arrival
+    def _merge_loop_async(self) -> None:
+        """Async flavor: CLAIM work under the lock, hand it to the merge
+        pipeline, immediately look for more.  Completion accounting happens
+        in _pipe_complete (seq order), so disk-run age order is identical
+        to the synchronous merger's."""
+        while True:
+            with self.lock:
+                while not self._closed and self._poisoned is None and \
+                        not self._mem_merge_due() and \
+                        not self._disk_merge_due_locked():
+                    self.lock.wait(2.0)
+                if self._closed or self._poisoned is not None:
+                    return
+                if self._mem_merge_due():
+                    items = list(self._mem)
+                    self._merging = self._merging + items
+                    self._mem = []
+                    work = ("mem", items)
+                elif self._disk_merge_due_locked():
+                    paths = self._disk_runs[:self.merge_factor]
+                    self._disk_runs = self._disk_runs[self.merge_factor:]
+                    self._disk_claim = list(paths)
+                    work = ("disk", paths)
+                else:
+                    continue        # woken with nothing runnable
+                seq = self._pipe_seq
+                self._pipe_seq += 1
+            try:
+                self._pipeline.submit(seq, work)
+            except BaseException as e:  # noqa: BLE001 — surface to callers
+                with self.lock:
+                    self._error = e
+                    self.lock.notify_all()
+                return
+
+    # -------------------------------------------------- merge pipeline lane
+    def _merge_mem_items(self, items: List[Tuple[int, int, KVBatch]],
+                         engine: Optional[str] = None) -> Run:
+        """One mem->disk merge body (slot-major, then arrival — the order
+        every path in this file merges by).  engine overrides for the
+        containment plane's host failover / on-device OOM retry."""
+        items = sorted(items)
         runs = [_as_run(b) for _, _, b in items if b.num_records > 0]
-        merged = merge_sorted_runs(runs, 1, self.key_width,
-                                   engine=self.engine,
+        return merge_sorted_runs(runs, 1, self.key_width,
+                                 engine=self.engine if engine is None
+                                 else engine,
+                                 device_min_records=self.device_min_records,
+                                 merge_factor=self.merge_factor,
+                                 key_normalizer=self.key_normalizer) \
+            if runs else _as_run(KVBatch.empty())
+
+    def _pipe_dispatch(self, payload):
+        """Pipeline dispatch stage (staging thread): the device/host merge
+        itself.  The chaos seams (device.dispatch.{oom,hang}) and the
+        dispatch watchdog wrap this call exactly as they wrap sorts."""
+        kind, raw = payload
+        if kind == "mem":
+            return (kind, raw, self._merge_mem_items(raw))
+        return (kind, raw, self._stream_merge_to_disk(raw))
+
+    def _pipe_readback(self, inflight, ids):
+        """Pipeline readback stage (worker thread): persist a mem merge as
+        a chunked run.  This is the stage that overlaps the NEXT merge's
+        dispatch — disk write k runs concurrently with device merge k+1."""
+        kind, raw, result = inflight
+        if kind == "mem":
+            return (kind, raw, self._write_chunked([result]))
+        return (kind, raw, result)      # disk cascades write while merging
+
+    def _pipe_failover(self, ids, payloads):
+        """Containment: re-run a claimed merge on the HOST engine from the
+        raw payload (committed batches / input run paths) and persist it —
+        the merge twin of DeviceSorter._async_failover."""
+        kind, raw = payloads[0]
+        if kind == "mem":
+            merged = self._merge_mem_items(raw, engine="host")
+            return (kind, raw, self._write_chunked([merged]))
+        return (kind, raw, self._stream_merge_to_disk(raw, engine="host"))
+
+    def _pipe_oom_retry(self, ids, payloads):
+        """OOM ladder: halve the run set, merge each half on device, then
+        merge the two results — halves are contiguous prefixes of the
+        slot-major order, so the composed merge is bit-identical (run-age
+        tie order preserved).  Raises to decline below 2 live runs (the
+        ladder then falls through to host failover)."""
+        kind, raw = payloads[0]
+        if kind != "mem":
+            raise MemoryError("disk cascade OOM: no device span to split")
+        items = sorted(raw)
+        live = [t for t in items if t[2].num_records > 0]
+        if len(live) < 2:
+            raise MemoryError("merge OOM split floor reached")
+        mid = len(live) // 2
+        halves = [self._merge_mem_items(part, engine="device")
+                  for part in (live[:mid], live[mid:])]
+        merged = merge_sorted_runs(halves, 1, self.key_width,
+                                   engine="device",
                                    device_min_records=self.device_min_records,
                                    merge_factor=self.merge_factor,
-                                   key_normalizer=self.key_normalizer) \
-            if runs else _as_run(KVBatch.empty())
+                                   key_normalizer=self.key_normalizer)
+        return (kind, raw, self._write_chunked([merged]))
+
+    def _pipe_complete(self, ids, result) -> None:
+        """Pipeline completion hook: stash by submission seq and fold every
+        consecutive finished merge into the manager state (out-of-order
+        readbacks never reorder the disk-run age list)."""
+        with self.lock:
+            for sid in ids:             # merge groups are single-span
+                self._pending_out[sid] = result
+            while self._next_out in self._pending_out:
+                kind, raw, path = self._pending_out.pop(self._next_out)
+                self._next_out += 1
+                if kind == "mem":
+                    self._fold_mem_locked(raw, path)
+                else:
+                    self._fold_disk_locked(raw, path)
+            self.lock.notify_all()
+
+    def _fold_mem_locked(self, items, path: str) -> None:
+        claimed = {q for _, q, _ in items}
+        self._merging = [t for t in self._merging if t[1] not in claimed]
+        if self._poisoned is not None:
+            # a claimed slot reset mid-merge: the written file contains
+            # stale data — discard it; the consumer attempt re-runs
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return
+        self._disk_slots.update(s for s, _, _ in items)
+        self._mem_bytes -= sum(b.nbytes for _, _, b in items)
+        self._disk_runs.append(path)
+        self._mem_to_disk += 1
+        self.counters.increment(TaskCounter.NUM_MEM_TO_DISK_MERGES)
+
+    def _fold_disk_locked(self, paths: List[str], out: str) -> None:
+        self._disk_claim = None
+        if self._poisoned is not None:
+            for p in list(paths) + [out]:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+            return
+        # the claimed paths were the OLDEST runs (list prefix): the result
+        # re-enters at the front, preserving age order exactly like the
+        # synchronous index-based replace
+        self._disk_runs.insert(0, out)
+        self._disk_to_disk += 1
+        for p in paths:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        self.counters.increment(TaskCounter.NUM_DISK_TO_DISK_MERGES)
+
+    def _do_mem_to_disk(self, items: List[Tuple[int, int, KVBatch]]) -> None:
+        merged = self._merge_mem_items(items)
         path = self._write_chunked([merged])
         freed = sum(b.nbytes for _, _, b in items)
         with self.lock:
@@ -352,22 +567,25 @@ class ShuffleMergeManager:
                 source.partition)
         return iter([source])
 
-    def _merged_block_iter(self, sources: Sequence) -> Iterator[KVBatch]:
+    def _merged_block_iter(self, sources: Sequence,
+                           engine: Optional[str] = None) -> Iterator[KVBatch]:
         """Blockwise vectorized k-way merge over paths/batches (age order =
         source order, so equal keys keep the reference MergeQueue's
         arrival-order semantics)."""
         return iter_merged_blocks(
             [self._block_iter(s) for s in sources], self.key_width,
-            engine=self.engine, key_normalizer=self.key_normalizer,
+            engine=self.engine if engine is None else engine,
+            key_normalizer=self.key_normalizer,
             merge_factor=self.merge_factor,
             device_min_records=self.device_min_records)
 
-    def _stream_merge_to_disk(self, paths: List[str]) -> str:
+    def _stream_merge_to_disk(self, paths: List[str],
+                              engine: Optional[str] = None) -> str:
         out_path = os.path.join(self.spill_dir,
                                 f"mmerge_{uuid.uuid4().hex}.crun")
         w = ChunkedRunWriter(out_path, codec=self.codec,
                              block_records=self.block_records)
-        for block in self._merged_block_iter(paths):
+        for block in self._merged_block_iter(paths, engine=engine):
             w.append(block)
         w.close()
         self.counters.increment(TaskCounter.ADDITIONAL_SPILLS_BYTES_WRITTEN,
@@ -382,6 +600,17 @@ class ShuffleMergeManager:
             self.lock.notify_all()
         if self._merger is not None:
             self._merger.join(timeout=300)
+        if self._pipeline is not None:
+            # in the async plane the background merges were mostly staged
+            # (or finished) while fetches were still landing: drain is
+            # usually a no-op wait on the tail merge, not a serial replay
+            try:
+                self._pipeline.drain()
+            except BaseException as e:  # noqa: BLE001 — containment floor
+                with self.lock:
+                    if self._error is None:
+                        self._error = e
+                    self.lock.notify_all()
         with self.lock:
             self._raise_if_broken()
             mem = sorted(self._mem)
@@ -426,6 +655,11 @@ class ShuffleMergeManager:
                 device_min_records=self.device_min_records,
                 key_normalizer=self.key_normalizer).batch
         return MergedResult(stream=_StreamPlan(self, disk + files, mem_seg))
+
+    def pipeline_events(self) -> List[Tuple[Any, str, str, float]]:
+        """Instrumentation events of the async merge lane (instrument=True):
+        feed to ops.async_stage.overlap_pairs for the overlap witness."""
+        return [] if self._pipeline is None else list(self._pipeline.events)
 
     def cleanup(self) -> None:
         with self.lock:

@@ -398,7 +398,8 @@ class AsyncSpanPipeline:
                                                   List[Any]], Any]] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  watchdog_dispatch_ms: float = 0.0,
-                 watchdog_readback_ms: float = 0.0) -> None:
+                 watchdog_readback_ms: float = 0.0,
+                 dispatch_wait_hist: str = DISPATCH_WAIT_HIST) -> None:
         if depth < 1:
             raise ValueError(f"pipeline depth must be >= 1, got {depth}")
         self._encode_fn = encode_fn or (lambda p: p)
@@ -426,6 +427,11 @@ class AsyncSpanPipeline:
                 else process_breaker()
         self._watchdog_dispatch_ms = float(watchdog_dispatch_ms)
         self._watchdog_readback_ms = float(watchdog_readback_ms)
+        #: which histogram records dispatch->host-visible latency: the sort
+        #: plane keeps DISPATCH_WAIT_HIST; the reduce-side merge lane
+        #: (library/merge_manager.py) points this at "device.merge" so its
+        #: waits don't pollute the producer pipeline's stage breakdown
+        self._dispatch_wait_hist = dispatch_wait_hist
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -719,7 +725,7 @@ class AsyncSpanPipeline:
                 self._watch_end(group)
             t1 = self._mark(ids, STAGE_D2H, "end")
             self._observe(STAGE_D2H, t0, t1)
-            self._observe(DISPATCH_WAIT_HIST, group.t_dispatch, t1)
+            self._observe(self._dispatch_wait_hist, group.t_dispatch, t1)
             # deterministic completion-reorder hook (chaos/test plane):
             # a delay rule here holds THIS span's completion while later
             # spans drain through the other workers

@@ -365,36 +365,222 @@ def _fused_resident_merge(lanes_list, lens_list):
     return perm
 
 
-def merge_resident_slices(slices) -> np.ndarray:
-    """k-way merge over device-resident key views.
-
-    slices: list of (lanes_dev, lens_dev, lo, hi) with identical lane
-    counts.  Returns the merge permutation into the HOST concatenation of
-    the real rows (run order preserved for equal keys).  No key bytes move
-    host->device; only the permutation comes back."""
-    counts = [hi - lo for (_l, _n, lo, hi) in slices]
-    # ONE common bucket for every slice: the merge program's compile key is
-    # then (k, B, L) instead of the full ordered tuple of per-run sizes —
-    # bounded compile variety at the cost of sorting k*B instead of
-    # sum(bucket_i) rows (sentinels are cheap; compiles are not)
-    common = _bucket(max(counts))
-    buckets = [common] * len(slices)
-    width = max(l.shape[1] for (l, _n, _lo, _hi) in slices)
-    lanes_list, lens_list = [], []
-    for (lanes, lens, lo, hi) in slices:
-        sl, ln = _slice_to_bucket(lanes, lens, lo, hi - lo, common, width)
-        lanes_list.append(sl)
-        lens_list.append(ln)
-    perm = np.asarray(_fused_resident_merge(lanes_list, lens_list))
-    # map bucketed-concat indices back to real host rows
-    bounds = np.zeros(len(buckets) + 1, dtype=np.int64)
-    np.cumsum(buckets, out=bounds[1:])
+def _map_bucketed_perm(perm: np.ndarray, counts, common: int) -> np.ndarray:
+    """Map a permutation over the BUCKETED concatenation (k runs, each
+    padded to `common` rows) back to host rows of the real concatenation,
+    dropping sentinel positions."""
+    bounds = np.zeros(len(counts) + 1, dtype=np.int64)
+    np.cumsum([common] * len(counts), out=bounds[1:])
     host_offsets = np.zeros(len(counts), dtype=np.int64)
     np.cumsum(counts[:-1], out=host_offsets[1:])
     run_id = np.searchsorted(bounds[1:], perm, side="right")
     within = perm - bounds[run_id]
     real = within < np.asarray(counts)[run_id]
     return (host_offsets[run_id] + within)[real].astype(np.int64)
+
+
+def merge_resident_slices(slices, kernel: str = "merge_path") -> np.ndarray:
+    """k-way merge over device-resident key views.
+
+    slices: list of (lanes_dev, lens_dev, lo, hi) with identical lane
+    counts.  Returns the merge permutation into the HOST concatenation of
+    the real rows (run order preserved for equal keys).  No key bytes move
+    host->device; only the permutation comes back.
+
+    kernel="merge_path" (default) runs the O(N) partitioned binary-merge
+    ladder — each level ranks every row of one run in its sibling, so a
+    k-way merge is log2(k) linear passes instead of one O(N log N) re-sort
+    of the concatenation.  kernel="sort" keeps the concatenate+re-sort
+    program callable (bench comparison, escape hatch)."""
+    counts = [hi - lo for (_l, _n, lo, hi) in slices]
+    # ONE common bucket for every slice: the merge program's compile key is
+    # then (k, B, L) instead of the full ordered tuple of per-run sizes —
+    # bounded compile variety at the cost of sorting k*B instead of
+    # sum(bucket_i) rows (sentinels are cheap; compiles are not)
+    common = _bucket(max(counts))
+    width = max(l.shape[1] for (l, _n, _lo, _hi) in slices)
+    lanes_list, lens_list = [], []
+    for (lanes, lens, lo, hi) in slices:
+        sl, ln = _slice_to_bucket(lanes, lens, lo, hi - lo, common, width)
+        lanes_list.append(sl)
+        lens_list.append(ln)
+    if kernel == "merge_path":
+        perm = np.asarray(_merge_path_resident(lanes_list, lens_list, common))
+    else:
+        perm = np.asarray(_fused_resident_merge(lanes_list, lens_list))
+    return _map_bucketed_perm(perm, counts, common)
+
+
+# ---------------------------------------------------------------------------
+# merge-path kernel: O(N) two-way merge of pre-sorted runs via cross-ranks.
+# out_pos(a_i) = i + |{b : b < a_i}| and out_pos(b_j) = j + |{a : a <= b_j}|
+# tile [0, na+nb) exactly (the asymmetric <=/< pair is what makes equal keys
+# emit in run-arrival order — the earlier run wins, matching the stable
+# concatenate+sort kernel and TezMerger's MergeQueue).  A k-way merge is a
+# log2(k) ladder of pair merges; runs stay HBM-resident between levels, so
+# encode/H2D is paid once per cascade instead of once per level.
+# ---------------------------------------------------------------------------
+def _lex_lt(al: jnp.ndarray, alen: jnp.ndarray,
+            bl: jnp.ndarray, blen: jnp.ndarray) -> jnp.ndarray:
+    """Row-wise (lanes..., length) lexicographic less-than over equal-shape
+    batches — the SAME composite comparator the LSD sort kernels order by
+    (lane 0 most significant, clamped length last).  Sentinel rows carry
+    length 0xFFFFFFFF, above any real clamped length, so an all-FF real key
+    still sorts before the pad tail."""
+    res = alen < blen
+    for i in range(al.shape[1] - 1, -1, -1):
+        res = jnp.where(al[:, i] == bl[:, i], res, al[:, i] < bl[:, i])
+    return res
+
+
+def _rank_search(run_lanes: jnp.ndarray, run_lens: jnp.ndarray,
+                 q_lanes: jnp.ndarray, q_lens: jnp.ndarray,
+                 count_equal: bool) -> jnp.ndarray:
+    """Vectorized binary search: rank of every query row in the sorted run.
+    count_equal=False counts strictly-less rows, True counts less-or-equal
+    (resolved at trace time — two compiled flavors).  O(m log n) total work
+    versus the O((m+n) log(m+n)) comparator sort it replaces."""
+    n = run_lanes.shape[0]
+    m = q_lanes.shape[0]
+    lo = jnp.zeros((m,), jnp.int32)
+    hi = jnp.full((m,), n, jnp.int32)
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = (lo + hi) >> 1
+        mid_l = jnp.take(run_lanes, mid, axis=0)
+        mid_n = jnp.take(run_lens, mid, axis=0)
+        if count_equal:   # run[mid] <= q  <=>  not (q < run[mid])
+            before = ~_lex_lt(q_lanes, q_lens, mid_l, mid_n)
+        else:             # run[mid] < q
+            before = _lex_lt(mid_l, mid_n, q_lanes, q_lens)
+        active = lo < hi
+        lo = jnp.where(active & before, mid + 1, lo)
+        hi = jnp.where(active & ~before, mid, hi)
+        return lo, hi
+
+    lo, _ = jax.lax.fori_loop(0, n.bit_length() + 1, body, (lo, hi))
+    return lo
+
+
+@functools.lru_cache(maxsize=1)
+def _pallas_merge_ranks() -> bool:
+    """Route rank computation through the Pallas flavor on TPU backends
+    (same search body — pallas_kernels delegates to _rank_search)."""
+    if os.environ.get("TEZ_TPU_DISABLE_PALLAS_MERGE"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _rank_rows(run_lanes: jnp.ndarray, run_lens: jnp.ndarray,
+               q_lanes: jnp.ndarray, q_lens: jnp.ndarray,
+               count_equal: bool) -> jnp.ndarray:
+    if _pallas_merge_ranks():
+        from tez_tpu.ops.pallas_kernels import merge_rank_pallas
+        return merge_rank_pallas(run_lanes, run_lens, q_lanes, q_lens,
+                                 count_equal)
+    return _rank_search(run_lanes, run_lens, q_lanes, q_lens, count_equal)
+
+
+@jax.jit
+def _merge_path_pair(a_lanes, a_lens, a_idx, b_lanes, b_lens, b_idx):
+    """One O(na+nb) merge level: scatter both runs straight to their output
+    positions.  Sentinel rows participate too — A-sentinel i lands at
+    i + realB and B-sentinel j at j + na, so the scatter is a collision-free
+    permutation with every real row in the prefix and the output again a
+    sorted run (ladder levels compose without re-compacting)."""
+    na, nb = a_lanes.shape[0], b_lanes.shape[0]
+    ra = _rank_rows(b_lanes, b_lens, a_lanes, a_lens, count_equal=False)
+    rb = _rank_rows(a_lanes, a_lens, b_lanes, b_lens, count_equal=True)
+    pos_a = jnp.arange(na, dtype=jnp.int32) + ra
+    pos_b = jnp.arange(nb, dtype=jnp.int32) + rb
+    out_lanes = jnp.empty((na + nb, a_lanes.shape[1]), a_lanes.dtype)
+    out_lanes = out_lanes.at[pos_a].set(a_lanes).at[pos_b].set(b_lanes)
+    out_lens = jnp.empty((na + nb,), a_lens.dtype)
+    out_lens = out_lens.at[pos_a].set(a_lens).at[pos_b].set(b_lens)
+    out_idx = jnp.empty((na + nb,), a_idx.dtype)
+    out_idx = out_idx.at[pos_a].set(a_idx).at[pos_b].set(b_idx)
+    return out_lanes, out_lens, out_idx
+
+
+@jax.jit
+def _merge_path_prep(lanes, lens, base):
+    """Per-run ladder prep: int32 lengths (-1 pad sentinel) -> u32 sort
+    lengths (0xFFFFFFFF sentinel) + global bucket indices.  `base` is a
+    dynamic argument so per-run offsets don't multiply compile keys."""
+    sort_lens = jnp.where(lens < 0, jnp.uint32(0xFFFFFFFF),
+                          lens.astype(jnp.uint32))
+    idx = base + jnp.arange(lanes.shape[0], dtype=jnp.int32)
+    return sort_lens, idx
+
+
+def _merge_path_ladder(runs):
+    """log2(k) ladder over (lanes, sort_lens, idx) triples: pair adjacent
+    runs left-to-right (odd last carries up) so equal keys meet in run
+    order at every level.  Returns the final idx column (the merge
+    permutation over the bucketed concatenation); everything stays on
+    device until the caller reads it back."""
+    while len(runs) > 1:
+        nxt = [_merge_path_pair(*runs[i], *runs[i + 1])
+               for i in range(0, len(runs) - 1, 2)]
+        if len(runs) % 2:
+            nxt.append(runs[-1])
+        runs = nxt
+    return runs[0][2]
+
+
+def _merge_path_resident(lanes_list, lens_list, common: int):
+    runs = []
+    for i, (sl, ln) in enumerate(zip(lanes_list, lens_list)):
+        sort_lens, idx = _merge_path_prep(sl, ln, i * common)
+        runs.append((sl, sort_lens, idx))
+    return _merge_path_ladder(runs)
+
+
+def merge_path_runs(parts_list: list[np.ndarray],
+                    lanes_list: list[np.ndarray],
+                    lengths_list: list[np.ndarray]) -> np.ndarray:
+    """Generic (non-resident) k-way merge-path merge of pre-sorted runs.
+
+    Each run is sorted by (partition, key lanes, clamped length); the
+    partition id is prepended as the most-significant u32 lane so the
+    composite comparator reproduces partition-major order.  Returns the
+    merge permutation into the host concatenation of the runs (equal keys
+    in run-arrival order).  Like sort_run, prefix-equal beyond-cap keys
+    compare equal here and are resolved by the host tie-break pass."""
+    counts = [l.shape[0] for l in lanes_list]
+    live = [i for i, c in enumerate(counts) if c > 0]
+    if not live:
+        return np.zeros(0, dtype=np.int64)
+    width = max(lanes_list[i].shape[1] for i in live)
+    width_cap = width * 4 + 1
+    common = _bucket(max(counts[i] for i in live))
+    runs = []
+    for j, i in enumerate(live):
+        n = counts[i]
+        comp = np.empty((common, width + 1), dtype=np.uint32)
+        comp[:n, 0] = parts_list[i].astype(np.uint32)
+        comp[:n, 1:1 + lanes_list[i].shape[1]] = lanes_list[i]
+        comp[:n, 1 + lanes_list[i].shape[1]:] = 0
+        comp[n:] = np.uint32(0xFFFFFFFF)
+        lens = np.full(common, -1, dtype=np.int32)
+        lens[:n] = np.minimum(lengths_list[i].astype(np.int64), width_cap)
+        sort_lens, idx = _merge_path_prep(jnp.asarray(comp),
+                                          jnp.asarray(lens), j * common)
+        runs.append((jnp.asarray(comp), sort_lens, idx))
+    perm = np.asarray(_merge_path_ladder(runs))
+    mapped = _map_bucketed_perm(perm, [counts[i] for i in live], common)
+    if len(live) != len(counts):   # re-offset into the FULL concatenation
+        all_offsets = np.zeros(len(counts), dtype=np.int64)
+        np.cumsum(counts[:-1], out=all_offsets[1:])
+        live_offsets = np.zeros(len(live), dtype=np.int64)
+        np.cumsum([counts[i] for i in live[:-1]], out=live_offsets[1:])
+        run_id = np.searchsorted(live_offsets[1:], mapped, side="right")
+        mapped = mapped - live_offsets[run_id] + all_offsets[np.asarray(live)[run_id]]
+    return mapped
 
 
 @functools.partial(jax.jit,

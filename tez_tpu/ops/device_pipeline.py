@@ -17,6 +17,12 @@ Two entry points:
   (ops.sorter) builds the same AsyncSpanPipeline around its own
   Run-producing stages; this class serves raw-array producers (benchmarks,
   device-to-device edges).
+
+The reduce side runs a third AsyncSpanPipeline instance: the merge lane in
+library/merge_manager.py, whose dispatch stage is the merge-path kernel
+(ops/device.py merge_path_runs — O(N) partitioned binary-merge of
+pre-sorted runs, no re-sort) and whose readback stage is the chunked-run
+disk write, so fetch/commit, device merge, and spill IO overlap.
 """
 from __future__ import annotations
 

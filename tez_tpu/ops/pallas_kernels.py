@@ -55,6 +55,59 @@ def fnv_hash_pallas(key_mat_u32: jnp.ndarray, lengths: jnp.ndarray,
     )(key_mat_u32, lengths)
 
 
+MERGE_ROW_BLOCK = 256
+
+
+def _merge_rank_kernel(run_lanes_ref, run_lens_ref, q_lanes_ref, q_lens_ref,
+                       out_ref, *, count_equal):
+    """One grid step: rank MERGE_ROW_BLOCK query rows in the full sorted
+    run (the run is replicated to every step — it is the binary-search
+    haystack, not tileable without a second-level search).
+
+    Delegates to device._rank_search — the ONE comparator+search body shared
+    with the XLA merge-path kernel — so the Pallas flavor can never diverge
+    from the fallback ordering."""
+    from tez_tpu.ops.device import _rank_search
+    out_ref[:] = _rank_search(run_lanes_ref[:], run_lens_ref[:],
+                              q_lanes_ref[:], q_lens_ref[:], count_equal)
+
+
+@functools.partial(jax.jit, static_argnames=("count_equal", "interpret"))
+def merge_rank_pallas(run_lanes: jnp.ndarray, run_lens: jnp.ndarray,
+                      q_lanes: jnp.ndarray, q_lens: jnp.ndarray,
+                      count_equal: bool = False,
+                      interpret: bool = False) -> jnp.ndarray:
+    """Rank of every query row in a sorted run (merge-path cross-rank).
+
+    run_lanes: uint32[N, W] sorted with run_lens: uint32[N]; q_lanes:
+    uint32[M, W] with q_lens: uint32[M].  M and N are power-of-two bucket
+    sizes (device._bucket), so MERGE_ROW_BLOCK | M when M >= 256; smaller
+    query blocks fall through to the XLA search directly.  Returns int32[M].
+    """
+    from jax.experimental import pallas as pl
+    from tez_tpu.ops.device import _rank_search
+
+    m, w = q_lanes.shape
+    if m < MERGE_ROW_BLOCK or m % MERGE_ROW_BLOCK:
+        return _rank_search(run_lanes, run_lens, q_lanes, q_lens, count_equal)
+    n = run_lanes.shape[0]
+    grid = (m // MERGE_ROW_BLOCK,)
+    kernel = functools.partial(_merge_rank_kernel, count_equal=count_equal)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m,), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((n, w), lambda i: (0, 0)),
+            pl.BlockSpec((n,), lambda i: (0,)),
+            pl.BlockSpec((MERGE_ROW_BLOCK, w), lambda i: (i, 0)),
+            pl.BlockSpec((MERGE_ROW_BLOCK,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((MERGE_ROW_BLOCK,), lambda i: (i,)),
+        interpret=interpret,
+    )(run_lanes, run_lens, q_lanes, q_lens)
+
+
 def hash_partition_pallas(key_mat: np.ndarray, lengths: np.ndarray,
                           num_partitions: int,
                           interpret: bool = False) -> np.ndarray:

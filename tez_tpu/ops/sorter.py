@@ -977,6 +977,15 @@ class DeviceSorter:
         return FileRun(path)
 
 
+def _record_merge_ms(counters: Optional[TezCounters], t0: float) -> None:
+    """device.merge latency histogram: wall of one device merge dispatch
+    (merge-path ladder or resident merge), the reduce-side twin of
+    device.sort."""
+    from tez_tpu.common import metrics
+    metrics.observe("device.merge", (time.time() - t0) * 1000.0,
+                    counters=counters)
+
+
 def _merge_resident_partitioned(live: Sequence[Run], num_partitions: int
                                 ) -> Tuple[np.ndarray, np.ndarray]:
     """Multi-partition device-resident merge: each run's HBM key columns are
@@ -1064,6 +1073,7 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
             else:
                 perm, row_index = _merge_resident_partitioned(
                     live, num_partitions)
+            _record_merge_ms(counters, t0)
             batch = KVBatch.concat([r.batch for r in live])
             sorted_batch = batch.take(perm)
             if counters is not None:
@@ -1134,7 +1144,23 @@ def merge_sorted_runs(runs: Sequence[Run], num_partitions: int,
         from tez_tpu.ops.host_sort import host_sort_run
         sorted_partitions, perm = host_sort_run(partitions, lanes, lengths)
     else:
-        sorted_partitions, perm = device.sort_run(partitions, lanes, lengths)
+        # the inputs are PRE-SORTED runs: the O(N) merge-path ladder
+        # (cross-rank scatter per level) replaces the O(N log N)
+        # concatenate+re-sort dispatch.  Same composite comparator as
+        # sort_run, equal keys keep run-arrival order, and prefix-equal
+        # beyond-cap keys still fall to the host tie-break below.
+        run_bounds = np.zeros(len(runs) + 1, dtype=np.int64)
+        np.cumsum([r.batch.num_records for r in runs], out=run_bounds[1:])
+        t_dev = time.time()
+        perm = device.merge_path_runs(
+            [partitions[run_bounds[i]:run_bounds[i + 1]]
+             for i in range(len(runs))],
+            [lanes[run_bounds[i]:run_bounds[i + 1]]
+             for i in range(len(runs))],
+            [lengths[run_bounds[i]:run_bounds[i + 1]]
+             for i in range(len(runs))])
+        _record_merge_ms(counters, t_dev)
+        sorted_partitions = partitions[perm]
     sorted_batch = batch.take(perm)
     sort_lengths, keyfn = _sorted_key_view(sort_bytes, sort_offsets, perm)
     refinement = _exact_tiebreak(sort_lengths, sorted_partitions,

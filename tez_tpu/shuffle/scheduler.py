@@ -111,7 +111,8 @@ class FetchScheduler:
                  max_attempts: int = 4,
                  stall_timeout: float = 15.0,
                  name: str = "shuffle",
-                 penalty_rng: Optional[random.Random] = None):
+                 penalty_rng: Optional[random.Random] = None,
+                 session_ttl: float = 30.0):
         self.deliver = deliver
         self.session_factory = session_factory
         self.num_fetchers = max(1, num_fetchers)
@@ -125,8 +126,17 @@ class FetchScheduler:
                                            jitter=True, rng=penalty_rng)
         self.max_attempts = max_attempts
         self.stall_timeout = stall_timeout
+        self.session_ttl = session_ttl
 
         self.lock = threading.Condition()
+        # per-host keep-alive cache: a healthy session is checked back in
+        # after its batch instead of closed, so the next batch to the same
+        # host skips the TCP connect + nonce handshake.  Bounded: OPEN
+        # sessions (cached + checked out) never exceed num_fetchers — the
+        # cache yields (oldest idle first) before a new connect.  The
+        # referee closes entries idle past session_ttl.
+        self._session_cache: Dict[HostKey, Tuple[Any, float]] = {}
+        self._open_sessions = 0
         self.hosts: Dict[HostKey, _Host] = {}
         self.ready: deque = deque()            # host keys with runnable work
         self.penalties: List[Tuple[float, HostKey]] = []   # heap
@@ -165,9 +175,56 @@ class FetchScheduler:
     def stop(self) -> None:
         with self.lock:
             self._stopped = True
+            for sess, _ in self._session_cache.values():
+                self._close_session(sess)
+            self._session_cache.clear()
             self.lock.notify_all()
 
     # ------------------------------------------------------------ internals
+    def _close_session(self, session: Any) -> None:
+        """Caller holds the lock (Condition wraps an RLock, so re-entry from
+        checkout eviction is fine).  close() is a socket close — it never
+        calls deliver, so the no-two-locks rule holds."""
+        self._open_sessions -= 1
+        try:
+            session.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _checkout_session(self, host: _Host) -> Any:
+        """Reuse the host's cached session, or connect a new one.  The
+        connect happens OUTSIDE the lock (it can block for seconds); the
+        open-session slot is reserved first so the bound can't be raced."""
+        with self.lock:
+            cached = self._session_cache.pop(host.key, None)
+            if cached is not None:
+                return cached[0]          # already counted in _open_sessions
+            while self._open_sessions >= self.num_fetchers and \
+                    self._session_cache:
+                oldest = min(self._session_cache,
+                             key=lambda k: self._session_cache[k][1])
+                sess, _ = self._session_cache.pop(oldest)
+                self._close_session(sess)
+            self._open_sessions += 1
+        try:
+            return self.session_factory(*host.key)
+        except BaseException:
+            with self.lock:
+                self._open_sessions -= 1
+            raise
+
+    def _checkin_session(self, host: _Host, session: Any,
+                         healthy: bool) -> None:
+        with self.lock:
+            if not healthy or self._stopped or self.session_ttl <= 0 or \
+                    host.key in self._session_cache:
+                # close-on-error (the connection is suspect), on shutdown,
+                # or when a concurrent speculative batch already cached one
+                self._close_session(session)
+            else:
+                self._session_cache[host.key] = (session, time.time())
+                self.lock.notify_all()    # referee recomputes TTL deadline
+
     def _make_ready(self, host: _Host) -> None:
         """Caller holds the lock."""
         if host.active == 0 and not host.penalized and host.pending and \
@@ -198,12 +255,13 @@ class FetchScheduler:
 
     def _fetch_batch(self, worker_id: int, host: _Host,
                      reqs: List[FetchRequest]) -> None:
-        """Open ONE session; fetch every request over it (coalescing)."""
+        """ONE session fetches every request (coalescing); reused from the
+        per-host cache across batches when the last one ended healthy."""
         session = None
         completed = 0
         failed_conn: Optional[Exception] = None
         try:
-            session = self.session_factory(*host.key)
+            session = self._checkout_session(host)
             for i, req in enumerate(reqs):
                 sp = tracing.span(
                     "shuffle.fetch", cat="shuffle", parent=req.trace,
@@ -232,10 +290,7 @@ class FetchScheduler:
             failed_conn = e
         finally:
             if session is not None:
-                try:
-                    session.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                self._checkin_session(host, session, failed_conn is None)
         failed_out: List[Tuple[FetchRequest, Exception]] = []
         with self.lock:
             self.inflight.pop(worker_id, None)
@@ -306,6 +361,14 @@ class FetchScheduler:
         with self.lock:
             while not self._stopped:
                 now = time.time()
+                # keep-alive TTL sweep: cached sessions idle past
+                # session_ttl are closed so quiesced hosts don't pin
+                # sockets (and server-side handler threads) forever
+                for key in [k for k, (_, last) in
+                            self._session_cache.items()
+                            if now - last >= self.session_ttl]:
+                    sess, _ = self._session_cache.pop(key)
+                    self._close_session(sess)
                 while self.penalties and self.penalties[0][0] <= now:
                     _, key = heapq.heappop(self.penalties)
                     host = self.hosts.get(key)
@@ -359,6 +422,12 @@ class FetchScheduler:
                     stall_at = infl.started + self.stall_timeout
                     if deadline is None or stall_at < deadline:
                         deadline = stall_at
+                if self._session_cache:
+                    ttl_at = min(last for _, last in
+                                 self._session_cache.values()) + \
+                        self.session_ttl
+                    if deadline is None or ttl_at < deadline:
+                        deadline = ttl_at
                 wait = 5.0 if deadline is None else \
                     max(0.01, deadline - time.time())
                 self.lock.wait(wait)

@@ -121,6 +121,20 @@ def diff(old_path: str, new_path: str,
     for key in sorted(set(new) - set(old)):
         print(f"{key:52} {'-':>10} {float(new[key]['value']):10.2f}"
               f"    (metric added)")
+    # absolute ratio floors: a metric that declares min_vs_baseline must
+    # hold that vs_baseline ratio in NEW regardless of what OLD recorded
+    # (so the gate bites even on the first run that ships the metric).
+    # A vs_baseline <= 0 is the "stage unavailable" sentinel and is
+    # reported but never gated.
+    for key in sorted(new):
+        rec = new[key]
+        floor, vs = rec.get("min_vs_baseline"), rec.get("vs_baseline")
+        if floor is None or vs is None or float(vs) <= 0.0:
+            continue
+        if float(vs) < float(floor):
+            print(f"{key:52} vs_baseline {float(vs):.2f}x below floor "
+                  f"{float(floor):.2f}x  << REGRESSION (ratio floor)")
+            regressions += 1
     if regressions:
         print(f"\n{regressions} metric(s) regressed more than "
               f"{threshold:.0%}")

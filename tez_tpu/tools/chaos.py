@@ -549,6 +549,102 @@ def run_device_oom_storm(seed: int, spans: int = 4,
                   f"{br.trips}x and recovered via probe; both runs bit-exact")
 
 
+def run_merge_storm(seed: int, batches: int = 6,
+                    records: int = 900) -> Tuple[bool, str]:
+    """Reduce-side merge-lane containment scenario: every async merge
+    dispatch raises a RESOURCE_EXHAUSTED-classified error
+    (``device.dispatch.oom`` fail fault) while fetched runs commit one per
+    merge claim.  A single-run claim has no halving point, so the OOM split
+    retry declines, the merge fails over to the host engine, and the
+    1-failure breaker trips; later merges short-circuit straight to host
+    without touching the device.  After the cooldown a fault-free manager
+    sharing the breaker recovers it through a half-open probe.  Both
+    drained outputs bit-exact vs the fault-free synchronous merger."""
+    from tez_tpu.common.counters import TezCounters
+    from tez_tpu.library.merge_manager import ShuffleMergeManager
+    from tez_tpu.ops.async_stage import CircuitBreaker
+    from tez_tpu.ops.runformat import KVBatch
+
+    def make_sorted(i: int) -> "object":
+        b = _chaos_batch(seed, i, records)
+        return KVBatch.from_pairs(sorted(b.iter_pairs(),
+                                         key=lambda kv: kv[0]))
+
+    data = [make_sorted(i) for i in range(batches)]
+    total = sum(b.nbytes for b in data)
+    workdir = tempfile.mkdtemp(prefix="tez-chaos-merge-")
+
+    def run(tag: str, depth: int, spec: str, breaker=None, paced=False):
+        if spec:
+            faults.install("chaos", faults.parse_spec(spec), seed=seed)
+        try:
+            spill = os.path.join(workdir, tag)
+            os.makedirs(spill)
+            counters = TezCounters()
+            mm = ShuffleMergeManager(counters, total * 4, spill,
+                                     engine="device", device_min_records=0,
+                                     merge_threshold=0.02,
+                                     max_single_fraction=2.0,
+                                     block_records=256, async_depth=depth,
+                                     breaker=breaker)
+            for slot, b in enumerate(data):
+                mm.commit(slot, b)
+                if paced:
+                    # one merge claim per committed run: observe the claim
+                    # before the next commit so every pipeline group holds
+                    # a single live run (no OOM halving point)
+                    deadline = time.time() + 20.0
+                    while mm._pipe_seq < slot + 1 and \
+                            time.time() < deadline:
+                        time.sleep(0.005)
+            result = mm.finish()
+            if getattr(result, "stream", None) is not None:
+                out = [(k, v) for _, k, v in result.stream.iter_records()]
+            else:
+                out = list(result.batch.iter_pairs())
+        finally:
+            if spec:
+                faults.install("chaos", [])
+        return out, counters
+
+    try:
+        baseline, _ = run("sync", 0, "")
+        br = CircuitBreaker(failures=1, cooldown_ms=400)
+        spec = "device.dispatch.oom:fail:n=99,exc=runtime"
+        stormed, counters = run("storm", 2, spec, breaker=br, paced=True)
+        fo = counters.group("DeviceFailover")
+        split_attempts = fo.find_counter("device.oom.split_attempts").value
+        failed_over = fo.find_counter("device.failover.spans").value
+        shorted = fo.find_counter("device.breaker.short_circuits").value
+        if stormed != baseline:
+            return False, "drained output diverges under the merge OOM storm"
+        if split_attempts < 1:
+            return False, "no OOM split attempt before host failover"
+        if failed_over < 1:
+            return False, "no merge failed over to the host engine"
+        if br.trips < 1:
+            return False, f"breaker never tripped ({failed_over} failovers)"
+        if shorted < 1:
+            return False, ("no merge short-circuited while the breaker "
+                           "was open")
+        # recovery leg: cooldown elapses, a fault-free manager sharing the
+        # breaker probes half-open and re-arms the device merge engine
+        time.sleep(0.45)
+        recovered, _ = run("recover", 2, "", breaker=br, paced=True)
+        if recovered != baseline:
+            return False, "drained output diverges after breaker recovery"
+        if br.recoveries < 1 or br.state != "closed":
+            return False, (f"breaker did not recover via half-open probe "
+                           f"(state={br.state}, recoveries={br.recoveries})")
+        return True, (f"{split_attempts} split attempt(s) declined, "
+                      f"{failed_over} merge(s) failed over, {shorted} "
+                      f"short-circuited; breaker tripped {br.trips}x and "
+                      f"recovered via probe; {batches} runs drained "
+                      f"bit-exact twice")
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def _export_trace(path: str) -> None:
     """Write whatever the span buffer holds (it survives per-DAG disarm) as
     Perfetto trace_event JSON, then drop the buffer."""
@@ -590,6 +686,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "device.dispatch.oom faults drive the split-then-"
                          "fallback ladder; the breaker trips and recovers "
                          "through a half-open probe, output bit-exact")
+    ap.add_argument("--merge-storm", action="store_true",
+                    help="run the reduce-side merge-lane containment "
+                         "scenario: seeded device.dispatch.oom faults on "
+                         "every async merge dispatch drive host failover, "
+                         "trip the breaker (later merges short-circuit), "
+                         "then a fault-free run recovers it via half-open "
+                         "probe — drained output bit-exact vs sync")
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="arm the tracing plane (tez.trace.enabled) on the "
                          "storm DAGs and write a Perfetto trace_event JSON "
@@ -600,6 +703,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         (args.device_ooo, "device-ooo", run_device_ooo),
         (args.device_hang, "device-hang", run_device_hang),
         (args.device_oom_storm, "device-oom-storm", run_device_oom_storm),
+        (args.merge_storm, "merge-storm", run_merge_storm),
     ]
     if any(on for on, _, _ in device_scenarios):
         failures = 0

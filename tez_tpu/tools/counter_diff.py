@@ -28,6 +28,15 @@ REGRESSION_RATIO = 2.0
 DEVICE_STAGE_HISTS = ("device.encode", "device.h2d", "device.dispatch_wait",
                       "device.d2h")
 
+#: The reduce-side merge plane's histograms: ``device.merge`` is device
+#: merge-kernel wall (merge-path dispatches plus the async merge lane's
+#: dispatch-wait), ``shuffle.merge`` the consumer-side merge/commit wall.
+#: Diffed like the device stages — cumulative wall ms — so a reduce side
+#: that quietly fell off the merge-path kernel onto concatenate+re-sort
+#: (or host failover) shows up as a sum shift even when p95 stays inside
+#: one power-of-2 bucket.
+MERGE_STAGE_HISTS = ("device.merge", "shuffle.merge")
+
 #: Failure-containment counters (ops/async_stage.py COUNTER_GROUP): a run
 #: that silently started leaning on host failover — or tripping the breaker
 #: — is a health regression even when wall clock barely moves, so these get
@@ -65,14 +74,15 @@ def diff_histograms(counters_a: Dict, counters_b: Dict,
 
 
 def diff_device_stages(counters_a: Dict, counters_b: Dict,
+                       names: Tuple[str, ...] = DEVICE_STAGE_HISTS,
                        ) -> List[Tuple[str, float, float, bool]]:
-    """[(stage, sum_ms_a, sum_ms_b, regressed)] for the async device
-    plane's stage histograms present in either run; regressed when B spent
+    """[(stage, sum_ms_a, sum_ms_b, regressed)] for the named stage
+    histograms present in either run; regressed when B spent
     REGRESSION_RATIO x A's total wall in that stage."""
     ha = histograms_from_counters(counters_a)
     hb = histograms_from_counters(counters_b)
     out = []
-    for name in DEVICE_STAGE_HISTS:
+    for name in names:
         if name not in ha and name not in hb:
             continue
         ms_a = ha.get(name, {}).get("sum_us", 0) / 1000.0
@@ -143,6 +153,16 @@ def main() -> int:
             flag = "  << REGRESSION" if regressed else ""
             print(f"{name:32} {ms_a:10.1f} {100 * ms_a / tot_a:4.0f}% "
                   f"{ms_b:10.1f} {100 * ms_b / tot_b:4.0f}% "
+                  f"{ms_b - ms_a:+12.1f}{flag}")
+            regressions += int(regressed)
+    merges = diff_device_stages(a.counters, b.counters,
+                                names=MERGE_STAGE_HISTS)
+    if merges:
+        print(f"\n{'reduce-side merge stage (wall ms)':32} "
+              f"{'A':>14} {'B':>14} {'delta':>12}")
+        for name, ms_a, ms_b, regressed in merges:
+            flag = "  << REGRESSION" if regressed else ""
+            print(f"{name:32} {ms_a:14.1f} {ms_b:14.1f} "
                   f"{ms_b - ms_a:+12.1f}{flag}")
             regressions += int(regressed)
     failover = diff_device_failover(a.counters, b.counters)

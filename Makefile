@@ -6,7 +6,7 @@ PY ?= python
 OLD ?= BENCH_r05.json
 NEW ?= /tmp/bench_new.json
 
-.PHONY: test bench bench-new bench-diff bench-merge chaos chaos-device-ooo chaos-device chaos-merge docs
+.PHONY: test bench bench-new bench-diff bench-merge bench-store chaos chaos-device-ooo chaos-device chaos-merge chaos-store docs
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -28,6 +28,11 @@ bench-diff:
 bench-merge:
 	JAX_PLATFORMS=cpu TEZ_BENCH_MERGE_ONLY=1 $(PY) bench.py
 
+# buffer-store short-circuit micro-bench only: store leased zero-copy fetch
+# vs loopback TCP, plus the lineage seal/republish session leg
+bench-store:
+	JAX_PLATFORMS=cpu TEZ_BENCH_STORE_ONLY=1 $(PY) bench.py
+
 chaos:
 	$(PY) -m tez_tpu.tools.chaos --trials 3
 
@@ -42,6 +47,11 @@ chaos-device:
 # breaker trip + short-circuit + half-open recovery, drained output bit-exact
 chaos-merge:
 	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --merge-storm --trials 3
+
+# buffer-store eviction storm: wide shuffle through deliberately tiny store
+# tiers forces demotion/eviction mid-merge, output bit-exact vs store-off
+chaos-store:
+	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --store-pressure --trials 3
 
 docs:
 	$(PY) -m tez_tpu.tools.gen_config_docs > docs/configuration.md

@@ -220,6 +220,102 @@ def bench_merge(num_records: int, key_len: int, cpu_fallback: bool) -> dict:
     }
 
 
+def bench_store(num_records: int, key_len: int, cpu_fallback: bool) -> dict:
+    """Tiered buffer-store short-circuit vs loopback TCP fetch (info line).
+
+    The same registered spills are fetched two ways: (A) over the
+    keep-alive DCN shuffle socket on loopback — connect + HMAC handshake
+    paid once, then per-partition request/serialize/copy per fetch — and
+    (B) through ShuffleBufferStore.fetch_partition, the leased zero-copy
+    view the fetch scheduler's local_probe takes for same-host producers.
+    vs_baseline = TCP wall / store wall; min_vs_baseline is the ratio
+    floor bench_diff enforces (the short-circuit losing its edge over the
+    wire means the lease path grew a copy).  The metric text also reports
+    the session-mode leg: spills sealed under lineage keys, republished
+    to a second DAG's path, and re-fetched bit-exact as cache hits."""
+    from tez_tpu.common.security import JobTokenSecretManager
+    from tez_tpu.ops.runformat import KVBatch, Run
+    from tez_tpu.shuffle.server import FetchSession, ShuffleServer
+    from tez_tpu.shuffle.service import ShuffleService
+    from tez_tpu.store.buffer_store import ShuffleBufferStore
+
+    n = min(num_records, 400_000)
+    num_spills, num_partitions = 4, 4
+    per = n // num_spills
+    service = ShuffleService()
+    store = ShuffleBufferStore(device_capacity=0, host_capacity=1 << 30)
+    service.attach_buffer_store(store)
+    paths = []
+    for s in range(num_spills):
+        kb, ko, vb, vo = make_records(per, key_len, seed=100 + s)
+        bounds = np.linspace(0, per, num_partitions + 1).astype(np.int64)
+        path = f"bench_dag/attempt_{s}/cons"
+        service.register(path, -1, Run(KVBatch(kb, ko, vb, vo), bounds),
+                         lineage=f"benchlin{s}/0/cons")
+        paths.append(path)
+
+    reps = 3
+    secrets = JobTokenSecretManager()
+    server = ShuffleServer(secrets, service).start()
+    try:
+        sess = FetchSession(secrets, "127.0.0.1", server.port)
+        try:
+            tcp_probe = sess.fetch(paths[0], -1, 1)        # warm + verify
+            for path in paths:
+                sess.fetch_range(path, -1, 0, num_partitions)
+            t0 = time.time()
+            for _ in range(reps):
+                for path in paths:
+                    sess.fetch_range(path, -1, 0, num_partitions)
+            tcp_s = (time.time() - t0) / reps
+        finally:
+            sess.close()
+    finally:
+        server.stop()
+
+    bytes_per_pass = 0
+    for path in paths:                                      # warm
+        for p in range(num_partitions):
+            bytes_per_pass += store.fetch_partition(path, -1, p).nbytes
+    store_probe = store.fetch_partition(paths[0], -1, 1)
+    assert np.array_equal(tcp_probe.key_bytes, store_probe.key_bytes) and \
+        np.array_equal(tcp_probe.val_bytes, store_probe.val_bytes), \
+        "TCP and store short-circuit served different partition bytes"
+    t0 = time.time()
+    for _ in range(reps):
+        for path in paths:
+            for p in range(num_partitions):
+                store.fetch_partition(path, -1, p)
+    store_s = (time.time() - t0) / reps
+
+    # session-mode leg: DAG commits -> seal, DAG aliases drop, a recurring
+    # DAG republishes the sealed entries under its own path and re-fetches
+    sealed = store.seal_lineage("bench_dag")
+    service.unregister_prefix("bench_dag")
+    hits = 0
+    for s in range(num_spills):
+        new_path = f"bench_dag2/attempt_{s}/cons"
+        hits += len(store.republish_lineage(f"benchlin{s}/0/cons", new_path))
+        reused = store.fetch_partition(new_path, -1, 1)
+        if s == 0:
+            assert np.array_equal(reused.key_bytes, store_probe.key_bytes), \
+                "lineage-republished partition diverges from the original"
+    store.close()
+
+    suffix = " [CPU FALLBACK: TPU relay stalled]" if cpu_fallback else ""
+    return {
+        "metric": (f"store short-circuit vs loopback TCP fetch (info line; "
+                   f"{num_spills} spills x {num_partitions} partitions, "
+                   f"{bytes_per_pass / 1e6:.1f} MB/pass, keep-alive TCP "
+                   f"session {bytes_per_pass / 1e6 / tcp_s:.0f} MB/s; "
+                   f"session leg: {sealed} sealed, {hits} lineage hits "
+                   f"republished + re-fetched bit-exact){suffix}"),
+        "value": round(bytes_per_pass / 1e6 / store_s, 2), "unit": "MB/s",
+        "vs_baseline": round(tcp_s / store_s, 3),
+        "min_vs_baseline": 1.5,
+    }
+
+
 _DEVICE_STAGES = (("encode", "device.encode"), ("h2d", "device.h2d"),
                   ("dispatch_wait", "device.dispatch_wait"),
                   ("d2h", "device.d2h"))
@@ -598,6 +694,13 @@ def main() -> int:
         if _bench_done is not None:
             _bench_done.set()
         print(json.dumps(line), flush=True)
+        return 0
+    if os.environ.get("TEZ_BENCH_STORE_ONLY") == "1":
+        # make bench-store: the buffer-store short-circuit info line —
+        # pure host path, no device probe needed
+        num_records = int(sys.argv[1]) if len(sys.argv) > 1 else 400_000
+        print(json.dumps(bench_store(num_records, 12, cpu_fallback)),
+              flush=True)
         return 0
     if os.environ.get("TEZ_BENCH_MERGE_ONLY") == "1":
         # make bench-merge: just the reduce-side merge-path info line

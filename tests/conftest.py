@@ -62,3 +62,14 @@ def _reset_epoch_registry():
     yield
     from tez_tpu.common import epoch
     epoch.reset()
+
+
+@pytest.fixture(autouse=True)
+def _reset_buffer_store():
+    """The tiered buffer store is a process singleton attached to the
+    shuffle service; a test that enabled it (store conf knobs) must not
+    leave its tiny tiers — or its sealed lineage cache — behind for
+    later tests."""
+    yield
+    from tez_tpu.store import reset_store
+    reset_store()

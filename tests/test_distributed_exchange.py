@@ -130,7 +130,9 @@ def test_ragged_exchange_matches_golden_or_skips(mesh8):
         out_lanes, out_lens, out_vals, out_valid, dropped = jax.device_get(
             fn(lanes, lengths, values, valid))
     except Exception as e:  # noqa: BLE001
-        if "UNIMPLEMENTED" in str(e) or isinstance(e, NotImplementedError):
+        if "UNIMPLEMENTED" in str(e) or isinstance(e, NotImplementedError) \
+                or ("ragged_all_to_all" in str(e)
+                    and isinstance(e, AttributeError)):
             pytest.skip(f"backend lacks ragged-all-to-all: {type(e).__name__}")
         raise
     assert int(dropped.sum()) == 0

@@ -289,3 +289,146 @@ def test_table_injectable_fetcher_conf_seam():
         table.shutdown()
         for i in range(4):
             svc.unregister_prefix(f"prod{i}")
+
+
+# ---------------------------------------------------------------- TTL cache
+
+
+class FakeClock:
+    """Injectable scheduler clock: every TTL/penalty/stall decision steps
+    only when the test says so."""
+
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _wait_cached(sched, n_sessions=None, timeout=5.0):
+    """Spin until the scheduler has stashed a keep-alive session (and,
+    optionally, until the hub saw n_sessions connections)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        with sched.lock:
+            if sched._session_cache:
+                return
+        time.sleep(0.005)
+    raise AssertionError("session never cached")
+
+
+def test_stale_cached_session_discarded_at_checkout():
+    """A keep-alive session idle past session_ttl must NOT be reused: the
+    server may have half-closed it.  Checkout validates the TTL itself
+    (not just the referee sweep) and the open-session slot accounting
+    nets zero across the close + fresh connect."""
+    hub, col = FakeHub(), Collector()
+    clk = FakeClock()
+    sched = _mk(hub, col, session_ttl=5.0, clock=clk, stall_timeout=1e9)
+    try:
+        sched.enqueue(FetchRequest("h1", 1, "a", -1, 0))
+        col.wait(1)
+        _wait_cached(sched)
+        clk.advance(10.0)              # idle past TTL
+        sched.enqueue(FetchRequest("h1", 1, "b", -1, 0))
+        col.wait(2)
+        assert len(col.ok) == 2 and not col.errors
+        # a second, fresh connection served "b"; the stale one was closed
+        assert len(hub.sessions) == 2
+        assert hub.sessions[0].closed
+        _wait_cached(sched)
+        assert not hub.sessions[1].closed
+        with sched.lock:
+            assert sched._open_sessions == 1   # close+reconnect netted zero
+    finally:
+        sched.stop()
+
+
+class GateHub(FakeHub):
+    """Serve blocks on path "slow" until the test releases the gate."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.gate = threading.Event()
+        self.entered = threading.Event()
+
+    def serve(self, session, path, spill, partition):
+        if path == "slow":
+            self.entered.set()
+            assert self.gate.wait(10)
+        return super().serve(session, path, spill, partition)
+
+
+def test_ttl_sweep_never_closes_checked_out_session():
+    """Regression: the referee's TTL sweep once raced a fetcher that had
+    just reused a cached session — the sweep closed the socket mid-fetch.
+    Checkout now POPS the cache entry, so a checked-out session is
+    invisible to the sweep no matter how far the clock jumps."""
+    hub, col = GateHub(), Collector()
+    clk = FakeClock()
+    sched = _mk(hub, col, session_ttl=5.0, clock=clk, stall_timeout=1e9)
+    try:
+        sched.enqueue(FetchRequest("h1", 1, "fast", -1, 0))
+        col.wait(1)
+        _wait_cached(sched)                    # keep-alive session stashed
+        sched.enqueue(FetchRequest("h1", 1, "slow", -1, 0))
+        assert hub.entered.wait(5)             # reused session, mid-fetch
+        assert len(hub.sessions) == 1          # reuse, not a new connect
+        clk.advance(50.0)                      # way past session_ttl
+        with sched.lock:
+            sched.lock.notify_all()            # wake the referee sweep
+        time.sleep(0.1)
+        assert not hub.sessions[0].closed      # sweep spared the session
+        hub.gate.set()
+        col.wait(2)
+        assert len(col.ok) == 2 and not col.errors
+        assert len(hub.sessions) == 1          # whole run on one socket
+    finally:
+        sched.stop()
+
+
+# ----------------------------------------------------- store short-circuit
+
+
+def test_local_probe_short_circuits_store_hits():
+    """Requests the local store can serve never open a connection; the
+    rest of the batch still coalesces onto one session."""
+    hub, col = FakeHub(), Collector()
+
+    def probe(path, spill, partition):
+        if path.startswith("here"):
+            return f"store:{path}:{spill}:{partition}"
+        return None
+
+    sched = _mk(hub, col, local_probe=probe)
+    try:
+        for i in range(4):
+            sched.enqueue(FetchRequest("h1", 1, f"here{i}", -1, 0))
+            sched.enqueue(FetchRequest("h1", 1, f"far{i}", -1, 0))
+        col.wait(8)
+        assert len(col.ok) == 8 and not col.errors
+        store_served = {k[0] for k, b in col.ok
+                        if str(b).startswith("store:")}
+        assert store_served == {f"here{i}" for i in range(4)}
+        # probed keys never reached the wire
+        assert all(p.startswith("far") for (_, p, _, _) in hub.fetches)
+        assert len(hub.fetches) == 4
+    finally:
+        sched.stop()
+
+
+def test_local_probe_all_hits_opens_no_connection():
+    hub, col = FakeHub(), Collector()
+    sched = _mk(hub, col,
+                local_probe=lambda p, s, pt: f"store:{p}:{s}:{pt}")
+    try:
+        for i in range(6):
+            sched.enqueue(FetchRequest("h1", 1, f"here{i}", -1, i))
+        col.wait(6)
+        assert len(col.ok) == 6 and not col.errors
+        assert hub.sessions == [] and hub.fetches == []
+    finally:
+        sched.stop()

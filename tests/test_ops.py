@@ -413,6 +413,7 @@ def test_codec_registry_zstd_roundtrip(tmp_path):
     tez.runtime.compress.codec)."""
     import numpy as np
     import pytest
+    pytest.importorskip("zstandard", reason="zstd wheel absent")
     from tez_tpu.ops.runformat import (KVBatch, MAGIC, Run, resolve_codec)
     batch = KVBatch.from_pairs(
         [(f"k{i % 7}".encode(), b"payload" * 8) for i in range(500)])
@@ -432,6 +433,8 @@ def test_codec_registry_zstd_roundtrip(tmp_path):
 
 def test_zstd_conf_through_sorter(tmp_path):
     import os
+    import pytest
+    pytest.importorskip("zstandard", reason="zstd wheel absent")
     from tez_tpu.ops.runformat import MAGIC
     from tez_tpu.ops.sorter import DeviceSorter
     spill = str(tmp_path)

@@ -66,6 +66,7 @@ def test_partitioned_run_empty_partitions(tmp_path):
 
 
 def test_partitioned_run_codec(tmp_path):
+    pytest.importorskip("zstandard", reason="zstd wheel absent")
     pairs = [(f"dup{i % 9}".encode(), b"x" * 64) for i in range(3000)]
     run, _ = _partition_sorted_run(pairs, 3)
     raw = str(tmp_path / "raw.prun")

@@ -24,7 +24,11 @@ from tez_tpu.shuffle.service import ShuffleService
 @pytest.fixture(scope="module")
 def certs(tmp_path_factory):
     """Self-signed CA signing one endpoint cert (mutual TLS: every
-    endpoint presents the same identity, verified against the CA)."""
+    endpoint presents the same identity, verified against the CA).
+    Skips when the environment can't generate fixtures (no cryptography
+    wheel) — the TLS plane itself is stdlib-ssl only."""
+    pytest.importorskip(
+        "cryptography", reason="cert-fixture generation needs cryptography")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa

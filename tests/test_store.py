@@ -1,0 +1,399 @@
+"""Tiered shuffle buffer store tests (tez_tpu/store): lease pinning,
+watermark demotion, byte-accounting invariants, epoch fencing, lineage
+seal/republish, and session-mode cross-DAG output reuse end-to-end."""
+from __future__ import annotations
+
+import collections
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from tez_tpu.common import epoch as epoch_registry
+from tez_tpu.common.epoch import EpochFencedError
+from tez_tpu.ops.runformat import KVBatch, Run
+from tez_tpu.store import ensure_store, local_buffer_store, reset_store
+from tez_tpu.store.buffer_store import (DEVICE, DISK, HOST,
+                                        ShuffleBufferStore, StoreKeyNotFound)
+
+
+def _run(n: int = 64, parts: int = 2, seed: int = 0,
+         dev_lanes: bool = False) -> Run:
+    rng = random.Random(seed)
+    pairs = [(b"k%06d" % rng.randrange(10_000), b"v%04d" % (i % 97))
+             for i in range(n)]
+    batch = KVBatch.from_pairs(sorted(pairs))
+    if dev_lanes:
+        # store accounting only needs .nbytes on each lane array, so plain
+        # numpy arrays stand in for HBM buffers here
+        batch.dev_keys = (np.zeros((n, 4), np.uint32),
+                          np.zeros(n, np.int32), 0, n)
+    bounds = np.linspace(0, n, parts + 1).astype(np.int64)
+    return Run(batch, bounds)
+
+
+def _pairs(batch: KVBatch):
+    return list(batch.iter_pairs())
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = ShuffleBufferStore(device_capacity=0, host_capacity=1 << 20,
+                           disk_dir=str(tmp_path / "store"))
+    yield s
+    s.close()
+
+
+# ------------------------------------------------------------- basic tiers
+
+def test_publish_fetch_roundtrip(store):
+    run = _run()
+    store.publish("dag1/a0/cons", -1, run)
+    for p in range(run.num_partitions):
+        got = store.fetch_partition("dag1/a0/cons", -1, p)
+        assert _pairs(got) == _pairs(run.partition(p))
+    assert store.counters["store.hits"] == run.num_partitions
+    assert store.tier_bytes(HOST) == run.nbytes
+    with pytest.raises(StoreKeyNotFound):
+        store.fetch_partition("dag1/a0/cons", 0, 0)
+    assert store.counters["store.misses"] == 1
+    assert store.unregister_prefix("dag1") == 1
+    assert store.tier_bytes(HOST) == 0
+    assert store.stats()["entries"] == 0
+
+
+def test_device_tier_accounts_lane_bytes_and_demotes(tmp_path):
+    s = ShuffleBufferStore(device_capacity=1 << 20, host_capacity=1 << 30,
+                           disk_dir=str(tmp_path / "d"))
+    try:
+        run = _run(dev_lanes=True)
+        lanes_nbytes = sum(a.nbytes for a in run.batch.dev_keys
+                          if hasattr(a, "nbytes"))
+        s.publish("dag1/a0/cons", -1, run)
+        assert s.tier_bytes(DEVICE) == lanes_nbytes
+        assert s.tier_bytes(HOST) == run.nbytes   # host arrays ride along
+        freed = s.relieve_device_pressure(1 << 30)
+        assert freed == lanes_nbytes
+        assert s.tier_bytes(DEVICE) == 0
+        assert s.counters["store.demotions.device_to_host"] == 1
+        # demotion dropped the lanes but data stays fetchable bit-exact
+        got = s.fetch_partition("dag1/a0/cons", -1, 0)
+        assert got.dev_keys is None
+        assert _pairs(got) == _pairs(run.partition(0))
+    finally:
+        s.close()
+
+
+def test_no_device_capacity_drops_lanes_at_publish(store):
+    store.publish("dag1/a0/cons", -1, _run(dev_lanes=True))
+    assert store.tier_bytes(DEVICE) == 0
+    assert store.get("dag1/a0/cons", -1).batch.dev_keys is None
+
+
+# --------------------------------------------------- watermarks and leases
+
+def test_watermark_demotion_cascade_host_to_disk(tmp_path):
+    run0 = _run(seed=0)
+    cap = run0.nbytes * 3
+    s = ShuffleBufferStore(device_capacity=0, host_capacity=cap,
+                           high_watermark=0.8, low_watermark=0.4,
+                           disk_dir=str(tmp_path / "d"))
+    try:
+        runs = [_run(seed=i) for i in range(8)]
+        for i, r in enumerate(runs):
+            s.publish(f"dag1/a{i}/cons", -1, r)
+        assert s.counters["store.demotions.host_to_disk"] >= 4
+        assert s.tier_bytes(HOST) <= cap * 0.8
+        assert s.tier_bytes(DISK) > 0
+        # every run still fetchable bit-exact, whichever tier it landed in
+        for i, r in enumerate(runs):
+            for p in range(r.num_partitions):
+                got = s.fetch_partition(f"dag1/a{i}/cons", -1, p)
+                assert _pairs(got) == _pairs(r.partition(p))
+    finally:
+        s.close()
+
+
+def test_lease_blocks_demotion_and_eviction(tmp_path):
+    run = _run(seed=1)
+    s = ShuffleBufferStore(device_capacity=0, host_capacity=run.nbytes * 2,
+                           high_watermark=0.5, low_watermark=0.1,
+                           disk_dir=str(tmp_path / "d"))
+    try:
+        s.publish("dag1/a0/cons", -1, run)
+        with s.lease("dag1/a0/cons", -1) as leased:
+            view = leased.partition(0)        # zero-copy view under lease
+            # pressure that would otherwise demote everything: the leased
+            # entry must be skipped even though it is the only candidate
+            assert s.relieve_host_pressure(1 << 30) == 0
+            s.publish("dag1/a1/cons", -1, _run(seed=2))   # watermark breach
+            assert s.tier_bytes(HOST) >= run.nbytes       # still resident
+            assert _pairs(view) == _pairs(run.partition(0))
+        # lease released: the same pressure now demotes it
+        assert s.relieve_host_pressure(1 << 30) > 0
+        assert s.counters["store.demotions.host_to_disk"] >= 1
+        got = s.fetch_partition("dag1/a0/cons", -1, 1)
+        assert _pairs(got) == _pairs(run.partition(1))
+    finally:
+        s.close()
+
+
+def test_leased_entry_survives_unregister(store):
+    run = _run()
+    store.publish("dag1/a0/cons", -1, run)
+    with store.lease("dag1/a0/cons", -1) as leased:
+        assert store.unregister_prefix("dag1") == 1
+        # the alias is gone but the reader's run stays whole until release
+        assert not store.contains("dag1/a0/cons", -1)
+        assert _pairs(leased.partition(0)) == _pairs(run.partition(0))
+    assert store.stats()["entries"] == 0
+    assert store.tier_bytes(HOST) == 0
+
+
+def test_disk_eviction_only_touches_sealed_lineage(tmp_path):
+    run = _run(seed=3)
+    s = ShuffleBufferStore(device_capacity=0, host_capacity=run.nbytes,
+                           disk_capacity=run.nbytes * 2,
+                           high_watermark=0.5, low_watermark=0.1,
+                           disk_dir=str(tmp_path / "d"))
+    try:
+        # live DAG outputs demoted to disk are never evicted, no matter
+        # how far over the disk watermark the tier goes
+        for i in range(4):
+            s.publish(f"dag1/a{i}/cons", -1, _run(seed=10 + i),
+                      lineage=f"lin{i}/0/cons")
+        assert s.tier_bytes(DISK) > s.disk_capacity * 0.5
+        assert s.counters["store.evictions.disk"] == 0
+        # sealed lineage-only entries ARE evictable once the DAG aliases go
+        assert s.seal_lineage("dag1") == 4
+        s.unregister_prefix("dag1")
+        s.publish("dag2/a0/cons", -1, _run(seed=20))   # trigger enforcement
+        assert s.counters["store.evictions.disk"] >= 1
+    finally:
+        s.close()
+
+
+# ----------------------------------------------------------- byte accounting
+
+def test_exact_byte_accounting_under_concurrency(tmp_path):
+    run0 = _run(seed=0)
+    s = ShuffleBufferStore(device_capacity=0, host_capacity=run0.nbytes * 4,
+                           high_watermark=0.8, low_watermark=0.4,
+                           disk_dir=str(tmp_path / "d"))
+    errors = []
+
+    def worker(w: int) -> None:
+        try:
+            for i in range(12):
+                path = f"dag1/w{w}_{i}/cons"
+                r = _run(seed=w * 100 + i)
+                s.publish(path, -1, r)
+                got = s.fetch_partition(path, -1, i % r.num_partitions)
+                assert got.num_records >= 0
+                if i % 3 == 0:
+                    s.unregister_prefix(path)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not errors, errors
+        # invariant: with no leases held, dropping every alias must return
+        # every tier to EXACTLY zero — any drift means an accounting bug
+        s.unregister_prefix("dag1")
+        assert s.stats()["entries"] == 0
+        assert s.tier_bytes(HOST) == 0
+        assert s.tier_bytes(DEVICE) == 0
+        assert s.tier_bytes(DISK) == 0
+    finally:
+        s.close()
+
+
+# ------------------------------------------------------------ epoch fencing
+
+def test_stale_epoch_publish_fenced(store):
+    epoch_registry.register("app_x", 3)
+    with pytest.raises(EpochFencedError):
+        store.publish("dag1/a0/cons", -1, _run(), epoch=2, app_id="app_x")
+    store.publish("dag1/a0/cons", -1, _run(), epoch=3, app_id="app_x")
+    assert store.contains("dag1/a0/cons", -1)
+
+
+def test_stale_epoch_sealed_lineage_misses(store):
+    epoch_registry.register("app_y", 1)
+    store.publish("dag1/a0/cons", -1, _run(), epoch=1, app_id="app_y",
+                  lineage="lin1/0/cons")
+    assert store.seal_lineage("dag1") == 1
+    assert store.lineage_spills("lin1/0/cons") == [-1]
+    # AM restarts: entries sealed by the superseded epoch are fenced out
+    epoch_registry.register("app_y", 2)
+    assert store.lineage_spills("lin1/0/cons") == []
+    with pytest.raises(EpochFencedError):
+        store.republish_lineage("lin1/0/cons", "dag2/a0/cons",
+                                epoch=1, app_id="app_y")
+
+
+# -------------------------------------------------------- lineage lifecycle
+
+def test_seal_republish_roundtrip(store):
+    run = _run(seed=5)
+    store.publish("dag1/a0/cons", -1, run, lineage="linA/0/cons")
+    store.publish("dag1/a0/cons", 0, _run(seed=6), lineage="linA/0/cons")
+    store.publish("dag1/a1/cons", -1, _run(seed=7))      # untagged: no seal
+    assert store.seal_lineage("dag1") == 2
+    assert store.counters["store.lineage.sealed"] == 2
+    # the DAG commits and its aliases drop; sealed entries survive
+    store.unregister_prefix("dag1")
+    assert store.lineage_spills("linA/0/cons") == [-1, 0]
+    assert store.lineage_spills("nope") == []
+    assert store.counters["store.lineage.misses"] == 1
+    # a recurring DAG aliases them under its own path, zero copy
+    assert store.republish_lineage("linA/0/cons",
+                                   "dag2/a9/cons") == [-1, 0]
+    got = store.fetch_partition("dag2/a9/cons", -1, 1)
+    assert _pairs(got) == _pairs(run.partition(1))
+    # dropping the new DAG still leaves the sealed copy for the next hit
+    store.unregister_prefix("dag2")
+    assert store.lineage_spills("linA/0/cons") == [-1, 0]
+
+
+# ------------------------------------------------------- singleton lifecycle
+
+def test_ensure_store_disabled_by_default():
+    assert ensure_store({}) is None
+    assert local_buffer_store() is None
+
+
+def test_ensure_store_conf_knobs_and_reset(tmp_path):
+    from tez_tpu.shuffle.service import local_shuffle_service
+    conf = {"tez.runtime.store.enabled": "true",
+            "tez.runtime.store.device.capacity-mb": 2,
+            "tez.runtime.store.host.capacity-mb": 0.5,
+            "tez.runtime.store.dir": str(tmp_path / "s")}
+    s = ensure_store(conf)
+    try:
+        assert s is not None
+        assert s is local_buffer_store()
+        assert ensure_store(conf) is s                 # idempotent
+        assert s.device_capacity == 2 << 20
+        assert s.host_capacity == (1 << 20) // 2       # fractional MB
+        assert local_shuffle_service().buffer_store() is s
+        # registrations route through the store via the service seam
+        run = _run()
+        local_shuffle_service().register("dagZ/a0/cons", -1, run)
+        assert s.contains("dagZ/a0/cons", -1)
+        got = local_shuffle_service().fetch_partition("dagZ/a0/cons", -1, 0)
+        assert _pairs(got) == _pairs(run.partition(0))
+        assert s.counters["store.hits"] == 1
+    finally:
+        reset_store()
+    assert local_buffer_store() is None
+    assert local_shuffle_service().buffer_store() is None
+
+
+# --------------------------------------------- session-mode cross-DAG reuse
+
+def _write_corpus(path, num_lines=200, seed=0):
+    rng = random.Random(seed)
+    words = [f"w{i:02d}" for i in range(25)]
+    counts = collections.Counter()
+    with open(path, "w") as fh:
+        for _ in range(num_lines):
+            line = [rng.choice(words) for _ in range(6)]
+            counts.update(line)
+            fh.write(" ".join(line) + "\n")
+    return counts
+
+
+def _read_out(out_dir):
+    import os
+    blobs = []
+    for name in sorted(os.listdir(out_dir)):
+        if not name.startswith("part-"):
+            continue
+        with open(os.path.join(out_dir, name), "rb") as fh:
+            blobs.append(fh.read())
+    assert blobs, f"no part- files in {out_dir}"
+    return b"".join(blobs)
+
+
+def test_session_cross_dag_output_reuse(tmp_path):
+    """Two identical wordcount DAGs in one session: the second run's
+    tokenizer/summation tasks must be served from sealed store lineage
+    (processors skipped), the leaf sorter vertex must recompute (file
+    outputs cannot reuse), and the outputs must be bit-exact."""
+    from tez_tpu.client.dag_client import DAGStatusState
+    from tez_tpu.client.tez_client import TezClient
+    from tez_tpu.examples import ordered_wordcount
+
+    corpus = tmp_path / "in.txt"
+    _write_corpus(str(corpus))
+    conf = {"tez.staging-dir": str(tmp_path / "staging"),
+            "tez.am.local.num-containers": 4,
+            "tez.runtime.store.enabled": True,
+            "tez.runtime.store.host.capacity-mb": 64}
+    outs = []
+    try:
+        for i, name in enumerate(("sess_run1", "sess_run2")):
+            out = str(tmp_path / f"out{i}")
+            dag = ordered_wordcount.build_dag(
+                [str(corpus)], out, tokenizer_parallelism=3,
+                summation_parallelism=2, sorter_parallelism=1)
+            with TezClient.create(name, conf) as client:
+                status = client.submit_dag(dag).wait_for_completion(
+                    timeout=90)
+            assert status.state is DAGStatusState.SUCCEEDED
+            outs.append(_read_out(out))
+        store = local_buffer_store()
+        assert store is not None
+        c = store.stats()["counters"]
+        # run 1 sealed its tokenizer+summation outputs (3 + 2 tasks); run 2
+        # hit them — 5 probes (one per task) plus republishes count as hits
+        assert c["store.lineage.sealed"] >= 5
+        assert c["store.lineage.hits"] >= 5
+        assert outs[0] == outs[1]
+    finally:
+        reset_store()
+
+
+def test_lineage_hashes_stable_and_conf_sensitive(tmp_path):
+    """vertex_lineage_hashes: identical plans hash identically; changing a
+    vertex conf knob changes that vertex AND its downstream closure."""
+    from tez_tpu.examples import ordered_wordcount
+    from tez_tpu.store.lineage import task_lineage, vertex_lineage_hashes
+
+    def plan(extra=None):
+        dag = ordered_wordcount.build_dag(
+            [str(tmp_path / "in.txt")], str(tmp_path / "out"),
+            tokenizer_parallelism=2, summation_parallelism=2,
+            sorter_parallelism=1)
+        if extra:
+            dag.vertices["summation"].set_conf("x.knob", extra)
+        return dag.create_dag_plan()
+
+    h1, h2 = vertex_lineage_hashes(plan()), vertex_lineage_hashes(plan())
+    assert h1 == h2 and set(h1) == {"tokenizer", "summation", "sorter"}
+    h3 = vertex_lineage_hashes(plan(extra="v2"))
+    assert h3["tokenizer"] == h1["tokenizer"]       # upstream untouched
+    assert h3["summation"] != h1["summation"]       # changed vertex
+    assert h3["sorter"] != h1["sorter"]             # downstream closure
+    assert task_lineage(h1["summation"], 1, "sorter") == \
+        f"{h1['summation']}/1/sorter"
+    assert task_lineage("", 1, "sorter") == ""      # lineage off
+
+
+# ------------------------------------------------------------ chaos harness
+
+def test_chaos_store_pressure_scenario(tmp_path):
+    """The `--store-pressure` chaos scenario: a wide shuffle through tiny
+    store tiers must demote/evict mid-merge and stay bit-exact."""
+    from tez_tpu.tools import chaos
+    ok, detail = chaos.run_store_pressure(0, str(tmp_path))
+    assert ok, detail
+    assert "churn=" in detail

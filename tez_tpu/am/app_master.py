@@ -300,8 +300,16 @@ class DAGAppMaster:
                 self._dag_done.notify_all()
             return
         # deletion tracking: drop the finished DAG's shuffle data
-        # (reference: ContainerLauncherManager DeletionTracker)
+        # (reference: ContainerLauncherManager DeletionTracker).  A store-
+        # backed session seals lineage-tagged outputs FIRST so identical
+        # recurring DAGs reuse them after this DAG's keys are released.
         from tez_tpu.shuffle.service import local_shuffle_service
+        store = local_shuffle_service().buffer_store()
+        if store is not None and final is DAGState.SUCCEEDED:
+            sealed = store.seal_lineage(str(dag.dag_id))
+            if sealed:
+                log.info("dag %s: sealed %d outputs for lineage reuse",
+                         dag.dag_id, sealed)
         n = local_shuffle_service().unregister_prefix(str(dag.dag_id))
         if n:
             log.info("dag %s: released %d shuffle outputs", dag.dag_id, n)
@@ -364,6 +372,15 @@ class DAGAppMaster:
             from tez_tpu.am.speculation import Speculator
             dag.speculator = Speculator(dag)
             dag.speculator.start()
+        # tiered buffer store: created on the first DAG that enables it and
+        # shared by the whole session; lineage hashes let recurring DAGs
+        # reuse sealed outputs (computed per-submit — they depend only on
+        # the plan, not the dag id)
+        from tez_tpu.store import ensure_store
+        if ensure_store(dag.conf) is not None and \
+                dag.conf.get(C.STORE_LINEAGE_REUSE):
+            from tez_tpu.store.lineage import vertex_lineage_hashes
+            dag.lineage_hashes = vertex_lineage_hashes(plan)
         # fault plane (test/chaos only): rules arm with the DAG and disarm
         # with it in on_dag_finished — per-DAG scoping
         from tez_tpu.common import faults

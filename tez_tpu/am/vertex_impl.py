@@ -801,6 +801,8 @@ class VertexImpl:
             conf=dict(self.conf),
             am_epoch=getattr(self.dag.ctx, "attempt", 0),
             trace_context=getattr(self.dag, "trace_carrier", ""),
+            lineage=getattr(self.dag, "lineage_hashes", {}).get(self.name,
+                                                                ""),
         )
 
     def status_dict(self) -> Dict[str, Any]:

@@ -557,6 +557,46 @@ MERGE_ASYNC_DEPTH = _key(
 HOST_SPILL_DIR = _key("tez.runtime.tpu.host.spill.dir", "", Scope.VERTEX,
                       "Where device buffers spill when HBM budget is exceeded; "
                       "'' = <staging>/spill")
+STORE_ENABLED = _key(
+    "tez.runtime.store.enabled", False, Scope.AM,
+    "route shuffle outputs through the tiered buffer store "
+    "(tez_tpu.store): a reference-counted HBM->host->disk object store "
+    "with lease pinning, watermark LRU demotion, and epoch-fenced keys.  "
+    "Off = the historical bare-registry data plane")
+STORE_DEVICE_CAPACITY_MB = _key(
+    "tez.runtime.store.device.capacity-mb", 256, Scope.AM,
+    "HBM pool budget for store-resident sorted key lanes; crossing the "
+    "high watermark demotes LRU unleased entries to the host tier "
+    "(drops their device lanes); 0 = no device tier (lanes drop at "
+    "publish)")
+STORE_HOST_CAPACITY_MB = _key(
+    "tez.runtime.store.host.capacity-mb", 1024, Scope.AM,
+    "host-RAM pool budget for store-resident runs; crossing the high "
+    "watermark demotes LRU unleased entries to the disk tier "
+    "(partition-indexed .prun files)")
+STORE_DISK_CAPACITY_MB = _key(
+    "tez.runtime.store.disk.capacity-mb", 0, Scope.AM,
+    "disk pool budget; only sealed cross-DAG lineage entries are ever "
+    "evicted from disk (live DAG outputs are never dropped); "
+    "0 = unbounded")
+STORE_HIGH_WATERMARK = _key(
+    "tez.runtime.store.watermark.high", 0.90, Scope.AM,
+    "tier occupancy fraction that triggers LRU demotion")
+STORE_LOW_WATERMARK = _key(
+    "tez.runtime.store.watermark.low", 0.70, Scope.AM,
+    "demotion cascade stops once tier occupancy drops below this "
+    "fraction")
+STORE_DIR = _key(
+    "tez.runtime.store.dir", "", Scope.AM,
+    "disk-tier directory for demoted runs and sealed lineage segments; "
+    "'' = a per-process temp dir removed on reset")
+STORE_LINEAGE_REUSE = _key(
+    "tez.runtime.store.lineage.reuse", True, Scope.AM,
+    "session mode: committed vertex outputs are sealed under "
+    "(vertex spec hash, task index, epoch) lineage keys and served as "
+    "cache hits to identical recurring DAGs — the producer task "
+    "republishes the stored runs instead of recomputing.  Only "
+    "meaningful when the store is enabled")
 
 
 def runtime_conf_subset(conf: Mapping) -> "TezConfiguration":

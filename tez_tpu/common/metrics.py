@@ -127,7 +127,11 @@ WELL_KNOWN_HISTOGRAMS = ("shuffle.fetch.rtt", "spill.write", "shuffle.merge",
                          "device.merge",
                          # host-engine failover re-sorts (failure
                          # containment, ops/async_stage.py)
-                         "device.failover.host_sort")
+                         "device.failover.host_sort",
+                         # tiered buffer store (tez_tpu/store): publish
+                         # admission, leased fetch, and watermark demotion
+                         # (host->disk spill happens inside the demote timer)
+                         "store.publish", "store.fetch", "store.demote")
 
 
 class MetricsRegistry:

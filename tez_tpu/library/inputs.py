@@ -135,8 +135,25 @@ class ShuffleFetchTable:
                 stall_timeout=float(
                     _k(C.SHUFFLE_SPECULATIVE_FETCH_WAIT_MS)) / 1e3,
                 session_ttl=float(
-                    _k(C.SHUFFLE_FETCH_SESSION_TTL_MS)) / 1e3)
+                    _k(C.SHUFFLE_FETCH_SESSION_TTL_MS)) / 1e3,
+                local_probe=self._store_probe
+                if self.service.buffer_store() is not None else None)
         return self._scheduler
+
+    def _store_probe(self, path: str, spill: int,
+                     partition: int) -> Optional[KVBatch]:
+        """Buffer-store short-circuit for the remote pool: a fetch whose
+        data this process already holds (store-registered or lineage-
+        republished) is served zero-copy instead of over TCP."""
+        try:
+            batch = self.service.fetch_partition(
+                path, spill, partition, counters=self.context.counters)
+        except ShuffleDataNotFound:
+            return None
+        with self._deliver_lock:
+            self.context.counters.find_counter(
+                "ShuffleStore", "store.short_circuit").increment(1)
+        return batch
 
     def shutdown(self) -> None:
         self._closing = True

@@ -172,7 +172,55 @@ class OrderedPartitionedKVOutput(LogicalOutput):
         self.service = local_shuffle_service()
         self.host = ctx.get_service_provider_metadata("shuffle") or \
             {"host": "local", "port": 0}
+        # tiered buffer store: runners create their own process store from
+        # the task conf (in-process mode finds the AM's); outputs publish
+        # with a lineage tag so a later identical DAG can reuse them
+        from tez_tpu.store import ensure_store
+        merged: Dict[str, Any] = dict(ctx.conf)
+        payload = ctx.user_payload.load()
+        if isinstance(payload, dict):
+            merged.update(payload)
+        ensure_store(merged)
+        self._lineage = ""
+        self._reused = False
+        self._reuse_ready = False
+        if not self._pipelined and bool(_conf_get(
+                ctx, "tez.runtime.store.lineage.reuse", True)):
+            from tez_tpu.store.lineage import task_lineage
+            self._lineage = task_lineage(
+                getattr(ctx, "lineage", ""), ctx.task_index,
+                ctx.destination_vertex_name)
+        store = self.service.buffer_store()
+        if self._lineage and store is not None:
+            # a non-pipelined output seals exactly one run (spill -1);
+            # anything else means a partial/incompatible seal — recompute
+            self._reuse_ready = store.lineage_spills(
+                self._lineage, app_id=getattr(ctx, "app_id", "")) == [-1]
         return []
+
+    # -- cross-DAG output reuse (session mode) -------------------------------
+    def reuse_available(self) -> bool:
+        """True when the store holds this task's sealed output from an
+        identical earlier DAG — the runner may then skip the processor and
+        publish_reused() instead of recomputing."""
+        return self._reuse_ready
+
+    def publish_reused(self) -> List[TezAPIEvent]:
+        """Alias the sealed lineage run under this attempt's path (zero
+        copy) and emit the same DME/VM events a fresh sort would."""
+        store = self.service.buffer_store()
+        ctx = self.context
+        path = output_path_component(ctx)
+        store.republish_lineage(self._lineage, path,
+                                epoch=getattr(ctx, "am_epoch", 0),
+                                app_id=getattr(ctx, "app_id", ""),
+                                counters=ctx.counters)
+        run = store.get(path, -1)
+        ctx.counters.increment(TaskCounter.OUTPUT_BYTES_PHYSICAL, run.nbytes)
+        ctx.counters.find_counter("ShuffleStore",
+                                  "store.reuse.outputs").increment(1)
+        self._reused = True
+        return self._events_for_run(run, -1, True)
 
     def get_writer(self) -> Writer:
         return _SorterWriter(self.sorter, self.key_serde, self.val_serde,
@@ -237,13 +285,19 @@ class OrderedPartitionedKVOutput(LogicalOutput):
             run = FileRun(path)
         self.service.register(output_path_component(self.context), spill_id,
                               run, epoch=getattr(self.context, "am_epoch", 0),
-                              app_id=getattr(self.context, "app_id", ""))
+                              app_id=getattr(self.context, "app_id", ""),
+                              lineage=self._lineage,
+                              counters=self.context.counters)
         # last=False; close() sends the final marker
         self.context.send_events(self._events_for_run(run, spill_id, False))
         self._spills_sent += 1
         self.context.counters.increment(TaskCounter.SHUFFLE_CHUNK_COUNT)
 
     def close(self) -> List[TezAPIEvent]:
+        if self._reused:
+            # publish_reused() already registered + announced the output;
+            # flushing the (empty) sorter would clobber the reused run
+            return []
         final_run = self.sorter.flush_run()
         if self._pipelined:
             # final empty marker event with last_event=True for completeness
@@ -257,14 +311,17 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                                   self._spills_sent,
                                   _empty_run(self.num_physical_outputs),
                                   epoch=getattr(self.context, "am_epoch", 0),
-                                  app_id=getattr(self.context, "app_id", ""))
+                                  app_id=getattr(self.context, "app_id", ""),
+                                  counters=self.context.counters)
             return [CompositeDataMovementEvent(0, self.num_physical_outputs,
                                                payload)]
         assert final_run is not None
         self.service.register(output_path_component(self.context), -1,
                               final_run,
                               epoch=getattr(self.context, "am_epoch", 0),
-                              app_id=getattr(self.context, "app_id", ""))
+                              app_id=getattr(self.context, "app_id", ""),
+                              lineage=self._lineage,
+                              counters=self.context.counters)
         self.context.counters.increment(
             TaskCounter.OUTPUT_BYTES_PHYSICAL, final_run.nbytes)
         return self._events_for_run(final_run, -1, True)

@@ -73,12 +73,15 @@ decisions emit ``DeviceFailover`` counters (``device.failover.spans``,
 from __future__ import annotations
 
 import collections
+import logging
 import queue
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from tez_tpu.common import faults, metrics, tracing
+
+log = logging.getLogger(__name__)
 
 #: Stage names, in pipeline order (also the tracing span names).
 STAGE_ENCODE = "device.encode"
@@ -242,6 +245,47 @@ def reset_process_breaker() -> None:
     with _PROC_BREAKER_LOCK:
         _PROC_BREAKER = None
     metrics.set_gauge(_BREAKER_GAUGE, 0.0)
+
+
+# -- device memory pressure hooks (evict-then-split) -------------------------
+# The tiered buffer store (tez_tpu.store) registers its
+# relieve_device_pressure here; the RESOURCE_EXHAUSTED ladder calls
+# relieve_pressure() BEFORE halving a span, so HBM held by evictable
+# store entries (cold resident key lanes) is reclaimed first and the
+# span often retries whole instead of paying the split merge.
+
+_PRESSURE_HOOKS: List[Callable[[int], int]] = []
+_PRESSURE_LOCK = threading.Lock()
+
+
+def register_pressure_hook(fn: Callable[[int], int]) -> None:
+    """Register a callback (nbytes_wanted -> nbytes_freed)."""
+    with _PRESSURE_LOCK:
+        if fn not in _PRESSURE_HOOKS:
+            _PRESSURE_HOOKS.append(fn)
+
+
+def clear_pressure_hooks() -> None:
+    with _PRESSURE_LOCK:
+        _PRESSURE_HOOKS.clear()
+
+
+def relieve_pressure(nbytes: int, counters: Any = None) -> int:
+    """Ask every registered hook to free device memory; returns the total
+    bytes reclaimed (0 when no hook is registered or nothing is
+    evictable)."""
+    with _PRESSURE_LOCK:
+        hooks = list(_PRESSURE_HOOKS)
+    freed = 0
+    for fn in hooks:
+        try:
+            freed += int(fn(int(nbytes)))
+        except Exception:  # noqa: BLE001 — relief is best-effort
+            log.exception("pressure hook failed")
+    if freed > 0:
+        _count(counters, "device.oom.evicted_bytes", freed)
+        _count(counters, "device.oom.evict_relief")
+    return freed
 
 
 class _DaemonPool:

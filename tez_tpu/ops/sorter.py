@@ -429,11 +429,29 @@ class DeviceSorter:
         return run
 
     def _async_oom_retry(self, ids, payloads) -> Run:
-        """RESOURCE_EXHAUSTED ladder: retry ON DEVICE with the span halved
-        (recursively, down to split_min_bytes) before the host engine takes
-        over.  Merging the stably-sorted halves with run-age tie order
-        equals the stable sort of the whole span — bit-exact."""
+        """RESOURCE_EXHAUSTED ladder: EVICT then split.  First ask the
+        buffer store's pressure hooks to reclaim HBM (cold resident key
+        lanes demote to the host tier) and retry the WHOLE span on
+        device; only when nothing was evictable — or the whole-span
+        retry OOMs again — fall to the halving split (recursively, down
+        to split_min_bytes) before the host engine takes over.  Merging
+        the stably-sorted halves with run-age tie order equals the
+        stable sort of the whole span — bit-exact."""
+        from tez_tpu.ops import async_stage
+        from tez_tpu.ops.device import is_resource_exhausted
         batch, custom_parts = self._group_batch(ids, payloads)
+        freed = async_stage.relieve_pressure(batch.nbytes, self.counters)
+        if freed > 0:
+            try:
+                run = self.sort_batch(batch,
+                                      custom_partitions=custom_parts,
+                                      engine="device")
+                if self.combiner is not None:
+                    run = self.combiner(run)
+                return run
+            except BaseException as e:  # noqa: BLE001 — ladder continues
+                if not is_resource_exhausted(e):
+                    raise
         run = self._split_device_sort(batch, custom_parts,
                                       detail=f"span={min(ids)}")
         if self.combiner is not None:

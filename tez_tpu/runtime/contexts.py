@@ -68,6 +68,11 @@ class _BaseContext:
         return self._runner.spec.attempt_id.dag_id.app_id
 
     @property
+    def lineage(self) -> str:
+        """Vertex lineage hash for store output reuse ("" = off)."""
+        return getattr(self._runner.spec, "lineage", "")
+
+    @property
     def counters(self) -> TezCounters:
         return self._runner.counters
 

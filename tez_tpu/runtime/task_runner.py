@@ -119,7 +119,12 @@ class TaskRunner:
                 with tracing.span("initialize", cat="task"):
                     self._initialize()
                 with tracing.span("run", cat="task"):
-                    self._run_processor()
+                    if self._try_reuse():
+                        log.info("task %s: outputs served from store "
+                                 "lineage — processor skipped",
+                                 self.spec.attempt_id)
+                    else:
+                        self._run_processor()
                 with tracing.span("close", cat="task"):
                     self._close()
             state = "SUCCEEDED"
@@ -214,6 +219,28 @@ class TaskRunner:
             self._inputs_ready.set()
             if trapped:
                 self._dispatch_incoming(trapped)
+
+    def _try_reuse(self) -> bool:
+        """Cross-DAG output reuse: when EVERY output reports a sealed store
+        run for this task's lineage, alias them under this attempt's path
+        and skip the processor entirely.  Any output that can't reuse (leaf
+        outputs, pipelined shuffle, lineage off/miss) forces a full run —
+        partial reuse would publish a mix of old and new data."""
+        self.check_killed()
+        outs = list(self.outputs.values())
+        if not outs:
+            return False
+        for out in outs:
+            probe = getattr(out, "reuse_available", None)
+            if probe is None or not probe():
+                return False
+        for out in outs:
+            evs = out.publish_reused() or []
+            if evs:
+                out.context.send_events(evs)
+        self.counters.find_counter("ShuffleStore",
+                                   "store.reuse.tasks").increment(1)
+        return True
 
     def _run_processor(self) -> None:
         self.check_killed()

@@ -58,6 +58,11 @@ class TaskSpec:
     #: attempt to the DAG's root span when the tracing plane is armed
     #: ("" = tracing disarmed; the runner then starts no spans).
     trace_context: str = ""
+    #: Content-addressed lineage hash of this task's vertex (spec + upstream
+    #: closure, see tez_tpu.store.lineage).  Outputs publish under
+    #: "<hash>/<task_index>/<dest>" so identical recurring DAGs in a session
+    #: can reuse sealed store entries ("" = lineage reuse off).
+    lineage: str = ""
 
     @property
     def task_index(self) -> int:

@@ -73,10 +73,11 @@ class _Host:
 class _Inflight:
     __slots__ = ("host_key", "requests", "started")
 
-    def __init__(self, host_key: HostKey, requests: List[FetchRequest]):
+    def __init__(self, host_key: HostKey, requests: List[FetchRequest],
+                 started: float):
         self.host_key = host_key
         self.requests = requests
-        self.started = time.time()
+        self.started = started
 
 
 def TcpFetchSession(secrets: Any, host: str, port: int,
@@ -112,9 +113,18 @@ class FetchScheduler:
                  stall_timeout: float = 15.0,
                  name: str = "shuffle",
                  penalty_rng: Optional[random.Random] = None,
-                 session_ttl: float = 30.0):
+                 session_ttl: float = 30.0,
+                 clock: Callable[[], float] = time.time,
+                 local_probe: Optional[Callable[[str, int, int], Any]] = None):
         self.deliver = deliver
         self.session_factory = session_factory
+        # injectable clock drives every TTL/penalty/stall decision so tests
+        # can step time deterministically (never touches perf_counter RTTs)
+        self._clock = clock
+        # store short-circuit: a probe that returns the batch when this
+        # host already holds the data (same-host buffer store) — probed
+        # requests never open a connection
+        self.local_probe = local_probe
         self.num_fetchers = max(1, num_fetchers)
         self.max_per_fetch = max(1, max_per_fetch)
         self.penalty_base = penalty_base
@@ -194,11 +204,25 @@ class FetchScheduler:
     def _checkout_session(self, host: _Host) -> Any:
         """Reuse the host's cached session, or connect a new one.  The
         connect happens OUTSIDE the lock (it can block for seconds); the
-        open-session slot is reserved first so the bound can't be raced."""
+        open-session slot is reserved first so the bound can't be raced.
+
+        TTL is validated HERE, not only in the referee sweep: a session
+        that idled past session_ttl may already be half-closed by the
+        server, and the referee may simply not have woken yet — reusing
+        it would fail the whole batch and penalize a healthy host.  The
+        expired session is closed and a fresh connect replaces it (the
+        slot count carries over 1:1)."""
         with self.lock:
             cached = self._session_cache.pop(host.key, None)
             if cached is not None:
-                return cached[0]          # already counted in _open_sessions
+                sess, last = cached
+                if self.session_ttl > 0 and \
+                        self._clock() - last >= self.session_ttl:
+                    # stale: close (releases its slot) and fall through to
+                    # the fresh-connect path, which re-reserves a slot
+                    self._close_session(sess)
+                else:
+                    return sess       # already counted in _open_sessions
             while self._open_sessions >= self.num_fetchers and \
                     self._session_cache:
                 oldest = min(self._session_cache,
@@ -222,7 +246,7 @@ class FetchScheduler:
                 # or when a concurrent speculative batch already cached one
                 self._close_session(session)
             else:
-                self._session_cache[host.key] = (session, time.time())
+                self._session_cache[host.key] = (session, self._clock())
                 self.lock.notify_all()    # referee recomputes TTL deadline
 
     def _make_ready(self, host: _Host) -> None:
@@ -249,7 +273,8 @@ class FetchScheduler:
                     self._make_ready(host)
                     continue
                 host.active += 1
-                self.inflight[worker_id] = _Inflight(host.key, batch_reqs)
+                self.inflight[worker_id] = _Inflight(host.key, batch_reqs,
+                                                     self._clock())
                 self.lock.notify_all()   # referee recomputes its deadline
             self._fetch_batch(worker_id, host, batch_reqs)
 
@@ -257,6 +282,32 @@ class FetchScheduler:
                      reqs: List[FetchRequest]) -> None:
         """ONE session fetches every request (coalescing); reused from the
         per-host cache across batches when the last one ended healthy."""
+        if self.local_probe is not None:
+            # store short-circuit: serve what this host already holds and
+            # connect only for the remainder (zero-copy same-host path)
+            remaining: List[FetchRequest] = []
+            for req in reqs:
+                try:
+                    batch = self.local_probe(req.path, req.spill,
+                                             req.partition)
+                except BaseException:  # noqa: BLE001 — probe is best-effort
+                    batch = None
+                if batch is None:
+                    remaining.append(req)
+                    continue
+                metrics.observe("shuffle.fetch.short_circuit", 0.0)
+                tracing.event("shuffle.fetch.short_circuit",
+                              parent=req.trace, src=req.path,
+                              spill=req.spill, partition=req.partition)
+                self._deliver_once(req, batch, None)
+            reqs = remaining
+            if not reqs:
+                with self.lock:
+                    self.inflight.pop(worker_id, None)
+                    host.active -= 1
+                    self._make_ready(host)
+                    self.lock.notify()
+                return
         session = None
         completed = 0
         failed_conn: Optional[Exception] = None
@@ -343,7 +394,7 @@ class FetchScheduler:
         if host.pending:
             host.penalized = True
             heapq.heappush(self.penalties,
-                           (time.time() + penalty, host.key))
+                           (self._clock() + penalty, host.key))
             tracing.event("shuffle.penalty_box",
                           parent=rest[0].trace if rest else None,
                           host=f"{host.key[0]}:{host.key[1]}",
@@ -360,7 +411,7 @@ class FetchScheduler:
         the earliest deadline (penalty expiry or stall) rather than polling."""
         with self.lock:
             while not self._stopped:
-                now = time.time()
+                now = self._clock()
                 # keep-alive TTL sweep: cached sessions idle past
                 # session_ttl are closed so quiesced hosts don't pin
                 # sockets (and server-side handler threads) forever
@@ -429,5 +480,5 @@ class FetchScheduler:
                     if deadline is None or ttl_at < deadline:
                         deadline = ttl_at
                 wait = 5.0 if deadline is None else \
-                    max(0.01, deadline - time.time())
+                    max(0.01, deadline - self._clock())
                 self.lock.wait(wait)

@@ -49,6 +49,7 @@ class ShuffleService:
         self._runs: Dict[Tuple[str, int], Run] = {}
         self._lock = threading.Lock()
         self._store: Any = None
+        self._buffer: Any = None
 
     def attach_store(self, store: Any) -> None:
         """Write-through persistence (FileShuffleStore): every registered
@@ -60,9 +61,21 @@ class ShuffleService:
     def has_store(self) -> bool:
         return self._store is not None
 
+    def attach_buffer_store(self, store: Any) -> None:
+        """Delegate run storage to the tiered buffer store
+        (tez_tpu.store.ShuffleBufferStore): new registrations publish
+        into its capacity-governed HBM/host/disk tiers and fetches serve
+        from it under leases.  Runs registered before attach stay in the
+        bare registry and keep working."""
+        self._buffer = store
+
+    def buffer_store(self) -> Any:
+        return self._buffer
+
     # -- producer side -------------------------------------------------------
     def register(self, path_component: str, spill_id: int, run: Run,
-                 epoch: int = 0, app_id: str = "") -> None:
+                 epoch: int = 0, app_id: str = "",
+                 lineage: str = "", counters: Any = None) -> None:
         """Producers stamped with an AM epoch are fenced: a zombie task from
         a pre-restart incarnation must not (re-)register outputs the live
         AM's re-runs now own.  Unstamped registrations (epoch 0, e.g. direct
@@ -79,8 +92,13 @@ class ShuffleService:
                 f"shuffle register from stale epoch {epoch} "
                 f"(current {epoch_registry.current(app_id)}): "
                 f"{path_component}/{spill_id}")
-        with self._lock:
-            self._runs[(path_component, spill_id)] = run
+        if self._buffer is not None:
+            self._buffer.publish(path_component, spill_id, run,
+                                 epoch=epoch, app_id=app_id,
+                                 lineage=lineage, counters=counters)
+        else:
+            with self._lock:
+                self._runs[(path_component, spill_id)] = run
         from tez_tpu.common import tracing
         tracing.event("shuffle.register", src=f"{path_component}/{spill_id}",
                       nbytes=getattr(run, "nbytes", 0))
@@ -106,13 +124,41 @@ class ShuffleService:
             deleter = getattr(run, "delete", None)
             if deleter is not None:
                 deleter()
+        n = len(victims)
+        if self._buffer is not None:
+            n += self._buffer.unregister_prefix(prefix)
         if self._store is not None:
             self._store.unregister_prefix(prefix)
-        return len(victims)
+        return n
 
     # -- consumer side (local short-circuit) ---------------------------------
+    def _lookup(self, path_component: str, spill_id: int) -> Optional[Any]:
+        """The run under a key: bare registry first, then the buffer
+        store (unleased peek — slicing a returned run is safe because
+        demotion never invalidates live views, see docs/store.md)."""
+        with self._lock:
+            run = self._runs.get((path_component, spill_id))
+        if run is None and self._buffer is not None:
+            run = self._buffer.get(path_component, spill_id)
+        return run
+
     def fetch_partition(self, path_component: str, spill_id: int,
-                        partition: int) -> KVBatch:
+                        partition: int, counters: Any = None) -> KVBatch:
+        if self._buffer is not None:
+            try:
+                batch = self._buffer.fetch_partition(
+                    path_component, spill_id, partition, counters=counters)
+            except FileNotFoundError:
+                raise ShuffleDataNotFound(
+                    f"{path_component}/{spill_id}") from None
+            except Exception as e:
+                if type(e).__name__ != "StoreKeyNotFound":
+                    raise
+                batch = None
+            if batch is not None:
+                if faults.armed():
+                    batch = _maybe_corrupt(path_component, spill_id, batch)
+                return batch
         with self._lock:
             run = self._runs.get((path_component, spill_id))
         if run is None:
@@ -131,8 +177,7 @@ class ShuffleService:
 
     def fetch_partition_range(self, path_component: str, spill_id: int,
                               start: int, stop: int) -> List[KVBatch]:
-        with self._lock:
-            run = self._runs.get((path_component, spill_id))
+        run = self._lookup(path_component, spill_id)
         if run is None:
             raise ShuffleDataNotFound(f"{path_component}/{spill_id}")
         try:
@@ -148,16 +193,14 @@ class ShuffleService:
         partition_nbytes) so a same-host consumer can merge straight off
         the producer's partition-indexed file — no materialization, no
         re-spill.  None when the run is RAM-resident or unknown."""
-        with self._lock:
-            run = self._runs.get((path_component, spill_id))
+        run = self._lookup(path_component, spill_id)
         if run is None or not hasattr(run, "iter_partition_blocks"):
             return None
         return run.path, run.partition_nbytes(partition)
 
     def partition_size(self, path_component: str, spill_id: int,
                        partition: int) -> int:
-        with self._lock:
-            run = self._runs.get((path_component, spill_id))
+        run = self._lookup(path_component, spill_id)
         if run is None:
             raise ShuffleDataNotFound(f"{path_component}/{spill_id}")
         try:
@@ -168,7 +211,13 @@ class ShuffleService:
 
     def stats(self) -> Tuple[int, int]:
         with self._lock:
-            return len(self._runs), sum(r.nbytes for r in self._runs.values())
+            n = len(self._runs)
+            nbytes = sum(r.nbytes for r in self._runs.values())
+        if self._buffer is not None:
+            s = self._buffer.stats()
+            n += s["entries"]
+            nbytes += sum(s["bytes"].values())
+        return n, nbytes
 
 
 _local = ShuffleService()

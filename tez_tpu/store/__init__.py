@@ -1,0 +1,83 @@
+"""Tiered shuffle buffer store (docs/store.md).
+
+``local_buffer_store()`` is the per-process singleton, mirroring
+``local_shuffle_service()``: producers publish through the shuffle
+service's delegation seam, consumers short-circuit fetches, and the AM
+seals lineage keys on DAG commit.  ``ensure_store(conf)`` creates it from
+the ``tez.runtime.store.*`` knobs on first use and attaches it to the
+local shuffle service; it returns None while the store is disabled so
+every call site stays zero-cost on the historical path.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from tez_tpu.store.buffer_store import (COUNTER_GROUP, DEVICE, DISK, HOST,
+                                        LINEAGE_PREFIX, ShuffleBufferStore,
+                                        StoreKeyNotFound)
+
+__all__ = ["ShuffleBufferStore", "StoreKeyNotFound", "local_buffer_store",
+           "ensure_store", "reset_store", "COUNTER_GROUP", "LINEAGE_PREFIX",
+           "DEVICE", "HOST", "DISK"]
+
+_lock = threading.Lock()
+_store: Optional[ShuffleBufferStore] = None
+
+
+def local_buffer_store() -> Optional[ShuffleBufferStore]:
+    """The process store, or None when no DAG enabled it yet."""
+    return _store
+
+
+def ensure_store(conf: Any) -> Optional[ShuffleBufferStore]:
+    """Create (once) and return the process store when the conf enables
+    it; None otherwise.  ``conf`` is anything with a dict-style ``get``
+    carrying tez.runtime.store.* keys."""
+    from tez_tpu.common import config as C
+
+    def _get(key):
+        v = conf.get(key.name) if hasattr(conf, "get") else None
+        return key.default if v is None else v
+
+    enabled = _get(C.STORE_ENABLED)
+    if isinstance(enabled, str):
+        enabled = enabled.lower() in ("1", "true", "yes")
+    if not enabled:
+        return None
+    global _store
+    with _lock:
+        if _store is None:
+            # fractional MB accepted (chaos/test scenarios shrink a tier
+            # below 1MB to force eviction storms on tiny datasets)
+            mb = float(1 << 20)
+            _store = ShuffleBufferStore(
+                device_capacity=int(float(_get(
+                    C.STORE_DEVICE_CAPACITY_MB)) * mb),
+                host_capacity=int(float(_get(
+                    C.STORE_HOST_CAPACITY_MB)) * mb),
+                disk_capacity=int(float(_get(
+                    C.STORE_DISK_CAPACITY_MB)) * mb),
+                disk_dir=str(_get(C.STORE_DIR) or ""),
+                high_watermark=float(_get(C.STORE_HIGH_WATERMARK)),
+                low_watermark=float(_get(C.STORE_LOW_WATERMARK)))
+            from tez_tpu.shuffle.service import local_shuffle_service
+            local_shuffle_service().attach_buffer_store(_store)
+            from tez_tpu.ops import async_stage
+            store = _store
+            async_stage.register_pressure_hook(
+                store.relieve_device_pressure)
+        return _store
+
+
+def reset_store() -> None:
+    """Tear down the process store (tests / session teardown)."""
+    global _store
+    with _lock:
+        store, _store = _store, None
+    if store is not None:
+        from tez_tpu.shuffle.service import local_shuffle_service
+        local_shuffle_service().attach_buffer_store(None)
+        from tez_tpu.ops import async_stage
+        async_stage.clear_pressure_hooks()
+        store.close()

@@ -85,6 +85,18 @@ class ChaosCountProcessor(SimpleProcessor):
             fh.write("\n".join(lines) + "\n")
 
 
+class ChaosWideEmitProcessor(SimpleProcessor):
+    """Store-pressure producer: enough bytes per task that a deliberately
+    tiny buffer-store host tier must demote/evict mid-shuffle."""
+
+    WIDE_KEYS = 4000
+
+    def run(self, inputs, outputs):
+        writer = outputs["consumer"].get_writer()
+        for i in range(self.WIDE_KEYS):
+            writer.write(f"key{i:05d}".encode(), i + 1)
+
+
 def make_storm(seed: int) -> str:
     """Seeded storm spec: 2-4 distinct recoverable faults."""
     rng = random.Random(seed)
@@ -93,9 +105,10 @@ def make_storm(seed: int) -> str:
 
 
 def _build_dag(name: str, result_path: str, fault_spec: str = "",
-               fault_seed: int = 0, trace: bool = False) -> DAG:
+               fault_seed: int = 0, trace: bool = False,
+               producer_cls: type = ChaosEmitProcessor) -> DAG:
     producer = Vertex.create("producer", ProcessorDescriptor.create(
-        ChaosEmitProcessor), NUM_PRODUCERS)
+        producer_cls), NUM_PRODUCERS)
     consumer = Vertex.create("consumer", ProcessorDescriptor.create(
         ChaosCountProcessor, payload={"result_path": result_path}), 1)
     conf = {"tez.runtime.key.class": "bytes",
@@ -120,21 +133,24 @@ def _build_dag(name: str, result_path: str, fault_spec: str = "",
 
 def _run_dag(workdir: str, name: str, fault_spec: str = "",
              fault_seed: int = 0, timeout: float = 120.0,
-             trace: bool = False) -> Tuple[str, bytes]:
+             trace: bool = False, extra_conf: Optional[Dict] = None,
+             producer_cls: type = ChaosEmitProcessor) -> Tuple[str, bytes]:
     """One client + one DAG in a fresh staging dir. Returns (state, result
     bytes); result is b'' if the DAG failed before writing."""
     staging = os.path.join(workdir, name, "staging")
     result_path = os.path.join(workdir, name, "result.txt")
     os.makedirs(os.path.dirname(result_path), exist_ok=True)
-    client = TezClient.create(name, {
+    conf = {
         "tez.staging-dir": staging,
         "tez.am.local.num-containers": 4,
         # leave headroom for injected task failures
         "tez.am.task.max.failed.attempts": 4,
-    }).start()
+    }
+    conf.update(extra_conf or {})
+    client = TezClient.create(name, conf).start()
     try:
         dag = _build_dag(name, result_path, fault_spec, fault_seed,
-                         trace=trace)
+                         trace=trace, producer_cls=producer_cls)
         status = client.submit_dag(dag).wait_for_completion(timeout=timeout)
         state = status.state.name
     finally:
@@ -164,6 +180,66 @@ def run_trial(seed: int, workdir: str, baseline: Optional[bytes] = None,
         return False, spec, (f"output diverged from baseline "
                              f"({len(got)} vs {len(baseline)} bytes)")
     return True, spec, "bit-exact vs baseline"
+
+
+# ---------------------------------------------------------- store pressure
+
+def run_store_pressure(seed: int, workdir: str,
+                       timeout: float = 120.0) -> Tuple[bool, str]:
+    """Buffer-store eviction-storm scenario. Returns (ok, detail).
+
+    The wide producer pushes ~100KB of shuffle data through a buffer store
+    whose tiers are deliberately tiny (host ~50KB, device ~20KB, watermarks
+    0.6/0.3), so the watermark enforcer must demote and evict mid-merge —
+    while the consumer is actively fetching.  The run must still succeed
+    and its output must be bit-exact vs a store-disabled baseline: tier
+    churn is allowed to cost I/O, never data."""
+    from tez_tpu.store import local_buffer_store, reset_store
+
+    reset_store()          # a leftover full-size store would hide pressure
+    try:
+        state, baseline = _run_dag(workdir, f"storebase{seed}",
+                                   timeout=timeout,
+                                   producer_cls=ChaosWideEmitProcessor)
+        if state != DAGStatusState.SUCCEEDED.name or not baseline:
+            return False, f"store-off baseline failed (state={state})"
+        store_conf = {
+            "tez.runtime.store.enabled": True,
+            "tez.runtime.store.device.capacity-mb": 0.02,
+            "tez.runtime.store.host.capacity-mb": 0.05,
+            "tez.runtime.store.watermark.high": 0.6,
+            "tez.runtime.store.watermark.low": 0.3,
+            # reuse off: this scenario measures pressure, not caching
+            "tez.runtime.store.lineage.reuse": False,
+        }
+        state, got = _run_dag(workdir, f"storepress{seed}", timeout=timeout,
+                              extra_conf=store_conf,
+                              producer_cls=ChaosWideEmitProcessor)
+        store = local_buffer_store()
+        if store is None:
+            return False, "store-enabled run never created the buffer store"
+        counters = store.stats()["counters"]
+        published = counters.get("store.published", 0)
+        churn = {k: v for k, v in counters.items()
+                 if (k.startswith("store.demotions.") or
+                     k.startswith("store.evictions.")) and v}
+        if state != DAGStatusState.SUCCEEDED.name:
+            return False, (f"store-pressure DAG finished {state}; "
+                           f"churn={churn}")
+        if got != baseline:
+            return False, (f"output diverged under store pressure "
+                           f"({len(got)} vs {len(baseline)} bytes); "
+                           f"churn={churn}")
+        if published < 1:
+            return False, "no output was ever published into the store"
+        if not churn:
+            return False, (f"tiny tiers never forced a demotion/eviction "
+                           f"({published} published) — pressure did not "
+                           f"bite; shrink the tiers or widen the producer")
+        return True, (f"bit-exact under eviction storm: {published} "
+                      f"published, churn={churn}")
+    finally:
+        reset_store()
 
 
 # ----------------------------------------------------------- commit storm
@@ -693,6 +769,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "trip the breaker (later merges short-circuit), "
                          "then a fault-free run recovers it via half-open "
                          "probe — drained output bit-exact vs sync")
+    ap.add_argument("--store-pressure", action="store_true",
+                    help="run the buffer-store eviction-storm scenario: a "
+                         "wide shuffle through deliberately tiny store "
+                         "tiers forces watermark demotion/eviction "
+                         "mid-merge; output must stay bit-exact vs a "
+                         "store-disabled baseline")
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="arm the tracing plane (tez.trace.enabled) on the "
                          "storm DAGs and write a Perfetto trace_event JSON "
@@ -721,6 +803,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1 if failures else 0
     workdir = args.workdir or tempfile.mkdtemp(prefix="tez-chaos-")
     cleanup = args.workdir is None
+    if args.store_pressure:
+        failures = 0
+        try:
+            for seed in range(args.seed, args.seed + args.trials):
+                ok, detail = run_store_pressure(seed, workdir,
+                                                timeout=args.timeout)
+                print(("ok   " if ok else "FAIL ") +
+                      f"store-pressure seed={seed}: {detail}")
+                if not ok:
+                    failures += 1
+                    print(f"REPRO: python -m tez_tpu.tools.chaos "
+                          f"--store-pressure --seed {seed}")
+        finally:
+            if cleanup:
+                shutil.rmtree(workdir, ignore_errors=True)
+        return 1 if failures else 0
     if args.commit_storm:
         try:
             ok, detail = run_commit_storm(workdir, timeout=args.timeout,

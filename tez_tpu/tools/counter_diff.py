@@ -51,6 +51,38 @@ DEVICE_FAILOVER_COUNTERS = (
     "device.oom.split_success")
 
 
+#: Tiered buffer-store counters (tez_tpu/store COUNTER_GROUP).  Hits and
+#: short-circuits are efficiency (more is better — never flagged);
+#: evictions/demotions are pressure: growth means the run started churning
+#: its tiers, which costs spill I/O even when wall clock barely moves.
+STORE_GROUP = "ShuffleStore"
+STORE_EFFICIENCY_COUNTERS = (
+    "store.published", "store.hits", "store.misses", "store.short_circuit",
+    "store.lineage.hits", "store.lineage.misses", "store.lineage.sealed",
+    "store.reuse.tasks", "store.reuse.outputs")
+STORE_PRESSURE_COUNTERS = (
+    "store.demotions.device_to_host", "store.demotions.host_to_disk",
+    "store.evictions.device", "store.evictions.host", "store.evictions.disk")
+
+
+def diff_store(counters_a: Dict, counters_b: Dict,
+               ) -> List[Tuple[str, int, int, bool]]:
+    """[(counter, a, b, regressed)] over the buffer-store section;
+    regressed only for PRESSURE counters where B churned more than A
+    (eviction/demotion growth = the store started thrashing — hit/miss
+    deltas are workload-shaped, not regressions)."""
+    ga = counters_a.get(STORE_GROUP, {})
+    gb = counters_b.get(STORE_GROUP, {})
+    out = []
+    for name in STORE_EFFICIENCY_COUNTERS + STORE_PRESSURE_COUNTERS:
+        if name not in ga and name not in gb:
+            continue
+        va, vb = int(ga.get(name, 0)), int(gb.get(name, 0))
+        out.append((name, va, vb,
+                    name in STORE_PRESSURE_COUNTERS and vb > va))
+    return out
+
+
 def flatten(counters: Dict) -> Dict[str, int]:
     return {f"{g}.{name}": v for g, cs in counters.items()
             if not g.startswith(HIST_GROUP_PREFIX)
@@ -165,6 +197,14 @@ def main() -> int:
             print(f"{name:32} {ms_a:14.1f} {ms_b:14.1f} "
                   f"{ms_b - ms_a:+12.1f}{flag}")
             regressions += int(regressed)
+    store = diff_store(a.counters, b.counters)
+    if store:
+        print(f"\n{'buffer store (hits/evictions/demotions)':60} "
+              f"{'A':>14} {'B':>14}")
+        for name, va, vb, regressed in store:
+            flag = "  << REGRESSION" if regressed else ""
+            print(f"{name:60} {va:14d} {vb:14d}{flag}")
+            regressions += int(regressed)
     failover = diff_device_failover(a.counters, b.counters)
     if failover:
         print(f"\n{'device.failover (containment)':60} "
@@ -178,7 +218,8 @@ def main() -> int:
           f"wall delta {b.duration - a.duration:+.2f}s")
     if regressions:
         print(f"{regressions} regression(s) (latency p95 >= "
-              f"{REGRESSION_RATIO}x baseline, or containment event growth)")
+              f"{REGRESSION_RATIO}x baseline, containment event growth, "
+              f"or store eviction/demotion churn growth)")
     return 0
 
 

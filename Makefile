@@ -6,10 +6,15 @@ PY ?= python
 OLD ?= BENCH_r05.json
 NEW ?= /tmp/bench_new.json
 
-.PHONY: test bench bench-new bench-diff bench-merge bench-store chaos chaos-device-ooo chaos-device chaos-merge chaos-store docs
+.PHONY: test lint bench bench-new bench-diff bench-merge bench-store chaos chaos-device-ooo chaos-device chaos-merge chaos-store docs
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+# static analysis gate (docs/static_analysis.md): exit 0 clean,
+# 1 = findings outside tez_tpu/tools/graftlint_baseline.json, 2 = error
+lint:
+	$(PY) -m tez_tpu.tools.graftlint
 
 bench:
 	$(PY) bench.py

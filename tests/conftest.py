@@ -20,6 +20,32 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _lockorder_witness_session():
+    """Arm the runtime lock-order witness for the whole suite (graftlint's
+    dynamic half — docs/static_analysis.md): every lock the tests create
+    inside tez_tpu is wrapped, nested acquisitions are recorded, and the
+    session fails if any order inversion was observed or if a witnessed
+    edge is missing from the static lock graph.  TEZ_LOCKORDER_WITNESS=0
+    opts out (e.g. when bisecting an unrelated failure)."""
+    if os.environ.get("TEZ_LOCKORDER_WITNESS", "1") == "0":
+        yield
+        return
+    from tez_tpu.common import lockorder
+    lockorder.arm("pytest-session")
+    yield
+    lockorder.disarm("pytest-session")
+    from tez_tpu.analysis import lockorder as static_lockorder
+    from tez_tpu.analysis.core import Context
+    import tez_tpu
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(tez_tpu.__file__)))
+    edges, locks = static_lockorder.build_graph(Context(root))
+    problems = lockorder.check(set(edges), locks)
+    assert not problems, \
+        "lock-order witness: " + "\n".join(problems)
+
+
 @pytest.fixture()
 def tmp_staging(tmp_path):
     return str(tmp_path / "staging")

@@ -1,0 +1,582 @@
+"""graftlint static-analysis suite tests: per-checker fixture snippets
+(positive / negative / suppression), the CLI exit-code contract and
+baseline flow, a self-run over the real tree asserting the committed
+baseline is clean, and the runtime lock-order witness — including a
+deliberately provoked A->B/B->A inversion and the static/dynamic
+cross-validation contract."""
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+import tez_tpu
+from tez_tpu.analysis import all_checkers
+from tez_tpu.analysis.core import (Context, load_baseline,
+                                   partition_by_baseline, run_checkers)
+from tez_tpu.analysis import (faultpoints, jax_hazards, knobs, lockorder,
+                              metric_names)
+from tez_tpu.common import lockorder as witness
+from tez_tpu.tools import graftlint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(
+    tez_tpu.__file__)))
+
+
+def _ctx(tmp_path, files, docs=None):
+    """Materialize a fixture package tree under tmp_path/tez_tpu."""
+    pkg = tmp_path / "tez_tpu"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, text in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        if p.parent != pkg and not (p.parent / "__init__.py").exists():
+            (p.parent / "__init__.py").write_text("")
+        p.write_text(textwrap.dedent(text))
+    if docs:
+        d = tmp_path / "docs"
+        d.mkdir(exist_ok=True)
+        for name, text in docs.items():
+            (d / name).write_text(textwrap.dedent(text))
+    return Context(str(tmp_path))
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+def _symbols(findings, code):
+    return sorted(f.symbol for f in findings if f.code == code)
+
+
+# ------------------------------------------------------------ lockorder
+
+_CYCLE_MODULE = """
+    import threading
+    LA = threading.Lock()
+    LB = threading.Lock()
+
+    def f1():
+        with LA:
+            with LB:
+                pass
+
+    def f2():
+        with LB:
+            with LA:
+                pass
+"""
+
+
+def test_lockorder_reports_inverse_nesting_cycle(tmp_path):
+    ctx = _ctx(tmp_path, {"pair.py": _CYCLE_MODULE})
+    found = lockorder.run(ctx)
+    assert _codes(found) == ["lock-cycle"]
+    assert found[0].symbol == "pair.LA<->pair.LB"
+
+
+def test_lockorder_consistent_order_is_clean(tmp_path):
+    ctx = _ctx(tmp_path, {"pair.py": """
+        import threading
+        LA = threading.Lock()
+        LB = threading.Lock()
+
+        def f1():
+            with LA:
+                with LB:
+                    pass
+
+        def f2():
+            with LA:
+                with LB:
+                    pass
+    """})
+    assert lockorder.run(ctx) == []
+
+
+def test_lockorder_cycle_through_call_edges(tmp_path):
+    # no direct inverse nesting anywhere: the cycle only exists through
+    # the call edges (hold own lock, call the other side)
+    ctx = _ctx(tmp_path, {
+        "m1.py": """
+            import threading
+
+            class Holder:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def hold_and_poke(self, other):
+                    with self._lock:
+                        other.do_work()
+        """,
+        "m2.py": """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def do_work(self):
+                    with self._lock:
+                        return 1
+
+                def rev(self, holder):
+                    with self._lock:
+                        holder.hold_and_poke(None)
+        """,
+    })
+    found = lockorder.run(ctx)
+    assert _codes(found) == ["lock-cycle"]
+    assert found[0].symbol == "m1.Holder._lock<->m2.Worker._lock"
+
+
+def test_lockorder_resolves_stored_callbacks(tmp_path):
+    # the pipeline invokes a constructor-injected callback while holding
+    # its own lock; the callback's acquisitions must land in the graph
+    ctx = _ctx(tmp_path, {
+        "pipe.py": """
+            import threading
+
+            class Pipe:
+                def __init__(self, on_complete=None):
+                    self._lock = threading.Lock()
+                    self._on_complete = on_complete
+
+                def complete(self, result):
+                    with self._lock:
+                        self._on_complete(result)
+        """,
+        "owner.py": """
+            import threading
+            from tez_tpu.pipe import Pipe
+
+            class Owner:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._pipe = Pipe(on_complete=self._fold)
+
+                def _fold(self, result):
+                    with self._lock:
+                        return result
+        """,
+    })
+    edges, _locks = lockorder.build_graph(ctx)
+    assert ("pipe.Pipe._lock", "owner.Owner._lock") in edges
+
+
+def test_lockorder_condition_aliases_to_wrapped_lock(tmp_path):
+    # Condition(self._lock) is the SAME lock: nesting them is not an edge
+    ctx = _ctx(tmp_path, {"cv.py": """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+
+            def use(self):
+                with self._lock:
+                    with self._cv:
+                        pass
+    """})
+    edges, locks = lockorder.build_graph(ctx)
+    assert edges == {}
+    assert locks == {"cv.Box._lock"}
+
+
+def test_lock_graph_exports_expected_real_edges():
+    """The static graph over the real tree must contain the nesting the
+    runtime witness demonstrably exercises (subset contract anchors)."""
+    edges, locks = lockorder.build_graph(Context(REPO_ROOT))
+    assert "shuffle.scheduler.FetchScheduler.lock" in locks
+    assert ("store.buffer_store.ShuffleBufferStore._lock",
+            "common.metrics.MetricsRegistry._lock") in edges
+    assert ("shuffle.scheduler.FetchScheduler.lock",
+            "common.metrics.MetricsRegistry._lock") in edges
+
+
+# ------------------------------------------------------------ knobs
+
+_KNOB_CONFIG = """
+    def _key(name, default=None, scope=None, doc=""):
+        return name
+
+    GOOD = _key("tez.good.knob", 1)
+    DEAD = _key("tez.dead.knob", 1)
+"""
+
+
+def test_knob_drift_codes(tmp_path):
+    ctx = _ctx(tmp_path, {
+        "common/config.py": _KNOB_CONFIG,
+        "user.py": """
+            def read(conf):
+                return (conf.get("tez.good.knob"),
+                        conf.get("tez.rogue.knob"))
+        """,
+    }, docs={"configuration.md": "| `tez.good.knob` |\n| `tez.dead.knob` |\n"})
+    found = knobs.run(ctx)
+    assert _codes(found) == ["knob-unread", "knob-unregistered"]
+    assert _symbols(found, "knob-unregistered") == ["tez.rogue.knob"]
+    assert _symbols(found, "knob-unread") == ["tez.dead.knob"]
+
+
+def test_knob_undocumented_when_docs_stale(tmp_path):
+    ctx = _ctx(tmp_path, {
+        "common/config.py": _KNOB_CONFIG,
+        "user.py": "def read(c):\n    return c.get('tez.good.knob')\n",
+    }, docs={"configuration.md": "| `tez.good.knob` |\n"})
+    found = knobs.run(ctx)
+    # tez.dead.knob is both unread and missing from the generated doc
+    assert _codes(found) == ["knob-undocumented", "knob-unread"]
+    assert _symbols(found, "knob-undocumented") == ["tez.dead.knob"]
+
+
+def test_inline_suppression_silences_finding(tmp_path):
+    ctx = _ctx(tmp_path, {
+        "common/config.py": _KNOB_CONFIG,
+        "user.py": """
+            def read(conf):
+                conf.get("tez.good.knob")
+                return conf.get("tez.rogue.knob")  # graftlint: disable=knob-unregistered
+        """,
+    }, docs={"configuration.md": "| `tez.good.knob` |\n| `tez.dead.knob` |\n"})
+    found = run_checkers(ctx, [knobs.CHECKER])
+    assert _codes(found) == ["knob-unread"]
+
+
+def test_suppression_on_line_above_and_all(tmp_path):
+    ctx = _ctx(tmp_path, {
+        "common/config.py": _KNOB_CONFIG,
+        "user.py": """
+            def read(conf):
+                conf.get("tez.good.knob")
+                # graftlint: disable=all
+                return conf.get("tez.rogue.knob")
+        """,
+    }, docs={"configuration.md": "| `tez.good.knob` |\n| `tez.dead.knob` |\n"})
+    found = run_checkers(ctx, [knobs.CHECKER])
+    assert _codes(found) == ["knob-unread"]
+
+
+# ------------------------------------------------------------ faultpoints
+
+def test_fault_point_drift_codes(tmp_path):
+    ctx = _ctx(tmp_path, {
+        "common/faults.py": """
+            KNOWN_POINTS = {
+                "used.point": "seam a",
+                "dead.point": "seam b",
+                "undoc.point": "seam c",
+            }
+
+            def fire(point, detail=""):
+                pass
+        """,
+        "seams.py": """
+            from tez_tpu.common import faults
+
+            def go():
+                faults.fire("used.point")
+                faults.fire("rogue.point")
+                faults.fire("undoc.point")
+        """,
+    }, docs={"fault_injection.md": """
+        | point | seam |
+        |---|---|
+        | `used.point` | a |
+        | `dead.point` | b |
+        | `stale.point` | gone |
+    """})
+    found = faultpoints.run(ctx)
+    assert _codes(found) == ["fault-doc-stale", "fault-undocumented",
+                             "fault-unfired", "fault-unregistered"]
+    assert _symbols(found, "fault-unregistered") == ["rogue.point"]
+    assert _symbols(found, "fault-unfired") == ["dead.point"]
+    assert _symbols(found, "fault-undocumented") == ["undoc.point"]
+    assert _symbols(found, "fault-doc-stale") == ["stale.point"]
+
+
+# ------------------------------------------------------------ metric_names
+
+def test_metric_drift_codes(tmp_path):
+    ctx = _ctx(tmp_path, {
+        "common/metrics.py": """
+            WELL_KNOWN_HISTOGRAMS = ("a.hist", "b.unused")
+        """,
+        "instr.py": """
+            from tez_tpu.common import metrics
+
+            def go(m):
+                metrics.observe("a.hist", 1.0)
+                metrics.observe("rogue.hist", 2.0)
+                m.set_gauge("queue.depth", 3)
+        """,
+    }, docs={"observability.md": "`a.hist` and `b.unused` histograms\n"})
+    found = metric_names.run(ctx)
+    assert _codes(found) == ["gauge-undocumented", "hist-unregistered",
+                             "hist-unused"]
+    assert _symbols(found, "hist-unregistered") == ["rogue.hist"]
+    assert _symbols(found, "hist-unused") == ["b.unused"]
+    assert _symbols(found, "gauge-undocumented") == ["queue.depth"]
+
+
+def test_counter_diff_section_cross_checked(tmp_path):
+    ctx = _ctx(tmp_path, {
+        "common/metrics.py": """
+            WELL_KNOWN_HISTOGRAMS = ("a.hist",)
+        """,
+        "tools/counter_diff.py": """
+            DEVICE_STAGE_HISTS = ("a.hist", "ghost.hist")
+        """,
+        "instr.py": """
+            from tez_tpu.common import metrics
+
+            def go():
+                metrics.observe("a.hist", 1.0)
+        """,
+    }, docs={"observability.md": "`a.hist`\n"})
+    found = metric_names.run(ctx)
+    assert _codes(found) == ["diff-stale-hist"]
+    assert found[0].symbol == "ghost.hist"
+
+
+# ------------------------------------------------------------ jax_hazards
+
+def test_jax_hazard_codes(tmp_path):
+    ctx = _ctx(tmp_path, {
+        "hazards.py": """
+            import threading
+            import jax
+
+            def bad_loop(xs):
+                out = []
+                for x in xs:
+                    f = jax.jit(lambda v: v)
+                    out.append(f(x))
+                return out
+
+            def bad_immediate(x):
+                return jax.jit(lambda v: v)(x)
+
+            def bad_thread(fn):
+                threading.Thread(target=fn).start()
+
+            def good_thread(fn):
+                threading.Thread(target=fn, daemon=True).start()
+
+            def bad_acquire(my_lock):
+                my_lock.acquire()
+        """,
+        "ops/device.py": """
+            def sync(x):
+                return x.item()
+        """,
+    })
+    found = jax_hazards.run(ctx)
+    assert _codes(found) == ["bare-acquire", "host-sync", "jit-immediate",
+                             "jit-in-loop", "thread-nondaemon"]
+
+
+def test_jax_builder_patterns_allowed(tmp_path):
+    ctx = _ctx(tmp_path, {
+        "ok.py": """
+            import functools
+            import jax
+
+            TOP = jax.jit(lambda v: v)
+
+            @functools.lru_cache(maxsize=1)
+            def build():
+                return jax.jit(lambda v: v + 1)
+
+            def item_off_hot_path(x):
+                return x.item()
+        """,
+    })
+    assert jax_hazards.run(ctx) == []
+
+
+# ------------------------------------------------------------ CLI / baseline
+
+def test_cli_exit_codes_and_baseline_flow(tmp_path):
+    _ctx(tmp_path, {
+        "common/config.py": _KNOB_CONFIG,
+        "user.py": """
+            def read(conf):
+                conf.get("tez.good.knob")
+                conf.get("tez.dead.knob")
+                return conf.get("tez.rogue.knob")
+        """,
+    }, docs={"configuration.md": "| `tez.good.knob` |\n| `tez.dead.knob` |\n"})
+    bl = str(tmp_path / "baseline.json")
+    argv = ["--root", str(tmp_path), "--baseline", bl]
+    assert graftlint.main(argv) == 1                 # new finding
+    assert graftlint.main(argv + ["--update-baseline"]) == 0
+    data = json.load(open(bl))
+    assert data["findings"] == [
+        "knobs:knob-unregistered:tez_tpu/user.py:tez.rogue.knob"]
+    assert graftlint.main(argv) == 0                 # baselined now
+    assert graftlint.main(["--checker", "no-such-checker"]) == 2
+
+
+def test_cli_detects_seeded_lock_cycle(tmp_path):
+    _ctx(tmp_path, {"pair.py": _CYCLE_MODULE})
+    assert graftlint.main(["--root", str(tmp_path),
+                           "--baseline", str(tmp_path / "bl.json")]) == 1
+
+
+def test_self_run_matches_committed_baseline():
+    """`make lint` contract: the committed tree is clean against the
+    committed baseline (no new findings, no stale entries)."""
+    findings = run_checkers(Context(REPO_ROOT), all_checkers())
+    new, _known, stale = partition_by_baseline(
+        findings, load_baseline(graftlint._DEFAULT_BASELINE))
+    assert [f.render() for f in new] == []
+    assert stale == []
+
+
+def test_finding_identity_is_line_stable(tmp_path):
+    ctx = _ctx(tmp_path, {
+        "common/config.py": _KNOB_CONFIG,
+        "user.py": "def f(c):\n    return c.get('tez.rogue.knob')\n",
+    })
+    ctx2 = _ctx(tmp_path / "shifted", {
+        "common/config.py": _KNOB_CONFIG,
+        "user.py": "# pushed\n# down\ndef f(c):\n"
+                   "    return c.get('tez.rogue.knob')\n",
+    })
+    (i1,) = [f.identity for f in knobs.run(ctx)
+             if f.code == "knob-unregistered"]
+    (i2,) = [f.identity for f in knobs.run(ctx2)
+             if f.code == "knob-unregistered"]
+    assert i1.split(":", 1)[1].endswith("user.py:tez.rogue.knob")
+    assert i1 == i2
+
+
+# ------------------------------------------------------------ runtime witness
+
+def test_witness_detects_provoked_inversion():
+    """Deliberate A->B then B->A on a PRIVATE witness instance (the
+    process-global record the session finalizer asserts on stays
+    pristine)."""
+    w = witness.LockWitness()
+    a = w.wrap(witness._ORIG_LOCK(), "fixture.A")
+    b = w.wrap(witness._ORIG_LOCK(), "fixture.B")
+    witness.arm("test-inversion")       # refcounted; recording gate on
+    try:
+        with a:
+            with b:
+                pass
+        assert w.violations() == []
+        assert w.edges() == {("fixture.A", "fixture.B")}
+        with b:
+            with a:
+                pass
+    finally:
+        witness.disarm("test-inversion")
+    (v,) = w.violations()
+    assert (v.held, v.acquired) == ("fixture.B", "fixture.A")
+    assert "test_graftlint.py" in v.where
+    assert "prior observations order fixture.A before fixture.B" \
+        in v.render()
+
+
+def test_witness_reentrant_rlock_is_not_an_edge():
+    w = witness.LockWitness()
+    r = w.wrap(witness._ORIG_RLOCK(), "fixture.R")
+    witness.arm("test-reentrant")
+    try:
+        with r:
+            with r:
+                pass
+    finally:
+        witness.disarm("test-reentrant")
+    assert w.edges() == set()
+    assert w.violations() == []
+
+
+def test_witness_names_and_validates_package_locks(tmp_path):
+    """Locks created inside tez_tpu while armed get static-analyzer
+    names, real nesting is recorded, and the observed edges validate
+    against the static graph (the acceptance-criteria subset check)."""
+    from tez_tpu.ops.runformat import KVBatch, Run
+    from tez_tpu.store.buffer_store import ShuffleBufferStore
+    import numpy as np
+
+    witness.arm("test-naming")
+    try:
+        s = ShuffleBufferStore(device_capacity=0, host_capacity=1 << 20,
+                               disk_dir=str(tmp_path / "store"))
+        assert getattr(s._lock, "_witness_name", None) == \
+            "store.buffer_store.ShuffleBufferStore._lock"
+        pairs = sorted((b"k%04d" % i, b"v%04d" % i) for i in range(32))
+        run = Run(KVBatch.from_pairs(pairs),
+                  np.array([0, 16, 32], dtype=np.int64))
+        s.publish("dag1/a0/cons", -1, run)
+        s.fetch_partition("dag1/a0/cons", -1, 0)
+        s.close()
+    finally:
+        witness.disarm("test-naming")
+    from tez_tpu.common import metrics
+    if getattr(metrics.registry()._lock, "_witness_name", None) is not None:
+        # the registry singleton was born inside an armed window, so the
+        # publish path must have recorded the store->metrics nesting;
+        # when it predates arming it is invisible BY DESIGN (the subset
+        # property) and there is no edge to assert on
+        edge = ("store.buffer_store.ShuffleBufferStore._lock",
+                "common.metrics.MetricsRegistry._lock")
+        assert edge in witness.witness().edges()
+    static_edges, static_locks = lockorder.build_graph(Context(REPO_ROOT))
+    assert witness.check(set(static_edges), static_locks) == []
+
+
+def test_witness_scope_refcounting():
+    """disarm() of one scope must not unpatch while another (e.g. the
+    session fixture's) is still armed."""
+    was_armed = witness.armed()
+    witness.arm("test-scope-a")
+    witness.arm("test-scope-b")
+    witness.disarm("test-scope-a")
+    assert witness.armed()
+    witness.disarm("test-scope-b")
+    assert witness.armed() == was_armed
+
+
+def test_witness_install_from_conf():
+    from tez_tpu.common import config as C
+    conf = C.TezConfiguration({C.DEBUG_LOCKORDER.name: True})
+    assert witness.install_from_conf(conf, "test-conf-scope")
+    assert witness.armed()
+    witness.disarm("test-conf-scope")
+    off = C.TezConfiguration({})
+    assert not witness.install_from_conf(off, "test-conf-scope2")
+
+
+def test_witness_condition_wait_keeps_stack_exact():
+    """Condition.wait releases and reacquires through the wrapper's
+    _release_save/_acquire_restore; the held stack must stay balanced
+    (an unbalanced stack would fabricate phantom edges afterwards)."""
+    import threading as th
+    w = witness.LockWitness()
+    inner = w.wrap(witness._ORIG_LOCK(), "fixture.CVL")
+    cv = witness._ORIG_CONDITION(inner)
+    other = w.wrap(witness._ORIG_LOCK(), "fixture.OTHER")
+    witness.arm("test-cv")
+    try:
+        def waker():
+            with cv:
+                cv.notify_all()
+        t = th.Thread(target=waker, daemon=True)
+        with cv:
+            t.start()
+            cv.wait(timeout=2.0)
+        with other:
+            pass                      # held stack must be empty again
+    finally:
+        witness.disarm("test-cv")
+    assert all(e[0] != "fixture.CVL" for e in w.edges()), w.edges()
+    assert w.violations() == []

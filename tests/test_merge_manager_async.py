@@ -84,6 +84,11 @@ def test_async_disk_cascade_matches_sync(tmp_path):
                                  block_records=128, async_depth=depth)
         for slot, b in enumerate(batches):
             mm.commit(slot, b)
+        # finish() closes the merger loop, which checks _closed BEFORE
+        # looking for runnable disk work — observe the cascade rather than
+        # race it (6 runs with merge_factor=2 make one inevitable)
+        _wait_for(lambda: mm._disk_to_disk >= 1,
+                  f"{tag}: disk cascade never ran")
         out = drain(mm)
         return mm, out
 

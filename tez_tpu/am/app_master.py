@@ -322,6 +322,8 @@ class DAGAppMaster:
             speculator.stop()
         from tez_tpu.common import faults
         faults.clear(str(dag.dag_id))
+        from tez_tpu.common import lockorder
+        lockorder.disarm(str(dag.dag_id))
         from tez_tpu.common import tracing
         sp = getattr(dag, "trace_span", None)
         if sp is not None:
@@ -385,6 +387,10 @@ class DAGAppMaster:
         # with it in on_dag_finished — per-DAG scoping
         from tez_tpu.common import faults
         faults.install_from_conf(dag.conf, scope=str(dag_id))
+        # lock-order witness (tez.debug.lockorder): armed per-DAG like the
+        # fault plane; disarmed in on_dag_finished, observations retained
+        from tez_tpu.common import lockorder
+        lockorder.install_from_conf(dag.conf, scope=str(dag_id))
         # tracing plane: armed with the DAG like faults; the DAG root span
         # stays open until on_dag_finished and every TaskSpec carries its
         # context so attempt/fetch spans land on the same trace id

@@ -1,0 +1,40 @@
+"""graftlint: AST-based static-analysis suite for the tez_tpu tree.
+
+The control plane is four interlocking threaded planes (async span
+pipeline, merge lane, tiered buffer store, fetch-session referee) and
+three string-keyed registries (``tez.*`` conf knobs, fault points,
+metric names) that drift silently from code and docs.  This package is
+the correctness tooling that scales with that codebase:
+
+- :mod:`core` — the checker plugin API: parsed-source context, findings
+  with stable identities, inline suppressions, committed baseline.
+- :mod:`lockorder` — discovers named lock attributes per class, builds
+  the inter-module lock acquisition graph from nested ``with`` blocks
+  and call edges, and reports cycles as potential deadlocks.  The
+  static graph is cross-validated at runtime by the lock-order witness
+  (:mod:`tez_tpu.common.lockorder`, armed via ``tez.debug.lockorder``).
+- :mod:`knobs` — every ``tez.*`` literal read in code must be
+  registered in ``common/config.py`` and documented, and every
+  registered knob must be read somewhere.
+- :mod:`faultpoints` — fault-injection call sites vs the canonical
+  ``faults.KNOWN_POINTS`` table vs ``docs/fault_injection.md``.
+- :mod:`metric_names` — histogram/gauge/counter names at
+  instrumentation sites vs ``common/metrics.py`` vs the
+  ``tools/counter_diff.py`` sections vs ``docs/observability.md``.
+- :mod:`jax_hazards` — ``jax.jit`` recompile churn, implicit host
+  syncs in pipeline hot paths, non-daemon threads, bare ``.acquire()``.
+
+CLI: ``python -m tez_tpu.tools.graftlint`` (or ``make lint``); see
+docs/static_analysis.md.
+"""
+from tez_tpu.analysis.core import (Checker, Context, Finding,  # noqa: F401
+                                   load_baseline, run_checkers,
+                                   save_baseline)
+
+
+def all_checkers():
+    """The five shipped checkers, in report order."""
+    from tez_tpu.analysis import (faultpoints, jax_hazards, knobs,
+                                  lockorder, metric_names)
+    return [lockorder.CHECKER, knobs.CHECKER, faultpoints.CHECKER,
+            metric_names.CHECKER, jax_hazards.CHECKER]

@@ -1,0 +1,220 @@
+"""graftlint checker plugin API: context, findings, suppressions, baseline.
+
+Design rules (mirroring how the other planes in this tree work):
+
+- **Stable identities.**  A finding's baseline key is symbolic —
+  ``checker:code:path:symbol`` — never a line number, so unrelated edits
+  don't churn the committed baseline.  Line numbers are display-only.
+- **Inline suppressions.**  ``# graftlint: disable=<code>[,<code>...]``
+  on the reported line (or the line above it) suppresses that finding;
+  ``disable=all`` suppresses every code on the line.  Suppressions are
+  for *triaged* findings — the comment is the audit trail.
+- **Committed baseline.**  ``tools/graftlint_baseline.json`` holds the
+  identities of known findings; the CLI exits nonzero only on findings
+  NOT in the baseline, so every future PR inherits the analysis without
+  first paying down historical debt.  ``--update-baseline`` rewrites it
+  (sorted, one identity per line — diffs stay reviewable).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: Inline suppression grammar, anywhere in a comment.
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([a-z0-9_,\-]+|all)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint result.
+
+    ``symbol`` is the stable identity component (knob name, fault point,
+    cycle signature, ``Class.attr`` ...); two findings with the same
+    (checker, code, path, symbol) are the same finding across edits.
+    """
+    checker: str
+    code: str
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-based display anchor (0 = whole file)
+    symbol: str
+    message: str
+
+    @property
+    def identity(self) -> str:
+        return f"{self.checker}:{self.code}:{self.path}:{self.symbol}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.code} "
+                f"[{self.checker}] {self.message}")
+
+
+class SourceFile:
+    """One parsed source file: AST + raw lines + per-line suppressions."""
+
+    def __init__(self, path: str, rel: str, text: str) -> None:
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(text, filename=path)
+        except SyntaxError as e:       # reported as a finding, not a crash
+            self.parse_error = e
+        self._suppressed: Dict[int, set] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                codes = set(m.group(1).split(","))
+                self._suppressed[i] = codes
+
+    def suppressed(self, line: int, code: str) -> bool:
+        """A finding at ``line`` is suppressed by a pragma on that line or
+        on the line directly above it (for lines that are too long to
+        carry a trailing comment)."""
+        for ln in (line, line - 1):
+            codes = self._suppressed.get(ln)
+            if codes and ("all" in codes or code in codes):
+                return True
+        return False
+
+
+class Context:
+    """Everything a checker may look at, parsed once and shared.
+
+    ``root`` is the repository root (the directory holding ``tez_tpu/``
+    and ``docs/``); ``package`` is the package dir analyzed (normally
+    ``<root>/tez_tpu``).  Fixture tests point ``package`` at a tmp tree.
+    """
+
+    def __init__(self, root: str, package: Optional[str] = None,
+                 docs_dir: Optional[str] = None) -> None:
+        self.root = os.path.abspath(root)
+        self.package = os.path.abspath(package or
+                                       os.path.join(self.root, "tez_tpu"))
+        self.docs_dir = os.path.abspath(docs_dir or
+                                        os.path.join(self.root, "docs"))
+        self.files: List[SourceFile] = []
+        for dirpath, dirnames, filenames in sorted(os.walk(self.package)):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git"))
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, self.root).replace(os.sep, "/")
+                with open(full, "r", encoding="utf-8") as f:
+                    self.files.append(SourceFile(full, rel, f.read()))
+        self._docs_cache: Dict[str, str] = {}
+
+    # -- helpers shared by checkers ----------------------------------------
+
+    def doc_text(self, name: str) -> str:
+        """Contents of docs/<name>, '' when absent (absence is itself
+        reported by the registry checkers)."""
+        if name not in self._docs_cache:
+            path = os.path.join(self.docs_dir, name)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    self._docs_cache[name] = f.read()
+            except OSError:
+                self._docs_cache[name] = ""
+        return self._docs_cache[name]
+
+    def find_file(self, suffix: str) -> Optional[SourceFile]:
+        """The analyzed file whose repo-relative path ends with suffix."""
+        suffix = suffix.replace(os.sep, "/")
+        for sf in self.files:
+            if sf.rel.endswith(suffix):
+                return sf
+        return None
+
+    def module_name(self, sf: SourceFile) -> str:
+        """Dotted module path relative to the analyzed package, e.g.
+        ``ops.async_stage`` — the shared naming base for lock names in
+        both the static graph and the runtime witness."""
+        rel = os.path.relpath(sf.path, self.package).replace(os.sep, "/")
+        mod = rel[:-3] if rel.endswith(".py") else rel
+        if mod.endswith("/__init__"):
+            mod = mod[: -len("/__init__")]
+        return mod.replace("/", ".")
+
+
+@dataclasses.dataclass(frozen=True)
+class Checker:
+    """A checker plugin: a name plus ``run(ctx) -> findings``.  Adding a
+    checker = one module with a ``CHECKER`` constant, listed in
+    :func:`tez_tpu.analysis.all_checkers` (docs/static_analysis.md)."""
+    name: str
+    doc: str
+    run: Callable[[Context], List[Finding]]
+
+
+def _apply_suppressions(ctx: Context,
+                        findings: Iterable[Finding]) -> List[Finding]:
+    by_rel = {sf.rel: sf for sf in ctx.files}
+    out = []
+    for f in findings:
+        sf = by_rel.get(f.path)
+        if sf is not None and f.line > 0 and sf.suppressed(f.line, f.code):
+            continue
+        out.append(f)
+    return out
+
+
+def run_checkers(ctx: Context,
+                 checkers: Sequence[Checker]) -> List[Finding]:
+    """Run the checkers and return suppression-filtered findings in a
+    stable order (path, line, code, symbol)."""
+    findings: List[Finding] = []
+    for sf in ctx.files:
+        if sf.parse_error is not None:
+            findings.append(Finding(
+                "core", "parse-error", sf.rel,
+                sf.parse_error.lineno or 0, sf.rel,
+                f"syntax error: {sf.parse_error.msg}"))
+    for checker in checkers:
+        findings.extend(checker.run(ctx))
+    findings = _apply_suppressions(ctx, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.code, f.symbol))
+    return findings
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path: str) -> List[str]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError:
+        return []
+    return list(data.get("findings", []))
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    data = {
+        "comment": "graftlint suppression baseline: known-finding "
+                   "identities (checker:code:path:symbol).  Regenerate "
+                   "with --update-baseline; keep diffs reviewed.",
+        "findings": sorted({f.identity for f in findings}),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def partition_by_baseline(findings: Sequence[Finding],
+                          baseline: Sequence[str]
+                          ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """(new, known, stale-baseline-entries)."""
+    base = set(baseline)
+    new = [f for f in findings if f.identity not in base]
+    known = [f for f in findings if f.identity in base]
+    current = {f.identity for f in findings}
+    stale = sorted(b for b in base if b not in current)
+    return new, known, stale

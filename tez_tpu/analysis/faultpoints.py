@@ -1,0 +1,122 @@
+"""Fault-point consistency: fire()/should_corrupt()/corrupt_bytes() call
+sites vs the canonical ``faults.KNOWN_POINTS`` table vs
+docs/fault_injection.md.
+
+``faults.fire`` deliberately accepts any point name (new seams need no
+central edit at runtime) — this checker is the compile-time closure of
+that openness:
+
+- ``fault-unregistered`` — a literal point fired somewhere but absent
+  from KNOWN_POINTS: invisible to the chaos storm menu and the docs.
+- ``fault-unfired`` — a KNOWN_POINTS entry whose name appears nowhere
+  else in the package: a seam that was removed (or renamed) without
+  updating the table.
+- ``fault-undocumented`` — KNOWN_POINTS entry missing from
+  docs/fault_injection.md.
+- ``fault-doc-stale`` — a backticked point in the doc's table that is no
+  longer in KNOWN_POINTS.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from tez_tpu.analysis.core import Checker, Context, Finding
+
+_FAULTS_SUFFIX = "common/faults.py"
+_FIRE_FUNCS = ("fire", "should_corrupt", "corrupt_bytes")
+#: backticked point names inside the doc's markdown table
+_DOC_POINT_RE = re.compile(r"^\|\s*`([a-z0-9._-]+)`", re.MULTILINE)
+
+
+def _known_points(ctx: Context) -> Tuple[Dict[str, int], str]:
+    sf = ctx.find_file(_FAULTS_SUFFIX)
+    if sf is None or sf.tree is None:
+        return {}, ""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and \
+                node.target.id == "KNOWN_POINTS" and \
+                isinstance(node.value, ast.Dict):
+            return {k.value: k.lineno for k in node.value.keys
+                    if isinstance(k, ast.Constant)}, sf.rel
+        if isinstance(node, ast.Assign) and node.targets and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "KNOWN_POINTS" and \
+                isinstance(node.value, ast.Dict):
+            return {k.value: k.lineno for k in node.value.keys
+                    if isinstance(k, ast.Constant)}, sf.rel
+    return {}, sf.rel
+
+
+def _is_fire_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in _FIRE_FUNCS
+    if isinstance(f, ast.Attribute):
+        # faults.fire(...), plane().fire(...), self._faults.fire(...)
+        return f.attr in _FIRE_FUNCS
+    return False
+
+
+def run(ctx: Context) -> List[Finding]:
+    known, faults_rel = _known_points(ctx)
+    findings: List[Finding] = []
+    if not known:
+        return findings
+
+    fired: Dict[str, Tuple[str, int]] = {}
+    mentioned: Dict[str, Tuple[str, int]] = {}
+    for sf in ctx.files:
+        if sf.tree is None or sf.rel.endswith(_FAULTS_SUFFIX):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and _is_fire_call(node) and \
+                    node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                fired.setdefault(node.args[0].value, (sf.rel, node.lineno))
+            # any literal occurrence counts as "the seam still exists"
+            # (split-retry and chaos-menu sites pass points via variables)
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and node.value in known:
+                mentioned.setdefault(node.value, (sf.rel, node.lineno))
+
+    doc = ctx.doc_text("fault_injection.md")
+    # the doc also tables the tez.test.fault.* conf knobs — those belong
+    # to the knobs checker, not here
+    doc_points = {p for p in _DOC_POINT_RE.findall(doc)
+                  if not p.startswith("tez.")} if doc else set()
+
+    for point, (rel, line) in sorted(fired.items()):
+        if point not in known:
+            findings.append(Finding(
+                "faultpoints", "fault-unregistered", rel, line, point,
+                f"fault point {point!r} fired here but missing from "
+                f"faults.KNOWN_POINTS"))
+    for point, line in sorted(known.items()):
+        if point not in mentioned:
+            findings.append(Finding(
+                "faultpoints", "fault-unfired", faults_rel, line, point,
+                f"KNOWN_POINTS entry {point!r} never referenced outside "
+                f"common/faults.py — dead seam?"))
+        if doc and point not in doc_points:
+            findings.append(Finding(
+                "faultpoints", "fault-undocumented", faults_rel, line,
+                point,
+                f"KNOWN_POINTS entry {point!r} missing from "
+                f"docs/fault_injection.md"))
+    for point in sorted(doc_points - set(known)):
+        findings.append(Finding(
+            "faultpoints", "fault-doc-stale", "docs/fault_injection.md",
+            0, point,
+            f"docs/fault_injection.md lists {point!r} which is not in "
+            f"faults.KNOWN_POINTS"))
+    return findings
+
+
+CHECKER = Checker(
+    "faultpoints",
+    "fault-injection call sites vs faults.KNOWN_POINTS vs "
+    "docs/fault_injection.md",
+    run)

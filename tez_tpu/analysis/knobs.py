@@ -1,0 +1,106 @@
+"""Config-knob consistency: ``tez.*`` literals vs the common/config.py
+registry vs docs/configuration.md.
+
+Codes:
+
+- ``knob-unregistered`` — a ``tez.*`` string literal is read somewhere in
+  the package but never registered through ``_key()`` in
+  ``common/config.py``; such a knob is invisible to ``make docs``, to
+  scope filtering, and to defaulting.
+- ``knob-undocumented`` — registered but missing from
+  ``docs/configuration.md`` (the doc is generated — this means someone
+  edited the registry without rerunning ``make docs``).
+- ``knob-unread`` — registered but its ConfKey constant (and its literal
+  name) never appears outside ``common/config.py``: dead configuration
+  surface.  Knobs kept for registry compatibility carry an inline
+  ``# graftlint: disable=knob-unread`` with the reason.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from tez_tpu.analysis.core import Checker, Context, Finding
+
+#: A full knob name: dotted, lowercase, no trailing dot.  Prefix strings
+#: (``"tez.runtime."``) and spec fragments deliberately don't match.
+_KNOB_RE = re.compile(r"^tez(\.[a-z0-9_-]+){2,}$")
+
+_CONFIG_SUFFIX = "common/config.py"
+
+
+def _registry(ctx: Context) -> Tuple[Dict[str, Tuple[str, int]], str]:
+    """{knob name: (ConfKey var name, line)} from common/config.py."""
+    sf = ctx.find_file(_CONFIG_SUFFIX)
+    if sf is None or sf.tree is None:
+        return {}, ""
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Assign) and
+                isinstance(node.value, ast.Call) and
+                isinstance(node.value.func, ast.Name) and
+                node.value.func.id == "_key" and node.value.args and
+                isinstance(node.value.args[0], ast.Constant) and
+                isinstance(node.value.args[0].value, str)):
+            continue
+        var = node.targets[0].id if node.targets and \
+            isinstance(node.targets[0], ast.Name) else ""
+        out[node.value.args[0].value] = (var, node.lineno)
+    return out, sf.rel
+
+
+def run(ctx: Context) -> List[Finding]:
+    registered, config_rel = _registry(ctx)
+    findings: List[Finding] = []
+    if not registered:
+        return findings
+
+    #: knob literal -> first read site outside config.py
+    reads: Dict[str, Tuple[str, int]] = {}
+    #: every identifier (Name id / Attribute attr) per non-config file —
+    #: how we tell a ConfKey constant is referenced at all
+    used_idents: Set[str] = set()
+    for sf in ctx.files:
+        if sf.tree is None or sf.rel.endswith(_CONFIG_SUFFIX):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    _KNOB_RE.match(node.value):
+                reads.setdefault(node.value, (sf.rel, node.lineno))
+            elif isinstance(node, ast.Name):
+                used_idents.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                used_idents.add(node.attr)
+
+    doc = ctx.doc_text("configuration.md")
+
+    for knob, (rel, line) in sorted(reads.items()):
+        if knob not in registered:
+            findings.append(Finding(
+                "knobs", "knob-unregistered", rel, line, knob,
+                f"tez knob {knob!r} is read here but not registered via "
+                f"_key() in common/config.py"))
+
+    cfg_line = 0
+    for knob, (var, line) in sorted(registered.items()):
+        if doc and f"`{knob}`" not in doc:
+            findings.append(Finding(
+                "knobs", "knob-undocumented", config_rel, line, knob,
+                f"registered knob {knob!r} missing from "
+                f"docs/configuration.md — rerun `make docs`"))
+        if knob not in reads and (not var or var not in used_idents):
+            findings.append(Finding(
+                "knobs", "knob-unread", config_rel, line, knob,
+                f"registered knob {knob!r} ({var or 'unnamed'}) is never "
+                f"read outside common/config.py"))
+        cfg_line = max(cfg_line, line)
+    return findings
+
+
+CHECKER = Checker(
+    "knobs",
+    "tez.* conf literals vs the config.py registry vs "
+    "docs/configuration.md",
+    run)

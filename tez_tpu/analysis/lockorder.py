@@ -1,0 +1,760 @@
+"""Static lock-order analysis: discover locks, build the acquisition
+graph, report cycles as potential deadlocks.
+
+Model (ThreadSanitizer-style, but source-level):
+
+1. **Lock discovery.**  ``self.X = threading.Lock()/RLock()/Condition()``
+   inside a class names instance lock ``<module>.<Class>.X``;
+   ``X = threading.Lock()`` at module level names ``<module>.X``.
+   ``threading.Condition(self.Y)`` is an *alias*: acquiring the
+   condition acquires lock ``Y``, so both resolve to Y's name — the
+   identical naming scheme the runtime witness
+   (:mod:`tez_tpu.common.lockorder`) derives from creation frames, which
+   is what makes the static/dynamic cross-validation a set comparison.
+
+2. **Acquisition graph.**  Within each function, nested ``with`` blocks
+   on resolvable lock expressions produce held->new edges (plus bare
+   ``.acquire()`` tracked block-locally).  Function summaries — the set
+   of locks a call may (transitively) acquire — propagate edges across
+   call sites: holding A while calling ``g()`` adds A->L for every L in
+   g's summary.  Calls are resolved through self-methods, instance-attr
+   and module-global types, imported modules, and finally by method
+   name across every analyzed class (a deliberate over-approximation:
+   the runtime witness's observed edges must be a SUBSET of this graph,
+   so resolution errs toward more edges, and the cycle report pays for
+   it with an occasional triaged false positive in the baseline).
+
+3. **Cycles.**  Strongly connected components of size > 1 (or a
+   self-loop through distinct functions) are potential deadlocks; each
+   SCC is one finding whose symbol is the sorted node list, so the
+   identity survives line churn.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tez_tpu.analysis.core import Checker, Context, Finding, SourceFile
+
+_LOCK_CTORS = ("Lock", "RLock", "Condition")
+
+#: Method names excluded from the by-name call-resolution fallback:
+#: ubiquitous stdlib-collection verbs that would wire every class to
+#: every other through dict/list/set receivers the type pass can't see.
+_FALLBACK_SKIP = frozenset({
+    "get", "set", "add", "pop", "append", "appendleft", "popleft",
+    "remove", "clear", "update", "items", "keys", "values", "copy",
+    "join", "split", "strip", "startswith", "endswith", "format",
+    "encode", "decode", "read", "write", "flush", "sort", "extend",
+    "index", "count", "insert", "setdefault", "discard", "put",
+    # lock-protocol verbs: ``self.lock.wait()`` on a Condition attribute
+    # reaches here as a chain call; the acquisition itself is modeled by
+    # the enclosing ``with``, not by resolving wait() to package classes
+    "wait", "wait_for", "notify", "notify_all", "locked",
+})
+
+#: Generic lifecycle verbs so ubiquitous that a by-name fallback match
+#: says nothing about the receiver: candidates for these contribute only
+#: their DIRECT acquires to the caller's summary.  Every other fallback
+#: name (handle_events, route_*, can_commit, ...) is specific enough to
+#: propagate the candidate's full transitive summary — which is what
+#: keeps deep chains under untyped dispatch (TaskRunner holding
+#: _dispatch_lock across ``inp.handle_events(...)`` into the fetch
+#: table and merge manager) inside the graph the runtime witness
+#: validates against, without ``stop()``/``close()`` welding every
+#: class in the tree into one spurious mega-cycle.
+_FALLBACK_DIRECT_ONLY = frozenset({
+    "__init__", "initialize", "run", "close", "stop", "start",
+    "analyze", "handle", "create", "shutdown", "submit_dag",
+})
+
+
+@dataclasses.dataclass
+class _FuncInfo:
+    key: Tuple[str, str]                    # (module, qualname)
+    sf: SourceFile
+    line: int
+    #: (lock name, locks held at that point)
+    acquires: List[Tuple[str, Tuple[str, ...]]] = \
+        dataclasses.field(default_factory=list)
+    #: (call descriptor, locks held at that point, lineno)
+    calls: List[Tuple[tuple, Tuple[str, ...], int]] = \
+        dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    module: str
+    name: str                               # qualified within module
+    sf: SourceFile
+    bases: List[str] = dataclasses.field(default_factory=list)
+    #: attr -> canonical lock name (aliases already resolved)
+    lock_attrs: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: attr -> (module, class) of the instance assigned to it
+    attr_types: Dict[str, Tuple[str, str]] = \
+        dataclasses.field(default_factory=dict)
+    methods: Dict[str, _FuncInfo] = dataclasses.field(default_factory=dict)
+    #: __init__ parameter names, in order (self excluded)
+    init_params: List[str] = dataclasses.field(default_factory=list)
+    #: attr -> __init__ param it was assigned from (``self.x = x`` /
+    #: ``self.x = x or default``): the stored-callback seam
+    attr_from_param: Dict[str, str] = \
+        dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class _ModuleInfo:
+    name: str
+    sf: SourceFile
+    #: local alias -> analyzed-module dotted name (tez_tpu imports only)
+    imports: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: local name -> (module, function) for from-imports of functions
+    func_imports: Dict[str, Tuple[str, str]] = \
+        dataclasses.field(default_factory=dict)
+    #: local name -> (module, class) for from-imports of classes
+    class_imports: Dict[str, Tuple[str, str]] = \
+        dataclasses.field(default_factory=dict)
+    threading_aliases: Set[str] = dataclasses.field(default_factory=set)
+    classes: Dict[str, _ClassInfo] = dataclasses.field(default_factory=dict)
+    functions: Dict[str, _FuncInfo] = dataclasses.field(default_factory=dict)
+    global_locks: Dict[str, str] = dataclasses.field(default_factory=dict)
+    global_types: Dict[str, Tuple[str, str]] = \
+        dataclasses.field(default_factory=dict)
+
+
+class _Model:
+    """The whole-package model all passes share."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, _ModuleInfo] = {}
+        self.functions: Dict[Tuple[str, str], _FuncInfo] = {}
+        #: method name -> [(module, class)] across every analyzed class
+        self.methods_by_name: Dict[str, List[Tuple[str, str]]] = {}
+        #: class simple name -> [(module, class)]
+        self.classes_by_name: Dict[str, List[Tuple[str, str]]] = {}
+        #: (module, class, ctor param) -> bound methods / functions passed
+        #: as that argument at any constructor call site — resolving
+        #: stored-callback invocations like ``self._on_complete(...)``
+        #: back to e.g. ``DeviceSorter._async_complete``
+        self.callback_bindings: Dict[Tuple[str, str, str],
+                                     Set[Tuple[str, str]]] = {}
+
+
+# --------------------------------------------------------------------------
+# Pass 1: per-module discovery
+# --------------------------------------------------------------------------
+
+def _is_lock_ctor(node: ast.expr, mi: _ModuleInfo) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' when node constructs one, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id in mi.threading_aliases \
+            and f.attr in _LOCK_CTORS:
+        return f.attr
+    if isinstance(f, ast.Name):
+        target = mi.func_imports.get(f.id)
+        if target is not None and target[0] == "threading" \
+                and target[1] in _LOCK_CTORS:
+            return target[1]
+    return None
+
+
+def _collect_imports(tree: ast.AST, mi: _ModuleInfo, pkg: str) -> None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name, asname = alias.name, alias.asname or alias.name
+                if name == "threading":
+                    mi.threading_aliases.add(asname)
+                elif name.startswith(pkg + "."):
+                    mi.imports[asname.split(".")[0] if not alias.asname
+                               else asname] = name[len(pkg) + 1:]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            mod = node.module
+            if mod == "threading":
+                for alias in node.names:
+                    mi.func_imports[alias.asname or alias.name] = \
+                        ("threading", alias.name)
+            elif mod == pkg or mod.startswith(pkg + "."):
+                sub = mod[len(pkg) + 1:] if mod != pkg else ""
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    # module import (from tez_tpu.common import metrics)
+                    # vs symbol import — disambiguated in the link pass,
+                    # record both candidate meanings here
+                    dotted = f"{sub}.{alias.name}" if sub else alias.name
+                    mi.imports.setdefault(local, dotted)
+                    if sub:
+                        mi.func_imports.setdefault(local, (sub, alias.name))
+                        mi.class_imports.setdefault(local, (sub, alias.name))
+
+
+def _resolve_class_ctor(node: ast.expr, mi: _ModuleInfo
+                        ) -> Optional[Tuple[str, str]]:
+    """(module, class) when node is ``Class(...)`` / ``mod.Class(...)``
+    over names importable from the analyzed package; linked later."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Name):
+        if f.id in mi.classes:
+            return (mi.name, f.id)
+        if f.id in mi.class_imports:
+            return mi.class_imports[f.id]
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id in mi.imports:
+        return (mi.imports[f.value.id], f.attr)
+    return None
+
+
+def _discover_module(ctx: Context, sf: SourceFile, model: _Model) -> None:
+    mi = _ModuleInfo(ctx.module_name(sf), sf)
+    model.modules[mi.name] = mi
+    assert sf.tree is not None
+    _collect_imports(sf.tree, mi, "tez_tpu")
+
+    def walk_class(cnode: ast.ClassDef, prefix: str) -> None:
+        cname = f"{prefix}{cnode.name}"
+        ci = _ClassInfo(mi.name, cname, sf)
+        ci.bases = [b.id for b in cnode.bases if isinstance(b, ast.Name)]
+        mi.classes[cname] = ci
+        model.classes_by_name.setdefault(cnode.name, []).append(
+            (mi.name, cname))
+        pending_alias: List[Tuple[str, str]] = []    # (attr, target attr)
+        for item in cnode.body:
+            if isinstance(item, ast.ClassDef):
+                walk_class(item, f"{cname}.")
+            elif isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = _FuncInfo((mi.name, f"{cname}.{item.name}"), sf,
+                               item.lineno)
+                ci.methods[item.name] = fi
+                model.functions[fi.key] = fi
+                model.methods_by_name.setdefault(item.name, []).append(
+                    (mi.name, cname))
+                if item.name == "__init__":
+                    ci.init_params = [
+                        a.arg for a in (item.args.args[1:]
+                                        + item.args.kwonlyargs)]
+                for sub in ast.walk(item):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    for tgt in sub.targets:
+                        if not (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            continue
+                        kind = _is_lock_ctor(sub.value, mi)
+                        if kind == "Condition" and sub.value.args:
+                            arg = sub.value.args[0]
+                            if isinstance(arg, ast.Attribute) and \
+                                    isinstance(arg.value, ast.Name) and \
+                                    arg.value.id == "self":
+                                pending_alias.append((tgt.attr, arg.attr))
+                                continue
+                        if kind is not None:
+                            ci.lock_attrs[tgt.attr] = \
+                                f"{mi.name}.{cname}.{tgt.attr}"
+                            continue
+                        typ = _resolve_class_ctor(sub.value, mi)
+                        if typ is not None:
+                            ci.attr_types[tgt.attr] = typ
+                            continue
+                        if item.name == "__init__":
+                            val = sub.value
+                            if isinstance(val, ast.BoolOp) and val.values:
+                                val = val.values[0]   # ``param or default``
+                            if isinstance(val, ast.Name) and \
+                                    val.id in ci.init_params:
+                                ci.attr_from_param[tgt.attr] = val.id
+        for attr, target in pending_alias:
+            ci.lock_attrs[attr] = ci.lock_attrs.get(
+                target, f"{mi.name}.{cname}.{target}")
+
+    globals_decls: Set[str] = set()
+    for node in sf.tree.body:
+        if isinstance(node, ast.ClassDef):
+            walk_class(node, "")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fi = _FuncInfo((mi.name, node.name), sf, node.lineno)
+            mi.functions[node.name] = fi
+            model.functions[fi.key] = fi
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Global):
+                    globals_decls.update(sub.names)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                if _is_lock_ctor(node.value, mi):
+                    mi.global_locks[tgt.id] = f"{mi.name}.{tgt.id}"
+                else:
+                    typ = _resolve_class_ctor(node.value, mi)
+                    if typ is not None:
+                        mi.global_types[tgt.id] = typ
+    # module globals assigned from inside functions (singleton factories):
+    # NAME = Class(...) under a ``global NAME`` declaration
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id in globals_decls:
+            typ = _resolve_class_ctor(node.value, mi)
+            if typ is not None:
+                mi.global_types.setdefault(node.targets[0].id, typ)
+
+
+# --------------------------------------------------------------------------
+# Pass 2: per-function acquisition + call events
+# --------------------------------------------------------------------------
+
+class _FuncWalker:
+    """Walk one function body tracking the held-lock stack through
+    nested ``with`` statements and block-local bare ``.acquire()``s."""
+
+    def __init__(self, model: _Model, mi: _ModuleInfo,
+                 ci: Optional[_ClassInfo], fi: _FuncInfo) -> None:
+        self.model = model
+        self.mi = mi
+        self.ci = ci
+        self.fi = fi
+
+    # -- lock expression resolution -----------------------------------------
+    def lock_of(self, node: ast.expr) -> Optional[str]:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name):
+            base, attr = node.value.id, node.attr
+            if base == "self" and self.ci is not None:
+                return self.ci.lock_attrs.get(attr)
+            if base in self.mi.imports:
+                target = self.model.modules.get(self.mi.imports[base])
+                if target is not None:
+                    return target.global_locks.get(attr)
+            return None
+        if isinstance(node, ast.Name):
+            return self.mi.global_locks.get(node.id)
+        return None
+
+    # -- call descriptor extraction ------------------------------------------
+    def call_ref(self, node: ast.Call) -> Optional[tuple]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            return ("name", f.id)
+        if isinstance(f, ast.Attribute):
+            m = f.attr
+            v = f.value
+            if isinstance(v, ast.Name):
+                if v.id == "self":
+                    return ("self", m)
+                if v.id in self.mi.imports:
+                    return ("mod", self.mi.imports[v.id], m)
+                if v.id in self.mi.global_types:
+                    return ("typed", self.mi.global_types[v.id], m)
+                return ("chain", m)
+            if isinstance(v, ast.Attribute) and \
+                    isinstance(v.value, ast.Name) and v.value.id == "self" \
+                    and self.ci is not None and \
+                    v.attr in self.ci.attr_types:
+                return ("typed", self.ci.attr_types[v.attr], m)
+            return ("chain", m)
+        return None
+
+    # -- body walking ---------------------------------------------------------
+    def walk_body(self, body: Sequence[ast.stmt],
+                  held: Tuple[str, ...]) -> None:
+        extra: List[str] = []          # block-local bare .acquire()s
+        for stmt in body:
+            self.walk_stmt(stmt, held + tuple(extra), extra)
+
+    def _record_acquire(self, lock: str, held: Tuple[str, ...]) -> None:
+        if lock not in held:
+            self.fi.acquires.append((lock, held))
+
+    def _bare_lock_call(self, stmt: ast.stmt) -> Optional[Tuple[str, str]]:
+        """(lock, 'acquire'|'release') for ``X.acquire()`` statements."""
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr in ("acquire", "release")):
+            return None
+        lock = self.lock_of(stmt.value.func.value)
+        return (lock, stmt.value.func.attr) if lock else None
+
+    def walk_stmt(self, stmt: ast.stmt, held: Tuple[str, ...],
+                  extra: List[str]) -> None:
+        bare = self._bare_lock_call(stmt)
+        if bare is not None:
+            lock, op = bare
+            if op == "acquire":
+                self._record_acquire(lock, held)
+                if lock not in held:
+                    extra.append(lock)
+            elif lock in extra:
+                extra.remove(lock)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in stmt.items:
+                self.scan_calls(item.context_expr, held)
+                lock = self.lock_of(item.context_expr)
+                if lock is not None:
+                    self._record_acquire(lock, inner)
+                    if lock not in inner:
+                        inner = inner + (lock,)
+            self.walk_body(stmt.body, inner)
+            return
+        # non-with compound statements: recurse with the same held set
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                self.walk_body(sub, held)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self.walk_body(handler.body, held)
+        if isinstance(stmt, (ast.If, ast.While)):
+            self.scan_calls(stmt.test, held)
+        elif isinstance(stmt, ast.For):
+            self.scan_calls(stmt.iter, held)
+        elif not hasattr(stmt, "body"):
+            self.scan_calls(stmt, held)
+
+    def scan_calls(self, node: ast.AST, held: Tuple[str, ...]) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue        # nested defs analyzed when called, not here
+            if isinstance(sub, ast.Call):
+                ref = self.call_ref(sub)
+                if ref is not None:
+                    self.fi.calls.append((ref, held, sub.lineno))
+                target = _resolve_class_ctor(sub, self.mi)
+                if target is not None:
+                    self._record_ctor_bindings(sub, target)
+
+    def _record_ctor_bindings(self, call: ast.Call,
+                              target: Tuple[str, str]) -> None:
+        """Bound methods / local functions passed as constructor args are
+        stored-callback candidates (``on_complete=self._async_complete``):
+        remember them per (class, param) so calls through the stored attr
+        resolve exactly instead of vanishing from the graph."""
+        mod, cls = target
+        tmi = self.model.modules.get(mod)
+        tci = tmi.classes.get(cls) if tmi is not None else None
+        if tci is None:
+            return
+
+        def meth_key(expr: ast.expr) -> Optional[Tuple[str, str]]:
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id == "self" and self.ci is not None and \
+                    expr.attr in self.ci.methods:
+                return (self.mi.name, f"{self.ci.name}.{expr.attr}")
+            if isinstance(expr, ast.Name) and expr.id in self.mi.functions:
+                return (self.mi.name, expr.id)
+            return None
+
+        for i, arg in enumerate(call.args):
+            key = meth_key(arg)
+            if key is not None and i < len(tci.init_params):
+                self.model.callback_bindings.setdefault(
+                    (mod, cls, tci.init_params[i]), set()).add(key)
+        for kw in call.keywords:
+            key = meth_key(kw.value)
+            if kw.arg is not None and key is not None:
+                self.model.callback_bindings.setdefault(
+                    (mod, cls, kw.arg), set()).add(key)
+
+
+def _walk_functions(model: _Model) -> None:
+    for mi in model.modules.values():
+        tree = mi.sf.tree
+        assert tree is not None
+
+        def handle(fnode, ci: Optional[_ClassInfo], fi: _FuncInfo) -> None:
+            _FuncWalker(model, mi, ci, fi).walk_body(fnode.body, ())
+
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                handle(node, None, mi.functions[node.name])
+            elif isinstance(node, ast.ClassDef):
+                stack = [(node, "")]
+                while stack:
+                    cnode, prefix = stack.pop()
+                    cname = f"{prefix}{cnode.name}"
+                    ci = mi.classes[cname]
+                    for item in cnode.body:
+                        if isinstance(item, ast.ClassDef):
+                            stack.append((item, f"{cname}."))
+                        elif isinstance(item, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef)):
+                            handle(item, ci, ci.methods[item.name])
+
+
+# --------------------------------------------------------------------------
+# Pass 3: call resolution + summary fixed point + edge generation
+# --------------------------------------------------------------------------
+
+def _resolve_call(model: _Model, mi: _ModuleInfo, fi: _FuncInfo,
+                  ref: tuple) -> List[Tuple[str, str, bool]]:
+    """Candidate callees as (module, qualname, exact).  ``exact`` callees
+    (resolved through self/types/imports) always propagate their full
+    transitive acquisition summary.  Name-fallback candidates do too
+    *unless* the method name is a generic lifecycle verb
+    (:data:`_FALLBACK_DIRECT_ONLY`), where candidates contribute only
+    their direct acquires — keeping chains like
+    ``counters.group(g).find_counter(n).increment()`` and untyped
+    dispatch like ``inp.handle_events(...)`` in the graph without
+    letting every ``stop()``/``start()`` in the tree weld all classes
+    into one spurious mega-cycle."""
+    kind = ref[0]
+    out: List[Tuple[str, str, bool]] = []
+
+    def class_method(mod: str, cls: str, meth: str) -> bool:
+        cmi = model.modules.get(mod)
+        if cmi is None:
+            return False
+        ci = cmi.classes.get(cls)
+        if ci is None:
+            return False
+        if meth in ci.methods:
+            out.append((mod, f"{cls}.{meth}", True))
+            return True
+        for base in ci.bases:      # single-level inheritance walk
+            for bmod, bcls in model.classes_by_name.get(base, []):
+                bmi = model.modules.get(bmod)
+                if bmi and meth in bmi.classes[bcls].methods:
+                    out.append((bmod, f"{bcls}.{meth}", True))
+                    return True
+        return False
+
+    def fallback(meth: str) -> None:
+        if meth in _FALLBACK_SKIP:
+            return
+        for mod, cls in model.methods_by_name.get(meth, []):
+            out.append((mod, f"{cls}.{meth}", False))
+
+    if kind == "self":
+        meth = ref[1]
+        cls = fi.key[1].rsplit(".", 1)[0] if "." in fi.key[1] else None
+        if cls is not None and class_method(mi.name, cls, meth):
+            return out
+        # ``self.X(...)`` where X is not a method: a stored callback.
+        # When X was assigned from an __init__ param, resolve to every
+        # bound method any constructor call site passed for that param —
+        # exact, so full summaries flow (the _complete_lock -> sorter /
+        # merge-manager chains the runtime witness observes).
+        ci = model.modules[mi.name].classes.get(cls) if cls else None
+        param = ci.attr_from_param.get(meth) if ci is not None else None
+        bound = model.callback_bindings.get((mi.name, cls, param)) \
+            if param is not None else None
+        if bound:
+            out.extend((m, q, True) for m, q in sorted(bound))
+        else:
+            fallback(meth)
+    elif kind == "typed":
+        (mod, cls), meth = ref[1], ref[2]
+        if not class_method(mod, cls, meth):
+            fallback(meth)
+    elif kind == "mod":
+        mod, name = ref[1], ref[2]
+        tmi = model.modules.get(mod)
+        if tmi is not None:
+            if name in tmi.functions:
+                out.append((mod, name, True))
+            elif name in tmi.classes:
+                class_method(mod, name, "__init__")
+        if not out:
+            # ``from tez_tpu.x import y`` records y under imports too;
+            # ref ("mod", "x.y", name) may really be class/func y's attr
+            fallback(name)
+    elif kind == "name":
+        name = ref[1]
+        if name in mi.functions:
+            out.append((mi.name, name, True))
+        elif name in mi.classes:
+            class_method(mi.name, name, "__init__")
+        elif name in mi.func_imports:
+            mod, orig = mi.func_imports[name]
+            tmi = model.modules.get(mod)
+            if tmi is not None:
+                if orig in tmi.functions:
+                    out.append((mod, orig, True))
+                elif orig in tmi.classes:
+                    class_method(mod, orig, "__init__")
+    elif kind == "chain":
+        fallback(ref[1])
+    return out
+
+
+def build_graph(ctx: Context) -> Tuple[
+        Dict[Tuple[str, str], Tuple[str, int]], Set[str]]:
+    """The static lock graph: {(held, acquired): (function key, line)}
+    plus the set of every discovered lock name."""
+    model = _Model()
+    for sf in ctx.files:
+        if sf.tree is not None:
+            _discover_module(ctx, sf, model)
+    _walk_functions(model)
+
+    resolved_calls: Dict[Tuple[str, str],
+                         List[Tuple[List[Tuple[str, str, bool]],
+                                    Tuple[str, ...], int]]] = {}
+    for key, fi in model.functions.items():
+        mi = model.modules[key[0]]
+        resolved_calls[key] = [
+            (_resolve_call(model, mi, fi, ref), held, line)
+            for ref, held, line in fi.calls]
+
+    # fixed point: transitive acquisition summary per function.  Exact
+    # callees and name-specific fallbacks propagate their whole summary;
+    # generic-verb fallbacks contribute only their direct acquires (see
+    # _resolve_call / _FALLBACK_DIRECT_ONLY).
+    direct: Dict[Tuple[str, str], Set[str]] = {
+        key: {lock for lock, _ in fi.acquires}
+        for key, fi in model.functions.items()}
+    summary: Dict[Tuple[str, str], Set[str]] = {
+        key: set(acq) for key, acq in direct.items()}
+
+    def source_for(qual: str, exact: bool) -> Dict[Tuple[str, str], Set[str]]:
+        if exact or qual.rsplit(".", 1)[-1] not in _FALLBACK_DIRECT_ONLY:
+            return summary
+        return direct
+
+    changed = True
+    while changed:
+        changed = False
+        for key in model.functions:
+            acc = summary[key]
+            before = len(acc)
+            for callees, _, _ in resolved_calls[key]:
+                for mod, qual, exact in callees:
+                    acc |= source_for(qual, exact).get((mod, qual), set())
+            if len(acc) != before:
+                changed = True
+
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def add(a: str, b: str, where: str, line: int) -> None:
+        if a != b:
+            edges.setdefault((a, b), (where, line))
+
+    for key, fi in model.functions.items():
+        where = f"{key[0]}.{key[1]}"
+        for lock, held in fi.acquires:
+            for h in held:
+                add(h, lock, where, fi.line)
+        for callees, held, line in resolved_calls[key]:
+            if not held:
+                continue
+            for mod, qual, exact in callees:
+                for lock in source_for(qual, exact).get((mod, qual), ()):
+                    for h in held:
+                        add(h, lock, where, line)
+
+    locks: Set[str] = set()
+    for mi in model.modules.values():
+        locks.update(mi.global_locks.values())
+        for ci in mi.classes.values():
+            locks.update(ci.lock_attrs.values())
+    return edges, locks
+
+
+def lock_graph(ctx: Context) -> Set[Tuple[str, str]]:
+    """Edge set only — what the runtime witness validates against."""
+    return set(build_graph(ctx)[0])
+
+
+# --------------------------------------------------------------------------
+# Cycle reporting
+# --------------------------------------------------------------------------
+
+def _sccs(nodes: Set[str],
+          adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """Tarjan SCCs, iterative (the graph is small but recursion-free
+    keeps pathological fixtures safe)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(nodes):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    out.append(sorted(comp))
+    return out
+
+
+def run(ctx: Context) -> List[Finding]:
+    edges, _locks = build_graph(ctx)
+    adj: Dict[str, Set[str]] = {}
+    nodes: Set[str] = set()
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+        nodes.add(a)
+        nodes.add(b)
+    findings: List[Finding] = []
+    for comp in _sccs(nodes, adj):
+        inside = [(a, b) for (a, b) in edges
+                  if a in comp and b in comp]
+        inside.sort()
+        samples = "; ".join(
+            f"{a}->{b} at {edges[(a, b)][0]}:{edges[(a, b)][1]}"
+            for a, b in inside[:4])
+        where, line = edges[inside[0]]
+        mod = where.split(".")[0]
+        sf = None
+        for cand in ctx.files:
+            if ctx.module_name(cand) == where.rsplit(".", 2)[0] or \
+                    ctx.module_name(cand).startswith(mod):
+                sf = cand
+                break
+        findings.append(Finding(
+            "lockorder", "lock-cycle",
+            sf.rel if sf is not None else "tez_tpu",
+            line, "<->".join(comp),
+            f"potential deadlock: lock acquisition cycle "
+            f"{' -> '.join(comp + comp[:1])} ({samples})"))
+    return findings
+
+
+CHECKER = Checker(
+    "lockorder",
+    "lock acquisition graph cycles (potential deadlocks), "
+    "cross-validated by the tez.debug.lockorder runtime witness",
+    run)

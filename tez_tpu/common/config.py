@@ -93,10 +93,28 @@ class TezConfiguration(dict):
 # --------------------------------------------------------------------------
 # AM / framework keys (TezConfiguration.java analog)
 # --------------------------------------------------------------------------
+# registry-compat key superseded by tez.framework.mode  # graftlint: disable=knob-unread
 LOCAL_MODE = _key("tez.local.mode", True, Scope.CLIENT,
                   "Run orchestrator in-process (reference: TezConfiguration.TEZ_LOCAL_MODE)")
 SESSION_MODE = _key("tez.session.mode", False, Scope.CLIENT,
                     "Keep AM alive across DAGs")
+FRAMEWORK_MODE = _key(
+    "tez.framework.mode", "local", Scope.CLIENT,
+    "'local' = in-process AM; 'remote' = connect to a running AM over "
+    "the umbilical wire (client-only key, never shipped into DAG plans)")
+AM_ADDRESS = _key(
+    "tez.am.address", "", Scope.CLIENT,
+    "host:port of the remote AM umbilical endpoint (remote framework "
+    "mode; client-only key)")
+JOB_TOKEN = _key(
+    "tez.job.token", "", Scope.CLIENT,
+    "hex-encoded shared job secret authenticating umbilical and shuffle "
+    "peers (client-only key, never shipped into DAG plans — see "
+    "TezClient._CLIENT_ONLY_KEYS)")
+APP_ID = _key(
+    "tez.app.id", "", Scope.AM,
+    "externally-assigned application id for history/log correlation; "
+    "'' = derive one from the submit timestamp")
 STAGING_DIR = _key("tez.staging-dir", "/tmp/tez-tpu-staging", Scope.CLIENT)
 AM_MAX_APP_ATTEMPTS = _key("tez.am.max.app.attempts", 2, Scope.AM)
 TASK_MAX_FAILED_ATTEMPTS = _key("tez.am.task.max.failed.attempts", 4, Scope.VERTEX,
@@ -125,6 +143,7 @@ AM_SESSION_MIN_HELD_CONTAINERS = _key("tez.am.session.min.held-containers", 0, S
 AM_CONTAINER_IDLE_RELEASE_TIMEOUT_MIN = _key(
     "tez.am.container.idle.release-timeout-min.millis", 5000, Scope.AM)
 TASK_HEARTBEAT_TIMEOUT_MS = _key("tez.task.heartbeat.timeout-ms", 300_000, Scope.VERTEX)
+# reference-parity key; liveness uses tez.task.heartbeat.timeout-ms  # graftlint: disable=knob-unread
 CONTAINER_HEARTBEAT_TIMEOUT_MS = _key("tez.container.heartbeat.timeout-ms", 300_000, Scope.AM)
 TASK_PROGRESS_STUCK_INTERVAL_MS = _key("tez.task.progress.stuck.interval-ms", -1, Scope.VERTEX)
 SPECULATION_ENABLED = _key("tez.am.speculation.enabled", False, Scope.VERTEX)
@@ -207,6 +226,13 @@ TEST_FAULT_SEED = _key(
     "Seed for the fault plane's deterministic schedule; the same "
     "(spec, seed) pair replays the identical fault storm "
     "(python -m tez_tpu.tools.chaos --seed N prints repro seeds)")
+DEBUG_LOCKORDER = _key(
+    "tez.debug.lockorder", False, Scope.DAG,
+    "Arm the runtime lock-order witness for this DAG (test/chaos only): "
+    "locks created inside tez_tpu are wrapped to record nested "
+    "acquisition edges and flag order inversions, cross-validating the "
+    "static graph from tez_tpu.analysis.lockorder (graftlint).  "
+    "See docs/static_analysis.md.  Off = zero cost")
 TRACE_ENABLED = _key(
     "tez.trace.enabled", False, Scope.DAG,
     "Arm the distributed tracing plane for this DAG: causal spans across "
@@ -371,6 +397,10 @@ POD_POOL_K8S_NAMESPACE = _key("tez.am.pod-pool.k8s.namespace", "default",
                               Scope.AM)
 POD_POOL_K8S_IMAGE = _key("tez.am.pod-pool.k8s.image",
                           "tez-tpu-runner:latest", Scope.AM)
+POD_POOL_K8S_POD_TEMPLATE = _key(
+    "tez.am.pod-pool.k8s.pod-template", "", Scope.AM,
+    "Path to a pod-spec YAML merged under the generated runner pod "
+    "(resources, tolerations, TPU node selectors); '' = built-in spec")
 
 # --------------------------------------------------------------------------
 # Runtime (per-edge / per-IO) keys (TezRuntimeConfiguration.java analog)
@@ -405,6 +435,7 @@ SHUFFLE_MERGE_BUDGET_MB = _key(
     "tez.runtime.shuffle.merge.budget.mb", 0, Scope.VERTEX,
     "consumer-side fetch/merge memory budget; 0 = use the MemoryDistributor "
     "grant (fetch.buffer.percent x io.sort.mb request)")
+# reference-parity key; penalty logic uses the report-window knobs  # graftlint: disable=knob-unread
 SHUFFLE_FAILED_CHECK_SINCE_LAST_COMPLETION = _key(
     "tez.runtime.shuffle.failed.check.since-last.completion", True, Scope.VERTEX)
 SHUFFLE_FETCH_MAX_TASK_OUTPUT_AT_ONCE = _key(
@@ -482,6 +513,11 @@ REPORT_PARTITION_STATS = _key("tez.runtime.report.partition.stats", True, Scope.
                               "(feeds auto-parallelism)")
 KEY_WIDTH_BYTES = _key("tez.runtime.tpu.key.width.bytes", 16, Scope.VERTEX,
                        "Fixed normalized key width for device radix sort (TPU-specific)")
+MESH_VALUE_WIDTH_BYTES = _key(
+    "tez.runtime.tpu.mesh.value.width.bytes", 16, Scope.VERTEX,
+    "Fixed value lane width for mesh-exchange edges (values are packed "
+    "into fixed-width device lanes for the SPMD all-to-all)")
+# reference-parity key; span sizing uses hbm budget + bucket ladder  # graftlint: disable=knob-unread
 DEVICE_BATCH_RECORDS = _key("tez.runtime.tpu.batch.records", 1 << 20, Scope.VERTEX,
                             "Records per device sort batch (static shape bucket)")
 DEVICE_SORT_MIN_RECORDS = _key(

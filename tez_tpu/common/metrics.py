@@ -128,6 +128,9 @@ WELL_KNOWN_HISTOGRAMS = ("shuffle.fetch.rtt", "spill.write", "shuffle.merge",
                          # host-engine failover re-sorts (failure
                          # containment, ops/async_stage.py)
                          "device.failover.host_sort",
+                         # in-process local-fetch short circuit latency
+                         # (shuffle/scheduler.py store/registry fast path)
+                         "shuffle.fetch.short_circuit",
                          # tiered buffer store (tez_tpu/store): publish
                          # admission, leased fetch, and watermark demotion
                          # (host->disk spill happens inside the demote timer)

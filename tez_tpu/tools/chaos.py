@@ -733,6 +733,39 @@ def _export_trace(path: str) -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    """Every chaos scenario runs under the runtime lock-order witness
+    (tez.debug.lockorder plane): nested lock acquisitions recorded during
+    the storm are checked for order inversions and cross-validated
+    against graftlint's static lock graph, so the soak gates acquisition
+    discipline alongside bit-exactness."""
+    from tez_tpu.common import lockorder
+    lockorder.arm("chaos")
+    try:
+        rc = _dispatch(argv)
+    finally:
+        lockorder.disarm("chaos")
+    try:
+        import tez_tpu
+        from tez_tpu.analysis import lockorder as static_lockorder
+        from tez_tpu.analysis.core import Context
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(tez_tpu.__file__)))
+        edges, locks = static_lockorder.build_graph(Context(root))
+        problems = lockorder.check(set(edges), locks)
+    except Exception as e:  # noqa: BLE001 — static pass must not mask rc
+        print(f"WARN lock-order witness: static cross-check skipped ({e})")
+        problems = lockorder.check()
+    if problems:
+        for p in problems:
+            print(f"FAIL lock-order witness: {p}")
+        return rc or 1
+    print(f"ok   lock-order witness: "
+          f"{len(lockorder.witness().edges())} edge(s) recorded, "
+          f"0 violations")
+    return rc
+
+
+def _dispatch(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tez_tpu.tools.chaos", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)

@@ -6,7 +6,7 @@ PY ?= python
 OLD ?= BENCH_r05.json
 NEW ?= /tmp/bench_new.json
 
-.PHONY: test lint bench bench-new bench-diff bench-merge bench-store chaos chaos-device-ooo chaos-device chaos-merge chaos-store docs
+.PHONY: test lint bench bench-new bench-diff bench-merge bench-store bench-sort chaos chaos-device-ooo chaos-device chaos-merge chaos-store chaos-push docs
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -38,6 +38,11 @@ bench-merge:
 bench-store:
 	JAX_PLATFORMS=cpu TEZ_BENCH_STORE_ONLY=1 $(PY) bench.py
 
+# external-sort scale leg: the same spill-heavy sort DAG end-to-end with
+# pull-based vs push-based shuffle; bench-diff enforces the ratio floor
+bench-sort:
+	JAX_PLATFORMS=cpu TEZ_BENCH_SORT_ONLY=1 $(PY) bench.py
+
 chaos:
 	$(PY) -m tez_tpu.tools.chaos --trials 3
 
@@ -57,6 +62,12 @@ chaos-merge:
 # tiers forces demotion/eviction mid-merge, output bit-exact vs store-off
 chaos-store:
 	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --store-pressure --trials 3
+
+# push-transport kill storm: eager pushes die mid-map-wave (seeded
+# shuffle.push.send faults); the pull backstop must keep the output
+# bit-exact vs a fault-free pull-only baseline
+chaos-push:
+	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --push-storm --trials 3
 
 docs:
 	$(PY) -m tez_tpu.tools.gen_config_docs > docs/configuration.md

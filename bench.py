@@ -702,6 +702,12 @@ def main() -> int:
         print(json.dumps(bench_store(num_records, 12, cpu_fallback)),
               flush=True)
         return 0
+    if os.environ.get("TEZ_BENCH_SORT_ONLY") == "1":
+        # make bench-sort: the external-sort push-vs-pull scale leg through
+        # the full framework — pure host path, no device probe needed
+        from tez_tpu.tools.sort_bench import bench_sort
+        print(json.dumps(bench_sort(cpu_fallback)), flush=True)
+        return 0
     if os.environ.get("TEZ_BENCH_MERGE_ONLY") == "1":
         # make bench-merge: just the reduce-side merge-path info line
         num_records = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000

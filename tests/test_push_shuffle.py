@@ -1,0 +1,345 @@
+"""Push-based pipelined shuffle: admission, transport, fencing, backstop.
+
+The contract under test (docs/push_shuffle.md): every spill is registered
+for pull BEFORE its push is queued, so no push failure — rejection storm,
+dead pusher, bad auth, stale epoch — may ever lose data; pushes that do
+land are zero-copy aliases of the pull-registered run (same host) or
+per-partition runs under ``push_key`` (remote).
+"""
+import threading
+import time
+
+import pytest
+
+from tez_tpu.common import epoch as epoch_registry
+from tez_tpu.common import faults
+from tez_tpu.common.counters import TaskCounter, TezCounters
+from tez_tpu.common.epoch import EpochFencedError
+from tez_tpu.common.faults import parse_spec
+from tez_tpu.common.security import JobTokenSecretManager
+from tez_tpu.ops.sorter import DeviceSorter
+from tez_tpu.shuffle.push import (PushAdmissionController, PushRejected,
+                                  SpillPusher, push_key)
+from tez_tpu.shuffle.server import ShuffleServer
+from tez_tpu.shuffle.service import ShuffleDataNotFound, ShuffleService
+from tez_tpu.store.buffer_store import HOST, ShuffleBufferStore
+
+
+def _make_run(partitions=3, records=60, tag="k"):
+    sorter = DeviceSorter(num_partitions=partitions)
+    for i in range(records):
+        sorter.write(f"{tag}{i:04d}".encode(), f"v{i}".encode())
+    return sorter.flush()
+
+
+@pytest.fixture()
+def landing(tmp_path):
+    """Consumer-side landing zone: service + buffer store + admission."""
+    service = ShuffleService()
+    store = ShuffleBufferStore(device_capacity=0, host_capacity=8 << 20,
+                               disk_dir=str(tmp_path / "spill"))
+    service.attach_buffer_store(store)
+    admission = PushAdmissionController(lambda: store,
+                                        source_quota_bytes=4 << 20,
+                                        retry_after_ms=1.0)
+    service.attach_push_admission(admission)
+    return service, store, admission
+
+
+# ---------------------------------------------------------------- admission
+
+def test_admission_watermark_rejects_above_host_capacity(tmp_path):
+    store = ShuffleBufferStore(device_capacity=0, host_capacity=1000,
+                               disk_dir=str(tmp_path))
+    adm = PushAdmissionController(lambda: store, admit_watermark=0.5,
+                                  retry_after_ms=7.0)
+    adm.admit("src_a", 100)
+    with pytest.raises(PushRejected) as ei:
+        adm.admit("src_a", 600)     # 0 in tier yet, but 600 > 1000 * 0.5
+    assert ei.value.retry_after_ms == 7.0
+    assert "watermark" in ei.value.reason
+    assert adm.admitted == 1 and adm.rejected == 1
+
+
+def test_admission_source_quota_first_oversize_admitted():
+    adm = PushAdmissionController(lambda: None, source_quota_bytes=100)
+    # no store: everything is rejected outright (push has no landing zone)
+    with pytest.raises(PushRejected) as ei:
+        adm.admit("src", 10)
+    assert "landing zone" in ei.value.reason
+
+    store_holder = []
+    adm2 = PushAdmissionController(lambda: store_holder[0],
+                                   source_quota_bytes=100)
+
+    class _FakeStore:
+        host_capacity = 0           # watermark rule off
+
+        def tier_bytes(self, tier):
+            return 0
+
+    store_holder.append(_FakeStore())
+    adm2.admit("hot", 5000)         # oversize while holding nothing: allowed
+    assert adm2.held("hot") == 5000
+    with pytest.raises(PushRejected):
+        adm2.admit("hot", 1)        # quota exhausted once holding
+    adm2.admit("cold", 50)
+    adm2.admit("cold", 50)
+    with pytest.raises(PushRejected):
+        adm2.admit("cold", 1)
+    assert adm2.release_prefix("hot") == 5000
+    adm2.admit("hot", 60)           # quota returned
+    assert adm2.held("hot") == 60
+
+
+def test_admission_fault_point_turns_decision_into_rejection():
+    class _FakeStore:
+        host_capacity = 0
+
+        def tier_bytes(self, tier):
+            return 0
+
+    adm = PushAdmissionController(lambda: _FakeStore())
+    faults.install("t", parse_spec("shuffle.push.admit:fail:n=1,exc=io"),
+                   seed=1)
+    try:
+        with pytest.raises(PushRejected) as ei:
+            adm.admit("src", 10)
+        assert "fault-injected" in ei.value.reason
+        adm.admit("src", 10)        # n=1: the next decision is clean
+    finally:
+        faults.clear_all()
+    assert adm.rejected == 1 and adm.admitted == 1
+
+
+# ----------------------------------------------------- same-host push publish
+
+def test_push_publish_same_host_is_zero_copy_alias(landing):
+    service, store, _ = landing
+    run = _make_run()
+    service.register("dagP/a_1/c", 0, run, use_store=False)   # pull backstop
+    service.push_publish("dagP/a_1/c", 0, run)
+    # the store entry IS the registered run object — no copy, no
+    # double-count between the pull registry and the push landing zone
+    assert store.get("dagP/a_1/c", 0) is run
+    got = service.fetch_partition("dagP/a_1/c", 0, 1)
+    assert list(got.iter_pairs()) == list(run.partition(1).iter_pairs())
+
+
+def test_push_publish_stale_epoch_fenced(landing):
+    service, store, _ = landing
+    epoch_registry.register("app_push", 3)
+    run = _make_run()
+    with pytest.raises(EpochFencedError):
+        service.push_publish("dagF/a_1/c", 0, run, epoch=2, app_id="app_push")
+    assert store.get("dagF/a_1/c", 0) is None
+    # the live epoch pushes fine
+    service.push_publish("dagF/a_1/c", 0, run, epoch=3, app_id="app_push")
+    assert store.get("dagF/a_1/c", 0) is run
+
+
+def test_push_publish_without_admission_rejects(tmp_path):
+    service = ShuffleService()
+    store = ShuffleBufferStore(device_capacity=0, host_capacity=1 << 20,
+                               disk_dir=str(tmp_path))
+    service.attach_buffer_store(store)      # store but NO admission
+    with pytest.raises(PushRejected):
+        service.push_publish("dagN/a_1/c", 0, _make_run())
+
+
+def test_push_listener_notified_and_errors_swallowed(landing):
+    service, _, _ = landing
+    seen = []
+
+    def broken(path, spill):
+        raise RuntimeError("consumer merge-wake exploded")
+
+    service.add_push_listener(broken)
+    service.add_push_listener(lambda path, spill: seen.append((path, spill)))
+    run = _make_run()
+    service.push_publish("dagL/a_1/c", 2, run)   # broken listener: no raise
+    assert seen == [("dagL/a_1/c", 2)]
+    service.remove_push_listener(broken)
+
+
+def test_unregister_prefix_sweeps_pushed_keys_and_quota(landing):
+    service, store, admission = landing
+    run = _make_run()
+    service.register("dagU/a_1/c", 0, run, use_store=False)
+    service.push_publish("dagU/a_1/c", 0, run)
+    # a remotely-landed partition under the same DAG prefix
+    service.push_publish("dagU/a_1/c", 1, _make_run(partitions=1),
+                         partition=2)
+    assert admission.held("dagU/a_1/c") > 0
+    service.unregister_prefix("dagU")
+    assert store.get("dagU/a_1/c", 0) is None
+    assert store.get(push_key("dagU/a_1/c", 2), 1) is None
+    assert admission.held("dagU/a_1/c") == 0
+    with pytest.raises(ShuffleDataNotFound):
+        service.fetch_partition("dagU/a_1/c", 0, 0)
+
+
+# ------------------------------------------------------------- SpillPusher
+
+def test_spill_pusher_local_counters_and_close_drain(landing):
+    service, store, _ = landing
+    counters = TezCounters()
+    pusher = SpillPusher(service, threads=2, counters=counters)
+    runs = [_make_run(tag=f"t{i}") for i in range(4)]
+    for i, run in enumerate(runs):
+        service.register("dagS/a_1/c", i, run, use_store=False)
+        assert pusher.submit("dagS/a_1/c", i, run)
+    pusher.close()                  # close drains: counters are settled
+    assert pusher.pushes_sent == 4 and pusher.pushes_rejected == 0
+    assert counters.find_counter(TaskCounter.SHUFFLE_PUSH_BYTES).value == \
+        sum(r.nbytes for r in runs)
+    assert not pusher.submit("dagS/a_1/c", 99, runs[0])   # closed
+    for i, run in enumerate(runs):
+        assert store.get("dagS/a_1/c", i) is run
+
+
+def test_spill_pusher_send_fault_storm_pull_backstop(landing):
+    service, store, _ = landing
+    counters = TezCounters()
+    run = _make_run()
+    service.register("dagK/a_1/c", 0, run, use_store=False)
+    faults.install("t", parse_spec("shuffle.push.send:fail:exc=io"), seed=1)
+    try:
+        pusher = SpillPusher(service, retries=2, counters=counters,
+                             backoff_base=0.001)
+        pusher.submit("dagK/a_1/c", 0, run)
+        pusher.close()
+    finally:
+        faults.clear_all()
+    assert pusher.pushes_rejected == 1 and pusher.pushes_sent == 0
+    assert counters.find_counter(TaskCounter.SHUFFLE_PUSH_REJECTED).value == 1
+    assert store.get("dagK/a_1/c", 0) is None         # push never landed
+    got = service.fetch_partition("dagK/a_1/c", 0, 0)  # pull backstop serves
+    assert list(got.iter_pairs()) == list(run.partition(0).iter_pairs())
+
+
+def test_spill_pusher_admission_storm_retries_then_abandons(landing):
+    service, store, admission = landing
+    run = _make_run()
+    service.register("dagR/a_1/c", 0, run, use_store=False)
+    faults.install("t", parse_spec("shuffle.push.admit:fail:exc=io"), seed=1)
+    try:
+        pusher = SpillPusher(service, retries=3, backoff_base=0.001)
+        t0 = time.perf_counter()
+        pusher.submit("dagR/a_1/c", 0, run)
+        pusher.close()
+        waited = time.perf_counter() - t0
+    finally:
+        faults.clear_all()
+    assert pusher.pushes_rejected == 1
+    assert admission.rejected == 3            # one per retry attempt
+    # each rejection honored the RETRY-AFTER hint (1 ms x 3) before retrying
+    assert waited >= 0.003
+    assert store.get("dagR/a_1/c", 0) is None
+
+
+def test_spill_pusher_inflight_cap_blocks_then_releases(landing):
+    service, _, _ = landing
+    run = _make_run(records=200)
+    limit = run.nbytes + 1          # second submit must wait for the first
+    order = []
+    orig = service.push_publish
+
+    def slow_publish(path, spill_id, r, **kw):
+        order.append(("start", spill_id))
+        time.sleep(0.05)
+        orig(path, spill_id, r, **kw)
+        order.append(("done", spill_id))
+
+    service.push_publish = slow_publish
+    pusher = SpillPusher(service, threads=2, inflight_limit_bytes=limit)
+    for i in range(3):
+        service.register("dagI/a_1/c", i, run, use_store=False)
+        assert pusher.submit("dagI/a_1/c", i, run)
+    pusher.close()
+    assert pusher.pushes_sent == 3
+    # the cap serialized the pushes: no spill started before the previous
+    # one finished, despite the 2-thread pool
+    for i in range(len(order) - 1):
+        if order[i][0] == "start":
+            assert order[i + 1] == ("done", order[i][1])
+
+
+# ------------------------------------------------------------- remote push
+
+@pytest.fixture()
+def remote_landing(landing):
+    service, store, admission = landing
+    secrets = JobTokenSecretManager()
+    server = ShuffleServer(secrets, service).start()
+    yield server, secrets, service, store
+    server.stop()
+
+
+def test_remote_push_roundtrip(remote_landing):
+    server, secrets, service, store = remote_landing
+    producer_service = ShuffleService()      # mapper host: no store at all
+    counters = TezCounters()
+    run = _make_run()
+    pusher = SpillPusher(producer_service, counters=counters,
+                         secrets=secrets)
+    assert pusher.submit("dagW/a_1/c", 0, run,
+                         host="127.0.0.1", port=server.port)
+    pusher.close()
+    assert pusher.pushes_sent == 1
+    assert counters.find_counter(TaskCounter.SHUFFLE_PUSH_BYTES).value == \
+        run.nbytes
+    # landed per-partition under push_key; the consumer-side service probe
+    # (plain key -> bare registry -> push key) serves them transparently
+    for p in range(3):
+        assert store.get(push_key("dagW/a_1/c", p), 0) is not None
+        got = service.fetch_partition("dagW/a_1/c", 0, p)
+        assert list(got.iter_pairs()) == list(run.partition(p).iter_pairs())
+
+
+def test_remote_push_bad_hmac_fatal_no_retry(remote_landing):
+    server, _, _, store = remote_landing
+    wrong = JobTokenSecretManager(b"not-the-secret" * 2)
+    counters = TezCounters()
+    pusher = SpillPusher(ShuffleService(), retries=3, counters=counters,
+                         secrets=wrong, backoff_base=0.001)
+    run = _make_run()
+    pusher.submit("dagH/a_1/c", 0, run, host="127.0.0.1", port=server.port)
+    pusher.close()
+    assert pusher.pushes_rejected == 1
+    assert counters.find_counter(
+        TaskCounter.SHUFFLE_PUSH_REJECTED).value == 1
+    # PermissionError is fatal: exactly ONE wire attempt, not three
+    assert server.auth_failures == 1
+    assert store.get(push_key("dagH/a_1/c", 0), 0) is None
+
+
+def test_remote_push_stale_epoch_fenced_no_retry(remote_landing):
+    server, secrets, _, store = remote_landing
+    epoch_registry.register("app_rp", 5)
+    pusher = SpillPusher(ShuffleService(), retries=3, secrets=secrets,
+                         epoch=4, app_id="app_rp", backoff_base=0.001)
+    run = _make_run()
+    pusher.submit("dagZ/a_1/c", 0, run, host="127.0.0.1", port=server.port)
+    pusher.close()
+    assert pusher.pushes_rejected == 1
+    assert store.get(push_key("dagZ/a_1/c", 0), 0) is None
+
+
+def test_remote_push_admission_retry_then_success(remote_landing):
+    """A RETRY-AFTER reply is retryable: the first attempt is rejected by
+    an injected admission fault, the retry lands."""
+    server, secrets, service, store = remote_landing
+    faults.install("t", parse_spec("shuffle.push.admit:fail:n=1,exc=io"),
+                   seed=1)
+    try:
+        pusher = SpillPusher(ShuffleService(), retries=3, secrets=secrets,
+                             backoff_base=0.001)
+        run = _make_run(partitions=1)
+        pusher.submit("dagA/a_1/c", 0, run,
+                      host="127.0.0.1", port=server.port)
+        pusher.close()
+    finally:
+        faults.clear_all()
+    assert pusher.pushes_sent == 1
+    assert store.get(push_key("dagA/a_1/c", 0), 0) is not None

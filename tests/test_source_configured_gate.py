@@ -1,0 +1,152 @@
+"""Regression tests for the unconfigured-source scheduling gate.
+
+A consumer vertex must not release tasks while any source vertex's
+parallelism is still unresolved (num_tasks == -1, e.g. an InputInitializer
+racing the consumer's init).  Before the gate, the ShuffleVertexManager
+clamped the unknown source total to 0, read the completed fraction as 1.0,
+and released every consumer task at vertex start; the task specs snapshot
+physical_input_count=-1, wait_all returned instantly, and the consumer
+SUCCEEDED empty — silent total data loss on every DAG after the first in
+a warm process (run 1 wins the race because the initializer finishes
+before spec build).
+"""
+import collections
+import os
+import random
+
+import pytest
+
+from tez_tpu.api.vertex_manager import VertexStateUpdate
+from tez_tpu.dag.edge_property import (DataMovementType, DataSourceType,
+                                       EdgeProperty, SchedulingType)
+from tez_tpu.common.payload import InputDescriptor, OutputDescriptor, UserPayload
+from tez_tpu.library.vertex_managers import ShuffleVertexManager
+
+
+def _sg_prop():
+    kv = {"tez.runtime.key.class": "bytes", "tez.runtime.value.class": "long"}
+    return EdgeProperty.create(
+        DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create(
+            "tez_tpu.library.outputs:OrderedPartitionedKVOutput", payload=kv),
+        InputDescriptor.create(
+            "tez_tpu.library.inputs:OrderedGroupedKVInput", payload=kv))
+
+
+class _GateVMContext:
+    """Fake VertexManagerPluginContext with a mutable source parallelism."""
+
+    def __init__(self, payload, in_edges, num_tasks):
+        self._payload = UserPayload.of(payload)
+        self._in_edges = in_edges
+        self.num_tasks = dict(num_tasks)
+        self.scheduled = []
+        self.state_registrations = []
+
+    @property
+    def vertex_name(self):
+        return "consumer"
+
+    @property
+    def user_payload(self):
+        return self._payload
+
+    def get_vertex_num_tasks(self, name):
+        return self.num_tasks[name]
+
+    def get_input_vertex_edge_properties(self):
+        return dict(self._in_edges)
+
+    def get_output_vertex_edge_properties(self):
+        return {}
+
+    def schedule_tasks(self, requests):
+        self.scheduled.extend(r.task_index for r in requests)
+
+    def vertex_reconfiguration_restored(self):
+        return False
+
+    def register_for_vertex_state_updates(self, vertex_name, states):
+        self.state_registrations.append((vertex_name, tuple(states)))
+
+
+def test_svm_holds_until_source_configured():
+    """No release while a shuffle source's parallelism is unresolved; the
+    CONFIGURED state update unblocks scheduling."""
+    ctx = _GateVMContext(
+        {"min_fraction": 0.0, "max_fraction": 0.0},
+        {"src": _sg_prop()},
+        {"src": -1, "consumer": 2})
+    vm = ShuffleVertexManager(ctx)
+    vm.initialize()
+    vm.on_vertex_started([])
+    assert ctx.scheduled == [], \
+        "consumer released against an unconfigured source"
+    # the source initializer resolves parallelism -> CONFIGURED fires
+    ctx.num_tasks["src"] = 3
+    vm.on_vertex_state_updated(VertexStateUpdate("src", "CONFIGURED"))
+    assert sorted(ctx.scheduled) == [0, 1]
+
+
+def test_svm_registers_for_source_state_updates():
+    ctx = _GateVMContext({}, {"src": _sg_prop()}, {"src": -1, "consumer": 2})
+    vm = ShuffleVertexManager(ctx)
+    vm.initialize()
+    assert ("src", ("CONFIGURED",)) in ctx.state_registrations
+
+
+def test_svm_auto_parallel_waits_for_source_configured():
+    """Unknown source total must not finalize the (irreversible) auto-
+    parallelism decision as if there were zero sources."""
+    ctx = _GateVMContext(
+        {"auto_parallel": True, "min_fraction": 0.0, "max_fraction": 0.0},
+        {"src": _sg_prop()},
+        {"src": -1, "consumer": 4})
+    vm = ShuffleVertexManager(ctx)
+    vm.initialize()
+    vm.on_vertex_started([])
+    assert not vm._parallelism_determined
+    assert ctx.scheduled == []
+
+
+def test_fetch_table_rejects_negative_slot_count():
+    """Defense in depth: a spec built against an unconfigured source must
+    fail the attempt loudly, never succeed empty."""
+    from tez_tpu.library.inputs import ShuffleFetchTable
+    with pytest.raises(ValueError, match="unresolved physical input count"):
+        ShuffleFetchTable(None, -1, 0)
+
+
+def _write_corpus(path, num_lines, seed):
+    words = ["apple", "banana", "cherry", "date", "fig", "grape", "kiwi"]
+    rng = random.Random(seed)
+    counts = collections.Counter()
+    with open(path, "w") as fh:
+        for _ in range(num_lines):
+            line = [rng.choice(words) for _ in range(rng.randrange(1, 10))]
+            counts.update(line)
+            fh.write(" ".join(line) + "\n")
+    return counts
+
+
+def test_pipelined_wordcount_repeated_same_process(tmp_path):
+    """The original failure mode: with auto source parallelism (initializer
+    driven) and pipelined shuffle, run 2+ in a warm process lost ALL data
+    because consumers were scheduled before the tokenizer configured."""
+    from tez_tpu.examples import ordered_wordcount
+    corpus = tmp_path / "in.txt"
+    golden = _write_corpus(str(corpus), num_lines=200, seed=3)
+    for run in (1, 2):
+        out_dir = str(tmp_path / f"out{run}")
+        state = ordered_wordcount.run(
+            [str(corpus)], out_dir,
+            conf={"tez.staging-dir": str(tmp_path / f"stg{run}"),
+                  "tez.runtime.pipelined-shuffle.enabled": True})
+        assert state == "SUCCEEDED"
+        rows = {}
+        with open(os.path.join(out_dir, "part-00000"), "rb") as fh:
+            for line in fh:
+                word, count = line.rstrip(b"\n").split(b"\t")
+                rows[word.decode()] = int(count)
+        assert rows == dict(golden), f"run {run} lost data"

@@ -297,6 +297,37 @@ def test_ensure_store_conf_knobs_and_reset(tmp_path):
     assert local_shuffle_service().buffer_store() is None
 
 
+def test_ensure_store_attaches_push_admission(tmp_path):
+    from tez_tpu.shuffle.service import local_shuffle_service
+    conf = {"tez.runtime.store.enabled": "true",
+            "tez.runtime.store.dir": str(tmp_path / "s"),
+            "tez.runtime.shuffle.push.enabled": "true",
+            "tez.runtime.shuffle.push.source-quota-mb": 3}
+    try:
+        s = ensure_store(conf)
+        assert s is not None
+        adm = local_shuffle_service().push_admission()
+        assert adm is not None
+        assert adm.source_quota == 3 << 20
+        assert ensure_store(conf) is s                 # idempotent
+        assert local_shuffle_service().push_admission() is adm
+    finally:
+        reset_store()
+    # reset detaches the landing zone along with the store
+    assert local_shuffle_service().push_admission() is None
+
+
+def test_ensure_store_push_off_no_admission(tmp_path):
+    from tez_tpu.shuffle.service import local_shuffle_service
+    conf = {"tez.runtime.store.enabled": "true",
+            "tez.runtime.store.dir": str(tmp_path / "s")}
+    try:
+        assert ensure_store(conf) is not None
+        assert local_shuffle_service().push_admission() is None
+    finally:
+        reset_store()
+
+
 # --------------------------------------------- session-mode cross-DAG reuse
 
 def _write_corpus(path, num_lines=200, seed=0):

@@ -42,6 +42,37 @@ def _assign_depth_priorities(dag: "DAGImpl") -> None:
         v.priority = (depth.get(name, 0) + 1) * 3
 
 
+def _push_coschedule(dag: "DAGImpl") -> None:
+    """Map-wave / merge-wave co-scheduling for push-based shuffle.
+
+    With eager push enabled, scatter-gather consumers run in INGEST mode:
+    the ShuffleVertexManager releases them at the push start fraction, and
+    here they get (a) priority bumped one notch INTO the vertex's reserved
+    +/-1 band — deeper-than-source but ahead of the source's retry band —
+    so released reducers interleave with the still-running map wave
+    instead of queueing strictly behind it, and (b) the controlled gate
+    lifted (the sources-fully-scheduled hold-back is exactly the barrier
+    push exists to break).  Priorities elsewhere are untouched.
+    """
+    from tez_tpu.common import config as C
+    push_on = dag.conf.get(C.PUSH_ENABLED.name, C.PUSH_ENABLED.default)
+    if isinstance(push_on, str):
+        push_on = push_on.lower() in ("1", "true", "yes")
+    if not push_on:
+        return
+    from tez_tpu.dag.edge_property import DataMovementType
+    consumers = {e.output_vertex for e in dag.plan.edges
+                 if e.edge_property.data_movement_type in (
+                     DataMovementType.SCATTER_GATHER,
+                     DataMovementType.CUSTOM)}
+    for name in consumers:
+        v = dag.vertices.get(name)
+        if v is None:
+            continue
+        v.priority -= 1
+        v.controlled_scheduling = False
+
+
 class DAGSchedulerNaturalOrder:
     """Priorities only; vertex managers decide when tasks schedule."""
 
@@ -51,6 +82,7 @@ class DAGSchedulerNaturalOrder:
         _assign_depth_priorities(dag)
         for v in dag.vertices.values():
             v.controlled_scheduling = self.controlled
+        _push_coschedule(dag)
 
 
 class DAGSchedulerNaturalOrderControlled(DAGSchedulerNaturalOrder):

@@ -49,6 +49,7 @@ class VertexEventType(enum.Enum):
     V_COMPLETED = enum.auto()            # internal bookkeeping check
     V_COMMIT_COMPLETED = enum.auto()     # per-vertex commit mode result
     V_SOURCE_SCHEDULED = enum.auto()     # controlled-mode holdback release
+    V_SOURCE_CONFIGURED = enum.auto()    # source parallelism resolved
     V_RECONFIGURE_DONE = enum.auto()
 
 

@@ -221,6 +221,13 @@ class VertexImpl:
             dag_id=str(self.vertex_id.dag_id), vertex_id=str(self.vertex_id),
             data={"vertex_name": self.name, "num_tasks": self.num_tasks}))
         self.dag.on_vertex_inited(self)
+        # tell downstream vertices our parallelism is now real: anything
+        # their schedule_tasks gate held back on this source can release
+        for e in self.out_edges.values():
+            self.ctx.dispatch(VertexEvent(
+                VertexEventType.V_SOURCE_CONFIGURED,
+                e.destination_vertex.vertex_id,
+                source_vertex_name=self.name))
         if self.start_requested:
             return self._do_start()
         return VertexState.INITED
@@ -402,7 +409,19 @@ class VertexImpl:
     def _on_source_scheduled(self, event: VertexEvent) -> None:
         self._drain_deferred_schedule()
 
+    def _on_source_configured(self, event: VertexEvent) -> None:
+        self._drain_deferred_schedule()
+
     # ---------------------------------------------------------- scheduling
+    def _sources_configured(self) -> bool:
+        """Every source vertex has resolved parallelism.  Scheduling a task
+        before this snapshots physical_input_count=-1 into its spec
+        (build_task_spec reads num_dest_physical_inputs off the live source
+        count) and the task completes empty — so schedule_tasks holds ALL
+        requests, whatever manager issued them, until sources configure."""
+        return all(e.source_vertex.num_tasks >= 0
+                   for e in self.in_edges.values())
+
     def _sources_fully_scheduled(self) -> bool:
         """Controlled-scheduling gate (DAGSchedulerNaturalOrderControlled):
         every SEQUENTIAL source vertex must have scheduled ALL its tasks."""
@@ -417,19 +436,26 @@ class VertexImpl:
                 return False
         return True
 
+    def _schedule_gate_open(self) -> bool:
+        if self.in_edges and not self._sources_configured():
+            return False
+        if getattr(self, "controlled_scheduling", False) and \
+                self.in_edges and not self._sources_fully_scheduled():
+            return False
+        return True
+
     def _drain_deferred_schedule(self) -> None:
-        if self._deferred_schedule and self._sources_fully_scheduled():
+        if self._deferred_schedule and self._schedule_gate_open():
             pending, self._deferred_schedule = self._deferred_schedule, []
-            log.info("vertex %s: sources fully scheduled, releasing %d "
-                     "held tasks", self.name, len(pending))
+            log.info("vertex %s: sources ready, releasing %d held tasks",
+                     self.name, len(pending))
             self.schedule_tasks(pending)
 
     def schedule_tasks(self, task_indices: Sequence[int]) -> None:
         """Called by the vertex manager host (reference:
         VertexImpl.scheduleTasks:1775)."""
         self.vm_tasks_scheduled = True
-        if getattr(self, "controlled_scheduling", False) and \
-                self.in_edges and not self._sources_fully_scheduled():
+        if not self._schedule_gate_open():
             seen = set(self._deferred_schedule)
             self._deferred_schedule.extend(
                 i for i in task_indices
@@ -866,6 +892,18 @@ def _build_vertex_factory() -> StateMachineFactory:
           VertexImpl._on_source_scheduled)
     f.add(S.INITED, S.INITED, E.V_SOURCE_SCHEDULED,
           VertexImpl._on_source_scheduled)
+    # a source vertex resolved its parallelism: release held schedules.
+    # Registered across pre-terminal states — the signal can land while
+    # the destination is still initializing (no-op then; the gate re-checks
+    # live source counts whenever schedule_tasks runs).
+    f.add(S.NEW, S.NEW, E.V_SOURCE_CONFIGURED,
+          VertexImpl._on_source_configured)
+    f.add(S.INITIALIZING, S.INITIALIZING, E.V_SOURCE_CONFIGURED,
+          VertexImpl._on_source_configured)
+    f.add(S.INITED, S.INITED, E.V_SOURCE_CONFIGURED,
+          VertexImpl._on_source_configured)
+    f.add(S.RUNNING, S.RUNNING, E.V_SOURCE_CONFIGURED,
+          VertexImpl._on_source_configured)
     f.add_multi(S.RUNNING, (S.RUNNING, S.KILLED), E.V_TERMINATE,
                 VertexImpl._on_terminate)
     f.add_multi(S.RUNNING, (S.FAILED,), E.V_MANAGER_USER_CODE_ERROR,

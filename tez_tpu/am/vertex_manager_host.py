@@ -65,6 +65,12 @@ class _VMContext(VertexManagerPluginContext):
         v = self.vertex.dag.vertex_by_name(vertex_name)
         return v.num_tasks if v is not None else -1
 
+    def get_vertex_conf(self) -> Any:
+        """Effective vertex configuration (DAG conf merged with the plan
+        conf) — payload-less default managers read runtime knobs here
+        (e.g. push-shuffle ingest mode)."""
+        return self.vertex.conf
+
     def get_input_vertex_edge_properties(self) -> Dict[str, EdgeProperty]:
         return {name: e.edge_property
                 for name, e in self.vertex.in_edges.items()}

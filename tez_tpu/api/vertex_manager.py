@@ -75,6 +75,13 @@ class VertexManagerPluginContext(abc.ABC):
         parallelism (reference: recovered VertexConfigurationDoneEvent)."""
         return False
 
+    def get_vertex_conf(self) -> Dict[str, Any]:
+        """The managed vertex's effective configuration (DAG conf merged
+        with the vertex plan conf).  Lets payload-less default managers
+        honor runtime knobs — e.g. the shuffle manager's push ingest
+        mode.  Default: empty (test/standalone contexts)."""
+        return {}
+
     @abc.abstractmethod
     def send_event_to_processor(self, events: Sequence[Any],
                                 task_indices: Sequence[int]) -> None: ...

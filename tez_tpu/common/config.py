@@ -633,6 +633,58 @@ STORE_LINEAGE_REUSE = _key(
     "cache hits to identical recurring DAGs — the producer task "
     "republishes the stored runs instead of recomputing.  Only "
     "meaningful when the store is enabled")
+PUSH_ENABLED = _key(
+    "tez.runtime.shuffle.push.enabled", False, Scope.VERTEX,
+    "push-based pipelined shuffle: producers ship every pipelined spill "
+    "eagerly into the reducer-side buffer store mid-map-wave (same-host "
+    "publishes are zero-copy; remote spills ride the shuffle server's "
+    "push verb), consumers start in ingest mode, and the merge lane "
+    "merges pushed arrivals early.  Implies pipelined spill emission.  "
+    "The pull path stays registered as the correctness backstop, so a "
+    "dead pusher or a rejected push never loses data.  Off = the "
+    "historical pull-only shuffle")
+PUSH_THREADS = _key(
+    "tez.runtime.shuffle.push.threads", 2, Scope.VERTEX,
+    "async pusher thread-pool size per producer task")
+PUSH_RETRIES = _key(
+    "tez.runtime.shuffle.push.retries", 3, Scope.VERTEX,
+    "send attempts per pushed spill (full-jitter exponential backoff "
+    "between tries, honoring the admission controller's retry-after "
+    "hint); exhausting them abandons the push to the pull backstop")
+PUSH_INFLIGHT_LIMIT_MB = _key(
+    "tez.runtime.shuffle.push.inflight-limit-mb", 64, Scope.VERTEX,
+    "per-destination cap on queued + in-flight pushed bytes; a producer "
+    "spilling faster than its reducers admit blocks at submit (map-side "
+    "backpressure) instead of ballooning the push queue")
+PUSH_SOURCE_QUOTA_MB = _key(
+    "tez.runtime.shuffle.push.source-quota-mb", 256, Scope.VERTEX,
+    "admission controller: max pushed bytes one source attempt may hold "
+    "resident in this host's store; beyond it pushes are rejected with "
+    "RETRY-AFTER (the source's spills stay pull-served) so a single "
+    "hot mapper cannot crowd out the wave")
+PUSH_ADMIT_WATERMARK = _key(
+    "tez.runtime.shuffle.push.admit-watermark", 0.85, Scope.VERTEX,
+    "admission controller: reject pushes once the store's host tier "
+    "would exceed this occupancy fraction — deliberately below the "
+    "store's own high watermark so eager pushes never trigger the "
+    "demotion cascade that pull-registered data would ride")
+PUSH_RETRY_AFTER_MS = _key(
+    "tez.runtime.shuffle.push.retry-after-ms", 50.0, Scope.VERTEX,
+    "retry-after hint attached to admission rejections; the pusher "
+    "sleeps at least this long (plus jittered backoff) before retrying")
+PUSH_START_FRACTION = _key(
+    "tez.runtime.shuffle.push.start-fraction", 0.05, Scope.VERTEX,
+    "map-wave/merge-wave co-scheduling: with push enabled, consumer "
+    "tasks of scatter-gather edges are ALL released once this fraction "
+    "of source tasks has finished (ingest mode) instead of riding the "
+    "slow-start [min, max] ramp — reducers sit ingesting pushed spills "
+    "while the map wave is still running")
+PUSH_EAGER_MERGE_THRESHOLD = _key(
+    "tez.runtime.shuffle.push.eager-merge-threshold", 0.5, Scope.VERTEX,
+    "with push enabled, the consumer's background merger starts a "
+    "mem->disk merge once committed memory crosses this fraction of the "
+    "merge budget (instead of only at tez.runtime.shuffle.merge.percent) "
+    "so merge work overlaps the map wave; 0 disables early merging")
 
 
 def runtime_conf_subset(conf: Mapping) -> "TezConfiguration":

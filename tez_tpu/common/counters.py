@@ -77,6 +77,11 @@ class TaskCounter(enum.Enum):
     ADDITIONAL_SPILL_COUNT = enum.auto()
     SHUFFLE_CHUNK_COUNT = enum.auto()
     SHUFFLE_BYTES = enum.auto()
+    # push-based pipelined shuffle (shuffle/push.py): bytes eagerly pushed
+    # into a reducer-side buffer store, and pushes the admission controller
+    # (or a dead transport) turned away — rejected spills stay pull-served
+    SHUFFLE_PUSH_BYTES = enum.auto()
+    SHUFFLE_PUSH_REJECTED = enum.auto()
     SHUFFLE_BYTES_DECOMPRESSED = enum.auto()
     SHUFFLE_BYTES_TO_MEM = enum.auto()
     SHUFFLE_BYTES_TO_DISK = enum.auto()

@@ -112,6 +112,15 @@ KNOWN_POINTS: Dict[str, str] = {
         "ops/async_stage.py D2H readback entry (detail = span=<id>); fail "
         "mode crashes the readback worker's attempt so the span re-sorts "
         "through the host engine",
+    "shuffle.push.send":
+        "shuffle/push.py SpillPusher send attempt (detail = "
+        "path/spill -> dest); fail mode kills the eager push so the "
+        "consumer must recover through the pull path — the push-storm "
+        "chaos lever",
+    "shuffle.push.admit":
+        "shuffle/push.py PushAdmissionController decision (detail = "
+        "source path + nbytes); fail mode turns the decision into a "
+        "RETRY-AFTER rejection, delay mode stretches admit_wait",
 }
 
 _EXC_KINDS = {

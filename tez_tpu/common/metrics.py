@@ -134,7 +134,12 @@ WELL_KNOWN_HISTOGRAMS = ("shuffle.fetch.rtt", "spill.write", "shuffle.merge",
                          # tiered buffer store (tez_tpu/store): publish
                          # admission, leased fetch, and watermark demotion
                          # (host->disk spill happens inside the demote timer)
-                         "store.publish", "store.fetch", "store.demote")
+                         "store.publish", "store.fetch", "store.demote",
+                         # push shuffle (shuffle/push.py): one eager push
+                         # round trip (same-host publish or remote push
+                         # verb) and the pusher's total admission wait
+                         # (retry-after backoff before accept/give-up)
+                         "shuffle.push.rtt", "shuffle.push.admit_wait")
 
 
 class MetricsRegistry:

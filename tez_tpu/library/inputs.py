@@ -65,6 +65,17 @@ class ShuffleFetchTable:
 
     def __init__(self, context: Any, num_slots: int, my_partition: int,
                  merge_manager: Optional[Any] = None):
+        if num_slots < 0:
+            # a negative slot count means this task's spec was built while
+            # its source vertex parallelism was still unresolved; wait_all's
+            # `completed >= num_slots` would be instantly true and the task
+            # would SUCCEED empty — silent data loss.  Fail loudly instead:
+            # the AM retries the attempt against a configured source.
+            raise ValueError(
+                f"shuffle input for partition {my_partition} constructed "
+                f"with unresolved physical input count {num_slots}; source "
+                f"vertex parallelism was not configured when this task was "
+                f"scheduled")
         self.context = context
         self.num_slots = num_slots
         self.my_partition = my_partition
@@ -422,11 +433,21 @@ class OrderedGroupedKVInput(LogicalInput):
             int(_conf_get(
                 ctx, "tez.runtime.tpu.device.sort.min.records", 1 << 16))
 
+        # push-based shuffle: eager merge overlaps the map wave — the
+        # background merger starts once the eager fraction of the budget
+        # is committed instead of waiting for admission pressure
+        push_on = bool(_conf_get(
+            ctx, "tez.runtime.shuffle.push.enabled", False))
+        self._push_enabled = push_on
+        eager = float(_conf_get(
+            ctx, "tez.runtime.shuffle.push.eager-merge-threshold",
+            0.5)) if push_on else 0.0
         self._mm_budget = budget_mb << 20
         self._mm_kwargs = dict(
             key_width=self.key_width, engine=merge_engine,
             merge_factor=factor,
             device_min_records=merge_min,
+            eager_threshold=eager,
             merge_threshold=float(_conf_get(
                 ctx, "tez.runtime.shuffle.merge.percent", 0.9)),
             max_single_fraction=float(_conf_get(
@@ -446,6 +467,7 @@ class OrderedGroupedKVInput(LogicalInput):
         ctx.request_initial_memory(int(frac * (sort_mb << 20)), _Granted(),
                            component_type="SORTED_MERGED_INPUT")
         self.merge_manager = None     # created in start(): grant lands first
+        self._push_listener = None    # registered in start() when push on
         self.table = ShuffleFetchTable(ctx, self.num_physical_inputs,
                                        my_partition=ctx.task_index)
         return []
@@ -459,6 +481,19 @@ class OrderedGroupedKVInput(LogicalInput):
             self.context.counters, self._mm_budget, self._spill_dir,
             **self._mm_kwargs)
         self.table.merge_manager = self.merge_manager
+        if self._push_enabled:
+            # merge-wake seam: a pushed arrival pokes the merger so the
+            # async merge lane re-evaluates eager-merge eligibility the
+            # moment bytes land, not a poll period later
+            from tez_tpu.shuffle.service import local_shuffle_service
+            mm = self.merge_manager
+
+            def _push_wake(_path: str, _spill: int, _mm=mm) -> None:
+                with _mm.lock:
+                    _mm.lock.notify_all()
+
+            self._push_listener = _push_wake
+            local_shuffle_service().add_push_listener(_push_wake)
 
     def handle_events(self, events: Sequence[TezAPIEvent]) -> None:
         for ev in events:
@@ -529,6 +564,10 @@ class OrderedGroupedKVInput(LogicalInput):
         self._merged = None
         self._group_starts = None
         self._stream_plan = None
+        if self._push_listener is not None:
+            from tez_tpu.shuffle.service import local_shuffle_service
+            local_shuffle_service().remove_push_listener(self._push_listener)
+            self._push_listener = None
         self.table.shutdown()
         if self.merge_manager is not None:
             self.merge_manager.cleanup()

@@ -75,6 +75,7 @@ class ShuffleMergeManager:
                  device_min_records: "int | None" = None,
                  merge_factor: int = 64,
                  merge_threshold: float = 0.9,
+                 eager_threshold: float = 0.0,
                  max_single_fraction: float = 0.25,
                  key_normalizer: Optional[Callable[[bytes], bytes]] = None,
                  codec: Optional[str] = None,
@@ -95,6 +96,13 @@ class ShuffleMergeManager:
             if device_min_records is None else device_min_records
         self.merge_factor = max(2, merge_factor)
         self.merge_threshold = merge_threshold
+        # push-based shuffle's merge-wave overlap: > 0 lets the background
+        # merger start a mem->disk merge once committed memory crosses
+        # eager_threshold * budget — well before the admission-pressure
+        # threshold above — so merge work runs WHILE the map wave is still
+        # pushing spills instead of serializing after it.  0 = historical
+        # behavior (merge only under admission pressure).
+        self.eager_threshold = max(0.0, float(eager_threshold))
         self.max_single = int(self.budget * max_single_fraction) \
             if self.budget > 0 else 0
         self.key_normalizer = key_normalizer
@@ -242,7 +250,7 @@ class ShuffleMergeManager:
             self._seq += 1
             self._mem_bytes += batch.nbytes
             self.peak_mem_bytes = max(self.peak_mem_bytes, self._mem_bytes)
-            if self._mem_bytes >= self.budget * self.merge_threshold:
+            if self._mem_bytes >= self.budget * self._wake_threshold():
                 self.lock.notify_all()
         self.counters.increment(TaskCounter.SHUFFLE_BYTES_TO_MEM, batch.nbytes)
         return True
@@ -295,15 +303,23 @@ class ShuffleMergeManager:
             raise RuntimeError(f"shuffle merge state lost: {self._poisoned}")
 
     # ------------------------------------------------------- background merge
+    def _wake_threshold(self) -> float:
+        """Fraction of the budget at which a commit wakes the merger: the
+        eager (push-overlap) threshold when enabled, else the admission-
+        pressure threshold."""
+        if 0.0 < self.eager_threshold < self.merge_threshold:
+            return self.eager_threshold
+        return self.merge_threshold
+
     def _mem_merge_due(self) -> bool:
-        """Under lock: committed memory crossed the merge threshold, OR a
-        fetcher is stalled on admission and there is anything at all to
-        free (without the second clause a batch that doesn't fit the
-        remaining budget while memory sits below the threshold would stall
-        its fetcher forever)."""
+        """Under lock: committed memory crossed the merge threshold (the
+        eager one when push overlap is on), OR a fetcher is stalled on
+        admission and there is anything at all to free (without the second
+        clause a batch that doesn't fit the remaining budget while memory
+        sits below the threshold would stall its fetcher forever)."""
         if not self._mem:
             return False
-        return self._mem_bytes >= self.budget * self.merge_threshold or \
+        return self._mem_bytes >= self.budget * self._wake_threshold() or \
             self._stalled > 0
 
     def _disk_merge_due_locked(self) -> bool:

@@ -98,6 +98,11 @@ class OrderedPartitionedKVOutput(LogicalOutput):
         sort_mb = int(_conf_get(ctx, "tez.runtime.io.sort.mb", 256))
         self._pipelined = bool(_conf_get(
             ctx, "tez.runtime.pipelined-shuffle.enabled", False))
+        # push-based shuffle rides the pipelined spill stream (one eager
+        # push per finished spill), so enabling push implies pipelined
+        self._push_enabled = bool(_conf_get(
+            ctx, "tez.runtime.shuffle.push.enabled", False))
+        self._pipelined = self._pipelined or self._push_enabled
         key_width = int(_conf_get(ctx, "tez.runtime.tpu.key.width.bytes", 16))
         combiner_name = _conf_get(ctx, "tez.runtime.combiner.class", "")
         spill_dir = _conf_get(ctx, "tez.runtime.tpu.host.spill.dir", "") or \
@@ -190,6 +195,21 @@ class OrderedPartitionedKVOutput(LogicalOutput):
             self._lineage = task_lineage(
                 getattr(ctx, "lineage", ""), ctx.task_index,
                 ctx.destination_vertex_name)
+        self._pusher = None
+        if self._push_enabled:
+            from tez_tpu.shuffle.push import SpillPusher
+            self._pusher = SpillPusher(
+                self.service,
+                threads=int(_conf_get(
+                    ctx, "tez.runtime.shuffle.push.threads", 2)),
+                retries=int(_conf_get(
+                    ctx, "tez.runtime.shuffle.push.retries", 3)),
+                inflight_limit_bytes=int(float(_conf_get(
+                    ctx, "tez.runtime.shuffle.push.inflight-limit-mb",
+                    64)) * (1 << 20)),
+                counters=ctx.counters,
+                epoch=getattr(ctx, "am_epoch", 0),
+                app_id=getattr(ctx, "app_id", ""))
         store = self.service.buffer_store()
         if self._lineage and store is not None:
             # a non-pipelined output seals exactly one run (spill -1);
@@ -265,10 +285,15 @@ class OrderedPartitionedKVOutput(LogicalOutput):
         # tez.runtime.pipelined-shuffle.enabled -> one event per spill)
         sorter = self.sorter
         ctr = self.context.counters
+        push = self._pusher is not None
         # _store_run convention: every shipped span counts as spilled
         ctr.increment(TaskCounter.SPILLED_RECORDS, run.batch.num_records)
         if sorter.spill_dir is not None and run.nbytes >= (1 << 20) and \
-                not self.service.has_store():
+                not self.service.has_store() and \
+                not (push and self.service.buffer_store() is not None):
+            # (with push + a buffer store, the store's watermark demotion
+            # is the bounded disk path and admission is the backpressure —
+            # a pspill here would re-serialize every spill for nothing)
             # (with a write-through store attached the store's own file IS
             # the disk copy — writing a pspill too would double the I/O)
             import uuid as _uuid
@@ -283,15 +308,25 @@ class OrderedPartitionedKVOutput(LogicalOutput):
             ctr.increment(TaskCounter.ADDITIONAL_SPILL_COUNT)
             ctr.increment(TaskCounter.HOST_SPILL_BYTES, written)
             run = FileRun(path)
-        self.service.register(output_path_component(self.context), spill_id,
+        path = output_path_component(self.context)
+        # push mode: the SYNCHRONOUS bare-registry register below is the
+        # pull backstop (events never race a missing key; a dead pusher
+        # never loses data) — the async push then aliases the same run
+        # into the reducer-side store, zero copy
+        self.service.register(path, spill_id,
                               run, epoch=getattr(self.context, "am_epoch", 0),
                               app_id=getattr(self.context, "app_id", ""),
                               lineage=self._lineage,
-                              counters=self.context.counters)
+                              counters=self.context.counters,
+                              use_store=not push)
         # last=False; close() sends the final marker
         self.context.send_events(self._events_for_run(run, spill_id, False))
         self._spills_sent += 1
         self.context.counters.increment(TaskCounter.SHUFFLE_CHUNK_COUNT)
+        if push:
+            self._pusher.submit(path, spill_id, run,
+                                host=self.host["host"],
+                                port=self.host["port"])
 
     def close(self) -> List[TezAPIEvent]:
         if self._reused:
@@ -299,6 +334,11 @@ class OrderedPartitionedKVOutput(LogicalOutput):
             # flushing the (empty) sorter would clobber the reused run
             return []
         final_run = self.sorter.flush_run()
+        if self._pusher is not None:
+            # drain: every queued push lands (or exhausts retries into the
+            # pull backstop) before the task reports DONE, so push
+            # counters are settled and the final marker is truthful
+            self._pusher.close()
         if self._pipelined:
             # final empty marker event with last_event=True for completeness
             payload = ShufflePayload(

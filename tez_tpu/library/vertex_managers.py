@@ -174,6 +174,24 @@ class ShuffleVertexManager(VertexManagerPlugin):
             payload = {}
         self.min_fraction = payload.get("min_fraction", self.DEFAULT_MIN_FRACTION)
         self.max_fraction = payload.get("max_fraction", self.DEFAULT_MAX_FRACTION)
+        # push-based shuffle ingest mode: with eager push enabled, the
+        # consumer should sit INGESTING pushed spills while the map wave
+        # runs — release every task once the start fraction of sources has
+        # finished (min==max makes _maybe_schedule release all) instead of
+        # riding the slow-start ramp.  An explicit payload fraction wins:
+        # someone who configured slow-start asked for it.  getattr: custom
+        # duck-typed contexts written before get_vertex_conf existed keep
+        # working (they simply never see ingest mode).
+        conf = getattr(self.context, "get_vertex_conf", dict)() or {}
+        push_on = conf.get("tez.runtime.shuffle.push.enabled", False) \
+            if hasattr(conf, "get") else False
+        if isinstance(push_on, str):
+            push_on = push_on.lower() in ("1", "true", "yes")
+        if push_on and "min_fraction" not in payload and \
+                "max_fraction" not in payload:
+            start = float(conf.get(
+                "tez.runtime.shuffle.push.start-fraction", 0.05) or 0.05)
+            self.min_fraction = self.max_fraction = start
         self.auto_parallel = payload.get("auto_parallel", False)
         self.desired_task_input_size = payload.get(
             "desired_task_input_size", 100 * 1024 * 1024)
@@ -184,6 +202,19 @@ class ShuffleVertexManager(VertexManagerPlugin):
         self._pending_completions: List[TaskAttemptIdentifier] = []
         self._output_stats: Dict[tuple, int] = {}   # (vertex, task) -> bytes
         self._parallelism_determined = not self.auto_parallel
+        # A source whose parallelism is still unresolved (num_tasks == -1,
+        # e.g. an InputInitializer racing this vertex's init) must hold
+        # scheduling: releasing consumers against an unconfigured source
+        # snapshots physical_input_count=-1 into their specs and they
+        # complete empty.  Register for CONFIGURED so scheduling re-fires
+        # the moment the source resolves (reference:
+        # ShuffleVertexManagerBase registers for every source vertex's
+        # state updates and counts numSourceTasksConfigured).  getattr:
+        # duck-typed test contexts without the registry keep working.
+        reg = getattr(self.context, "register_for_vertex_state_updates", None)
+        if reg is not None:
+            for name in self._shuffle_source_names():
+                reg(name, ["CONFIGURED"])
 
     # -- source bookkeeping --------------------------------------------------
     def _shuffle_source_names(self) -> List[str]:
@@ -193,8 +224,18 @@ class ShuffleVertexManager(VertexManagerPlugin):
                                                DataMovementType.CUSTOM)]
 
     def _total_source_tasks(self) -> int:
-        return sum(max(0, self.context.get_vertex_num_tasks(name))
-                   for name in self._shuffle_source_names())
+        """Total shuffle-source tasks, or -1 while ANY source is still
+        unconfigured.  -1 must not be clamped into the sum: 'unknown' and
+        'empty' are different answers — an unknown total makes the
+        completed fraction meaningless (0/0 reads as 1.0 and releases the
+        whole consumer against a source that hasn't resolved yet)."""
+        total = 0
+        for name in self._shuffle_source_names():
+            n = self.context.get_vertex_num_tasks(name)
+            if n < 0:
+                return -1
+            total += n
+        return total
 
     def _completed_fraction(self, source_names: Sequence[str],
                             total_sources: int) -> float:
@@ -252,6 +293,12 @@ class ShuffleVertexManager(VertexManagerPlugin):
                                    events: List[Any]) -> None:
         pass
 
+    def on_vertex_state_updated(self, update) -> None:
+        """A source vertex just resolved its parallelism (CONFIGURED):
+        anything held back by the unconfigured-source gate can go now."""
+        if update.state == "CONFIGURED" and self._started:
+            self._maybe_schedule()
+
     # -- auto-parallelism (reference: ShuffleVertexManagerBase.computeRouting
     # :444 — shrink to ceil(totalSize/desiredTaskInputDataSize) and swap the
     # edge managers for range routing) ---------------------------------------
@@ -265,6 +312,8 @@ class ShuffleVertexManager(VertexManagerPlugin):
             self._parallelism_determined = True
             return True
         total_sources = self._total_source_tasks()
+        if total_sources < 0:
+            return False        # a source is unconfigured — wait for it
         if total_sources == 0:
             self._parallelism_determined = True
             return True
@@ -323,6 +372,8 @@ class ShuffleVertexManager(VertexManagerPlugin):
         if not self._try_determine_parallelism():
             return
         total_sources = self._total_source_tasks()
+        if total_sources < 0:
+            return              # a source is unconfigured — wait for it
         num_tasks = self.context.get_vertex_num_tasks(self.context.vertex_name)
         if num_tasks <= 0:
             return

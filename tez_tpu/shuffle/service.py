@@ -22,6 +22,7 @@ from tez_tpu.common import epoch as epoch_registry
 from tez_tpu.common import faults
 from tez_tpu.common.epoch import EpochFencedError
 from tez_tpu.ops.runformat import KVBatch, Run, RUN_HEADER_NBYTES
+from tez_tpu.shuffle.push import PushRejected, push_key
 
 
 def _maybe_corrupt(path_component: str, spill_id: int,
@@ -50,6 +51,8 @@ class ShuffleService:
         self._lock = threading.Lock()
         self._store: Any = None
         self._buffer: Any = None
+        self._push_admission: Any = None
+        self._push_listeners: List[Any] = []
 
     def attach_store(self, store: Any) -> None:
         """Write-through persistence (FileShuffleStore): every registered
@@ -72,10 +75,35 @@ class ShuffleService:
     def buffer_store(self) -> Any:
         return self._buffer
 
+    def attach_push_admission(self, admission: Any) -> None:
+        """Gatekeeper for eager pushes landing on this host
+        (tez_tpu.shuffle.push.PushAdmissionController); None detaches —
+        push_publish then rejects everything and producers stay on the
+        pull path."""
+        self._push_admission = admission
+
+    def push_admission(self) -> Any:
+        return self._push_admission
+
+    def add_push_listener(self, fn: Any) -> None:
+        """``fn(path_component, spill_id)`` fires after every admitted
+        push publish — the merge-wake seam: consumers poke their merge
+        manager so the async merge lane reacts to pushed arrivals
+        mid-map-wave.  Listener errors are swallowed (a broken consumer
+        must not fail the producer's push)."""
+        self._push_listeners.append(fn)
+
+    def remove_push_listener(self, fn: Any) -> None:
+        try:
+            self._push_listeners.remove(fn)
+        except ValueError:
+            pass
+
     # -- producer side -------------------------------------------------------
     def register(self, path_component: str, spill_id: int, run: Run,
                  epoch: int = 0, app_id: str = "",
-                 lineage: str = "", counters: Any = None) -> None:
+                 lineage: str = "", counters: Any = None,
+                 use_store: bool = True) -> None:
         """Producers stamped with an AM epoch are fenced: a zombie task from
         a pre-restart incarnation must not (re-)register outputs the live
         AM's re-runs now own.  Unstamped registrations (epoch 0, e.g. direct
@@ -92,11 +120,15 @@ class ShuffleService:
                 f"shuffle register from stale epoch {epoch} "
                 f"(current {epoch_registry.current(app_id)}): "
                 f"{path_component}/{spill_id}")
-        if self._buffer is not None:
+        if self._buffer is not None and use_store:
             self._buffer.publish(path_component, spill_id, run,
                                  epoch=epoch, app_id=app_id,
                                  lineage=lineage, counters=counters)
         else:
+            # use_store=False is the push path's pull backstop: the run
+            # lands in the bare registry synchronously (events may never
+            # race a missing key) and the ASYNC push later aliases the
+            # same object into the store — zero copy, no double-count
             with self._lock:
                 self._runs[(path_component, spill_id)] = run
         from tez_tpu.common import tracing
@@ -113,6 +145,52 @@ class ShuffleService:
             if not still:
                 self._store.unregister_prefix(path_component)
 
+    def push_publish(self, path_component: str, spill_id: int, run: Any,
+                     partition: Optional[int] = None, epoch: int = 0,
+                     app_id: str = "", counters: Any = None) -> None:
+        """Eager-push landing zone (docs/push_shuffle.md).
+
+        Admission-checked publish into the buffer store.  ``partition``
+        None = a same-host push of the WHOLE run under the plain
+        ``(path, spill)`` key (complete — every partition — so a consumer
+        probe can never be served a partial view); an int = one remotely
+        pushed partition under ``push_key(path, partition)`` holding a
+        single-partition run.  Raises PushRejected (admission said no —
+        caller retries then falls back to pull) or EpochFencedError (a
+        re-attempted mapper's stale push, rejected exactly like a stale
+        register)."""
+        if epoch > 0 and epoch_registry.is_stale(app_id, epoch):
+            faults.fire("fence.stale_epoch",
+                        detail=f"shuffle.push {path_component}")
+            from tez_tpu.common import tracing
+            tracing.event("fence.stale_epoch", seam="shuffle.push",
+                          reason="stale_producer", msg_epoch=epoch,
+                          src=f"{path_component}/{spill_id}")
+            raise EpochFencedError(
+                f"shuffle push from stale epoch {epoch} "
+                f"(current {epoch_registry.current(app_id)}): "
+                f"{path_component}/{spill_id}")
+        if self._buffer is None or self._push_admission is None:
+            raise PushRejected(
+                0.0, "push has no landing zone on this host (no buffer "
+                     "store / admission controller attached)")
+        nbytes = int(getattr(run, "nbytes", 0))
+        self._push_admission.admit(path_component, nbytes,
+                                   counters=counters)
+        key_path = path_component if partition is None else \
+            push_key(path_component, partition)
+        self._buffer.publish(key_path, spill_id, run, epoch=epoch,
+                             app_id=app_id, counters=counters)
+        from tez_tpu.common import tracing
+        tracing.event("shuffle.push", src=f"{path_component}/{spill_id}",
+                      nbytes=nbytes,
+                      partition=-1 if partition is None else partition)
+        for fn in list(self._push_listeners):
+            try:
+                fn(path_component, spill_id)
+            except Exception:       # merge-wake is advisory, never fatal
+                pass
+
     def unregister_prefix(self, prefix: str) -> int:
         """Deletion tracker: drop all outputs whose path starts with prefix
         (per-DAG / per-vertex cleanup).  Disk-backed runs (FileRun) also
@@ -126,7 +204,11 @@ class ShuffleService:
                 deleter()
         n = len(victims)
         if self._buffer is not None:
+            # push keys (path#pN) share the path prefix, so pushed
+            # partitions die with their DAG here too
             n += self._buffer.unregister_prefix(prefix)
+        if self._push_admission is not None:
+            self._push_admission.release_prefix(prefix)
         if self._store is not None:
             self._store.unregister_prefix(prefix)
         return n
@@ -162,6 +244,26 @@ class ShuffleService:
         with self._lock:
             run = self._runs.get((path_component, spill_id))
         if run is None:
+            # third probe: a remotely PUSHED partition — the producer has
+            # no local registration here, but its pusher may have landed
+            # this partition under push_key (a single-partition run, so
+            # partition index 0 inside the stored run)
+            if self._buffer is not None:
+                try:
+                    batch = self._buffer.fetch_partition(
+                        push_key(path_component, partition), spill_id, 0,
+                        counters=counters)
+                except FileNotFoundError:
+                    raise ShuffleDataNotFound(
+                        f"{path_component}/{spill_id}") from None
+                except Exception as e:
+                    if type(e).__name__ != "StoreKeyNotFound":
+                        raise
+                else:
+                    if faults.armed():
+                        batch = _maybe_corrupt(path_component, spill_id,
+                                               batch)
+                    return batch
             raise ShuffleDataNotFound(f"{path_component}/{spill_id}")
         try:
             batch = run.partition(partition)

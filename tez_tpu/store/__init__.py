@@ -67,6 +67,23 @@ def ensure_store(conf: Any) -> Optional[ShuffleBufferStore]:
             store = _store
             async_stage.register_pressure_hook(
                 store.relieve_device_pressure)
+        push_on = _get(C.PUSH_ENABLED)
+        if isinstance(push_on, str):
+            push_on = push_on.lower() in ("1", "true", "yes")
+        if push_on:
+            # eager-push landing zone: attach the admission controller the
+            # first push-enabled DAG configures (idempotent per process,
+            # like the store itself; reset_store detaches it)
+            from tez_tpu.shuffle.service import local_shuffle_service
+            svc = local_shuffle_service()
+            if svc.push_admission() is None:
+                from tez_tpu.shuffle.push import PushAdmissionController
+                svc.attach_push_admission(PushAdmissionController(
+                    local_buffer_store,
+                    source_quota_bytes=int(float(_get(
+                        C.PUSH_SOURCE_QUOTA_MB)) * (1 << 20)),
+                    admit_watermark=float(_get(C.PUSH_ADMIT_WATERMARK)),
+                    retry_after_ms=float(_get(C.PUSH_RETRY_AFTER_MS))))
         return _store
 
 
@@ -78,6 +95,7 @@ def reset_store() -> None:
     if store is not None:
         from tez_tpu.shuffle.service import local_shuffle_service
         local_shuffle_service().attach_buffer_store(None)
+        local_shuffle_service().attach_push_admission(None)
         from tez_tpu.ops import async_stage
         async_stage.clear_pressure_hooks()
         store.close()

@@ -97,6 +97,19 @@ class ChaosWideEmitProcessor(SimpleProcessor):
             writer.write(f"key{i:05d}".encode(), i + 1)
 
 
+class ChaosPushEmitProcessor(SimpleProcessor):
+    """Push-storm producer: several io.sort.mb's worth of records per task
+    so the pipelined sorter emits a stream of spills — each one an eager
+    push for the storm to kill mid-map-wave."""
+
+    PUSH_KEYS = 150_000
+
+    def run(self, inputs, outputs):
+        writer = outputs["consumer"].get_writer()
+        for i in range(self.PUSH_KEYS):
+            writer.write(f"key{i:06d}".encode(), i + 1)
+
+
 def make_storm(seed: int) -> str:
     """Seeded storm spec: 2-4 distinct recoverable faults."""
     rng = random.Random(seed)
@@ -134,9 +147,11 @@ def _build_dag(name: str, result_path: str, fault_spec: str = "",
 def _run_dag(workdir: str, name: str, fault_spec: str = "",
              fault_seed: int = 0, timeout: float = 120.0,
              trace: bool = False, extra_conf: Optional[Dict] = None,
-             producer_cls: type = ChaosEmitProcessor) -> Tuple[str, bytes]:
+             producer_cls: type = ChaosEmitProcessor,
+             counters: Optional[Dict] = None) -> Tuple[str, bytes]:
     """One client + one DAG in a fresh staging dir. Returns (state, result
-    bytes); result is b'' if the DAG failed before writing."""
+    bytes); result is b'' if the DAG failed before writing.  Pass a dict as
+    ``counters`` to receive the DAG's counter groups summed across tasks."""
     staging = os.path.join(workdir, name, "staging")
     result_path = os.path.join(workdir, name, "result.txt")
     os.makedirs(os.path.dirname(result_path), exist_ok=True)
@@ -151,8 +166,16 @@ def _run_dag(workdir: str, name: str, fault_spec: str = "",
     try:
         dag = _build_dag(name, result_path, fault_spec, fault_seed,
                          trace=trace, producer_cls=producer_cls)
-        status = client.submit_dag(dag).wait_for_completion(timeout=timeout)
+        dag_client = client.submit_dag(dag)
+        status = dag_client.wait_for_completion(timeout=timeout)
         state = status.state.name
+        if counters is not None:
+            final = dag_client.get_dag_status(with_counters=True)
+            if final.counters is not None:
+                for group, cs in final.counters.to_dict().items():
+                    g = counters.setdefault(group, {})
+                    for cname, v in cs.items():
+                        g[cname] = g.get(cname, 0) + v
     finally:
         client.stop()
         faults.clear_all()
@@ -238,6 +261,73 @@ def run_store_pressure(seed: int, workdir: str,
                            f"bite; shrink the tiers or widen the producer")
         return True, (f"bit-exact under eviction storm: {published} "
                       f"published, churn={churn}")
+    finally:
+        reset_store()
+
+
+# ------------------------------------------------------------- push storm
+
+def run_push_storm(seed: int, workdir: str,
+                   timeout: float = 120.0) -> Tuple[bool, str]:
+    """Push-transport kill scenario. Returns (ok, detail).
+
+    A multi-spill producer runs with push-based shuffle enabled while a
+    seeded ``shuffle.push.send`` pfail storm kills pushers mid-map-wave
+    (retries clamped to 1 so a killed push is really dead).  The pull path
+    is the correctness backstop: every spill was synchronously registered
+    before its push left the building, so the run must still SUCCEED and
+    its output must be bit-exact vs a fault-free pull-only baseline.  The
+    storm must also demonstrably bite — at least one push rejected AND at
+    least one push landed, else the trial proves nothing either way."""
+    from tez_tpu.store import local_buffer_store, reset_store
+
+    reset_store()          # a leftover store would hide this run's pushes
+    try:
+        state, baseline = _run_dag(workdir, f"pushbase{seed}",
+                                   timeout=timeout,
+                                   extra_conf={"tez.runtime.io.sort.mb": 1},
+                                   producer_cls=ChaosPushEmitProcessor)
+        if state != DAGStatusState.SUCCEEDED.name or not baseline:
+            return False, f"pull-only baseline failed (state={state})"
+        push_conf = {
+            "tez.runtime.io.sort.mb": 1,       # many spills == many pushes
+            "tez.runtime.shuffle.push.enabled": True,
+            "tez.runtime.shuffle.push.retries": 1,
+            "tez.runtime.store.enabled": True,
+            # reuse off: this scenario measures the backstop, not caching
+            "tez.runtime.store.lineage.reuse": False,
+        }
+        spec = "shuffle.push.send:pfail:p=0.5,exc=io"
+        counters: Dict = {}
+        state, got = _run_dag(workdir, f"pushstorm{seed}", fault_spec=spec,
+                              fault_seed=seed, timeout=timeout,
+                              extra_conf=push_conf,
+                              producer_cls=ChaosPushEmitProcessor,
+                              counters=counters)
+        task = counters.get("TaskCounter", {})
+        pushed = task.get("SHUFFLE_PUSH_BYTES", 0)
+        rejected = task.get("SHUFFLE_PUSH_REJECTED", 0)
+        if state != DAGStatusState.SUCCEEDED.name:
+            return False, (f"push-storm DAG finished {state}; "
+                           f"pushed={pushed} rejected={rejected}")
+        if got != baseline:
+            return False, (f"output diverged under the push storm "
+                           f"({len(got)} vs {len(baseline)} bytes); "
+                           f"pushed={pushed} rejected={rejected}")
+        store = local_buffer_store()
+        published = 0
+        if store is not None:
+            published = store.stats()["counters"].get("store.published", 0)
+        if rejected < 1:
+            return False, (f"storm never killed a push ({pushed} bytes "
+                           f"pushed) — raise p or emit more spills")
+        if pushed < 1 or published < 1:
+            return False, (f"no push ever landed ({rejected} rejected, "
+                           f"{published} published) — the run degenerated "
+                           f"to pull-only and proves nothing about push")
+        return True, (f"bit-exact on the pull backstop: {pushed} bytes "
+                      f"pushed ({published} published), {rejected} push(es) "
+                      f"killed by the storm")
     finally:
         reset_store()
 
@@ -808,6 +898,13 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                          "tiers forces watermark demotion/eviction "
                          "mid-merge; output must stay bit-exact vs a "
                          "store-disabled baseline")
+    ap.add_argument("--push-storm", action="store_true",
+                    help="run the push-transport kill scenario: a seeded "
+                         "shuffle.push.send pfail storm kills eager pushes "
+                         "mid-map-wave; the pull backstop must keep the "
+                         "output bit-exact vs a fault-free pull-only "
+                         "baseline, with at least one push killed and one "
+                         "landed")
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="arm the tracing plane (tez.trace.enabled) on the "
                          "storm DAGs and write a Perfetto trace_event JSON "
@@ -848,6 +945,22 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                     failures += 1
                     print(f"REPRO: python -m tez_tpu.tools.chaos "
                           f"--store-pressure --seed {seed}")
+        finally:
+            if cleanup:
+                shutil.rmtree(workdir, ignore_errors=True)
+        return 1 if failures else 0
+    if args.push_storm:
+        failures = 0
+        try:
+            for seed in range(args.seed, args.seed + args.trials):
+                ok, detail = run_push_storm(seed, workdir,
+                                            timeout=args.timeout)
+                print(("ok   " if ok else "FAIL ") +
+                      f"push-storm seed={seed}: {detail}")
+                if not ok:
+                    failures += 1
+                    print(f"REPRO: python -m tez_tpu.tools.chaos "
+                          f"--push-storm --seed {seed}")
         finally:
             if cleanup:
                 shutil.rmtree(workdir, ignore_errors=True)

@@ -65,6 +65,35 @@ STORE_PRESSURE_COUNTERS = (
     "store.evictions.device", "store.evictions.host", "store.evictions.disk")
 
 
+#: Push-based shuffle (tez_tpu/shuffle/push.py).  Pushed bytes are
+#: efficiency (eager pushes landing = the pipeline working — never
+#: flagged); rejections are pressure: growth means the admission
+#: controller (or a dead transport) started turning pushes away and the
+#: run leaned back on the pull path.  The counters live in the TaskCounter
+#: enum group; the histograms ride the common LatencyHistogram plumbing.
+PUSH_GROUP = "TaskCounter"
+PUSH_EFFICIENCY_COUNTERS = ("SHUFFLE_PUSH_BYTES",)
+PUSH_PRESSURE_COUNTERS = ("SHUFFLE_PUSH_REJECTED",)
+PUSH_HISTS = ("shuffle.push.rtt", "shuffle.push.admit_wait")
+
+
+def diff_push(counters_a: Dict, counters_b: Dict,
+              ) -> List[Tuple[str, int, int, bool]]:
+    """[(counter, a, b, regressed)] over the push-shuffle section;
+    regressed only when B rejected more pushes than A (pushed-byte deltas
+    are workload-shaped, not regressions)."""
+    ga = counters_a.get(PUSH_GROUP, {})
+    gb = counters_b.get(PUSH_GROUP, {})
+    out = []
+    for name in PUSH_EFFICIENCY_COUNTERS + PUSH_PRESSURE_COUNTERS:
+        if name not in ga and name not in gb:
+            continue
+        va, vb = int(ga.get(name, 0)), int(gb.get(name, 0))
+        out.append((name, va, vb,
+                    name in PUSH_PRESSURE_COUNTERS and vb > va))
+    return out
+
+
 def diff_store(counters_a: Dict, counters_b: Dict,
                ) -> List[Tuple[str, int, int, bool]]:
     """[(counter, a, b, regressed)] over the buffer-store section;
@@ -205,6 +234,24 @@ def main() -> int:
             flag = "  << REGRESSION" if regressed else ""
             print(f"{name:60} {va:14d} {vb:14d}{flag}")
             regressions += int(regressed)
+    push = diff_push(a.counters, b.counters)
+    if push:
+        print(f"\n{'push shuffle (bytes/rejections)':60} "
+              f"{'A':>14} {'B':>14}")
+        for name, va, vb, regressed in push:
+            flag = "  << REGRESSION" if regressed else ""
+            print(f"{name:60} {va:14d} {vb:14d}{flag}")
+            regressions += int(regressed)
+        pushes = diff_device_stages(a.counters, b.counters,
+                                    names=PUSH_HISTS)
+        if pushes:
+            print(f"\n{'push transport (wall ms)':32} "
+                  f"{'A':>14} {'B':>14} {'delta':>12}")
+            for name, ms_a, ms_b, regressed in pushes:
+                flag = "  << REGRESSION" if regressed else ""
+                print(f"{name:32} {ms_a:14.1f} {ms_b:14.1f} "
+                      f"{ms_b - ms_a:+12.1f}{flag}")
+                regressions += int(regressed)
     failover = diff_device_failover(a.counters, b.counters)
     if failover:
         print(f"\n{'device.failover (containment)':60} "

@@ -6,7 +6,7 @@ PY ?= python
 OLD ?= BENCH_r05.json
 NEW ?= /tmp/bench_new.json
 
-.PHONY: test lint bench bench-new bench-diff bench-merge bench-store bench-sort chaos chaos-device-ooo chaos-device chaos-merge chaos-store chaos-push docs
+.PHONY: test lint bench bench-new bench-diff bench-merge bench-store bench-sort bench-exchange chaos chaos-device-ooo chaos-device chaos-merge chaos-store chaos-push chaos-exchange docs
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -43,6 +43,12 @@ bench-store:
 bench-sort:
 	JAX_PLATFORMS=cpu TEZ_BENCH_SORT_ONLY=1 $(PY) bench.py
 
+# MULTICHIP skewed-key exchange legs (8 virtual devices on CPU): padded
+# baseline vs ragged/skew-aware/coded, bit-identical outputs; bench-diff
+# enforces the skew-aware leg's min_vs_baseline >= 1.3 floor
+bench-exchange:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 TEZ_BENCH_EXCHANGE_ONLY=1 $(PY) bench.py
+
 chaos:
 	$(PY) -m tez_tpu.tools.chaos --trials 3
 
@@ -68,6 +74,12 @@ chaos-store:
 # bit-exact vs a fault-free pull-only baseline
 chaos-push:
 	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --push-storm --trials 3
+
+# skewed hot-key exchange with one delayed chip (mesh.exchange.delay):
+# the splitter must hold the round count down and coded r2 must mask the
+# straggler, output bit-exact vs the fault-free padded baseline
+chaos-exchange:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 $(PY) -m tez_tpu.tools.chaos --exchange-skew --trials 3
 
 docs:
 	$(PY) -m tez_tpu.tools.gen_config_docs > docs/configuration.md

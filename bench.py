@@ -708,6 +708,15 @@ def main() -> int:
         from tez_tpu.tools.sort_bench import bench_sort
         print(json.dumps(bench_sort(cpu_fallback)), flush=True)
         return 0
+    if os.environ.get("TEZ_BENCH_EXCHANGE_ONLY") == "1":
+        # make bench-exchange: the MULTICHIP skewed-key corpus through the
+        # mesh exchange plane — padded baseline vs ragged/skew-aware/coded
+        # legs, one metric line each (the skew-aware line carries the
+        # bench_diff min_vs_baseline floor)
+        from tez_tpu.tools.exchange_bench import bench_exchange
+        for rec in bench_exchange(cpu_fallback):
+            print(json.dumps(rec), flush=True)
+        return 0
     if os.environ.get("TEZ_BENCH_MERGE_ONLY") == "1":
         # make bench-merge: just the reduce-side merge-path info line
         num_records = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000

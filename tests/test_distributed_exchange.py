@@ -119,24 +119,81 @@ def test_distributed_shuffle_overflow_is_reported(mesh8):
 
 
 def test_ragged_exchange_matches_golden_or_skips(mesh8):
-    """The ragged (zero-padding-on-wire) exchange; XLA:CPU lacks the
-    ragged-all-to-all thunk, so this compiles+runs only on TPU."""
+    """Ragged-engine parity on backends that have the thunk; elsewhere a
+    SKIP carrying the probe's reason (never a silent pass — the probe
+    re-raises anything that is not the known missing-thunk signature)."""
+    from tez_tpu.parallel.exchange import probe_ragged_support
+    ok, reason = probe_ragged_support(mesh8)
+    if not ok:
+        pytest.skip(reason)
     W, N, L, V = 8, 32, 2, 2
     fn = build_distributed_shuffle(mesh8, L, N, N, value_words=V,
                                    ragged=True)
     lanes, lengths, values, valid = _inputs(W, N, L, V, seed=3,
                                             valid_frac=1.0)
-    try:
-        out_lanes, out_lens, out_vals, out_valid, dropped = jax.device_get(
-            fn(lanes, lengths, values, valid))
-    except Exception as e:  # noqa: BLE001
-        if "UNIMPLEMENTED" in str(e) or isinstance(e, NotImplementedError) \
-                or ("ragged_all_to_all" in str(e)
-                    and isinstance(e, AttributeError)):
-            pytest.skip(f"backend lacks ragged-all-to-all: {type(e).__name__}")
-        raise
+    out_lanes, out_lens, out_vals, out_valid, dropped = jax.device_get(
+        fn(lanes, lengths, values, valid))
     assert int(dropped.sum()) == 0
     golden = distributed_shuffle_reference(lanes, lengths, values, valid, W)
     got = _got(out_lanes, out_lens, out_vals, out_valid, W)
     for w in range(W):
         assert sorted(got[w]) == sorted(golden[w]), f"worker {w}"
+
+
+def test_probe_is_cached_and_resolver_maps_knob(mesh8):
+    """The probe caches per (devices, platform); resolve_engine maps the
+    knob onto what the backend can run — 'padded' is always honored,
+    'auto'/'ragged' follow the probe, junk raises naming the knob."""
+    from tez_tpu.parallel.exchange import (probe_ragged_support,
+                                           resolve_engine)
+    ok, reason = probe_ragged_support(mesh8)
+    assert probe_ragged_support(mesh8) == (ok, reason)   # cached
+    assert resolve_engine("padded", mesh8)[0] == "padded"
+    eng_auto, why_auto = resolve_engine("auto", mesh8)
+    eng_req, why_req = resolve_engine("ragged", mesh8)
+    assert eng_auto == eng_req == ("ragged" if ok else "padded")
+    if not ok:
+        assert reason in why_req or "padded" in why_req
+    with pytest.raises(ValueError, match="tez.runtime.mesh.exchange.engine"):
+        resolve_engine("turbo", mesh8)
+
+
+def test_explicit_dests_matching_hash_reproduces_golden(mesh8):
+    """explicit_dests with the FNV route itself must be bit-identical to
+    hash routing — the coordinator always sends explicit routes, so this
+    is the bridge invariant between the two formulations."""
+    from tez_tpu.ops.host_sort import fnv_rows_host
+    from tez_tpu.ops.keycodec import lanes_to_matrix
+    W, N, L, V, CAP = 8, 64, 2, 3, 64 * 8
+    lanes, lengths, values, valid = _inputs(W, N, L, V, seed=5)
+    dests = (fnv_rows_host(lanes_to_matrix(lanes),
+                           lengths.astype(np.int64)) %
+             np.uint32(W)).astype(np.uint32)
+    fn = build_distributed_shuffle(mesh8, L, N, CAP, value_words=V,
+                                   explicit_dests=True)
+    out = jax.device_get(fn(lanes, lengths, values, valid.astype(bool),
+                            dests))
+    assert int(out[4].sum()) == 0
+    golden = distributed_shuffle_reference(lanes, lengths, values, valid, W)
+    got = _got(*out[:4], W)
+    for w in range(W):
+        assert got[w] == golden[w], f"worker {w}"
+
+
+def test_explicit_dests_redirect_overrides_hash(mesh8):
+    """Explicit routing WINS over the key hash: every valid row sent to
+    worker 3 lands on worker 3, key-sorted, regardless of what the keys
+    hash to (the splitter/coded seam)."""
+    W, N, L = 8, 16, 2
+    lanes, lengths, values, valid = _inputs(W, N, L, 1, seed=9)
+    dests = np.full(W * N, 3, np.uint32)
+    fn = build_distributed_shuffle(mesh8, L, N, W * N, value_words=1,
+                                   explicit_dests=True)
+    out_lanes, out_lens, out_vals, out_valid, dropped = jax.device_get(
+        fn(lanes, lengths, values, valid.astype(bool), dests))
+    assert int(dropped.sum()) == 0
+    got = _got(out_lanes, out_lens, out_vals, out_valid, W)
+    assert all(not got[w] for w in range(W) if w != 3)
+    assert len(got[3]) == int(valid.sum())
+    keys3 = [(g[0], g[1]) for g in got[3]]
+    assert keys3 == sorted(keys3)

@@ -1,0 +1,195 @@
+"""Skew/straggler exchange plane: round planning, the folded-in
+splitter, engine resolution, and coded r2 — all bit-exact against the
+legacy padded formulation (itself kernel-verified against the host
+reference in test_distributed_exchange.py)."""
+import numpy as np
+import pytest
+
+import jax
+
+from tez_tpu.common import faults
+from tez_tpu.ops.runformat import KVBatch
+from tez_tpu.parallel.coordinator import (MeshExchangeCoordinator,
+                                          plan_rounds)
+
+KEY_BYTES = 6
+VAL_BYTES = 5
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _devices():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+
+
+@pytest.fixture(autouse=True)
+def _no_faults():
+    yield
+    faults.install("test", [])
+
+
+def _corpus(rows, producers, consumers, hot_frac, hot_part, seed=0):
+    """Producer spans with ``hot_frac`` of rows in consumer partition
+    ``hot_part`` — classified by the real FNV partitioner, so the skew is
+    exact by construction."""
+    from tez_tpu.ops.host_sort import fnv_rows_host
+    rng = np.random.default_rng(seed)
+    pool = rng.integers(0, 256, size=(4096, KEY_BYTES), dtype=np.uint8)
+    part = fnv_rows_host(pool, np.full(pool.shape[0], KEY_BYTES,
+                                       dtype=np.int64)) % consumers
+    hot, cold = pool[part == hot_part], pool[part != hot_part]
+    n_hot = int(rows * hot_frac)
+    keys = np.concatenate([
+        hot[rng.integers(0, hot.shape[0], n_hot)],
+        cold[rng.integers(0, cold.shape[0], rows - n_hot)]])
+    keys = keys[rng.permutation(rows)]
+    vals = rng.integers(0, 256, size=(rows, VAL_BYTES), dtype=np.uint8)
+    spans = []
+    for i in range(producers):
+        k, v = keys[i::producers], vals[i::producers]
+        n = k.shape[0]
+        spans.append(KVBatch(
+            k.reshape(-1), np.arange(n + 1, dtype=np.int64) * KEY_BYTES,
+            v.reshape(-1), np.arange(n + 1, dtype=np.int64) * VAL_BYTES))
+    return spans
+
+
+def _run(coord, spans, edge, consumers, **kw):
+    for i, b in enumerate(spans):
+        coord.register_producer(edge, i, len(spans), consumers, b,
+                                KEY_BYTES, VAL_BYTES, **kw)
+    return [coord.wait_consumer(edge, c, len(spans), consumers, timeout=120)
+            for c in range(consumers)]
+
+
+def _sig(res):
+    return [(np.asarray(b.key_bytes).tobytes(),
+             np.asarray(b.val_bytes).tobytes()) for b in res]
+
+
+def _golden(spans, consumers):
+    out = _run(MeshExchangeCoordinator(legacy_sizing=True), spans,
+               "golden/a->b", consumers, engine="padded")
+    return _sig(out)
+
+
+# ---------------------------------------------------------------- planning
+
+def test_plan_rounds_budget_invariants():
+    """Every round's quota fits the device budget, quotas sum exactly to
+    the histogram, and the balanced cap never exceeds per_round."""
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        D = int(rng.integers(1, 9))
+        per_round = int(rng.integers(1, 200))
+        counts = rng.integers(0, per_round * 4, D).astype(np.int64)
+        for legacy in (False, True):
+            plan = plan_rounds(counts, per_round, D, legacy=legacy)
+            total = np.zeros(D, dtype=np.int64)
+            for quota, cap in plan:
+                assert quota.max() <= per_round
+                assert 1 <= cap <= per_round
+                assert quota.sum() > 0          # no empty rounds
+                total += quota
+            np.testing.assert_array_equal(total, counts)
+    assert plan_rounds(np.zeros(4, dtype=np.int64), 16, 4) == []
+
+
+def test_plan_rounds_balanced_cap_beats_legacy():
+    """One hot destination: legacy pads every pair to the hot partition,
+    balanced splits its quota over D senders — a D-fold smaller cap."""
+    counts = np.array([1000, 10, 10, 10], dtype=np.int64)
+    [(_, legacy_cap)] = plan_rounds(counts, 1 << 20, 4, legacy=True)
+    [(_, cap)] = plan_rounds(counts, 1 << 20, 4, legacy=False)
+    assert legacy_cap >= 1000
+    assert cap < legacy_cap
+    assert cap >= -(-1000 // 4)      # still holds the hot dest's chunks
+
+
+# ------------------------------------------------------- property matrix
+
+@pytest.mark.parametrize("consumers", [8, 16])
+@pytest.mark.parametrize("hot_frac", [0.0, 0.45])
+@pytest.mark.parametrize("coded", ["off", "r2"])
+def test_exchange_matrix_bit_exact(consumers, hot_frac, coded):
+    """(W, skew, engine=auto, coded) matrix: every cell bit-identical to
+    the legacy padded run of the same corpus — including W=16 on 8
+    devices (two consumer partitions per device, host recombine)."""
+    spans = _corpus(6_000, 4, consumers, hot_frac, hot_part=1,
+                    seed=consumers * 10 + int(hot_frac * 100))
+    golden = _golden(spans, consumers)
+    coord = MeshExchangeCoordinator(max_rows_per_round=2_000, split_after=1)
+    out = _run(coord, spans, f"cell-{coded}/a->b", consumers,
+               engine="auto", coded=coded)
+    assert _sig(out) == golden
+    if hot_frac > 0.0:
+        # 45% in one of >=8 partitions always busts the 2k budget
+        assert coord.partition_splits >= 1
+        assert coord.multi_round_exchanges == 0
+    from tez_tpu.parallel.exchange import probe_ragged_support
+    ok, _ = probe_ragged_support(coord.mesh_for(coord.devices_for(consumers)))
+    assert coord.last_engine == ("ragged" if ok else "padded")
+
+
+def test_splitter_recombine_preserves_key_order():
+    """Equal hot keys split across sub-partitions must recombine in their
+    original arrival order — values of one repeated key come back exactly
+    as the no-split exchange delivers them."""
+    consumers = 8
+    spans = _corpus(4_000, 4, consumers, hot_frac=0.5, hot_part=3, seed=2)
+    golden = _golden(spans, consumers)
+    coord = MeshExchangeCoordinator(max_rows_per_round=600, split_after=1)
+    out = _run(coord, spans, "recombine/a->b", consumers, engine="auto")
+    assert coord.partition_splits >= 1
+    assert _sig(out) == golden      # byte-exact => value order preserved
+
+
+def test_splitter_disabled_falls_back_to_rounds():
+    """split_after=0 turns the splitter off: the same hot corpus instead
+    pays extra rounds, and stays bit-exact."""
+    consumers = 8
+    spans = _corpus(4_000, 4, consumers, hot_frac=0.5, hot_part=3, seed=2)
+    golden = _golden(spans, consumers)
+    coord = MeshExchangeCoordinator(max_rows_per_round=600, split_after=0)
+    out = _run(coord, spans, "nosplit/a->b", consumers, engine="auto")
+    assert coord.partition_splits == 0
+    assert coord.multi_round_exchanges >= 1
+    assert _sig(out) == golden
+
+
+# ------------------------------------------------------------------ coded
+
+def test_coded_r2_masks_delayed_chip():
+    """With one chip's readback delayed, the coded exchange returns from
+    the buddy copy without waiting out the delay — and stays bit-exact."""
+    consumers = 8
+    spans = _corpus(3_000, 4, consumers, hot_frac=0.0, hot_part=0, seed=4)
+    golden = _golden(spans, consumers)
+    coord = MeshExchangeCoordinator()
+    # warm run compiles the coded program fault-free
+    _run(coord, spans, "warm-coded/a->b", consumers, coded="r2")
+    faults.install("test", faults.parse_spec(
+        "mesh.exchange.delay:delay:ms=1500,n=1,match=device=5"))
+    import time
+    t0 = time.perf_counter()
+    out = _run(coord, spans, "delayed-coded/a->b", consumers, coded="r2")
+    wall = time.perf_counter() - t0
+    assert _sig(out) == golden
+    assert coord.coded_buddy_wins >= 1
+    assert wall < 1.5, f"coded exchange waited out the delay ({wall:.2f}s)"
+
+
+def test_coded_r2_both_copies_failed_raises():
+    """fail-mode on BOTH holders of one partition (primary chip and its
+    buddy) must surface an error, not silently drop the partition."""
+    consumers = 8
+    spans = _corpus(2_000, 4, consumers, hot_frac=0.0, hot_part=0, seed=6)
+    coord = MeshExchangeCoordinator()
+    _run(coord, spans, "warm-fail/a->b", consumers, coded="r2")
+    # partition 2's primary is device 2; its buddy copy lives on device 3
+    # ((2+1) % 8) — failing both readbacks kills every recovery path
+    faults.install("test", faults.parse_spec(
+        "mesh.exchange.delay:fail:n=1,match=device=2;"
+        "mesh.exchange.delay:fail:n=1,match=device=3"))
+    with pytest.raises(Exception, match="copies"):
+        _run(coord, spans, "bothfail/a->b", consumers, coded="r2")

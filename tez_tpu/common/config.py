@@ -469,6 +469,28 @@ TPU_MESH_MAX_ROWS_PER_ROUND = _key(
     "per-edge cap on rows moved per exchange round (skewed partitions run "
     "multi-round above it); 0 = coordinator default "
     "(TEZ_TPU_MESH_MAX_ROWS_PER_ROUND env or 1Mi rows)")
+MESH_EXCHANGE_ENGINE = _key(
+    "tez.runtime.mesh.exchange.engine", "auto", Scope.VERTEX,
+    "ICI collective carrying mesh-exchange edges: 'padded' = fixed "
+    "[W, CAP] all_to_all (portable; padding crosses ICI as slack), "
+    "'ragged' = ragged_all_to_all (only real rows move; TPU-only, falls "
+    "back loudly where the backend lacks the thunk), 'auto' = ragged "
+    "when the runtime probe passes, padded otherwise (bit-exact either "
+    "way; see docs/exchange.md)")
+MESH_EXCHANGE_CODED = _key(
+    "tez.runtime.mesh.exchange.coded", "off", Scope.VERTEX,
+    "Coded TeraSort-style redundant exchange: 'r2' sends every "
+    "partition's rows to its primary device AND one rotation-offset "
+    "buddy, and the consumer takes the first complete copy — masks one "
+    "slow or faulted chip per exchange at 2x send flops (flops are "
+    "cheap, ICI stragglers are not); 'off' = single copy")
+MESH_EXCHANGE_SPLIT_AFTER = _key(
+    "tez.runtime.mesh.exchange.split.after", 2, Scope.VERTEX,
+    "fair-shuffle splitter trigger: after this many CONSECUTIVE "
+    "exchanges of a recurring edge with one partition over "
+    "max-rows-per-round, hot partitions are re-partitioned across "
+    "sub-destinations with a merge-side recombine instead of "
+    "re-rounding forever; 0 = never split")
 TPU_MESH_MAX_KEY_BYTES = _key(
     "tez.runtime.tpu.mesh.max.key.bytes", 256, Scope.VERTEX,
     "hard cap on key bytes the mesh exchange carries (slot widths "

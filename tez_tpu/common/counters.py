@@ -113,6 +113,20 @@ class TaskCounter(enum.Enum):
     D2H_TRANSFER_BYTES = enum.auto()
 
 
+# Mesh ICI exchange plane (parallel/coordinator.py): string-named counters
+# in their own group — the exchange is an edge-level event, not a per-task
+# IO, so it reports through the triggering producer's TezCounters rather
+# than the TaskCounter enum.  counter_diff renders these as the `exchange`
+# section (efficiency rows are workload-shaped and never flagged; pressure
+# rows regress when they GROW — more rounds / more splits means the plane
+# started re-rounding or re-partitioning to absorb skew).
+MESH_EXCHANGE_GROUP = "MeshExchange"
+MESH_EXCHANGE_EFFICIENCY_COUNTERS = (
+    "exchange.rows.sent", "exchange.bytes.sent",
+    "exchange.coded.duplicate.bytes", "exchange.coded.buddy.wins")
+MESH_EXCHANGE_PRESSURE_COUNTERS = ("exchange.rounds", "exchange.splits")
+
+
 class FileSystemCounter(enum.Enum):
     """Reference: FileSystemCounterGroup (per-FS bytes/ops)."""
     FILE_BYTES_READ = enum.auto()

@@ -82,6 +82,11 @@ KNOWN_POINTS: Dict[str, str] = {
     "mesh.exchange":
         "parallel/coordinator.py host-level mesh exchange entry (the jitted "
         "SPMD body itself is not instrumentable)",
+    "mesh.exchange.delay":
+        "parallel/coordinator.py per-device shard readback (detail = "
+        "<edge>:round=<r>:device=<d>); delay mode turns one chip into a "
+        "readback straggler — the lever chaos uses to prove coded r2 "
+        "masks it via the buddy copy; fail mode fails that chip's copy",
     "task.run":
         "runtime/task_runner.py processor invocation (detail = attempt id; "
         "delay mode makes an attempt a straggler, fail mode crashes it)",

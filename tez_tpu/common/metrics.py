@@ -139,7 +139,12 @@ WELL_KNOWN_HISTOGRAMS = ("shuffle.fetch.rtt", "spill.write", "shuffle.merge",
                          # round trip (same-host publish or remote push
                          # verb) and the pusher's total admission wait
                          # (retry-after backoff before accept/give-up)
-                         "shuffle.push.rtt", "shuffle.push.admit_wait")
+                         "shuffle.push.rtt", "shuffle.push.admit_wait",
+                         # mesh ICI exchange (parallel/coordinator.py): one
+                         # exchange round end-to-end — placement, SPMD
+                         # dispatch, per-device readback (coded: first
+                         # complete copy), decode
+                         "mesh.exchange.round")
 
 
 class MetricsRegistry:

@@ -69,6 +69,12 @@ class MeshOrderedPartitionedKVOutput(LogicalOutput):
             ctx, "tez.runtime.tpu.mesh.max.value.bytes", 1024))
         self.max_rows_per_round = int(_conf_get(
             ctx, "tez.runtime.tpu.mesh.max-rows-per-round", 0))
+        self.exchange_engine = str(_conf_get(
+            ctx, "tez.runtime.mesh.exchange.engine", "auto"))
+        self.exchange_coded = str(_conf_get(
+            ctx, "tez.runtime.mesh.exchange.coded", "off"))
+        self.exchange_split_after = int(_conf_get(
+            ctx, "tez.runtime.mesh.exchange.split.after", 2))
         if _conf_get(ctx, "tez.runtime.key.comparator.class", ""):
             raise ValueError(
                 "mesh edges sort by raw key bytes on device; custom "
@@ -129,7 +135,11 @@ class MeshOrderedPartitionedKVOutput(LogicalOutput):
             value_width=self.value_width,
             max_rows_per_round=self.max_rows_per_round,
             max_key_bytes=self.max_key_bytes,
-            max_value_bytes=self.max_value_bytes)
+            max_value_bytes=self.max_value_bytes,
+            engine=self.exchange_engine,
+            coded=self.exchange_coded,
+            split_after=self.exchange_split_after,
+            counters=ctx.counters)
         ctx.counters.increment(TaskCounter.SHUFFLE_BYTES, batch.nbytes)
         payload = ShufflePayload(host=MESH_HOST, port=0,
                                  path_component=edge, last_event=True)

@@ -10,6 +10,24 @@ never overflow), runs it over the device mesh — multi-round when one round
 would exceed the per-device row budget (SURVEY.md §5.7 multi-pass analog)
 — and consumer tasks block on their sorted partition.
 
+The exchange plane is skew- and straggler-aware:
+
+* Round sizing comes from the per-(sender, partition) histogram, not the
+  global max: each destination's round rows are balanced across senders in
+  contiguous arrival-order chunks, so the per-pair CAP shrinks by up to D×
+  versus the padded worst case (``plan_rounds``; ``legacy_sizing=True``
+  keeps the old formulation as the bench baseline).
+* The fair-shuffle splitter is folded in: an edge that keeps arriving with
+  one partition over ``max_rows_per_round`` (``split.after`` consecutive
+  exchanges, tracked across recurring DAG runs by edge suffix) gets its hot
+  partitions re-partitioned across d sub-destinations, with a merge-side
+  recombine by the true consumer hash — instead of re-rounding forever.
+* Coded r2 mode (Coded TeraSort-style) duplicates every row to its
+  destination's rotation buddy and takes the FIRST complete copy at
+  readback, masking one slow or faulted chip at 2x send flops.  The
+  ``mesh.exchange.delay`` fault point fires per (round, device) on the
+  readback threads so chaos can prove the masking.
+
 Single-controller topology: every runner in this process shares one
 coordinator (the analog of local_shuffle_service); a multi-host deployment
 runs one coordinator per host participating in a global jax mesh, with the
@@ -24,6 +42,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from tez_tpu.common import faults
+from tez_tpu.common.counters import MESH_EXCHANGE_GROUP
 from tez_tpu.ops.keycodec import matrix_to_lanes, pad_to_matrix
 from tez_tpu.ops.runformat import KVBatch
 
@@ -78,6 +97,10 @@ class _EdgeState:
         self.num_producers = num_producers
         self.num_consumers = num_consumers
         self.max_rows_per_round: Optional[int] = None   # per-edge conf
+        self.engine: Optional[str] = None     # auto|padded|ragged (per-edge)
+        self.coded: Optional[str] = None      # off|r2 (per-edge)
+        self.split_after: Optional[int] = None
+        self.counters = None                  # triggering producer's sink
         self.spans: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
         self.results: Optional[List[KVBatch]] = None
         self.error: Optional[BaseException] = None
@@ -85,21 +108,67 @@ class _EdgeState:
         self.dirty = False         # spans changed while executing: re-run
 
 
+def plan_rounds(counts: np.ndarray, per_round: int, num_devices: int,
+                legacy: bool = False) -> List[Tuple[np.ndarray, int]]:
+    """Round plan for an exchange with per-destination row ``counts``:
+    a list of (quota, cap) where quota[d] is destination d's rows in that
+    round and cap the per-(sender, dest) slot count the kernel compiles
+    with.  Round r carries each destination's arrival ranks
+    [r*per_round, (r+1)*per_round), so quota = clip(counts - r*per_round,
+    0, per_round) and no quota ever exceeds the device budget.
+
+    Legacy sizing pads every pair to the round's largest partition — any
+    one sender COULD hold a whole destination's rows.  Histogram sizing
+    instead balances each destination's quota across all D senders in
+    contiguous chunks (the coordinator owns placement, so it can promise
+    this), shrinking cap to ceil(quota.max()/D): up to D× less padded ICI
+    traffic under skew.  Power-of-two bucketing keeps compile keys stable.
+    """
+    from tez_tpu.ops.device import _bucket
+    counts = np.asarray(counts, dtype=np.int64)
+    max_part = int(counts.max()) if counts.size else 0
+    if max_part == 0:
+        return []          # nothing to send: no rounds at all
+    rounds = -(-max_part // per_round)
+    plan: List[Tuple[np.ndarray, int]] = []
+    for r in range(rounds):
+        quota = np.clip(counts - r * per_round, 0, per_round)
+        if legacy:
+            cap = min(_bucket(min(max_part, per_round)), per_round)
+        else:
+            chunk = max(1, -(-int(quota.max()) // num_devices))
+            cap = min(_bucket(chunk), per_round)
+        plan.append((quota, cap))
+    return plan
+
+
 class MeshExchangeCoordinator:
     """Per-process exchange coordinator (one per runner host)."""
 
-    def __init__(self, mesh=None, max_rows_per_round: int = 1 << 20):
+    def __init__(self, mesh=None, max_rows_per_round: int = 1 << 20,
+                 engine: str = "auto", legacy_sizing: bool = False,
+                 split_after: int = 2):
         self._mesh = mesh
         self.max_rows_per_round = max_rows_per_round
+        self.engine = engine            # default; per-edge conf overrides
+        self.legacy_sizing = legacy_sizing   # bench baseline: max-part CAP
+        self.split_after = split_after  # 0 = splitter disabled
         self.lock = threading.Condition()
         self.edges: Dict[str, _EdgeState] = {}
         # compiled exchange programs keyed by (devices, shape...) — meshes
         # are cached per size below so these keys are stable across edges
-        self._compiled: Dict[Tuple[int, int, int, int], object] = {}
+        self._compiled: Dict[Tuple[int, int, int, int, int, bool], object] \
+            = {}
         self._meshes: Dict[int, object] = {}
         self.exchanges_run = 0
         self.rows_exchanged = 0
         self.multi_round_exchanges = 0
+        self.partition_splits = 0
+        self.coded_buddy_wins = 0
+        self.last_engine: Optional[str] = None
+        # consecutive over-budget streak per recurring edge (keyed by the
+        # edge id MINUS the per-run dag prefix, so history survives re-runs)
+        self._skew_history: Dict[str, int] = {}
 
     # ------------------------------------------------------------------ mesh
     def devices_for(self, num_consumers: int) -> int:
@@ -144,7 +213,11 @@ class MeshExchangeCoordinator:
                           value_width: int,
                           max_rows_per_round: Optional[int] = None,
                           max_key_bytes: int = 256,
-                          max_value_bytes: int = 1024) -> None:
+                          max_value_bytes: int = 1024,
+                          engine: Optional[str] = None,
+                          coded: Optional[str] = None,
+                          split_after: Optional[int] = None,
+                          counters=None) -> None:
         """Record one producer span (encoded).  The LAST registration runs
         the exchange inline on that producer's thread — the gang barrier:
         by then every producer's data is resident, which is exactly the
@@ -184,6 +257,14 @@ class MeshExchangeCoordinator:
                 edge_id, _EdgeState(num_producers, num_consumers, edge_id))
             if max_rows_per_round:
                 st.max_rows_per_round = int(max_rows_per_round)
+            if engine:
+                st.engine = engine
+            if coded:
+                st.coded = coded
+            if split_after is not None:
+                st.split_after = int(split_after)
+            if counters is not None:
+                st.counters = counters
             st.spans[task_index] = (lanes,
                                     klens.astype(np.uint32),
                                     vwords)
@@ -292,26 +373,109 @@ class MeshExchangeCoordinator:
 
     # -------------------------------------------------------------- exchange
     def _compiled_fn(self, mesh, num_lanes: int, rows_per_worker: int,
-                     cap: int, value_words: int):
+                     cap: int, value_words: int, ragged: bool = False):
         from tez_tpu.parallel.exchange import build_distributed_shuffle
         key = (mesh.devices.size, num_lanes, rows_per_worker, cap,
-               value_words)
+               value_words, ragged)
         fn = self._compiled.get(key)
         if fn is None:
+            # always explicit_dests: the coordinator owns routing (splitter
+            # re-targets, coded duplicates) — the kernel must not re-derive
+            # destinations from the key hash
             fn = build_distributed_shuffle(mesh, num_lanes, rows_per_worker,
-                                           cap, value_words=value_words)
+                                           cap, value_words=value_words,
+                                           ragged=ragged,
+                                           explicit_dests=True)
             self._compiled[key] = fn
         return fn
+
+    def _read_shards(self, arrs, mesh, edge_id: str, round_idx: int):
+        """Materialize the exchange outputs one device at a time, each on
+        its own daemon reader thread.  Every reader fires the
+        ``mesh.exchange.delay`` fault point (detail
+        ``<edge>:round=<r>:device=<d>``) before touching its shard — the
+        chaos lever that turns one chip into a readback straggler, since
+        the jitted SPMD body itself is not instrumentable.  Returns
+        (events, results, any_done); results[d] becomes the device's
+        (lanes, klens, vwords, valid) tuple, or the exception its reader
+        hit (a faulted chip), once events[d] is set."""
+        D = mesh.devices.size
+        pos = {dev: i for i, dev in enumerate(mesh.devices.flat)}
+        shard_maps = []
+        for a in arrs:
+            shard_maps.append(
+                {pos[s.device]: s.data for s in a.addressable_shards})
+        events = [threading.Event() for _ in range(D)]
+        results: List[object] = [None] * D
+        any_done = threading.Event()
+
+        def _read(d: int) -> None:
+            try:
+                faults.fire("mesh.exchange.delay",
+                            detail=f"{edge_id}:round={round_idx}:device={d}")
+                results[d] = tuple(np.asarray(m[d]) for m in shard_maps)
+            except BaseException as e:  # noqa: BLE001 — surfaced by reader
+                results[d] = e
+            finally:
+                events[d].set()
+                any_done.set()
+
+        for d in range(D):
+            # daemon: a delayed/hung reader is ABANDONED once its buddy's
+            # copy wins — it must never pin process exit
+            threading.Thread(target=_read, args=(d,), daemon=True,
+                             name=f"mesh-exchange-read-{d}").start()
+        return events, results, any_done
+
+    def _select_coded(self, events, results, any_done,
+                      num_devices: int) -> Tuple[Dict[int, int], int]:
+        """First-complete-copy selection for coded r2: partition p is
+        served by whichever of (primary p, buddy (p+1)%D) materializes
+        first; ties prefer the primary so buddy wins are a true straggler
+        signal.  A reader that FAILED (fault, not delay) is skipped — the
+        surviving copy masks faulted chips too; only both copies failing
+        surfaces an error."""
+        from tez_tpu.parallel.mesh import coded_buddy
+        remaining = set(range(num_devices))
+        chosen: Dict[int, int] = {}
+        wins = 0
+        while remaining:
+            progressed = False
+            for p in sorted(remaining):
+                cands = (p, coded_buddy(p, num_devices))
+                done = [d for d in cands if events[d].is_set() and
+                        not isinstance(results[d], BaseException)]
+                if done:
+                    chosen[p] = done[0]
+                    wins += int(done[0] != p)
+                    remaining.discard(p)
+                    progressed = True
+                elif all(events[d].is_set() for d in cands):
+                    err = next(results[d] for d in cands
+                               if isinstance(results[d], BaseException))
+                    raise RuntimeError(
+                        f"mesh exchange: both copies of partition {p} "
+                        f"failed under coded r2") from err
+            if remaining and not progressed:
+                any_done.wait(0.05)
+                any_done.clear()
+        return chosen, wins
 
     def _execute(self, st: _EdgeState) -> List[KVBatch]:
         """Run the SPMD exchange for a complete edge.  CAP comes from exact
         host-side partition counts (fnv_rows_host == the kernel's
         partitioner), so the padded all-to-all cannot overflow; when the
         biggest partition exceeds max_rows_per_round the exchange runs in
-        rank-sliced rounds and each consumer's rounds merge at the end."""
+        rank-sliced rounds and each consumer's rounds merge at the end.
+        See the module docstring for the skew levers layered on top
+        (histogram round sizing, the splitter, coded r2)."""
+        import time
+
+        from tez_tpu.common import metrics
         from tez_tpu.ops.host_sort import fnv_rows_host
         from tez_tpu.ops.sorter import merge_sorted_runs
         from tez_tpu.ops.runformat import Run
+        from tez_tpu.parallel.exchange import resolve_engine
 
         # host-level seam: the jitted SPMD body is not instrumentable, so
         # chaos hits the exchange at entry (the caller's error path turns
@@ -353,64 +517,228 @@ class MeshExchangeCoordinator:
         from tez_tpu.ops.keycodec import lanes_to_matrix
         kmat = lanes_to_matrix(lanes)
         hashes = fnv_rows_host(kmat, klens.astype(np.int64))
-        part = (hashes % np.uint32(D)).astype(np.int64)
-        counts = np.bincount(part, minlength=D)
-        max_part = int(counts.max())
+        rdest = (hashes % np.uint32(D)).astype(np.int64)
+        counts = np.bincount(rdest, minlength=D)
         per_round = st.max_rows_per_round or self.max_rows_per_round
-        rounds = max(1, -(-max_part // per_round))
-        # power-of-two bucketing keeps the compiled-program cache keys
-        # stable across runs with slightly different cardinalities (the
-        # kernel tolerates extra capacity as padding)
-        cap = min(_bucket(min(max_part, per_round)), per_round)
 
-        # rank of each row within its partition (stable arrival order)
-        order = np.argsort(part, kind="stable")
+        # ---- fair-shuffle splitter: an edge whose largest partition has
+        # exceeded the round budget split_after times IN A ROW (recurring
+        # runs share the id suffix; the dag prefix changes per run) gets
+        # each hot destination re-partitioned across d_sub sub-destinations
+        # in contiguous arrival blocks.  Routing stops being key-derivable
+        # for those rows, but the CONSUMER identity (hash % W) still is —
+        # the merge-side recombine below reassembles split partitions.
+        skew_key = st.edge_id.split("/", 1)[-1] or st.edge_id
+        over_budget = int(counts.max()) > per_round
+        with self.lock:
+            if over_budget:
+                streak = self._skew_history.get(skew_key, 0) + 1
+                self._skew_history[skew_key] = streak
+            else:
+                self._skew_history.pop(skew_key, None)
+                streak = 0
+        split_after = st.split_after if st.split_after is not None \
+            else self.split_after
+        splits = 0
+        if over_budget and D > 1 and split_after > 0 and \
+                streak >= split_after:
+            hot = np.flatnonzero(counts > per_round)
+            load = counts.astype(np.int64).copy()
+            load[hot] = per_round      # each hot dest keeps a full round
+            # split every hot dest against the ORIGINAL routing snapshot:
+            # rows an earlier split re-homed INTO d are not d's to re-split
+            orig_rdest = rdest.copy()
+            # biggest partition gets first pick of the headroom
+            for d in hot[np.argsort(-counts[hot], kind="stable")]:
+                n_d = int(counts[d])
+                amounts = np.zeros(D, dtype=np.int64)
+                amounts[d] = per_round
+                remaining = n_d - per_round
+                # fill other destinations' headroom, least-loaded first:
+                # whenever the total fits in D*per_round at all, the
+                # exchange comes out single-round
+                for t in np.argsort(load, kind="stable"):
+                    if remaining == 0:
+                        break
+                    if t == d or load[t] >= per_round:
+                        continue
+                    take = min(int(per_round - load[t]), remaining)
+                    amounts[t] += take
+                    load[t] += take
+                    remaining -= take
+                if remaining:
+                    # no headroom left: multi-round is inevitable; spread
+                    # the rest evenly so no destination re-rounds alone
+                    base, extra = divmod(remaining, D)
+                    add = np.full(D, base, dtype=np.int64)
+                    add[:extra] += 1
+                    amounts += add
+                    load += add
+                # carve d's arrival-ordered rows into contiguous blocks
+                # handed to destinations in ASCENDING device index: the
+                # consumer-side recombine merges runs in device order, so
+                # ascending blocks reconstruct arrival order exactly
+                # (deterministic equal-key ties, same as the unsplit path)
+                rows = np.flatnonzero(orig_rdest == d)  # ascending==arrival
+                rdest[rows] = np.repeat(np.arange(D), amounts)
+                splits += 1
+            counts = np.bincount(rdest, minlength=D)
+            with self.lock:
+                self.partition_splits += splits
+            log.info("mesh exchange %s: splitter engaged after %d "
+                     "over-budget exchange(s); %d hot partition(s) "
+                     "re-partitioned", st.edge_id, streak, splits)
+
+        engine, engine_reason = resolve_engine(st.engine or self.engine,
+                                               mesh)
+        self.last_engine = engine
+        log.debug("mesh exchange %s: engine=%s (%s)", st.edge_id, engine,
+                  engine_reason)
+        coded = (st.coded or "off") == "r2" and D > 1
+        plan = plan_rounds(counts, per_round, D, legacy=self.legacy_sizing)
+
+        # rank of each row within its routing partition (arrival order)
+        order = np.argsort(rdest, kind="stable")
         ranks = np.empty(total, dtype=np.int64)
         starts = np.zeros(D + 1, dtype=np.int64)
         np.cumsum(counts, out=starts[1:])
         ranks[order] = np.arange(total, dtype=np.int64) - \
             np.repeat(starts[:-1], counts)
 
+        row_words = num_lanes + 1 + value_words   # lanes + klen + vwords
+        sent_rows = dup_rows = buddy_wins = rounds_run = 0
         per_round_results: List[List[KVBatch]] = []
-        for r in range(rounds):
-            lo, hi = r * cap, (r + 1) * cap
-            sel = np.flatnonzero((ranks >= lo) & (ranks < hi))
+        for r, (quota, cap) in enumerate(plan):
+            lo = r * per_round
+            sel = np.flatnonzero((ranks >= lo) & (ranks < lo + per_round))
             n_round = sel.size
             if n_round == 0:
                 continue
-            # rows per worker, padded AND bucketed (stable compile keys)
-            N = _bucket(-(-n_round // D))
-            pad = D * N - n_round
-            r_lanes = np.concatenate(
-                [lanes[sel],
-                 np.zeros((pad, num_lanes), np.uint32)])
-            r_klens = np.concatenate([klens[sel],
-                                      np.zeros(pad, np.uint32)])
-            r_vwords = np.concatenate(
-                [vwords[sel], np.zeros((pad, value_words), np.uint32)])
-            r_valid = np.concatenate([np.ones(n_round, bool),
-                                      np.zeros(pad, bool)])
-            fn = self._compiled_fn(mesh, num_lanes, N, cap, value_words)
+            t_round = time.perf_counter()
+            rows_idx = sel
+            dests_all = rdest[sel]
+            rtag = None
+            if coded:
+                # r2: every row ALSO goes to its destination's rotation
+                # buddy.  An extra value word carries the routing partition
+                # (same on both copies) so each shard can tell its primary
+                # rows from buddy copies — not derivable from the key once
+                # the splitter has re-routed rows.
+                rows_idx = np.concatenate([sel, sel])
+                rtag = np.concatenate([dests_all, dests_all]) \
+                    .astype(np.uint32)
+                dests_all = np.concatenate(
+                    [dests_all, (dests_all + 1) % D])
+                dup_rows += n_round
+            qc = np.bincount(dests_all, minlength=D)
+            if coded:
+                # duplication doubled the quotas; re-derive the balanced
+                # cap from the combined histogram (coded always uses
+                # balanced placement — legacy tail-packing could put a
+                # whole destination's copies on one sender)
+                cap = min(_bucket(max(1, -(-int(qc.max()) // D))),
+                          per_round)
+            balanced = coded or not self.legacy_sizing
+            if balanced:
+                # balanced blocked placement: destination d's rows split
+                # into <= D contiguous arrival-order chunks, chunk j ->
+                # sender j, so no (sender, dest) pair exceeds
+                # ceil(quota_d / D) <= cap.  Contiguous chunks + the
+                # receiver's stable sender-major merge preserve global
+                # arrival order for equal keys.
+                qorder = np.argsort(dests_all, kind="stable")
+                lrank = np.empty(dests_all.size, dtype=np.int64)
+                qstarts = np.zeros(D + 1, dtype=np.int64)
+                np.cumsum(qc, out=qstarts[1:])
+                lrank[qorder] = np.arange(dests_all.size, dtype=np.int64) \
+                    - np.repeat(qstarts[:-1], qc)
+                chunk_d = np.maximum(1, -(-qc // D))
+                senders = lrank // chunk_d[dests_all]
+                loads = np.bincount(senders, minlength=D)
+                N = _bucket(int(loads.max()))
+                place = np.argsort(senders, kind="stable")
+                within = np.empty(senders.size, dtype=np.int64)
+                lstarts = np.zeros(D + 1, dtype=np.int64)
+                np.cumsum(loads, out=lstarts[1:])
+                within[place] = \
+                    np.arange(senders.size, dtype=np.int64) - \
+                    np.repeat(lstarts[:-1], loads)
+                pos = senders * N + within
+            else:
+                # legacy layout: rows in arrival order, zero tail pad
+                N = _bucket(-(-dests_all.size // D))
+                pos = np.arange(dests_all.size, dtype=np.int64)
+            vw = value_words + (1 if coded else 0)
+            r_lanes = np.zeros((D * N, num_lanes), np.uint32)
+            r_klens = np.zeros(D * N, np.uint32)
+            r_vwords = np.zeros((D * N, vw), np.uint32)
+            r_valid = np.zeros(D * N, bool)
+            r_dests = np.zeros(D * N, np.uint32)
+            r_lanes[pos] = lanes[rows_idx]
+            r_klens[pos] = klens[rows_idx]
+            r_vwords[pos, :value_words] = vwords[rows_idx]
+            if coded:
+                r_vwords[pos, value_words] = rtag
+            r_valid[pos] = True
+            r_dests[pos] = dests_all.astype(np.uint32)
+            fn = self._compiled_fn(mesh, num_lanes, N, cap, vw,
+                                   ragged=(engine == "ragged"))
             out_lanes, out_klens, out_vwords, out_valid, dropped = \
-                fn(r_lanes, r_klens, r_vwords, r_valid)
+                fn(r_lanes, r_klens, r_vwords, r_valid, r_dests)
+            # the dropped flag is a tiny replicated array: reading it does
+            # not serialize the per-device readback below (the delay fault
+            # stalls our reader threads, not device compute)
             dropped_total = int(np.asarray(dropped).sum())
             if dropped_total:
                 raise MeshCapacityError(
                     f"mesh exchange overflow: {dropped_total} rows dropped "
                     f"(cap {cap}, round {r}) — capacity accounting bug")
-            out_lanes = np.asarray(out_lanes).reshape(D, -1, num_lanes)
-            out_klens = np.asarray(out_klens).reshape(D, -1)
-            out_vwords = np.asarray(out_vwords).reshape(D, -1, value_words)
-            out_valid = np.asarray(out_valid).reshape(D, -1)
-            per_round_results.append([
-                _decode_rows(out_lanes[w], out_klens[w], out_vwords[w],
-                             out_valid[w]) for w in range(D)])
+            events, results, any_done = self._read_shards(
+                (out_lanes, out_klens, out_vwords, out_valid), mesh,
+                st.edge_id, r)
+            round_parts: List[KVBatch] = []
+            if coded:
+                chosen, wins = self._select_coded(events, results,
+                                                  any_done, D)
+                buddy_wins += wins
+                for p in range(D):
+                    dl, dk, dv, dval = results[chosen[p]]
+                    keep = dval.astype(bool) & (dv[:, value_words] == p)
+                    round_parts.append(_decode_rows(
+                        dl, dk,
+                        np.ascontiguousarray(dv[:, :value_words]), keep))
+            else:
+                for d in range(D):
+                    events[d].wait()
+                    if isinstance(results[d], BaseException):
+                        raise results[d]
+                    dl, dk, dv, dval = results[d]
+                    round_parts.append(
+                        _decode_rows(dl, dk, dv, dval.astype(bool)))
+            per_round_results.append(round_parts)
+            metrics.observe("mesh.exchange.round",
+                            (time.perf_counter() - t_round) * 1000.0,
+                            st.counters)
+            sent_rows += n_round
+            rounds_run += 1
             with self.lock:
                 self.rows_exchanged += n_round
         with self.lock:
             self.exchanges_run += 1
-            if rounds > 1:
+            self.coded_buddy_wins += buddy_wins
+            if rounds_run > 1:
                 self.multi_round_exchanges += 1
+        if st.counters is not None:
+            g = st.counters.group(MESH_EXCHANGE_GROUP)
+            g.find_counter("exchange.rows.sent").increment(sent_rows)
+            g.find_counter("exchange.bytes.sent").increment(
+                sent_rows * row_words * 4)
+            g.find_counter("exchange.rounds").increment(rounds_run)
+            g.find_counter("exchange.splits").increment(splits)
+            g.find_counter("exchange.coded.duplicate.bytes").increment(
+                dup_rows * row_words * 4)
+            g.find_counter("exchange.coded.buddy.wins").increment(
+                buddy_wins)
 
         if len(per_round_results) == 1:
             per_device = per_round_results[0]
@@ -429,27 +757,40 @@ class MeshExchangeCoordinator:
                 else:
                     per_device.append(merge_sorted_runs(
                         runs, 1, num_lanes * 4, engine="host").batch)
-        if W == D:
+        if W == D and splits == 0:
             return per_device
-        # consumers exceed devices: device d holds partitions
-        # {c : c % D == d} key-sorted; split them apart (stable selection
-        # from a key-sorted stream stays key-sorted)
-        results: List[Optional[KVBatch]] = [None] * W
+        # general consumer assembly, covering both W > D (device d holds
+        # consumer partitions {c : c % D == d} key-sorted) and the
+        # splitter's merge-side recombine (a split consumer's rows landed
+        # on several devices; each device's slice is key-sorted, so a
+        # stable host merge in device order reassembles the partition with
+        # arrival-order ties).  The TRUE consumer hash (fnv % W) is always
+        # key-derivable, even for re-routed rows.
+        runs_per_consumer: List[List[KVBatch]] = [[] for _ in range(W)]
         for d in range(D):
             batch = per_device[d]
             if batch.num_records == 0:
-                for c in range(d, W, D):
-                    results[c] = KVBatch.empty()
                 continue
             bmat, blens = pad_to_matrix(batch.key_bytes, batch.key_offsets,
                                         num_lanes * 4)
             c_part = (fnv_rows_host(bmat, blens.astype(np.int64)) %
                       np.uint32(W)).astype(np.int64)
-            for c in range(d, W, D):
-                sel = np.flatnonzero(c_part == c)
-                results[c] = batch.take(sel) if sel.size else \
-                    KVBatch.empty()
-        return results    # type: ignore[return-value]
+            for c in np.unique(c_part):
+                csel = np.flatnonzero(c_part == c)
+                runs_per_consumer[int(c)].append(batch.take(csel))
+        results_out: List[KVBatch] = []
+        for c in range(W):
+            runs = runs_per_consumer[c]
+            if not runs:
+                results_out.append(KVBatch.empty())
+            elif len(runs) == 1:
+                results_out.append(runs[0])
+            else:
+                results_out.append(merge_sorted_runs(
+                    [Run(b, np.array([0, b.num_records], dtype=np.int64))
+                     for b in runs], 1, num_lanes * 4,
+                    engine="host").batch)
+        return results_out
 
 
 _coordinator: Optional[MeshExchangeCoordinator] = None

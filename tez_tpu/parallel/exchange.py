@@ -22,11 +22,32 @@ kernel: the mesh exchange coordinator sizes CAP from exact partition counts
 and falls back to a multi-round exchange when one round would exceed the
 device budget (SURVEY.md §5.7), with fair-shuffle splitting for persistent
 skew.
+
+Two first-class engines share the choreography (docs/exchange.md):
+
+- ``padded`` — the portable default: a fixed [W, CAP] send buffer per
+  worker moves over ``jax.lax.all_to_all``; padding slots cross ICI as
+  slack.
+- ``ragged`` — ``jax.lax.ragged_all_to_all``: only real rows cross ICI.
+  TPU-only today (XLA:CPU lacks the thunk); ``probe_ragged_support``
+  detects availability at runtime and ``resolve_engine`` maps the
+  ``tez.runtime.mesh.exchange.engine`` knob (auto|padded|ragged) onto a
+  bit-exact choice for this backend.
+
+Routing is normally the on-device FNV-1a of each key, but callers may pass
+EXPLICIT per-row destinations (``explicit_dests=True``): the coordinator
+already computes the exact host-side histogram with the same hash, and
+explicit routing is what lets it re-partition persistently hot keys across
+sub-partitions (fair-shuffle splitter) and send coded duplicate rows to a
+rotation-offset buddy device (Coded TeraSort r2) — neither destination is
+derivable from the key alone.
 """
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+import logging
+import threading
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,7 +56,11 @@ from jax.sharding import PartitionSpec as P
 
 from tez_tpu.parallel.mesh import WORKER_AXIS
 
+log = logging.getLogger(__name__)
+
 INVALID = jnp.uint32(0xFFFFFFFF)
+
+EXCHANGE_ENGINES = ("auto", "padded", "ragged")
 
 
 def _fnv_lanes(lanes: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
@@ -70,13 +95,21 @@ def _stable_sort_rows(keys_cols, payload_cols):
     return [c[perm] for c in keys_cols], [p[perm] for p in payload_cols], perm
 
 
-def _partition_sort(lanes, lengths, values, valid, num_workers):
-    """Shared prologue: hash-partition + stable local sort by
+def _partition_sort(lanes, lengths, values, valid, num_workers, dests=None):
+    """Shared prologue: partition + stable local sort by
     (partition, key lanes, key length); invalid rows carry partition ==
-    num_workers so they sort to the tail."""
+    num_workers so they sort to the tail.  ``dests`` (u32[N], < num_workers)
+    overrides the on-device hash routing with explicit destinations —
+    the splitter/coded seam (module docstring)."""
     n, num_lanes = lanes.shape
-    part = jnp.where(valid, _fnv_lanes(lanes, lengths) % num_workers,
-                     jnp.uint32(num_workers))
+    if dests is None:
+        route = _fnv_lanes(lanes, lengths) % num_workers
+    else:
+        # clamp defensively: an out-of-range dest must never index past the
+        # send buffer (the coordinator always passes values < num_workers)
+        route = jnp.minimum(dests.astype(jnp.uint32),
+                            jnp.uint32(num_workers - 1))
+    part = jnp.where(valid, route, jnp.uint32(num_workers))
     key_cols = [part.astype(jnp.uint32)] + \
         [lanes[:, i] for i in range(num_lanes)] + [lengths.astype(jnp.uint32)]
     sorted_keys, sorted_payload, _ = _stable_sort_rows(
@@ -107,16 +140,18 @@ def _merge_received(rlanes, rlengths, rvals, rvalid):
 
 def _shuffle_step_local(lanes: jnp.ndarray, lengths: jnp.ndarray,
                         values: jnp.ndarray, valid: jnp.ndarray,
-                        num_workers: int, cap: int) -> Tuple[jnp.ndarray, ...]:
+                        dests: jnp.ndarray = None,
+                        *, num_workers: int,
+                        cap: int) -> Tuple[jnp.ndarray, ...]:
     """Per-worker body run under shard_map.  lanes: u32[N, L]; lengths:
-    u32[N]; values: u32[N, V]; valid: bool[N].  Returns (lanes', lengths',
-    values', valid', dropped) holding this worker's partition, key-sorted,
-    padded to [W*cap], plus a per-worker count of rows lost to capacity
-    overflow (must be zero)."""
+    u32[N]; values: u32[N, V]; valid: bool[N]; dests: optional u32[N]
+    explicit routing.  Returns (lanes', lengths', values', valid', dropped)
+    holding this worker's partition, key-sorted, padded to [W*cap], plus a
+    per-worker count of rows lost to capacity overflow (must be zero)."""
     n, num_lanes = lanes.shape
     num_vwords = values.shape[1]
     spart, slanes, slengths, svalues, svalid = _partition_sort(
-        lanes, lengths, values, valid, num_workers)
+        lanes, lengths, values, valid, num_workers, dests)
 
     # scatter rows into the fixed [W, cap] send buffer: row i of partition p
     # goes to slot (p, rank_within_partition(i))
@@ -169,7 +204,8 @@ def _shuffle_step_local(lanes: jnp.ndarray, lengths: jnp.ndarray,
 
 def _shuffle_step_local_ragged(lanes: jnp.ndarray, lengths: jnp.ndarray,
                                values: jnp.ndarray, valid: jnp.ndarray,
-                               num_workers: int,
+                               dests: jnp.ndarray = None,
+                               *, num_workers: int,
                                out_cap: int) -> Tuple[jnp.ndarray, ...]:
     """Ragged variant: only real rows cross ICI (jax.lax.ragged_all_to_all).
 
@@ -183,7 +219,7 @@ def _shuffle_step_local_ragged(lanes: jnp.ndarray, lengths: jnp.ndarray,
     n, num_lanes = lanes.shape
     num_vwords = values.shape[1]
     spart, slanes, slengths, svalues, _ = _partition_sort(
-        lanes, lengths, values, valid, num_workers)
+        lanes, lengths, values, valid, num_workers, dests)
 
     raw_sizes = jnp.bincount(
         jnp.minimum(spart, num_workers).astype(jnp.int32),
@@ -234,11 +270,14 @@ def _shuffle_step_local_ragged(lanes: jnp.ndarray, lengths: jnp.ndarray,
 
 def build_distributed_shuffle(mesh, num_lanes: int, rows_per_worker: int,
                               cap_per_pair: int, value_words: int = 1,
-                              ragged: bool = False):
+                              ragged: bool = False,
+                              explicit_dests: bool = False):
     """Compile the SPMD shuffle step for a mesh.  Returns a jitted function
     f(lanes u32[W*N, L], lengths u32[W*N], values u32[W*N, V],
-      valid bool[W*N]) -> per-worker sorted partitions, sharded over the
-    mesh."""
+      valid bool[W*N][, dests u32[W*N]]) -> per-worker sorted partitions,
+    sharded over the mesh.  ``explicit_dests`` adds the dests input and
+    routes by it instead of the on-device key hash (coordinator splitter /
+    coded-buddy seam)."""
     try:
         from jax import shard_map          # jax >= 0.8
     except ImportError:                    # pragma: no cover — older jax
@@ -256,14 +295,85 @@ def build_distributed_shuffle(mesh, num_lanes: int, rows_per_worker: int,
     # replication-check kwarg was renamed check_rep -> check_vma in jax 0.8
     check_kw = "check_vma" if "check_vma" in \
         inspect.signature(shard_map).parameters else "check_rep"
+    n_in = 5 if explicit_dests else 4
     smapped = shard_map(
         body, mesh=mesh,
-        in_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS),
-                  P(WORKER_AXIS)),
+        in_specs=tuple(P(WORKER_AXIS) for _ in range(n_in)),
         out_specs=(P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS),
                    P(WORKER_AXIS), P(WORKER_AXIS)),
         **{check_kw: False})
     return jax.jit(smapped)
+
+
+# ---------------------------------------------------------------------------
+# Engine selection: capability probe + knob resolution
+# ---------------------------------------------------------------------------
+
+_probe_lock = threading.Lock()
+_RAGGED_PROBE: Dict[Tuple[int, str], Tuple[bool, str]] = {}
+
+
+def _ragged_unsupported_reason(e: BaseException, platform: str) -> str:
+    """Classify a probe failure as 'backend lacks it' vs a real bug; the
+    same triage the guarded parity test used before the probe existed."""
+    if "UNIMPLEMENTED" in str(e) or isinstance(e, NotImplementedError) or \
+            (isinstance(e, AttributeError) and "ragged_all_to_all" in str(e)):
+        return (f"{platform} backend lacks the ragged-all-to-all thunk "
+                f"({type(e).__name__})")
+    raise e
+
+
+def probe_ragged_support(mesh) -> Tuple[bool, str]:
+    """(supported, reason) for ``jax.lax.ragged_all_to_all`` on this mesh's
+    backend — compiled AND executed once on a tiny shape, cached per
+    (device count, platform).  A probe failure that is not the known
+    missing-thunk signature re-raises: masking a real compile bug as
+    'unsupported' would silently pin every exchange to the padded engine."""
+    platform = mesh.devices.flat[0].platform
+    key = (mesh.devices.size, platform)
+    with _probe_lock:
+        cached = _RAGGED_PROBE.get(key)
+    if cached is not None:
+        return cached
+    if not hasattr(jax.lax, "ragged_all_to_all"):
+        result = (False, "this jax has no jax.lax.ragged_all_to_all")
+    else:
+        W = mesh.devices.size
+        try:
+            fn = build_distributed_shuffle(mesh, 1, 1, 1, value_words=1,
+                                           ragged=True)
+            jax.device_get(fn(np.zeros((W, 1), np.uint32),
+                              np.ones(W, np.uint32),
+                              np.zeros((W, 1), np.uint32),
+                              np.ones(W, bool)))
+            result = (True, f"ragged_all_to_all available on {platform}")
+        except Exception as e:  # noqa: BLE001 — classified, re-raised if real
+            result = (False, _ragged_unsupported_reason(e, platform))
+    with _probe_lock:
+        _RAGGED_PROBE[key] = result
+    return result
+
+
+def resolve_engine(requested: str, mesh) -> Tuple[str, str]:
+    """Map the ``tez.runtime.mesh.exchange.engine`` knob onto the engine
+    this backend can actually run, bit-exact either way.  Returns
+    (engine, reason): 'auto' takes ragged when the probe passes; an
+    explicit 'ragged' on a backend without it falls back to padded with a
+    loud warning (never an error — the padded formulation computes the
+    identical result)."""
+    if requested not in EXCHANGE_ENGINES:
+        raise ValueError(
+            f"tez.runtime.mesh.exchange.engine={requested!r}: expected one "
+            f"of {'|'.join(EXCHANGE_ENGINES)}")
+    if requested == "padded":
+        return "padded", "engine=padded requested"
+    ok, reason = probe_ragged_support(mesh)
+    if ok:
+        return "ragged", reason
+    if requested == "ragged":
+        log.warning("mesh exchange: engine=ragged requested but %s; "
+                    "falling back to the bit-exact padded engine", reason)
+    return "padded", reason
 
 
 def fnv_bytes_host(key: bytes) -> int:

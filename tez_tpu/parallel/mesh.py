@@ -29,6 +29,13 @@ def make_mesh(n_devices: Optional[int] = None,
     return Mesh(np.asarray(devices), (WORKER_AXIS,))
 
 
+def coded_buddy(partition: int, num_devices: int, offset: int = 1) -> int:
+    """Rotation-offset buddy device for the coded (r2) redundant exchange:
+    partition p's duplicate copy lands on device (p + offset) % D, so one
+    slow or faulted chip never owns both copies of any partition."""
+    return (partition + offset) % num_devices
+
+
 def worker_sharding(mesh: Mesh) -> NamedSharding:
     """Rows sharded across workers (leading axis)."""
     return NamedSharding(mesh, PartitionSpec(WORKER_AXIS))

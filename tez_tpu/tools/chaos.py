@@ -811,6 +811,109 @@ def run_merge_storm(seed: int, batches: int = 6,
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def run_exchange_skew(seed: int, rows: int = 24_000, producers: int = 4,
+                      consumers: int = 8) -> Tuple[bool, str]:
+    """Skewed-key mesh exchange with one delayed chip.  A seeded corpus
+    puts ~45% of all rows in one hot consumer partition (over the round
+    budget, so the coordinator's splitter must engage instead of
+    re-rounding), and a ``mesh.exchange.delay`` fault stalls one device's
+    shard readback for longer than the whole exchange should take.  Under
+    coded r2 the buddy copy must mask the straggler: output bit-exact vs
+    a fault-free padded-baseline run, no multi-round storm, at least one
+    split and one buddy win, and wall time well under the injected delay."""
+    import numpy as np
+
+    import jax
+    from tez_tpu.common.counters import TezCounters
+    from tez_tpu.ops.host_sort import fnv_rows_host
+    from tez_tpu.ops.runformat import KVBatch
+    from tez_tpu.parallel.coordinator import MeshExchangeCoordinator
+
+    if len(jax.devices()) < 2:
+        return True, ("SKIPPED: exchange-skew needs >= 2 devices (run via "
+                      "make chaos-exchange, which forces 8 virtual CPU "
+                      "devices)")
+
+    rng = np.random.default_rng(seed)
+    hot_part = seed % consumers
+    pool = rng.integers(0, 256, size=(20_000, 8), dtype=np.uint8)
+    part = fnv_rows_host(pool, np.full(pool.shape[0], 8,
+                                       dtype=np.int64)) % consumers
+    hot_pool, cold_pool = pool[part == hot_part], pool[part != hot_part]
+    n_hot = int(rows * 0.45)
+    keys = np.concatenate([
+        hot_pool[rng.integers(0, hot_pool.shape[0], n_hot)],
+        cold_pool[rng.integers(0, cold_pool.shape[0], rows - n_hot)]])
+    keys = keys[rng.permutation(rows)]
+    vals = rng.integers(0, 256, size=(rows, 12), dtype=np.uint8)
+    spans = []
+    for i in range(producers):
+        k, v = keys[i::producers], vals[i::producers]
+        n = k.shape[0]
+        spans.append(KVBatch(
+            k.reshape(-1), np.arange(n + 1, dtype=np.int64) * 8,
+            v.reshape(-1), np.arange(n + 1, dtype=np.int64) * 12))
+
+    def run(coord, edge: str, **kw):
+        for i, b in enumerate(spans):
+            coord.register_producer(edge, i, producers, consumers, b,
+                                    16, 16, **kw)
+        return [coord.wait_consumer(edge, c, producers, consumers,
+                                    timeout=60.0) for c in range(consumers)]
+
+    def sig(res):
+        return [(np.asarray(b.key_bytes).tobytes(),
+                 np.asarray(b.val_bytes).tobytes()) for b in res]
+
+    golden = sig(run(MeshExchangeCoordinator(legacy_sizing=True),
+                     f"chaos-exchange-{seed}-golden/a->b",
+                     engine="padded"))
+
+    per_round = 5_000        # hot partition (~10.8k rows) is over budget
+    trial = MeshExchangeCoordinator(max_rows_per_round=per_round,
+                                    split_after=1)
+    counters = TezCounters()
+    # warm exchange, fault-free: compiles the programs AND proves the
+    # splitter path on the same histogram, so the timed leg below measures
+    # straggler masking, not jit compilation
+    warm = sig(run(trial, f"chaos-exchange-{seed}-warm/a->b", coded="r2",
+                   counters=counters))
+    if warm != golden:
+        return False, "fault-free coded/split run diverges from baseline"
+    D = trial.devices_for(consumers)
+    delayed = random.Random(seed).randrange(D)
+    delay_ms = 2_500
+    faults.install("chaos", faults.parse_spec(
+        f"mesh.exchange.delay:delay:ms={delay_ms},n=1,"
+        f"match=device={delayed}"), seed=seed)
+    try:
+        t0 = time.perf_counter()
+        out = sig(run(trial, f"chaos-exchange-{seed}-trial/a->b",
+                      coded="r2", counters=counters))
+        wall = time.perf_counter() - t0
+    finally:
+        faults.install("chaos", [])
+    if out != golden:
+        return False, (f"coded output diverges from the fault-free padded "
+                       f"baseline (delayed device {delayed})")
+    if trial.multi_round_exchanges:
+        return False, (f"multi-round storm: {trial.multi_round_exchanges} "
+                       f"exchange(s) re-rounded despite the splitter")
+    if trial.partition_splits < 1:
+        return False, "splitter never engaged on the hot partition"
+    if trial.coded_buddy_wins < 1:
+        return False, (f"no buddy win: the delayed chip (device {delayed}) "
+                       f"was not masked by its coded copy")
+    if wall >= delay_ms / 1000.0:
+        return False, (f"exchange wall {wall:.2f}s >= injected "
+                       f"{delay_ms}ms delay — straggler not masked")
+    return True, (f"hot partition {hot_part} split "
+                  f"{trial.partition_splits}x, device {delayed} delayed "
+                  f"{delay_ms}ms, masked by {trial.coded_buddy_wins} buddy "
+                  f"win(s); {rows} rows bit-exact in {wall:.2f}s, "
+                  f"0 multi-round exchanges")
+
+
 def _export_trace(path: str) -> None:
     """Write whatever the span buffer holds (it survives per-DAG disarm) as
     Perfetto trace_event JSON, then drop the buffer."""
@@ -905,6 +1008,13 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                          "output bit-exact vs a fault-free pull-only "
                          "baseline, with at least one push killed and one "
                          "landed")
+    ap.add_argument("--exchange-skew", action="store_true",
+                    help="run the skewed-key mesh-exchange scenario: a hot "
+                         "partition over the round budget plus one chip "
+                         "delayed at shard readback (mesh.exchange.delay); "
+                         "the splitter must avoid the multi-round storm "
+                         "and coded r2 must mask the straggler, bit-exact "
+                         "vs the fault-free padded baseline")
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="arm the tracing plane (tez.trace.enabled) on the "
                          "storm DAGs and write a Perfetto trace_event JSON "
@@ -916,6 +1026,7 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
         (args.device_hang, "device-hang", run_device_hang),
         (args.device_oom_storm, "device-oom-storm", run_device_oom_storm),
         (args.merge_storm, "merge-storm", run_merge_storm),
+        (args.exchange_skew, "exchange-skew", run_exchange_skew),
     ]
     if any(on for on, _, _ in device_scenarios):
         failures = 0

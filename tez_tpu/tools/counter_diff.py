@@ -14,6 +14,9 @@ from __future__ import annotations
 import sys
 from typing import Dict, List, Tuple
 
+from tez_tpu.common.counters import (MESH_EXCHANGE_EFFICIENCY_COUNTERS,
+                                     MESH_EXCHANGE_GROUP,
+                                     MESH_EXCHANGE_PRESSURE_COUNTERS)
 from tez_tpu.common.metrics import HIST_GROUP_PREFIX, histograms_from_counters
 from tez_tpu.tools.history_parser import parse_jsonl_files
 
@@ -75,6 +78,35 @@ PUSH_GROUP = "TaskCounter"
 PUSH_EFFICIENCY_COUNTERS = ("SHUFFLE_PUSH_BYTES",)
 PUSH_PRESSURE_COUNTERS = ("SHUFFLE_PUSH_REJECTED",)
 PUSH_HISTS = ("shuffle.push.rtt", "shuffle.push.admit_wait")
+
+
+#: Mesh ICI exchange (parallel/coordinator.py).  Rows/bytes sent and coded
+#: duplicate traffic are workload-shaped efficiency numbers (coded
+#: duplicate bytes literally buy straggler masking — never flagged);
+#: rounds and splits are pressure: growth means the exchange plane started
+#: re-rounding or re-partitioning to absorb skew it previously did not
+#: see.  Per-round RTT rides the common LatencyHistogram plumbing.
+EXCHANGE_GROUP = MESH_EXCHANGE_GROUP
+EXCHANGE_EFFICIENCY_COUNTERS = MESH_EXCHANGE_EFFICIENCY_COUNTERS
+EXCHANGE_PRESSURE_COUNTERS = MESH_EXCHANGE_PRESSURE_COUNTERS
+EXCHANGE_HISTS = ("mesh.exchange.round",)
+
+
+def diff_exchange(counters_a: Dict, counters_b: Dict,
+                  ) -> List[Tuple[str, int, int, bool]]:
+    """[(counter, a, b, regressed)] over the mesh-exchange section;
+    regressed only when B needed more rounds or splits than A (row/byte
+    and coded-duplicate deltas are workload-shaped, not regressions)."""
+    ga = counters_a.get(EXCHANGE_GROUP, {})
+    gb = counters_b.get(EXCHANGE_GROUP, {})
+    out = []
+    for name in EXCHANGE_EFFICIENCY_COUNTERS + EXCHANGE_PRESSURE_COUNTERS:
+        if name not in ga and name not in gb:
+            continue
+        va, vb = int(ga.get(name, 0)), int(gb.get(name, 0))
+        out.append((name, va, vb,
+                    name in EXCHANGE_PRESSURE_COUNTERS and vb > va))
+    return out
 
 
 def diff_push(counters_a: Dict, counters_b: Dict,
@@ -252,6 +284,24 @@ def main() -> int:
                 print(f"{name:32} {ms_a:14.1f} {ms_b:14.1f} "
                       f"{ms_b - ms_a:+12.1f}{flag}")
                 regressions += int(regressed)
+    exchange = diff_exchange(a.counters, b.counters)
+    if exchange:
+        print(f"\n{'mesh exchange (rows/rounds/splits/coded)':60} "
+              f"{'A':>14} {'B':>14}")
+        for name, va, vb, regressed in exchange:
+            flag = "  << REGRESSION" if regressed else ""
+            print(f"{name:60} {va:14d} {vb:14d}{flag}")
+            regressions += int(regressed)
+        ex_rtt = diff_device_stages(a.counters, b.counters,
+                                    names=EXCHANGE_HISTS)
+        if ex_rtt:
+            print(f"\n{'exchange round (wall ms)':32} "
+                  f"{'A':>14} {'B':>14} {'delta':>12}")
+            for name, ms_a, ms_b, regressed in ex_rtt:
+                flag = "  << REGRESSION" if regressed else ""
+                print(f"{name:32} {ms_a:14.1f} {ms_b:14.1f} "
+                      f"{ms_b - ms_a:+12.1f}{flag}")
+                regressions += int(regressed)
     failover = diff_device_failover(a.counters, b.counters)
     if failover:
         print(f"\n{'device.failover (containment)':60} "
@@ -266,7 +316,8 @@ def main() -> int:
     if regressions:
         print(f"{regressions} regression(s) (latency p95 >= "
               f"{REGRESSION_RATIO}x baseline, containment event growth, "
-              f"or store eviction/demotion churn growth)")
+              f"store eviction/demotion churn growth, or exchange "
+              f"round/split growth)")
     return 0
 
 

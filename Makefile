@@ -6,7 +6,7 @@ PY ?= python
 OLD ?= BENCH_r05.json
 NEW ?= /tmp/bench_new.json
 
-.PHONY: test lint bench bench-new bench-diff bench-merge bench-store bench-sort bench-exchange chaos chaos-device-ooo chaos-device chaos-merge chaos-store chaos-push chaos-exchange docs
+.PHONY: test lint bench bench-new bench-diff bench-merge bench-store bench-sort bench-exchange chaos chaos-device-ooo chaos-device chaos-merge chaos-store chaos-push chaos-exchange soak docs
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -74,6 +74,14 @@ chaos-store:
 # bit-exact vs a fault-free pull-only baseline
 chaos-push:
 	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --push-storm --trials 3
+
+# multi-tenant session soak: one resident session AM under barrier-synced
+# recurring DAGs from 3 tenants, forced am.admit.shed / am.queue.delay
+# faults plus seeded task faults — every accepted DAG bit-exact, shed
+# submissions the only (typed) losses, store bytes tenant-attributed,
+# zero epoch fences, per-tenant p95 bounded
+soak:
+	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --tenant-storm --trials 3
 
 # skewed hot-key exchange with one delayed chip (mesh.exchange.delay):
 # the splitter must hold the round count down and coded r2 must mask the

@@ -1,0 +1,576 @@
+"""Multi-tenant session AM tests (docs/multitenancy.md).
+
+Covers the admission controller's three verdicts (ACCEPT / QUEUE / SHED)
+and the lossless-admission ledger a killed queue consumer leaves behind;
+deficit-round-robin tenant fair-share in the task scheduler; per-tenant
+store byte quotas and the governed result cache (TTL, admission policy,
+per-tenant cap); and whole-session integration — concurrent DAGs through
+one resident AM, typed shed + jittered resubmit, and zero epoch fences
+with two live DAGs.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import pickle
+import random
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from tez_tpu.am.admission import AdmissionController
+from tez_tpu.am.history import HistoryEventType
+from tez_tpu.client.dag_client import DAGStatusState
+from tez_tpu.client.errors import DAGRejectedError
+from tez_tpu.client.tez_client import TezClient
+from tez_tpu.common import config as C
+from tez_tpu.common import faults, metrics
+from tez_tpu.common.ids import DAGId
+from tez_tpu.common.payload import (InputDescriptor, OutputDescriptor,
+                                    ProcessorDescriptor)
+from tez_tpu.dag.dag import DAG, Edge, Vertex
+from tez_tpu.dag.edge_property import (DataMovementType, DataSourceType,
+                                       EdgeProperty, SchedulingType)
+from tez_tpu.dag.plan import DAGPlan
+from tez_tpu.ops.runformat import KVBatch, Run
+from tez_tpu.store.buffer_store import (DISK, HOST, ShuffleBufferStore,
+                                        StoreKeyNotFound, StoreQuotaExceeded)
+
+
+def _wait_until(pred, timeout: float = 10.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def sleep_vertex(name, parallelism, sleep_ms=1):
+    return Vertex.create(name, ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor",
+        payload={"sleep_ms": sleep_ms}), parallelism)
+
+
+def make_test_vertex(name, parallelism):
+    return Vertex.create(name, ProcessorDescriptor.create(
+        "tez_tpu.library.test_components:TestProcessor"), parallelism)
+
+
+def tedge(a, b, movement=DataMovementType.SCATTER_GATHER):
+    return Edge.create(a, b, EdgeProperty.create(
+        movement, DataSourceType.PERSISTED, SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create("tez_tpu.library.test_components:TestOutput"),
+        InputDescriptor.create("tez_tpu.library.test_components:TestInput")))
+
+
+def _plan(name: str, tenant: str = "", sleep_ms: int = 1):
+    dag = DAG.create(name).add_vertex(sleep_vertex("v", 1, sleep_ms))
+    if tenant:
+        dag.set_conf("tez.dag.tenant", tenant)
+    return dag.create_dag_plan({})
+
+
+# ------------------------------------------------ admission verdicts (unit)
+
+class _StubAM:
+    """Just enough DAGAppMaster surface for AdmissionController: conf,
+    app_id, the history sink, and a _start_dag that mints fresh ids."""
+
+    def __init__(self, conf=None):
+        self.conf = C.TezConfiguration(conf or {})
+        self.app_id = "app_admit_1"
+        self.events = []
+        self.start_exc = None
+        self._seq = itertools.count(1)
+
+    def history(self, ev):
+        self.events.append(ev)
+
+    def _start_dag(self, plan, recovery_data, tenant):
+        if self.start_exc is not None:
+            raise self.start_exc
+        return f"dag_{next(self._seq)}"
+
+    def of(self, t):
+        return [e for e in self.events if e.event_type is t]
+
+
+@pytest.fixture()
+def admit2():
+    am = _StubAM({"tez.am.session.max-concurrent-dags": 1,
+                  "tez.am.session.queue-size": 4,
+                  "tez.am.session.shed.retry-after-ms": 250})
+    ac = AdmissionController(am)
+    yield am, ac
+    ac.stop()
+
+
+def test_admission_accept_immediate(admit2):
+    am, ac = admit2
+    assert ac.submit(_plan("d1", tenant="acme")) == "dag_1"
+    st = ac.status()
+    assert st["running"] == 1 and st["queue_depth"] == 0
+    assert st["consumer_alive"]
+    assert st["tenants"]["acme"] == {
+        "running": 1, "queued": 0, "accepted": 1, "shed": 0,
+        "completed": 0, "failed": 0}
+
+
+def test_admission_queue_journals_then_promotes(admit2):
+    am, ac = admit2
+    ac.submit(_plan("d1", tenant="acme"))
+    got = {}
+
+    def second():
+        got["dag_id"] = ac.submit(_plan("d2", tenant="acme"))
+
+    t = threading.Thread(target=second, daemon=True)
+    t.start()
+    assert _wait_until(lambda: ac.status()["queue_depth"] == 1)
+    # the lossless-admission contract: the parked plan is journaled
+    # BEFORE the submitter blocks, and it round-trips byte-exact
+    queued = am.of(HistoryEventType.DAG_QUEUED)
+    assert len(queued) == 1
+    plan = DAGPlan.deserialize(bytes.fromhex(queued[0].data["plan"]))
+    assert plan.name == "d2" and queued[0].data["tenant"] == "acme"
+    # free the slot -> the consumer promotes the parked submission
+    ac.on_dag_finished("acme", "SUCCEEDED", 5.0)
+    t.join(timeout=10)
+    assert got.get("dag_id") == "dag_2"
+    st = ac.status()
+    assert st["queue_depth"] == 0 and st["running"] == 1
+    assert st["tenants"]["acme"]["completed"] == 1
+    h = metrics.registry().histograms().get("am.admit.queue_wait")
+    assert h is not None and h.count >= 1
+
+
+def test_admission_shed_queue_full():
+    am = _StubAM({"tez.am.session.max-concurrent-dags": 1,
+                  "tez.am.session.queue-size": 0,
+                  "tez.am.session.shed.retry-after-ms": 250})
+    ac = AdmissionController(am)
+    try:
+        ac.submit(_plan("d1", tenant="acme"))
+        with pytest.raises(DAGRejectedError) as ei:
+            ac.submit(_plan("d2", tenant="acme"))
+        e = ei.value
+        assert "queue full" in e.reason
+        assert e.retry_after_s == pytest.approx(0.25)
+        assert e.tenant == "acme" and e.queue_depth == 0
+        shed = am.of(HistoryEventType.DAG_ADMISSION_SHED)
+        assert len(shed) == 1 and shed[0].data["dag_name"] == "d2"
+        assert shed[0].data["retry_after_ms"] == pytest.approx(250.0)
+        st = ac.status()
+        assert st["tenants"]["acme"]["shed"] == 1
+        # shed contract: nothing server-side remembers the submission
+        assert ac.unresolved() == []
+    finally:
+        ac.stop()
+
+
+def test_admission_shed_tenant_inflight_cap():
+    am = _StubAM({"tez.am.session.max-concurrent-dags": 4,
+                  "tez.am.session.tenant.max-inflight": 1})
+    ac = AdmissionController(am)
+    try:
+        ac.submit(_plan("a1", tenant="acme"))
+        with pytest.raises(DAGRejectedError) as ei:
+            ac.submit(_plan("a2", tenant="acme"))
+        assert "max-inflight" in ei.value.reason
+        assert ei.value.tenant_inflight == 1
+        # another tenant is not collateral damage
+        ac.submit(_plan("b1", tenant="beta"))
+        st = ac.status()
+        assert st["tenants"]["acme"]["shed"] == 1
+        assert st["tenants"]["beta"]["accepted"] == 1
+    finally:
+        ac.stop()
+
+
+def test_admission_fault_forced_shed(admit2):
+    am, ac = admit2
+    faults.install("mt-shed", faults.parse_spec("am.admit.shed:fail:n=1"))
+    with pytest.raises(DAGRejectedError) as ei:
+        ac.submit(_plan("d1", tenant="acme"))
+    assert "fault-injected shed" in ei.value.reason
+    assert ac.submit(_plan("d2", tenant="acme")) == "dag_1"
+
+
+def test_admission_rollback_on_start_failure(admit2):
+    am, ac = admit2
+    am.start_exc = RuntimeError("container pool exploded")
+    with pytest.raises(RuntimeError, match="container pool exploded"):
+        ac.submit(_plan("d1", tenant="acme"))
+    st = ac.status()
+    assert st["running"] == 0
+    assert st["tenants"]["acme"]["failed"] == 1
+    # the slot is actually free again, not leaked
+    am.start_exc = None
+    assert ac.submit(_plan("d2", tenant="acme")) == "dag_1"
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_queue_consumer_kill_leaves_lossless_ledger(admit2):
+    """Regression for the lossless-admission contract: kill the queue
+    consumer mid-drain (am.queue.delay:fail fires after the pop, before
+    _start_dag) — the DAG_QUEUED ledger record and unresolved() must
+    still account for the submission; nothing is silently dropped."""
+    am, ac = admit2
+    ac.submit(_plan("d1", tenant="acme"))
+    faults.install("mt-kill", faults.parse_spec("am.queue.delay:fail:n=1"))
+    t = threading.Thread(
+        target=lambda: ac.submit(_plan("d2q", tenant="acme")), daemon=True)
+    t.start()
+    assert _wait_until(lambda: ac.status()["queue_depth"] == 1)
+    ac.on_dag_finished("acme", "SUCCEEDED", 5.0)   # consumer pops -> dies
+    assert _wait_until(lambda: not ac.consumer_alive())
+    queued = am.of(HistoryEventType.DAG_QUEUED)
+    assert len(queued) == 1
+    sub_id = queued[0].dag_id
+    # the popped-but-never-started submission is still visible ...
+    assert ac.unresolved() == [sub_id]
+    # ... and its full plan survives in the ledger for replay on restart
+    plan = DAGPlan.deserialize(bytes.fromhex(queued[0].data["plan"]))
+    assert plan.name == "d2q"
+    assert t.is_alive(), "submitter must still be blocked, not dropped"
+    # unblock the submitter the way an AM restart would (resolve with error)
+    ac._draining.error = RuntimeError("AM restarting; replay from ledger")
+    ac._draining.done.set()
+    t.join(timeout=10)
+
+
+def test_rejected_error_pickles_with_hint():
+    e = DAGRejectedError("queue full", retry_after_s=0.75, tenant="acme",
+                         queue_depth=3, tenant_inflight=2)
+    e2 = pickle.loads(pickle.dumps(e))
+    assert isinstance(e2, DAGRejectedError)
+    assert (e2.reason, e2.retry_after_s, e2.tenant, e2.queue_depth,
+            e2.tenant_inflight) == ("queue full", 0.75, "acme", 3, 2)
+    assert "RETRY-AFTER 0.750s" in str(e2)
+
+
+# ------------------------------------------------ DRR fair-share (unit)
+
+def _drr_sched(weights: str, fair_share: bool = True, slots: int = 1):
+    from tez_tpu.am.task_scheduler import LocalTaskSchedulerService
+    ctx = SimpleNamespace(
+        conf=C.TezConfiguration({
+            "tez.am.session.fair-share": fair_share,
+            "tez.am.session.tenant.weights": weights}),
+        ensure_runners=lambda backlog: None, dispatch=lambda e: None)
+    return LocalTaskSchedulerService(ctx, num_slots=slots)
+
+
+def _drr_handouts(sched, per_tenant, n):
+    va = DAGId("app_drr_p", 1).vertex(0)
+    vb = DAGId("app_drr_p", 2).vertex(0)
+    for i in range(per_tenant):
+        sched.schedule(va.task(i).attempt(0),
+                       SimpleNamespace(tenant="A"), priority=5)
+        sched.schedule(vb.task(i).attempt(0),
+                       SimpleNamespace(tenant="B"), priority=5)
+    return "".join(
+        sched.get_task(f"c{i}", timeout=0.2).tenant for i in range(n))
+
+
+def test_drr_honors_weights_2_to_1():
+    order = _drr_handouts(_drr_sched("A=2,B=1"), per_tenant=12, n=12)
+    counts = collections.Counter(order)
+    assert counts["A"] == 8 and counts["B"] == 4, order
+    # interleaved, not front-loaded: A never gets more than its burst
+    assert "AAA" not in order and "BB" not in order, order
+
+
+def test_drr_equal_weights_alternate():
+    order = _drr_handouts(_drr_sched("A=1,B=1"), per_tenant=8, n=12)
+    counts = collections.Counter(order)
+    assert counts["A"] == 6 and counts["B"] == 6, order
+    assert "AA" not in order and "BB" not in order, order
+
+
+def test_drr_fractional_weight_still_served():
+    # w < 1 accumulates credit across rotations instead of starving
+    order = _drr_handouts(_drr_sched("A=0.5,B=1"), per_tenant=12, n=12)
+    counts = collections.Counter(order)
+    assert counts["A"] == 4 and counts["B"] == 8, order
+
+
+def test_drr_work_conserving_when_tenant_drains():
+    sched = _drr_sched("A=2,B=1")
+    va = DAGId("app_drr_p", 1).vertex(0)
+    vb = DAGId("app_drr_p", 2).vertex(0)
+    for i in range(2):
+        sched.schedule(va.task(i).attempt(0),
+                       SimpleNamespace(tenant="A"), priority=5)
+    for i in range(6):
+        sched.schedule(vb.task(i).attempt(0),
+                       SimpleNamespace(tenant="B"), priority=5)
+    out = [sched.get_task(f"c{i}", timeout=0.2) for i in range(8)]
+    assert all(s is not None for s in out), "idle slots with queued work"
+    assert collections.Counter(s.tenant for s in out) == {"A": 2, "B": 6}
+
+
+def test_drr_off_falls_back_to_priority():
+    sched = _drr_sched("A=8,B=1", fair_share=False)
+    va = DAGId("app_drr_p", 1).vertex(0)
+    vb = DAGId("app_drr_p", 2).vertex(0)
+    sched.schedule(va.task(0).attempt(0),
+                   SimpleNamespace(tenant="A"), priority=20)
+    sched.schedule(vb.task(0).attempt(0),
+                   SimpleNamespace(tenant="B"), priority=5)
+    # plain priority order: B's high-priority task first despite A's weight
+    assert sched.get_task("c0", timeout=0.2).tenant == "B"
+    assert sched.get_task("c1", timeout=0.2).tenant == "A"
+
+
+# ------------------------------------------------ store quotas + result cache
+
+def _run(n: int = 64, parts: int = 2, seed: int = 0) -> Run:
+    rng = random.Random(seed)
+    pairs = [(b"k%06d" % rng.randrange(10_000), b"v%04d" % (i % 97))
+             for i in range(n)]
+    batch = KVBatch.from_pairs(sorted(pairs))
+    bounds = np.linspace(0, n, parts + 1).astype(np.int64)
+    return Run(batch, bounds)
+
+
+def test_tenant_host_quota_rejects_and_isolates(tmp_path):
+    run = _run()
+    s = ShuffleBufferStore(device_capacity=0, host_capacity=1 << 30,
+                           disk_dir=str(tmp_path / "q"),
+                           tenant_host_quota=int(run.nbytes))
+    try:
+        s.publish("dagA/a0/c", -1, run, tenant="acme")
+        with pytest.raises(StoreQuotaExceeded) as ei:
+            s.publish("dagA/a1/c", -1, _run(seed=1), tenant="acme")
+        assert ei.value.tenant == "acme" and ei.value.tier == HOST
+        assert s.counters["store.quota.rejected.host"] == 1
+        # the quota is per tenant, not global: beta still publishes
+        s.publish("dagB/b0/c", -1, _run(seed=2), tenant="beta")
+        tb = s.tenant_bytes()
+        assert set(tb) == {"acme", "beta"}
+        assert tb["acme"][HOST] == run.nbytes
+    finally:
+        s.close()
+
+
+def test_result_cache_ttl_expires_sealed_entries(tmp_path):
+    now = [1000.0]
+    s = ShuffleBufferStore(device_capacity=0, host_capacity=1 << 30,
+                           disk_dir=str(tmp_path / "t"),
+                           clock=lambda: now[0], result_cache_ttl=10.0)
+    try:
+        s.publish("dagA/a0/c", 0, _run(), lineage="L1", tenant="acme")
+        assert s.seal_lineage("dagA") == 1
+        assert s.lineage_spills("L1") == [0]
+        now[0] += 11.0
+        assert s.lineage_spills("L1") == []
+        assert s.counters["store.result_cache.expired"] == 1
+    finally:
+        s.close()
+
+
+def test_result_cache_second_use_admission(tmp_path):
+    s = ShuffleBufferStore(device_capacity=0, host_capacity=1 << 30,
+                           disk_dir=str(tmp_path / "s"),
+                           result_cache_admit="second-use")
+    try:
+        s.publish("dagA/a0/c", 0, _run(), lineage="L2", tenant="acme")
+        # first seal defers: the tag has never been probed (scan resistance)
+        assert s.seal_lineage("dagA") == 0
+        assert s.counters["store.result_cache.deferred"] == 1
+        assert s.lineage_spills("L2") == []       # miss records the tag
+        assert s.seal_lineage("dagA") == 1        # second use admits
+        assert s.lineage_spills("L2") == [0]
+    finally:
+        s.close()
+
+
+def test_result_cache_tenant_cap_evicts_lru(tmp_path):
+    run = _run()
+    s = ShuffleBufferStore(device_capacity=0, host_capacity=1 << 30,
+                           disk_dir=str(tmp_path / "c"),
+                           result_cache_bytes=int(run.nbytes))
+    try:
+        s.publish("dagA/x/c", 0, run, lineage="La", tenant="acme")
+        s.publish("dagA/y/c", 0, _run(seed=1), lineage="Lb", tenant="acme")
+        assert s.seal_lineage("dagA") == 2
+        # only one seal fits under the per-tenant cap: the LRU one (La,
+        # sealed first, never hit) was evicted to admit Lb
+        assert s.counters["store.result_cache.evicted"] == 1
+        assert s.lineage_spills("La") == []
+        assert s.lineage_spills("Lb") == [0]
+    finally:
+        s.close()
+
+
+def test_concurrent_dag_finish_races_seal_and_unregister(tmp_path):
+    """Two DAGs commit at once: each seals its lineage then drops its DAG
+    aliases (the AM's SUCCEEDED path) while readers fetch — byte
+    accounting and tenant attribution must come out exact."""
+    s = ShuffleBufferStore(device_capacity=0, host_capacity=1 << 30,
+                           disk_dir=str(tmp_path / "r"))
+    spills, errs = 8, []
+    runs = {t: [_run(seed=10 * i + hash(t) % 7) for i in range(spills)]
+            for t in ("acme", "beta")}
+    try:
+        for tenant, rs in runs.items():
+            for i, r in enumerate(rs):
+                s.publish(f"dag-{tenant}/a{i}/c", 0, r,
+                          lineage=f"{tenant}-L{i}", tenant=tenant)
+        start = threading.Barrier(3)
+
+        def commit(tenant):
+            try:
+                start.wait(timeout=10)
+                assert s.seal_lineage(f"dag-{tenant}") == spills
+                s.unregister_prefix(f"dag-{tenant}")
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        def read():
+            try:
+                start.wait(timeout=10)
+                for i in range(spills):
+                    try:
+                        s.fetch_partition("dag-acme/a%d/c" % i, 0, 0)
+                    except StoreKeyNotFound:
+                        pass          # unregistered mid-read: a clean miss
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=commit, args=(t,), daemon=True)
+              for t in runs] + [threading.Thread(target=read, daemon=True)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not errs, errs
+        # every surviving entry is a sealed lineage alias; bytes and
+        # tenant attribution both still balance exactly
+        st = s.stats()
+        assert st["entries"] == 2 * spills
+        want = {t: sum(r.nbytes for r in rs) for t, rs in runs.items()}
+        tb = s.tenant_bytes()
+        assert {t: tb[t][HOST] for t in runs} == want
+        assert st["bytes"][HOST] == sum(want.values())
+        for tenant in runs:
+            for i in range(spills):
+                assert s.lineage_spills(f"{tenant}-L{i}") == [0]
+    finally:
+        s.close()
+
+
+# ------------------------------------------------ session integration
+
+def test_session_concurrent_dags_one_am(tmp_staging):
+    conf = {"tez.staging-dir": tmp_staging,
+            "tez.am.local.num-containers": 4,
+            "tez.am.session.max-concurrent-dags": 2,
+            "tez.am.session.queue-size": 4}
+    client = TezClient.create("mt-sess", conf, session=True).start()
+    states, errs = {}, []
+    try:
+        start = threading.Barrier(3)
+
+        def one(i):
+            try:
+                dag = DAG.create(f"mt{i}").add_vertex(
+                    sleep_vertex("v", 2, sleep_ms=50))
+                dag.set_conf("tez.dag.tenant", f"t{i % 2}")
+                start.wait(timeout=10)
+                dc = client.submit_dag(dag)
+                states[i] = dc.wait_for_completion(timeout=60).state
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=one, args=(i,), daemon=True)
+              for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=90)
+        assert not errs, errs
+        assert all(states[i] is DAGStatusState.SUCCEEDED for i in range(3))
+        qs = client.queue_status()
+        assert qs["running"] == 0 and qs["queue_depth"] == 0
+        assert qs["consumer_alive"] and qs["live_dags"] == {}
+        assert sum(t["completed"] for t in qs["tenants"].values()) == 3
+    finally:
+        client.stop()
+
+
+def test_session_shed_typed_error_then_retry_succeeds(tmp_staging):
+    from tez_tpu.utils.backoff import ExponentialBackoff
+    conf = {"tez.staging-dir": tmp_staging,
+            "tez.am.local.num-containers": 2,
+            "tez.am.session.max-concurrent-dags": 1,
+            "tez.am.session.queue-size": 2,
+            "tez.am.session.shed.retry-after-ms": 20}
+    client = TezClient.create("mt-shed", conf, session=True).start()
+    try:
+        faults.install("mt-shed-it",
+                       faults.parse_spec("am.admit.shed:fail:n=2"))
+        dag1 = DAG.create("shed1").add_vertex(sleep_vertex("v", 1))
+        with pytest.raises(DAGRejectedError) as ei:
+            client.submit_dag(dag1)
+        assert ei.value.retry_after_s == pytest.approx(0.02)
+        # the retry helper eats the second forced shed and then lands
+        dag2 = DAG.create("shed2").add_vertex(sleep_vertex("v", 1))
+        dc = client.submit_dag_with_retry(
+            dag2, retries=5,
+            backoff=ExponentialBackoff(base=0.01, cap=0.05, jitter=True,
+                                       rng=random.Random(0)))
+        assert dc.wait_for_completion(timeout=60).state is \
+            DAGStatusState.SUCCEEDED
+        qs = client.queue_status()
+        assert sum(t["shed"] for t in qs["tenants"].values()) == 2
+    finally:
+        client.stop()
+
+
+def test_two_live_dags_zero_epoch_fences(tmp_staging):
+    """Two traced shuffle DAGs running concurrently in one AM must never
+    trip the epoch fence — per-DAG registration prefixes and the shared
+    epoch registry stay disjoint."""
+    from tez_tpu.common import tracing
+    conf = {"tez.staging-dir": tmp_staging,
+            "tez.am.local.num-containers": 4,
+            "tez.am.session.max-concurrent-dags": 2}
+    client = TezClient.create("mt-fence", conf, session=True).start()
+    states, errs = {}, []
+    try:
+        start = threading.Barrier(2)
+
+        def one(i):
+            try:
+                a, b = make_test_vertex("a", 2), make_test_vertex("b", 2)
+                dag = DAG.create(f"fence{i}").add_vertex(a).add_vertex(b)
+                dag.add_edge(tedge(a, b))
+                dag.set_conf("tez.trace.enabled", True)
+                start.wait(timeout=10)
+                states[i] = client.submit_dag(dag).wait_for_completion(
+                    timeout=60).state
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=one, args=(i,), daemon=True)
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=90)
+        assert not errs, errs
+        assert all(s is DAGStatusState.SUCCEEDED for s in states.values())
+        spans = tracing.snapshot()
+        fences = [s for s in spans if s.name == "fence.stale_epoch"] + \
+            [n for s in spans for _, n, _ in s.events
+             if n == "fence.stale_epoch"]
+        assert not fences, f"epoch fences tripped: {fences}"
+    finally:
+        client.stop()

@@ -13,6 +13,7 @@ before spec build).
 import collections
 import os
 import random
+import threading
 
 import pytest
 
@@ -150,3 +151,45 @@ def test_pipelined_wordcount_repeated_same_process(tmp_path):
                 word, count = line.rstrip(b"\n").split(b"\t")
                 rows[word.decode()] = int(count)
         assert rows == dict(golden), f"run {run} lost data"
+
+
+def test_pipelined_wordcount_interleaved_dags_bit_exact(tmp_path):
+    """Two pipelined wordcount DAGs interleaved in the same process
+    (distinct corpora, distinct staging dirs, barrier-synced start) must
+    each stay bit-exact: the process-global shuffle plane keys every
+    registration by DAG path, so concurrent pipelined spills from one DAG
+    must never satisfy — or corrupt — the other's fetches."""
+    from tez_tpu.examples import ordered_wordcount
+    corpora, goldens = {}, {}
+    for run_id, seed in (("a", 7), ("b", 11)):
+        path = tmp_path / f"in-{run_id}.txt"
+        goldens[run_id] = _write_corpus(str(path), num_lines=150, seed=seed)
+        corpora[run_id] = str(path)
+    errs, start = [], threading.Barrier(2)
+
+    def drive(run_id):
+        try:
+            start.wait(timeout=30)
+            out_dir = str(tmp_path / f"out-{run_id}")
+            state = ordered_wordcount.run(
+                [corpora[run_id]], out_dir,
+                conf={"tez.staging-dir": str(tmp_path / f"stg-{run_id}"),
+                      "tez.runtime.pipelined-shuffle.enabled": True})
+            assert state == "SUCCEEDED"
+            rows = {}
+            with open(os.path.join(out_dir, "part-00000"), "rb") as fh:
+                for line in fh:
+                    word, count = line.rstrip(b"\n").split(b"\t")
+                    rows[word.decode()] = int(count)
+            assert rows == dict(goldens[run_id]), \
+                f"dag {run_id} lost or cross-mixed data"
+        except BaseException as e:  # noqa: BLE001 — surface on main thread
+            errs.append((run_id, e))
+
+    threads = [threading.Thread(target=drive, args=(r,), daemon=True)
+               for r in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errs, errs

@@ -11,6 +11,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 import concurrent.futures
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -116,13 +117,52 @@ class DAGAppMaster:
         self.logging_service = logging_service
         self.executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=8, thread_name_prefix=f"am-exec-{app_id}")
-        self.current_dag: Optional[DAGImpl] = None
+        #: live (non-terminal) DAGs keyed by str(dag_id), in submit order —
+        #: the session runs tez.am.session.max-concurrent-dags of them at
+        #: once; events route here by the dag_id their id chains carry
+        self.live_dags: Dict[str, DAGImpl] = {}
+        #: recently finished DAGImpls, bounded — kept so dag_status /
+        #: counters stay queryable after completion (events never route
+        #: here: a terminal DAG's trailing events are dropped instead)
+        self.retired_dags: Dict[str, DAGImpl] = {}
         self.completed_dags: Dict[str, DAGState] = {}
         self.completed_dag_names: Dict[str, str] = {}
         self._dag_seq = 0
         self._dag_done = threading.Condition()
+        from tez_tpu.am.admission import AdmissionController
+        self.admission = AdmissionController(self)
         self._register_handlers()
         self._started = False
+
+    @property
+    def current_dag(self) -> Optional[DAGImpl]:
+        """Most recently started live DAG (single-DAG compat surface; the
+        web UI and tests predating multi-tenancy read it)."""
+        # lock-free: callers include dispatcher + web threads; a racing
+        # registry mutation just means retrying the snapshot.  Falls back
+        # to the most recently retired DAG — the historical slot kept the
+        # finished DAG visible, and status/counters readers rely on that.
+        while True:
+            try:
+                vals = list(self.live_dags.values())
+                if not vals:
+                    vals = list(self.retired_dags.values())
+                return vals[-1] if vals else None
+            except RuntimeError:      # dict mutated during iteration
+                continue
+
+    def find_dag(self, dag_id: Any,
+                 include_retired: bool = False) -> Optional[DAGImpl]:
+        dag = self.live_dags.get(str(dag_id))
+        if dag is None and include_retired:
+            dag = self.retired_dags.get(str(dag_id))
+        return dag
+
+    def _retire_dag_locked(self, dag: DAGImpl) -> None:
+        self.live_dags.pop(str(dag.dag_id), None)
+        self.retired_dags[str(dag.dag_id)] = dag
+        while len(self.retired_dags) > 16:
+            self.retired_dags.pop(next(iter(self.retired_dags)))
 
     # -- service lifecycle ---------------------------------------------------
     def start(self) -> None:
@@ -149,8 +189,8 @@ class DAGAppMaster:
             self.web_ui.stop()
         self.thread_dumper.stop()
         self.heartbeat_monitor.stop()
-        dag = self.current_dag
-        if dag is not None:
+        self.admission.stop()
+        for dag in list(self.live_dags.values()):
             speculator = getattr(dag, "speculator", None)
             if speculator is not None:
                 speculator.stop()
@@ -177,23 +217,24 @@ class DAGAppMaster:
         d.register(TaskAttemptEventType, self._handle_attempt_event)
         d.register(SchedulerEventType, self.scheduler_manager.handle)
 
-    # -- event handlers (dispatcher thread) ----------------------------------
+    # -- event handlers (dispatcher thread): every event's id chain names
+    # its DAG, so concurrent DAGs route without any ambient "current" slot
     def _handle_dag_event(self, event: DAGEvent) -> None:
-        dag = self.current_dag
-        if dag is not None and dag.dag_id == event.dag_id:
+        dag = self.find_dag(event.dag_id)
+        if dag is not None:
             dag.handle(event)
 
     def _handle_vertex_event(self, event: VertexEvent) -> None:
-        dag = self.current_dag
-        if dag is None or dag.dag_id != event.vertex_id.dag_id:
+        dag = self.find_dag(event.vertex_id.dag_id)
+        if dag is None:
             return
         v = dag.vertex_by_id(event.vertex_id)
         if v is not None:
             v.handle(event)
 
     def _handle_task_event(self, event: TaskEvent) -> None:
-        dag = self.current_dag
-        if dag is None or dag.dag_id != event.task_id.dag_id:
+        dag = self.find_dag(event.task_id.dag_id)
+        if dag is None:
             return
         v = dag.vertex_by_id(event.task_id.vertex_id)
         if v is None:
@@ -203,8 +244,8 @@ class DAGAppMaster:
             t.handle(event)
 
     def _handle_attempt_event(self, event: TaskAttemptEvent) -> None:
-        dag = self.current_dag
-        if dag is None or dag.dag_id != event.attempt_id.dag_id:
+        dag = self.find_dag(event.attempt_id.dag_id)
+        if dag is None:
             return
         v = dag.vertex_by_id(event.attempt_id.vertex_id)
         if v is None:
@@ -216,11 +257,11 @@ class DAGAppMaster:
 
     def _on_dispatcher_error(self, exc: BaseException, event: Any) -> None:
         """AM error funnel (reference: DAGAppMaster error handling —
-        unhandled dispatcher error fails the DAG, not the process)."""
-        dag = self.current_dag
-        if dag is not None and dag.state not in TERMINAL_DAG_STATES:
-            self.dispatch(DAGEvent(DAGEventType.INTERNAL_ERROR, dag.dag_id,
-                                   diagnostics=repr(exc)))
+        unhandled dispatcher error fails the DAG(s), not the process)."""
+        for dag in list(self.live_dags.values()):
+            if dag.state not in TERMINAL_DAG_STATES:
+                self.dispatch(DAGEvent(DAGEventType.INTERNAL_ERROR,
+                                       dag.dag_id, diagnostics=repr(exc)))
 
     # -- AMContext surface used by components --------------------------------
     def dispatch(self, event: Any) -> None:
@@ -295,9 +336,11 @@ class DAGAppMaster:
             log.warning("dag %s: finished FENCED (%s); skipping "
                         "process-global cleanup", dag.dag_id, final.name)
             with self._dag_done:
+                self._retire_dag_locked(dag)
                 self.completed_dags[str(dag.dag_id)] = final
                 self.completed_dag_names[str(dag.dag_id)] = dag.name
                 self._dag_done.notify_all()
+            self._notify_admission(dag, final)
             return
         # deletion tracking: drop the finished DAG's shuffle data
         # (reference: ContainerLauncherManager DeletionTracker).  A store-
@@ -331,28 +374,47 @@ class DAGAppMaster:
             sp.finish()
         tracing.clear(str(dag.dag_id))
         with self._dag_done:
+            self._retire_dag_locked(dag)
             self.completed_dags[str(dag.dag_id)] = final
             self.completed_dag_names[str(dag.dag_id)] = dag.name
             self._dag_done.notify_all()
+        self._notify_admission(dag, final)
+
+    def _notify_admission(self, dag: DAGImpl, final: DAGState) -> None:
+        """Release the DAG's admission slot (promotes the queue head) and
+        record its per-tenant completion latency.  Outside _dag_done — the
+        admission lock never nests inside it."""
+        elapsed_s = (time.monotonic()
+                     - getattr(dag, "submit_monotonic", time.monotonic()))
+        self.admission.on_dag_finished(
+            getattr(dag, "tenant", ""), final.name, elapsed_s * 1000.0)
 
     # -- DAG submission (client-facing) --------------------------------------
     def submit_dag(self, plan: DAGPlan, recovery_data: Any = None) -> DAGId:
+        """Admission-controlled submit: ACCEPT starts the DAG now, QUEUE
+        blocks until the FIFO consumer promotes it, SHED raises a typed
+        DAGRejectedError carrying the RETRY-AFTER hint."""
         assert self._started, "AM not started"
+        return self.admission.submit(plan, recovery_data)
+
+    def _start_dag(self, plan: DAGPlan, recovery_data: Any,
+                   tenant: str) -> DAGId:
+        """Instantiate + start an admitted DAG (AdmissionController only)."""
         with self._dag_done:
-            if self.current_dag is not None and \
-                    self.current_dag.state not in TERMINAL_DAG_STATES:
-                raise RuntimeError("a DAG is already running")
-        self._dag_seq += 1
-        dag_id = DAGId(self.app_id, self._dag_seq)
+            self._dag_seq += 1
+            dag_id = DAGId(self.app_id, self._dag_seq)
         plan_hex = plan.serialize().hex()
         # per-DAG logging switch must be known before the first dag event
         self.history_handler.set_dag_conf(dag_id, plan.dag_conf)
         self.history(HistoryEvent(
             HistoryEventType.DAG_SUBMITTED, dag_id=str(dag_id),
-            data={"dag_name": plan.name,
+            data={"dag_name": plan.name, "tenant": tenant,
                   "plan": plan_hex}))
         dag = DAGImpl(dag_id, plan, self, recovery_data=recovery_data)
-        self.current_dag = dag
+        dag.tenant = tenant
+        dag.submit_monotonic = time.monotonic()
+        with self._dag_done:
+            self.live_dags[str(dag_id)] = dag
         # DAG-scoped knob: per-DAG conf overrides the AM conf
         if dag.conf.get(C.GENERATE_DEBUG_ARTIFACTS):
             # reference: the AM writes the expanded dag plan text into
@@ -588,10 +650,18 @@ class DAGAppMaster:
         return dag_id
 
     def dag_status(self, dag_id: DAGId) -> Dict[str, Any]:
-        dag = self.current_dag
-        if dag is None or dag.dag_id != dag_id:
+        dag = self.find_dag(dag_id, include_retired=True)
+        if dag is None:
             state = self.completed_dags.get(str(dag_id))
-            return {"name": "?", "state": state.name if state else "UNKNOWN",
+            name = self.completed_dag_names.get(str(dag_id), "?")
+            return {"name": name, "state": state.name if state else "UNKNOWN",
                     "progress": 1.0 if state else 0.0, "vertices": {},
                     "diagnostics": []}
         return dag.status_dict()
+
+    def queue_status(self) -> Dict[str, Any]:
+        """Admission/queue snapshot (client RPC + GET /queue)."""
+        st = self.admission.status()
+        st["live_dags"] = {did: d.name for did, d in
+                           list(self.live_dags.items())}
+        return st

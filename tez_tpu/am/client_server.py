@@ -22,7 +22,8 @@ from tez_tpu.common.security import JobTokenSecretManager
 log = logging.getLogger(__name__)
 
 _METHODS = frozenset({"submit_dag", "dag_status", "kill_dag", "wait_for_dag",
-                      "web_ui_address", "shutdown_session", "prewarm"})
+                      "web_ui_address", "shutdown_session", "prewarm",
+                      "queue_status"})
 
 
 class _Handler(socketserver.StreamRequestHandler):

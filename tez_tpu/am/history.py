@@ -35,6 +35,15 @@ class HistoryEventType(enum.Enum):
     DAG_COMMIT_ABORTED = enum.auto()
     DAG_FINISHED = enum.auto()
     DAG_KILL_REQUEST = enum.auto()
+    # multi-tenant admission ledger: QUEUED marks a submission parked in
+    # the bounded FIFO behind the concurrency cap (its plan rides in
+    # data, so a crashed queue consumer never loses an accepted submit);
+    # ADMISSION_SHED records the typed RETRY-AFTER verdict returned to
+    # the client.  Every QUEUED submission must later reach
+    # DAG_SUBMITTED or DAG_ADMISSION_SHED — the lossless-admission
+    # contract (docs/multitenancy.md).
+    DAG_QUEUED = enum.auto()
+    DAG_ADMISSION_SHED = enum.auto()
     VERTEX_INITIALIZED = enum.auto()
     VERTEX_STARTED = enum.auto()
     VERTEX_CONFIGURE_DONE = enum.auto()
@@ -67,6 +76,8 @@ SUMMARY_EVENT_TYPES = frozenset({
     HistoryEventType.VERTEX_GROUP_COMMIT_FINISHED,
     HistoryEventType.DAG_FINISHED,
     HistoryEventType.DAG_KILL_REQUEST,
+    HistoryEventType.DAG_QUEUED,
+    HistoryEventType.DAG_ADMISSION_SHED,
 })
 
 

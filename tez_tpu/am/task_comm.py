@@ -153,7 +153,7 @@ class TaskCommunicatorManager:
         # zombie attempt (or this comm itself, once superseded) never wins
         if self._fenced(epoch, f"can_commit {attempt_id}"):
             return False
-        vertex = self.ctx.current_dag.vertex_by_id(attempt_id.vertex_id)
+        vertex = self._vertex_for(attempt_id)
         if vertex is None:
             return False
         task = vertex.tasks.get(attempt_id.task_id.id)
@@ -245,10 +245,18 @@ class TaskCommunicatorManager:
                 VertexEventType.V_ROUTE_EVENT, vertex_id,
                 tez_event=tez_event))
 
+    def _vertex_for(self, attempt_id: TaskAttemptId) -> Any:
+        """Resolve through the live-DAG registry — the attempt's id chain
+        names its DAG, so concurrent DAGs never cross wires here."""
+        find = getattr(self.ctx, "find_dag", None)
+        dag = find(attempt_id.vertex_id.dag_id) if find is not None \
+            else getattr(self.ctx, "current_dag", None)
+        return dag.vertex_by_id(attempt_id.vertex_id) \
+            if dag is not None else None
+
     def _pull_events(self, attempt_id: TaskAttemptId,
                      session: _AttemptSession) -> List[TezAPIEvent]:
-        vertex = self.ctx.current_dag.vertex_by_id(attempt_id.vertex_id) \
-            if self.ctx.current_dag else None
+        vertex = self._vertex_for(attempt_id)
         if vertex is None:
             return []
         # bound one heartbeat response (tez.task.max-event-backlog): a

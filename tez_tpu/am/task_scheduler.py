@@ -18,7 +18,7 @@ import itertools
 import logging
 import threading
 import time
-from typing import Any, Dict, List, Optional, Set, TYPE_CHECKING
+from typing import Any, Dict, List, Optional, Set, Tuple, TYPE_CHECKING
 
 from tez_tpu.am.events import (SchedulerEvent, SchedulerEventType,
                                TaskAttemptEvent, TaskAttemptEventType)
@@ -73,17 +73,64 @@ class LocalTaskSchedulerService(TaskSchedulerService):
         self._container_failures: Dict[Any, int] = {}
         self._blacklisted: Set[Any] = set()
         self._shutdown = False
+        # -- tenant fair-share (deficit round-robin, docs/multitenancy.md):
+        # queued-work counts per tenant, the DRR rotation + credits, and
+        # the weights parsed once from tez.am.session.tenant.weights
+        from tez_tpu.common import config as C
+        conf = getattr(ctx, "conf", None)
+        self._fair_share = bool(conf.get(C.AM_SESSION_FAIR_SHARE)) \
+            if conf is not None else True
+        self._tenant_weights = self._parse_weights(
+            str(conf.get(C.AM_SESSION_TENANT_WEIGHTS) or "")
+            if conf is not None else "")
+        self._queued_tenant: Dict[TaskAttemptId, str] = {}
+        self._tenant_queued: Dict[str, int] = {}
+        self._rr_order: List[str] = []
+        self._rr_idx = 0
+        self._tenant_deficit: Dict[str, float] = {}
+
+    @staticmethod
+    def _parse_weights(spec: str) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, w = part.partition("=")
+            try:
+                out[name.strip()] = max(0.001, float(w or 1.0))
+            except ValueError:
+                log.warning("bad tenant weight %r ignored", part)
+        return out
+
+    def _weight(self, tenant: str) -> float:
+        return self._tenant_weights.get(tenant, 1.0)
 
     def schedule(self, attempt_id: TaskAttemptId, task_spec: TaskSpec,
                  priority: int) -> None:
+        tenant = getattr(task_spec, "tenant", "") or ""
         with self._lock:
             heapq.heappush(self._heap,
                            (priority, next(self._seq), attempt_id, task_spec))
             self._queued[attempt_id] = time.time()
             self._priorities[attempt_id] = priority
+            self._queued_tenant[attempt_id] = tenant
+            self._tenant_queued[tenant] = \
+                self._tenant_queued.get(tenant, 0) + 1
+            if tenant not in self._rr_order:
+                self._rr_order.append(tenant)
             self._available.notify()
         self.ctx.ensure_runners(self.backlog())
         self._maybe_preempt()
+
+    def _drop_queued_tenant_locked(self, attempt_id: TaskAttemptId) -> None:
+        tenant = self._queued_tenant.pop(attempt_id, None)
+        if tenant is not None:
+            n = self._tenant_queued.get(tenant, 0) - 1
+            if n > 0:
+                self._tenant_queued[tenant] = n
+            else:
+                self._tenant_queued.pop(tenant, None)
 
     def _maybe_preempt(self) -> None:
         """Higher-priority work waiting with every slot busy on strictly
@@ -187,7 +234,8 @@ class LocalTaskSchedulerService(TaskSchedulerService):
     def deallocate(self, attempt_id: TaskAttemptId,
                    failed: bool = False) -> None:
         with self._lock:
-            self._queued.pop(attempt_id, None)
+            if self._queued.pop(attempt_id, None) is not None:
+                self._drop_queued_tenant_locked(attempt_id)
             self._preempting.discard(attempt_id)
             self._priorities.pop(attempt_id, None)
             container = self._running.pop(attempt_id, None)
@@ -233,8 +281,14 @@ class LocalTaskSchedulerService(TaskSchedulerService):
             if container_id in self._blacklisted:
                 return None
             while True:
+                # deficit round-robin tenant pick: with >1 tenant queued,
+                # prefer the tenant whose credit is due; a tenant with no
+                # poppable entry (concurrency cap) falls back to the best
+                # other entry — fair, but always work-conserving
+                want = self._drr_pick_locked()
                 deferred: List[Any] = []
                 handout = None
+                fallback = None          # first poppable non-want entry
                 while self._heap:
                     entry = heapq.heappop(self._heap)
                     prio, seq, attempt_id, spec = entry
@@ -247,20 +301,67 @@ class LocalTaskSchedulerService(TaskSchedulerService):
                         # the next entry, re-queue the skipped ones
                         deferred.append(entry)
                         continue
-                    self._queued.pop(attempt_id, None)
-                    self._running[attempt_id] = container_id
-                    self._vertex_running[attempt_id.vertex_id] = \
-                        self._vertex_running.get(attempt_id.vertex_id, 0) + 1
-                    handout = spec
+                    tenant = self._queued_tenant.get(attempt_id, "")
+                    if want is not None and tenant != want:
+                        deferred.append(entry)
+                        if fallback is None:
+                            fallback = entry
+                        continue
+                    handout = entry
                     break
+                if handout is None and fallback is not None:
+                    handout = fallback
+                    deferred.remove(fallback)
                 for entry in deferred:
                     heapq.heappush(self._heap, entry)
                 if handout is not None:
-                    return handout
+                    prio, seq, attempt_id, spec = handout
+                    tenant = self._queued_tenant.get(attempt_id, "")
+                    self._queued.pop(attempt_id, None)
+                    self._drop_queued_tenant_locked(attempt_id)
+                    self._running[attempt_id] = container_id
+                    self._vertex_running[attempt_id.vertex_id] = \
+                        self._vertex_running.get(attempt_id.vertex_id, 0) + 1
+                    if want is not None:
+                        # charge whichever tenant actually got the slot
+                        d = self._tenant_deficit.get(tenant, 0.0)
+                        self._tenant_deficit[tenant] = max(0.0, d - 1.0)
+                    return spec
                 if self._shutdown:
                     return None
                 if not self._available.wait(timeout):
                     return None
+
+    def _drr_pick_locked(self) -> Optional[str]:
+        """Next tenant owed a slot (deficit round-robin): visiting a tenant
+        replenishes its credit by its weight; a tenant is served while its
+        credit lasts, then the rotation advances.  None = fair-share off or
+        only one tenant has queued work (plain priority order)."""
+        if not self._fair_share:
+            return None
+        eligible = {t for t, n in self._tenant_queued.items() if n > 0}
+        if len(eligible) <= 1:
+            return None
+        order = self._rr_order
+        for _ in range(4 * len(order) + 4):
+            t = order[self._rr_idx % len(order)]
+            if t in eligible and self._tenant_deficit.get(t, 0.0) >= 1.0:
+                return t                 # still in service on this turn
+            # t's turn is over (no queued work, or credit spent): the
+            # rotation advances and the tenant it ARRIVES at earns its
+            # quantum.  Replenishing before advancing would hand the
+            # current tenant fresh credit every pick — a monopoly, not
+            # round-robin.
+            if t not in eligible:
+                self._tenant_deficit[t] = 0.0   # empty queue loses credit
+            self._rr_idx += 1
+            nt = order[self._rr_idx % len(order)]
+            if nt in eligible:
+                # cap the burst a long-idle tenant could otherwise bank
+                self._tenant_deficit[nt] = min(
+                    self._tenant_deficit.get(nt, 0.0) + self._weight(nt),
+                    4.0 * self._weight(nt))
+        return next(iter(sorted(eligible)))    # unreachable-in-practice guard
 
 
     def shutdown(self) -> None:
@@ -290,10 +391,18 @@ class DagAwareTaskSchedulerService(LocalTaskSchedulerService):
         self._descendants_cache: Dict[str, Dict[str, Set[str]]] = {}
 
     # ----------------------------------------------------------- topology
-    def _descendants(self) -> Dict[str, Set[str]]:
+    def _dag_for(self, attempt_id: TaskAttemptId) -> Any:
+        """Resolve the attempt's DAG through the live registry (concurrent
+        session DAGs); falls back to current_dag for older contexts."""
+        find = getattr(self.ctx, "find_dag", None)
+        if find is not None:
+            return find(attempt_id.vertex_id.dag_id)
+        return getattr(self.ctx, "current_dag", None)
+
+    def _descendants(self, dag: Any) -> Dict[str, Set[str]]:
         """vertex name -> set of (transitive) descendant vertex names for
-        the current DAG (reference: vertexDescendants BitSets)."""
-        dag = getattr(self.ctx, "current_dag", None)
+        one DAG (reference: vertexDescendants BitSets); cached per dag_id
+        since several DAGs stay live at once."""
         if dag is None:
             return {}
         key = str(dag.dag_id)
@@ -317,11 +426,13 @@ class DagAwareTaskSchedulerService(LocalTaskSchedulerService):
             return out
 
         result = {name: desc(name) for name in children}
-        self._descendants_cache = {key: result}   # one DAG at a time
+        if len(self._descendants_cache) > 16:    # bound the session cache
+            self._descendants_cache.clear()
+        self._descendants_cache[key] = result
         return result
 
     def _vertex_name(self, attempt_id: TaskAttemptId) -> str:
-        dag = getattr(self.ctx, "current_dag", None)
+        dag = self._dag_for(attempt_id)
         if dag is None:
             return ""
         v = dag.vertex_by_id(attempt_id.vertex_id)
@@ -331,12 +442,27 @@ class DagAwareTaskSchedulerService(LocalTaskSchedulerService):
         """Victims must be descendants of ANY vertex with queued requests
         (the reference's blocked-set ∩ assigned-vertices rule) — evicting a
         descendant always helps, because it cannot finish before its
-        blocked ancestor anyway."""
-        descendants = self._descendants()
-        blocked: Set[str] = set()
+        blocked ancestor anyway.  Blocked entries are (dag_id, vertex)
+        pairs: vertex names may collide across concurrent DAGs, and
+        cross-DAG preemption through a name collision would be unfair."""
+        blocked: Set[Tuple[str, str]] = set()
         for a in waiting:
-            blocked |= descendants.get(self._vertex_name(a), set())
-        return lambda att: self._vertex_name(att) in blocked
+            dag = self._dag_for(a)
+            if dag is None:
+                continue
+            descendants = self._descendants(dag)
+            for name in descendants.get(self._vertex_name(a), set()):
+                blocked.add((str(dag.dag_id), name))
+
+        def _is_victim(att: TaskAttemptId) -> bool:
+            # resolve the victim's DAG through the same registry the
+            # blocked set was built from, so both sides agree on the id
+            dag = self._dag_for(att)
+            if dag is None:
+                return False
+            return (str(dag.dag_id), self._vertex_name(att)) in blocked
+
+        return _is_victim
 
 
 def create_task_scheduler(ctx: Any, num_slots: int) -> TaskSchedulerService:

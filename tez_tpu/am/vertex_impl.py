@@ -829,6 +829,7 @@ class VertexImpl:
             trace_context=getattr(self.dag, "trace_carrier", ""),
             lineage=getattr(self.dag, "lineage_hashes", {}).get(self.name,
                                                                 ""),
+            tenant=getattr(self.dag, "tenant", ""),
         )
 
     def status_dict(self) -> Dict[str, Any]:

@@ -289,6 +289,9 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self._send(200, json.dumps(body, default=str).encode())
         elif path == "/dags":
             self._send(200, json.dumps(self._dags(am)).encode())
+        elif path == "/queue":
+            self._send(200, json.dumps(self._queue(am),
+                                       default=str).encode())
         elif path == "/graph":
             self._send(200, json.dumps(self._graph(am), default=str).encode())
         elif path == "/tasks":
@@ -345,11 +348,26 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         # by the dispatcher thread while we serve
         out = [{"dag_id": d, "name": names.get(d, ""), "state": s.name}
                for d, s in list(am.completed_dags.items())]
-        dag = am.current_dag
-        if dag is not None and str(dag.dag_id) not in am.completed_dags:
-            out.append({"dag_id": str(dag.dag_id), "name": dag.name,
-                        "state": dag.state.name})
+        live = list(getattr(am, "live_dags", {}).values())
+        if not live and am.current_dag is not None:
+            live = [am.current_dag]
+        for dag in live:
+            if str(dag.dag_id) not in am.completed_dags:
+                out.append({"dag_id": str(dag.dag_id), "name": dag.name,
+                            "state": dag.state.name,
+                            "tenant": getattr(dag, "tenant", "")})
         return out
+
+    @staticmethod
+    def _queue(am: Any) -> Dict[str, Any]:
+        """Admission/queue snapshot: queue depth, per-tenant in-flight/
+        queued/shed counts, plus per-tenant resident store bytes."""
+        status = am.queue_status() if hasattr(am, "queue_status") else {}
+        from tez_tpu.store import local_buffer_store
+        store = local_buffer_store()
+        if store is not None:
+            status["store_tenant_bytes"] = store.tenant_bytes()
+        return status
 
     @staticmethod
     def _graph(am: Any) -> Dict[str, Any]:
@@ -448,14 +466,26 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         """Prometheus text scrape: process-global latency histograms +
         running-task/queued-fetch/epoch gauges + DAG counters."""
         from tez_tpu.common import metrics
-        dag = am.current_dag
+        # every live DAG contributes (concurrent session AM); an idle AM
+        # falls back to the most recently retired DAG so post-completion
+        # scrapes still see the final counters
+        dags = list(getattr(am, "live_dags", {}).values())
+        if not dags and am.current_dag is not None:
+            dags = [am.current_dag]
         running = 0
         counters_dict: Dict[str, Dict[str, int]] = {}
-        if dag is not None:
+        if len(dags) == 1:
+            counters_dict = dags[0].counters.to_dict()
+        elif dags:
+            from tez_tpu.common.counters import TezCounters
+            agg = TezCounters()
+            for dag in dags:
+                agg.aggregate(dag.counters)
+            counters_dict = agg.to_dict()
+        for dag in dags:
             for v in list(dag.vertices.values()):
                 running += sum(1 for t in list(v.tasks.values())
                                if t.state.name == "RUNNING")
-            counters_dict = dag.counters.to_dict()
         gauges = metrics.registry().gauges()
         gauges["running_tasks"] = float(running)
         gauges["am_epoch"] = float(getattr(am, "attempt", 0) or 0)

@@ -91,8 +91,10 @@ class DAGClient:
                 diagnostics=d.get("diagnostics", []))
         counters = None
         if with_counters:
-            dag = getattr(self._am, "current_dag", None)  # local AM only;
+            find = getattr(self._am, "find_dag", None)  # local AM only;
             # remote proxies report counters via history instead
+            dag = find(self.dag_id, include_retired=True) \
+                if find is not None else None
             if dag is not None and dag.dag_id == self.dag_id:
                 counters = dag.counters
         return DAGStatus(

@@ -55,6 +55,11 @@ class RemoteAMProxy(FramedClient):
     def prewarm(self) -> None:
         self._call("prewarm")
 
+    def queue_status(self) -> Any:
+        """Admission/queue snapshot (same shape as GET /queue on the AM
+        web UI): per-tenant in-flight/queued/shed counts + queue depth."""
+        return self._call("queue_status")
+
     def web_ui_address(self) -> Optional[str]:
         return self._call("web_ui_address")
 

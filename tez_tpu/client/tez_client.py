@@ -9,15 +9,40 @@ FrameworkClient behind the same surface.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Dict, Optional
 
 from tez_tpu.am.app_master import DAGAppMaster
 from tez_tpu.client.dag_client import DAGClient
+from tez_tpu.client.errors import DAGRejectedError
 from tez_tpu.common import config as C
 from tez_tpu.common.ids import new_app_id
 from tez_tpu.dag.dag import DAG
+from tez_tpu.utils.backoff import ExponentialBackoff, retry_call
 
 log = logging.getLogger(__name__)
+
+__all__ = ["TezClient", "FrameworkClient", "LocalFrameworkClient",
+           "DAGRejectedError"]
+
+
+class _RetryAfterBackoff:
+    """Backoff policy flooring each delay at the AM's RETRY-AFTER hint.
+
+    The server's hint is a floor, not the whole story: sleeping exactly
+    retry-after re-synchronizes every shed client into the same resubmit
+    instant, so full-jittered exponential delay rides on top (the same
+    decorrelation argument as utils/backoff.py)."""
+
+    def __init__(self, inner: ExponentialBackoff):
+        self.inner = inner
+        self.hint = 0.0
+
+    def delay(self, attempt: int) -> float:
+        return self.hint + self.inner.delay(attempt)
+
+    def sleep(self, attempt: int) -> None:
+        time.sleep(self.delay(attempt))
 
 
 class FrameworkClient:
@@ -90,6 +115,35 @@ class TezClient:
         plan = dag.create_dag_plan(conf)
         dag_id = self.framework_client.submit_dag(plan)
         return DAGClient(self.framework_client.am, dag_id)
+
+    def submit_dag_with_retry(self, dag: DAG, retries: int = 5,
+                              backoff: Optional[ExponentialBackoff] = None,
+                              rng: Any = None) -> DAGClient:
+        """submit_dag that honors load shedding: a typed
+        :class:`DAGRejectedError` (the AM's SHED verdict) is resubmitted
+        after sleeping at least its RETRY-AFTER hint plus full-jitter
+        exponential backoff.  Any other failure — and the final rejection
+        after ``retries`` attempts — propagates unchanged."""
+        policy = _RetryAfterBackoff(
+            backoff or ExponentialBackoff(base=0.2, cap=10.0, jitter=True,
+                                          rng=rng))
+
+        def once() -> DAGClient:
+            try:
+                return self.submit_dag(dag)
+            except DAGRejectedError as e:
+                policy.hint = max(0.0, float(e.retry_after_s))
+                log.info("dag %s shed by AM (%s); retry after >= %.3fs",
+                         dag.name, e.reason, policy.hint)
+                raise
+
+        return retry_call(once, retries, retryable=(DAGRejectedError,),
+                          backoff=policy)
+
+    def queue_status(self) -> Dict[str, Any]:
+        """The AM's admission/queue snapshot (works for local and remote
+        framework clients — the remote proxy has the same method)."""
+        return self.framework_client.am.queue_status()
 
     def pre_warm(self) -> None:
         """Spin runners up before the first DAG (reference: preWarm:897).

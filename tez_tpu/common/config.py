@@ -707,6 +707,83 @@ PUSH_EAGER_MERGE_THRESHOLD = _key(
     "mem->disk merge once committed memory crosses this fraction of the "
     "merge budget (instead of only at tez.runtime.shuffle.merge.percent) "
     "so merge work overlaps the map wave; 0 disables early merging")
+DAG_TENANT = _key(
+    "tez.dag.tenant", "", Scope.DAG,
+    "tenant id stamped onto the DAG plan at submit (and onto every "
+    "TaskSpec of the DAG): the unit of admission caps, fair-share "
+    "weighting, store byte quotas, and result-cache governance in the "
+    "multi-tenant session AM (docs/multitenancy.md); '' = the anonymous "
+    "default tenant")
+AM_SESSION_MAX_CONCURRENT_DAGS = _key(
+    "tez.am.session.max-concurrent-dags", 1, Scope.AM,
+    "resident session AM: how many DAGs may run concurrently; submits "
+    "beyond it enter the bounded FIFO admission queue.  1 = the "
+    "historical one-DAG-at-a-time session (but queued, not rejected)")
+AM_SESSION_QUEUE_SIZE = _key(
+    "tez.am.session.queue-size", 8, Scope.AM,
+    "bounded FIFO admission queue behind the concurrency cap; a submit "
+    "arriving with the queue full is shed with a typed RETRY-AFTER "
+    "verdict instead of waiting unboundedly")
+AM_SESSION_TENANT_MAX_INFLIGHT = _key(
+    "tez.am.session.tenant.max-inflight", 0, Scope.AM,
+    "per-tenant cap on running + queued DAGs; a tenant at its cap has "
+    "further submits shed with RETRY-AFTER so one tenant cannot occupy "
+    "the whole queue.  0 = unlimited")
+AM_SESSION_SHED_RETRY_AFTER_MS = _key(
+    "tez.am.session.shed.retry-after-ms", 500.0, Scope.AM,
+    "retry-after hint attached to admission shed verdicts; clients "
+    "sleep at least this long (plus full-jitter backoff) before "
+    "resubmitting (TezClient.submit_dag_with_retry)")
+AM_SESSION_ADMIT_STORE_WATERMARK = _key(
+    "tez.am.session.admit.store-watermark", 0.95, Scope.AM,
+    "admission pressure gate: with the buffer store enabled, a submit "
+    "finding the host tier beyond this occupancy fraction first asks "
+    "the store to relieve pressure (relieve_host_pressure) and is shed "
+    "if occupancy stays above the gate — the control-plane analog of "
+    "the push-shuffle admit watermark")
+AM_SESSION_TENANT_WEIGHTS = _key(
+    "tez.am.session.tenant.weights", "", Scope.AM,
+    "weighted fair-share across tenants as 'tenantA=3,tenantB=1'; the "
+    "task scheduler's deficit round-robin grants slots (and thereby the "
+    "async device lanes the tasks drive) proportionally to weight.  "
+    "Unlisted tenants weigh 1; '' = all tenants equal")
+AM_SESSION_FAIR_SHARE = _key(
+    "tez.am.session.fair-share", True, Scope.AM,
+    "deficit round-robin tenant fair-share at the task-scheduler "
+    "allocation point; off = pure priority-heap order across all "
+    "tenants (the historical single-tenant behavior)")
+STORE_TENANT_DEVICE_QUOTA_MB = _key(
+    "tez.runtime.store.quota.device-mb", 0, Scope.AM,
+    "per-tenant cap on device(HBM)-tier resident store bytes; a publish "
+    "that would cross it lands on the host tier instead (lanes drop), "
+    "so one tenant cannot monopolize HBM.  0 = unlimited")
+STORE_TENANT_HOST_QUOTA_MB = _key(
+    "tez.runtime.store.quota.host-mb", 0, Scope.AM,
+    "per-tenant cap on host-tier resident store bytes; a publish over "
+    "quota is refused (StoreQuotaExceeded) and the producer falls back "
+    "to its own spill files — isolation, not correctness.  "
+    "0 = unlimited")
+STORE_TENANT_DISK_QUOTA_MB = _key(
+    "tez.runtime.store.quota.disk-mb", 0, Scope.AM,
+    "per-tenant cap on disk-tier resident store bytes (demoted runs + "
+    "sealed lineage); crossing it evicts that tenant's stalest sealed "
+    "lineage entries first.  0 = unlimited")
+STORE_RESULT_CACHE_TTL_SECS = _key(
+    "tez.runtime.store.quota.result-cache.ttl-secs", 0.0, Scope.AM,
+    "governed result cache: sealed lineage entries older than this are "
+    "expired (not served, and reaped by the next quota sweep) so "
+    "recurring tenants re-derive stale results.  0 = no expiry")
+STORE_RESULT_CACHE_MB = _key(
+    "tez.runtime.store.quota.result-cache-mb", 0, Scope.AM,
+    "per-tenant byte cap on sealed result-cache (lineage) entries; "
+    "sealing beyond it evicts that tenant's least-recently-hit sealed "
+    "entries.  0 = unlimited")
+STORE_RESULT_CACHE_ADMIT = _key(
+    "tez.runtime.store.quota.result-cache.admit", "always", Scope.AM,
+    "result-cache admission policy at seal time: 'always' seals every "
+    "committed lineage-tagged output, 'second-use' seals only lineage "
+    "keys already observed once this session (scan-resistant), 'never' "
+    "disables sealing (lineage reuse off for quota purposes)")
 
 
 def runtime_conf_subset(conf: Mapping) -> "TezConfiguration":

@@ -126,6 +126,17 @@ KNOWN_POINTS: Dict[str, str] = {
         "shuffle/push.py PushAdmissionController decision (detail = "
         "source path + nbytes); fail mode turns the decision into a "
         "RETRY-AFTER rejection, delay mode stretches admit_wait",
+    "am.admit.shed":
+        "am/admission.py AdmissionController decision (detail = "
+        "tenant/dag name); fail mode forces the verdict to SHED with "
+        "RETRY-AFTER regardless of load — the tenant-storm chaos lever "
+        "for exercising client resubmit paths",
+    "am.queue.delay":
+        "am/admission.py queue consumer drain step (detail = queued "
+        "submission id); delay mode holds a parked submission before it "
+        "is promoted to submit, stretching queue latency; fail mode "
+        "crashes the consumer thread mid-drain (the lossless-admission "
+        "ledger regression lever)",
 }
 
 _EXC_KINDS = {

@@ -144,7 +144,11 @@ WELL_KNOWN_HISTOGRAMS = ("shuffle.fetch.rtt", "spill.write", "shuffle.merge",
                          # exchange round end-to-end — placement, SPMD
                          # dispatch, per-device readback (coded: first
                          # complete copy), decode
-                         "mesh.exchange.round")
+                         "mesh.exchange.round",
+                         # session admission (am/admission.py): how long a
+                         # QUEUE-verdict submission parks before the consumer
+                         # promotes it to a running DAG
+                         "am.admit.queue_wait")
 
 
 class MetricsRegistry:

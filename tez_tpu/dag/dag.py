@@ -378,4 +378,5 @@ class DAG:
             group_edges=tuple(group_edge_plans),
             dag_conf=dag_conf,
             credentials=dict(self.credentials),
+            tenant=str(dag_conf.get("tez.dag.tenant", "") or ""),
         )

@@ -88,6 +88,10 @@ class DAGPlan:
     group_edges: Tuple[GroupInputEdgePlan, ...] = ()
     dag_conf: Dict[str, Any] = dataclasses.field(default_factory=dict)
     credentials: Dict[str, bytes] = dataclasses.field(default_factory=dict)
+    #: tenant id for multi-tenant session AMs (admission caps, fair-share,
+    #: store quotas — docs/multitenancy.md); "" = the anonymous tenant.
+    #: Populated from ``tez.dag.tenant`` by DAG.create_dag_plan.
+    tenant: str = ""
 
     def vertex(self, name: str) -> VertexPlan:
         for v in self.vertices:

@@ -209,7 +209,8 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                     64)) * (1 << 20)),
                 counters=ctx.counters,
                 epoch=getattr(ctx, "am_epoch", 0),
-                app_id=getattr(ctx, "app_id", ""))
+                app_id=getattr(ctx, "app_id", ""),
+                tenant=getattr(ctx, "tenant", ""))
         store = self.service.buffer_store()
         if self._lineage and store is not None:
             # a non-pipelined output seals exactly one run (spill -1);
@@ -317,6 +318,7 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                               run, epoch=getattr(self.context, "am_epoch", 0),
                               app_id=getattr(self.context, "app_id", ""),
                               lineage=self._lineage,
+                              tenant=getattr(self.context, "tenant", ""),
                               counters=self.context.counters,
                               use_store=not push)
         # last=False; close() sends the final marker
@@ -361,6 +363,7 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                               epoch=getattr(self.context, "am_epoch", 0),
                               app_id=getattr(self.context, "app_id", ""),
                               lineage=self._lineage,
+                              tenant=getattr(self.context, "tenant", ""),
                               counters=self.context.counters)
         self.context.counters.increment(
             TaskCounter.OUTPUT_BYTES_PHYSICAL, final_run.nbytes)

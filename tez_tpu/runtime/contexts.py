@@ -73,6 +73,12 @@ class _BaseContext:
         return getattr(self._runner.spec, "lineage", "")
 
     @property
+    def tenant(self) -> str:
+        """Tenant the owning DAG was submitted under ("" = anonymous);
+        store publishes charge this tenant's byte quotas."""
+        return getattr(self._runner.spec, "tenant", "")
+
+    @property
     def counters(self) -> TezCounters:
         return self._runner.counters
 

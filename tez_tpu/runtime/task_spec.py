@@ -63,6 +63,10 @@ class TaskSpec:
     #: "<hash>/<task_index>/<dest>" so identical recurring DAGs in a session
     #: can reuse sealed store entries ("" = lineage reuse off).
     lineage: str = ""
+    #: Tenant id inherited from the DAG plan (multi-tenant session AM):
+    #: the task scheduler's deficit round-robin and the buffer store's
+    #: byte quotas key on it ("" = the anonymous default tenant).
+    tenant: str = ""
 
     @property
     def task_index(self) -> int:

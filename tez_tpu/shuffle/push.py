@@ -255,6 +255,7 @@ class SpillPusher:
     def __init__(self, service: Any, threads: int = 2, retries: int = 3,
                  inflight_limit_bytes: int = 64 << 20,
                  counters: Any = None, epoch: int = 0, app_id: str = "",
+                 tenant: str = "",
                  secrets: Optional[JobTokenSecretManager] = None,
                  backoff_base: float = 0.05, rng: Any = None):
         self.service = service
@@ -263,6 +264,7 @@ class SpillPusher:
         self.counters = counters
         self.epoch = epoch
         self.app_id = app_id
+        self.tenant = tenant
         self.secrets = secrets
         self.backoff_base = backoff_base
         self._rng = rng
@@ -338,7 +340,8 @@ class SpillPusher:
                     # registry already holds
                     self.service.push_publish(
                         path, spill_id, run, epoch=self.epoch,
-                        app_id=self.app_id, counters=self.counters)
+                        app_id=self.app_id, tenant=self.tenant,
+                        counters=self.counters)
                 else:
                     if self.secrets is None:
                         raise PermissionError(

@@ -102,7 +102,8 @@ class ShuffleService:
     # -- producer side -------------------------------------------------------
     def register(self, path_component: str, spill_id: int, run: Run,
                  epoch: int = 0, app_id: str = "",
-                 lineage: str = "", counters: Any = None,
+                 lineage: str = "", tenant: str = "",
+                 counters: Any = None,
                  use_store: bool = True) -> None:
         """Producers stamped with an AM epoch are fenced: a zombie task from
         a pre-restart incarnation must not (re-)register outputs the live
@@ -121,9 +122,18 @@ class ShuffleService:
                 f"(current {epoch_registry.current(app_id)}): "
                 f"{path_component}/{spill_id}")
         if self._buffer is not None and use_store:
-            self._buffer.publish(path_component, spill_id, run,
-                                 epoch=epoch, app_id=app_id,
-                                 lineage=lineage, counters=counters)
+            from tez_tpu.store.buffer_store import StoreQuotaExceeded
+            try:
+                self._buffer.publish(path_component, spill_id, run,
+                                     epoch=epoch, app_id=app_id,
+                                     lineage=lineage, tenant=tenant,
+                                     counters=counters)
+            except StoreQuotaExceeded:
+                # per-tenant quota refusal is isolation, not data loss:
+                # the run stays in the bare registry (the producer's own
+                # memory), pull-served like the pre-store path
+                with self._lock:
+                    self._runs[(path_component, spill_id)] = run
         else:
             # use_store=False is the push path's pull backstop: the run
             # lands in the bare registry synchronously (events may never
@@ -147,7 +157,8 @@ class ShuffleService:
 
     def push_publish(self, path_component: str, spill_id: int, run: Any,
                      partition: Optional[int] = None, epoch: int = 0,
-                     app_id: str = "", counters: Any = None) -> None:
+                     app_id: str = "", tenant: str = "",
+                     counters: Any = None) -> None:
         """Eager-push landing zone (docs/push_shuffle.md).
 
         Admission-checked publish into the buffer store.  ``partition``
@@ -179,8 +190,15 @@ class ShuffleService:
                                    counters=counters)
         key_path = path_component if partition is None else \
             push_key(path_component, partition)
-        self._buffer.publish(key_path, spill_id, run, epoch=epoch,
-                             app_id=app_id, counters=counters)
+        from tez_tpu.store.buffer_store import StoreQuotaExceeded
+        try:
+            self._buffer.publish(key_path, spill_id, run, epoch=epoch,
+                                 app_id=app_id, tenant=tenant,
+                                 counters=counters)
+        except StoreQuotaExceeded as e:
+            # surfaces like any admission refusal: the pusher backs off,
+            # retries, then abandons to the pull backstop
+            raise PushRejected(0.0, str(e)) from e
         from tez_tpu.common import tracing
         tracing.event("shuffle.push", src=f"{path_component}/{spill_id}",
                       nbytes=nbytes,

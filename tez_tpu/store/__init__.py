@@ -15,9 +15,11 @@ from typing import Any, Optional
 
 from tez_tpu.store.buffer_store import (COUNTER_GROUP, DEVICE, DISK, HOST,
                                         LINEAGE_PREFIX, ShuffleBufferStore,
-                                        StoreKeyNotFound)
+                                        StoreKeyNotFound,
+                                        StoreQuotaExceeded)
 
-__all__ = ["ShuffleBufferStore", "StoreKeyNotFound", "local_buffer_store",
+__all__ = ["ShuffleBufferStore", "StoreKeyNotFound", "StoreQuotaExceeded",
+           "local_buffer_store",
            "ensure_store", "reset_store", "COUNTER_GROUP", "LINEAGE_PREFIX",
            "DEVICE", "HOST", "DISK"]
 
@@ -60,7 +62,18 @@ def ensure_store(conf: Any) -> Optional[ShuffleBufferStore]:
                     C.STORE_DISK_CAPACITY_MB)) * mb),
                 disk_dir=str(_get(C.STORE_DIR) or ""),
                 high_watermark=float(_get(C.STORE_HIGH_WATERMARK)),
-                low_watermark=float(_get(C.STORE_LOW_WATERMARK)))
+                low_watermark=float(_get(C.STORE_LOW_WATERMARK)),
+                tenant_device_quota=int(float(_get(
+                    C.STORE_TENANT_DEVICE_QUOTA_MB)) * mb),
+                tenant_host_quota=int(float(_get(
+                    C.STORE_TENANT_HOST_QUOTA_MB)) * mb),
+                tenant_disk_quota=int(float(_get(
+                    C.STORE_TENANT_DISK_QUOTA_MB)) * mb),
+                result_cache_ttl=float(_get(C.STORE_RESULT_CACHE_TTL_SECS)),
+                result_cache_bytes=int(float(_get(
+                    C.STORE_RESULT_CACHE_MB)) * mb),
+                result_cache_admit=str(_get(C.STORE_RESULT_CACHE_ADMIT)
+                                       or "always"))
             from tez_tpu.shuffle.service import local_shuffle_service
             local_shuffle_service().attach_buffer_store(_store)
             from tez_tpu.ops import async_stage

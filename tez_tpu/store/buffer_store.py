@@ -59,6 +59,21 @@ class StoreKeyNotFound(Exception):
     pass
 
 
+class StoreQuotaExceeded(Exception):
+    """A publish would take its tenant over a per-tenant tier quota.
+
+    Isolation, not correctness: the producer falls back to its own spill
+    files / the bare registry, so the DAG still completes — it just stops
+    consuming shared store capacity."""
+
+    def __init__(self, tenant: str, tier: str, used: int, quota: int):
+        super().__init__(
+            f"tenant {tenant or '<anon>'} over {tier} quota "
+            f"({used} + publish > {quota} bytes)")
+        self.tenant = tenant
+        self.tier = tier
+
+
 def _dev_nbytes(run: Any) -> int:
     """HBM bytes pinned by a run's device key lanes (0 when none)."""
     batch = getattr(run, "batch", None)
@@ -74,10 +89,10 @@ class StoreEntry:
 
     __slots__ = ("run", "tier", "host_nbytes", "dev_nbytes", "leases",
                  "refs", "epoch", "app_id", "lineage", "last_access",
-                 "dead", "keys")
+                 "dead", "keys", "tenant", "sealed_at")
 
     def __init__(self, run: Any, tier: str, clock: Callable[[], float],
-                 epoch: int, app_id: str, lineage: str):
+                 epoch: int, app_id: str, lineage: str, tenant: str = ""):
         self.run = run
         self.tier = tier
         self.host_nbytes = int(run.nbytes) if tier != DISK else 0
@@ -87,8 +102,10 @@ class StoreEntry:
         self.epoch = epoch
         self.app_id = app_id
         self.lineage = lineage
+        self.tenant = tenant
         self.last_access = clock()
         self.dead = False
+        self.sealed_at = 0.0                    # result-cache TTL anchor
         self.keys: List[Tuple[str, int]] = []   # registry aliases
 
 
@@ -107,7 +124,13 @@ class ShuffleBufferStore:
                  disk_dir: str = "",
                  high_watermark: float = 0.90,
                  low_watermark: float = 0.70,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 tenant_device_quota: int = 0,
+                 tenant_host_quota: int = 0,
+                 tenant_disk_quota: int = 0,
+                 result_cache_ttl: float = 0.0,
+                 result_cache_bytes: int = 0,
+                 result_cache_admit: str = "always"):
         self.device_capacity = int(device_capacity)
         self.host_capacity = int(host_capacity)
         self.disk_capacity = int(disk_capacity)
@@ -115,10 +138,24 @@ class ShuffleBufferStore:
         self.disk_dir = disk_dir or tempfile.mkdtemp(prefix="tez-store-")
         self.high = float(high_watermark)
         self.low = float(low_watermark)
+        # per-tenant isolation: the same byte cap applies to EVERY tenant
+        # on each tier (0 = unlimited); quotas gate fresh publishes only —
+        # capacity-driven demotion stays tenant-blind so the global
+        # watermarks always win
+        self.tenant_quota = {DEVICE: int(tenant_device_quota),
+                             HOST: int(tenant_host_quota),
+                             DISK: int(tenant_disk_quota)}
+        # governed result cache (sealed lineage): TTL, per-tenant byte cap
+        # (evicts least-recently-hit first), and seal-time admission policy
+        self.result_cache_ttl = float(result_cache_ttl)
+        self.result_cache_bytes = int(result_cache_bytes)
+        self.result_cache_admit = str(result_cache_admit or "always")
+        self._lineage_seen: Dict[str, float] = {}   # second-use admission
         self._clock = clock
         self._lock = threading.Lock()
         self._entries: Dict[Tuple[str, int], StoreEntry] = {}
         self._bytes = {DEVICE: 0, HOST: 0, DISK: 0}
+        self._tenant_bytes: Dict[str, Dict[str, int]] = {}
         self.counters: Dict[str, int] = {
             "store.published": 0, "store.hits": 0, "store.misses": 0,
             "store.lineage.hits": 0, "store.lineage.misses": 0,
@@ -127,23 +164,38 @@ class ShuffleBufferStore:
             "store.demotions.host_to_disk": 0,
             "store.evictions.device": 0, "store.evictions.host": 0,
             "store.evictions.disk": 0,
+            "store.quota.device_demoted": 0,
+            "store.quota.rejected.host": 0, "store.quota.rejected.disk": 0,
+            "store.result_cache.expired": 0,
+            "store.result_cache.evicted": 0,
+            "store.result_cache.deferred": 0,
         }
 
     # -- accounting helpers (call with lock held) ----------------------------
 
     def _account(self, entry: StoreEntry, sign: int) -> None:
+        tb = self._tenant_bytes.setdefault(
+            entry.tenant, {DEVICE: 0, HOST: 0, DISK: 0})
         if entry.tier == DEVICE:
             self._bytes[DEVICE] += sign * entry.dev_nbytes
             self._bytes[HOST] += sign * entry.host_nbytes
+            tb[DEVICE] += sign * entry.dev_nbytes
+            tb[HOST] += sign * entry.host_nbytes
         elif entry.tier == HOST:
             self._bytes[HOST] += sign * entry.host_nbytes
+            tb[HOST] += sign * entry.host_nbytes
         else:
             self._bytes[DISK] += sign * int(entry.run.nbytes)
+            tb[DISK] += sign * int(entry.run.nbytes)
 
     def _publish_gauges(self) -> None:
         for tier in TIERS:
             metrics.set_gauge(f"store.{tier}.bytes", self._bytes[tier])
         metrics.set_gauge("store.entries", len(self._entries))
+        for tenant, tb in self._tenant_bytes.items():
+            metrics.set_gauge(
+                f"store.tenant.{tenant or 'default'}.bytes",
+                float(sum(tb.values())))
 
     def _bump(self, name: str, counters: Any = None, n: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + n
@@ -154,26 +206,52 @@ class ShuffleBufferStore:
 
     def publish(self, path_component: str, spill_id: int, run: Any,
                 epoch: int = 0, app_id: str = "", lineage: str = "",
-                counters: Any = None) -> None:
+                tenant: str = "", counters: Any = None) -> None:
         """Insert a run under (path_component, spill_id).
 
         Epoch-fenced like ShuffleService.register: a stamped publish from
         a superseded AM incarnation raises instead of resurrecting zombie
-        output.  ``lineage`` tags the entry for session-mode sealing."""
+        output.  ``lineage`` tags the entry for session-mode sealing;
+        ``tenant`` charges the bytes to that tenant's quota (device
+        over-quota lands on host instead; host/disk over-quota raise
+        :class:`StoreQuotaExceeded` — the producer keeps its own copy)."""
         if epoch > 0 and epoch_registry.is_stale(app_id, epoch):
             raise EpochFencedError(
                 f"store publish from stale epoch {epoch} "
                 f"(current {epoch_registry.current(app_id)}): "
                 f"{path_component}/{spill_id}")
+        tenant = str(tenant or "")
         if isinstance(run, FileRun):
             tier = DISK
         elif _dev_nbytes(run) > 0 and self.device_capacity > 0:
             tier = DEVICE
+            if self._tenant_over(tenant, DEVICE, _dev_nbytes(run)):
+                # HBM isolation is soft: the run is still admitted, just
+                # without its device lanes — consumers re-upload on demand
+                run = self._drop_lanes(run)
+                tier = HOST
+                self._bump("store.quota.device_demoted", counters)
         else:
             if _dev_nbytes(run) > 0:
                 run = self._drop_lanes(run)
             tier = HOST
-        entry = StoreEntry(run, tier, self._clock, epoch, app_id, lineage)
+        if tier == HOST and self._tenant_over(tenant, HOST,
+                                              int(run.nbytes)):
+            self._bump("store.quota.rejected.host", counters)
+            raise StoreQuotaExceeded(tenant, HOST,
+                                     self._tenant_used(tenant, HOST),
+                                     self.tenant_quota[HOST])
+        if tier == DISK and self._tenant_over(tenant, DISK,
+                                              int(run.nbytes)):
+            # make room from the tenant's own cold cache before refusing
+            self._evict_tenant_lineage(tenant, int(run.nbytes), counters)
+            if self._tenant_over(tenant, DISK, int(run.nbytes)):
+                self._bump("store.quota.rejected.disk", counters)
+                raise StoreQuotaExceeded(tenant, DISK,
+                                         self._tenant_used(tenant, DISK),
+                                         self.tenant_quota[DISK])
+        entry = StoreEntry(run, tier, self._clock, epoch, app_id, lineage,
+                           tenant=tenant)
         key = (path_component, spill_id)
         with self._lock:
             old = self._entries.get(key)
@@ -187,6 +265,43 @@ class ShuffleBufferStore:
             self._publish_gauges()
         with metrics.timer("store.publish"):
             self._enforce_watermarks(counters)
+
+    # -- per-tenant quota helpers --------------------------------------------
+
+    def _tenant_used(self, tenant: str, tier: str) -> int:
+        with self._lock:
+            return self._tenant_bytes.get(tenant, {}).get(tier, 0)
+
+    def _tenant_over(self, tenant: str, tier: str, nbytes: int) -> bool:
+        quota = self.tenant_quota.get(tier, 0)
+        if quota <= 0:
+            return False
+        return self._tenant_used(tenant, tier) + nbytes > quota
+
+    def _evict_tenant_lineage(self, tenant: str, need: int,
+                              counters: Any) -> None:
+        """Drop the tenant's stalest sealed-lineage disk entries until
+        ~need bytes of its disk quota are free (never touches live DAG
+        output or other tenants)."""
+        with self._lock:
+            cands = [(k, e) for k, e in self._entries.items()
+                     if e.tier == DISK and e.tenant == tenant
+                     and e.leases == 0 and not e.dead
+                     and all(kk[0].startswith(LINEAGE_PREFIX)
+                             for kk in e.keys)]
+            cands.sort(key=lambda ke: ke[1].last_access)
+            freed, seen = 0, set()
+            for _, entry in cands:
+                if freed >= need:
+                    break
+                if id(entry) in seen:
+                    continue
+                seen.add(id(entry))
+                freed += int(entry.run.nbytes)
+                for k in list(entry.keys):
+                    self._unlink_locked(k, entry)
+                self._bump("store.evictions.disk", counters)
+            self._publish_gauges()
 
     @staticmethod
     def _drop_lanes(run: Run) -> Run:
@@ -420,30 +535,105 @@ class ShuffleBufferStore:
         """Alias every committed entry under ``path_prefix`` that carries a
         lineage tag to a retained ``__lineage__/<tag>`` key.  Called by the
         AM when the owning DAG commits SUCCEEDED — BEFORE unregister_prefix
-        drops the DAG aliases — so identical recurring DAGs can hit."""
+        drops the DAG aliases — so identical recurring DAGs can hit.
+
+        This is the governed result cache's admission gate: policy
+        'never' seals nothing, 'second-use' only seals lineage tags a
+        probe already missed on (scan resistance), and a per-tenant byte
+        cap evicts the tenant's least-recently-hit sealed entries to make
+        room."""
+        if self.result_cache_admit == "never":
+            return 0
         sealed = 0
         with self._lock:
+            now = self._clock()
             for (path, spill), entry in list(self._entries.items()):
                 if not path.startswith(path_prefix) or not entry.lineage \
                         or entry.dead:
                     continue
+                if self.result_cache_admit == "second-use" and \
+                        entry.lineage not in self._lineage_seen:
+                    self._bump("store.result_cache.deferred", counters)
+                    continue
                 lkey = (LINEAGE_PREFIX + entry.lineage, spill)
                 if lkey in self._entries:
                     continue
+                self._cap_result_cache_locked(entry.tenant,
+                                              self._entry_nbytes(entry),
+                                              counters)
                 self._entries[lkey] = entry
                 entry.refs += 1
                 entry.keys.append(lkey)
+                entry.sealed_at = now
                 sealed += 1
             if sealed:
                 self._bump("store.lineage.sealed", counters, sealed)
             self._publish_gauges()
         return sealed
 
+    @staticmethod
+    def _entry_nbytes(entry: StoreEntry) -> int:
+        return int(getattr(entry.run, "nbytes", 0))
+
+    def _sealed_entries_locked(self, tenant: Optional[str] = None
+                               ) -> List[StoreEntry]:
+        out, seen = [], set()
+        for (p, _), e in self._entries.items():
+            if not p.startswith(LINEAGE_PREFIX) or e.dead:
+                continue
+            if tenant is not None and e.tenant != tenant:
+                continue
+            if id(e) in seen:
+                continue
+            seen.add(id(e))
+            out.append(e)
+        return out
+
+    def _cap_result_cache_locked(self, tenant: str, incoming: int,
+                                 counters: Any) -> None:
+        """Evict the tenant's least-recently-hit sealed entries until the
+        incoming seal fits under the per-tenant result-cache byte cap."""
+        if self.result_cache_bytes <= 0:
+            return
+        sealed = self._sealed_entries_locked(tenant)
+        used = sum(self._entry_nbytes(e) for e in sealed)
+        if used + incoming <= self.result_cache_bytes:
+            return
+        sealed.sort(key=lambda e: e.last_access)
+        for entry in sealed:
+            if used + incoming <= self.result_cache_bytes or \
+                    entry.leases > 0:
+                break
+            used -= self._entry_nbytes(entry)
+            # drop ONLY the lineage aliases: a still-live DAG key keeps
+            # the entry; a cache-only entry frees entirely
+            for k in [k for k in list(entry.keys)
+                      if k[0].startswith(LINEAGE_PREFIX)]:
+                self._unlink_locked(k, entry)
+            self._bump("store.result_cache.evicted", counters)
+
+    def _expire_result_cache_locked(self, counters: Any = None) -> None:
+        """Reap sealed entries past the TTL (expired results must not be
+        served to a recurring tenant)."""
+        if self.result_cache_ttl <= 0:
+            return
+        cutoff = self._clock() - self.result_cache_ttl
+        for entry in self._sealed_entries_locked():
+            if entry.sealed_at and entry.sealed_at < cutoff and \
+                    entry.leases == 0:
+                for k in [k for k in list(entry.keys)
+                          if k[0].startswith(LINEAGE_PREFIX)]:
+                    self._unlink_locked(k, entry)
+                self._bump("store.result_cache.expired", counters)
+
     def lineage_spills(self, lineage: str, app_id: str = "") -> List[int]:
         """Spill ids sealed under ``lineage``, or [] on a miss.  An entry
-        sealed by a superseded AM epoch is fenced out of reuse."""
+        sealed by a superseded AM epoch — or one past the result-cache
+        TTL — is fenced out of reuse.  A miss records the tag so the
+        'second-use' admission policy seals it next time."""
         path = LINEAGE_PREFIX + lineage
         with self._lock:
+            self._expire_result_cache_locked()
             out = []
             for (p, s), e in self._entries.items():
                 if p != path or e.dead:
@@ -452,6 +642,8 @@ class ShuffleBufferStore:
                     continue
                 out.append(s)
             name = "store.lineage.hits" if out else "store.lineage.misses"
+            if not out:
+                self._lineage_seen[lineage] = self._clock()
             self._bump(name)
             return sorted(out)
 
@@ -490,11 +682,26 @@ class ShuffleBufferStore:
         with self._lock:
             return {"entries": len(self._entries),
                     "bytes": dict(self._bytes),
+                    "tenant_bytes": {t: dict(tb) for t, tb
+                                     in self._tenant_bytes.items()},
                     "counters": dict(self.counters)}
 
     def tier_bytes(self, tier: str) -> int:
         with self._lock:
             return self._bytes[tier]
+
+    def capacity(self, tier: str) -> int:
+        """Configured byte capacity of a tier (0 = uncapped/disabled);
+        the admission controller's store-pressure gate reads this."""
+        return {DEVICE: self.device_capacity, HOST: self.host_capacity,
+                DISK: self.disk_capacity}[tier]
+
+    def tenant_bytes(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant per-tier resident bytes snapshot (chaos's
+        cross-tenant leak check and the /queue endpoint read this)."""
+        with self._lock:
+            return {t: dict(tb) for t, tb in self._tenant_bytes.items()
+                    if any(tb.values())}
 
     def close(self) -> None:
         """Drop everything (tests / process teardown)."""
@@ -509,6 +716,8 @@ class ShuffleBufferStore:
                 if e.leases == 0:
                     self._dispose_locked(e)
             self._bytes = {DEVICE: 0, HOST: 0, DISK: 0}
+            for tb in self._tenant_bytes.values():
+                tb.update({DEVICE: 0, HOST: 0, DISK: 0})
             self._publish_gauges()
         if self._own_dir:
             import shutil

@@ -332,6 +332,280 @@ def run_push_storm(seed: int, workdir: str,
         reset_store()
 
 
+# ----------------------------------------------------------- tenant storm
+
+class ChaosTenantEmitProcessor(SimpleProcessor):
+    """Tenant-salted producer: each tenant's key space and values are
+    disjoint functions of the payload salt, so any cross-tenant mixing in
+    the session AM shows up as a bit-level diff, never a coincidence."""
+
+    def run(self, inputs, outputs):
+        payload = self.context.user_payload.load() or {}
+        salt = int(payload.get("salt", 0))
+        writer = outputs["consumer"].get_writer()
+        for i in range(KEYS_PER_TASK):
+            writer.write(f"t{salt}key{i:03d}".encode(), i + 1 + salt)
+
+
+#: Recoverable per-DAG faults for the tenant storm: small budgets so every
+#: accepted DAG stays inside its retry envelope (the admission faults —
+#: am.admit.shed / am.queue.delay — are installed process-wide instead,
+#: because they fire before the DAG exists to carry a conf).
+TENANT_STORM_MENU = (
+    "task.run:fail:n=1,exc=runtime",
+    "task.run:delay:ms=250,n=1",
+    "shuffle.fetch.read:fail:n=1,exc=io",
+)
+
+
+def _build_tenant_dag(name: str, result_path: str, salt: int,
+                      tenant: str = "", fault_spec: str = "",
+                      fault_seed: int = 0, trace: bool = False) -> DAG:
+    producer = Vertex.create("producer", ProcessorDescriptor.create(
+        ChaosTenantEmitProcessor, payload={"salt": salt}), NUM_PRODUCERS)
+    consumer = Vertex.create("consumer", ProcessorDescriptor.create(
+        ChaosCountProcessor, payload={"result_path": result_path}), 1)
+    conf = {"tez.runtime.key.class": "bytes",
+            "tez.runtime.value.class": "long"}
+    prop = EdgeProperty.create(
+        DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create(
+            "tez_tpu.library.outputs:OrderedPartitionedKVOutput",
+            payload=conf),
+        InputDescriptor.create(
+            "tez_tpu.library.inputs:OrderedGroupedKVInput", payload=conf))
+    dag = DAG.create(name).add_vertex(producer).add_vertex(consumer)
+    dag.add_edge(Edge.create(producer, consumer, prop))
+    if tenant:
+        dag.set_conf("tez.dag.tenant", tenant)
+    if fault_spec:
+        dag.set_conf("tez.test.fault.spec", fault_spec)
+        dag.set_conf("tez.test.fault.seed", fault_seed)
+    if trace:
+        dag.set_conf("tez.trace.enabled", True)
+    return dag
+
+
+def run_tenant_storm(seed: int, workdir: str, timeout: float = 120.0,
+                     tenants: int = 3, rounds: int = 3,
+                     p95_bound_s: float = 30.0) -> Tuple[bool, str]:
+    """Multi-tenant session soak. Returns (ok, detail).
+
+    One resident session AM (max-concurrent-dags=2, queue-size=2) takes
+    recurring DAGs from ``tenants`` concurrent submitter threads, each
+    round barrier-synchronized so every round is a genuine 3-way admission
+    race.  A process-wide ``am.admit.shed`` fault forces the first two
+    submissions to SHED (clients must resubmit on the typed RETRY-AFTER)
+    and ``am.queue.delay`` stalls the queue consumer mid-promote; on top,
+    half the DAGs carry a seeded recoverable task/fetch fault.  The
+    contract under all of that:
+
+    - every ACCEPTED DAG completes bit-exact vs its tenant's fault-free
+      baseline (shed submissions — resubmitted until accepted — are the
+      only losses, and they are typed, never silent);
+    - per-tenant store bytes stay attributed to their tenant: no bytes
+      under an unknown or anonymous tenant (cross-tenant leak);
+    - zero epoch-fence events (two live DAGs in one AM incarnation must
+      never fence each other);
+    - per-tenant p95 completion latency (tenant.<t>.dag.latency in the
+      metrics registry) stays under ``p95_bound_s``.
+    """
+    from tez_tpu.common import metrics as metrics_mod
+    from tez_tpu.common import tracing
+    from tez_tpu.store import local_buffer_store, reset_store
+    from tez_tpu.utils.backoff import ExponentialBackoff
+
+    reset_store()
+    tracing.clear_all()
+    metrics_mod.registry().reset()   # p95/queue-wait reads are storm-scoped
+    tenant_names = [f"tenant{t}" for t in range(tenants)]
+
+    # fault-free per-tenant baselines, each on its own throwaway AM
+    baselines: List[bytes] = []
+    for t in range(tenants):
+        base = os.path.join(workdir, f"tsbase{seed}-t{t}")
+        result_path = os.path.join(base, "result.txt")
+        os.makedirs(base, exist_ok=True)
+        client = TezClient.create(f"tsbase{t}", {
+            "tez.staging-dir": os.path.join(base, "staging"),
+            "tez.am.local.num-containers": 4}).start()
+        try:
+            dag = _build_tenant_dag(f"tsbase{seed}-t{t}", result_path,
+                                    salt=t)
+            status = client.submit_dag(dag).wait_for_completion(
+                timeout=timeout)
+        finally:
+            client.stop()
+        if status.state.name != DAGStatusState.SUCCEEDED.name or \
+                not os.path.exists(result_path):
+            return False, (f"tenant {t} baseline failed "
+                           f"(state={status.state.name})")
+        with open(result_path, "rb") as fh:
+            baselines.append(fh.read())
+    if len(set(baselines)) != tenants:
+        return False, "tenant baselines are not pairwise distinct"
+
+    storm_dir = os.path.join(workdir, f"tenantstorm{seed}")
+    results_dir = os.path.join(storm_dir, "results")
+    os.makedirs(results_dir, exist_ok=True)
+    session_conf = {
+        "tez.staging-dir": os.path.join(storm_dir, "staging"),
+        "tez.am.local.num-containers": 4,
+        "tez.am.task.max.failed.attempts": 4,
+        "tez.am.session.max-concurrent-dags": 2,
+        "tez.am.session.queue-size": 2,
+        "tez.am.session.shed.retry-after-ms": 100,
+        "tez.am.session.fair-share": True,
+        "tez.am.session.tenant.weights":
+            ",".join(f"{n}={tenants - i}"
+                     for i, n in enumerate(tenant_names)),
+        # store on with roomy per-tenant quotas: the storm checks byte
+        # ATTRIBUTION (leaks), not quota pressure — store-pressure covers
+        # that; lineage reuse exercises the governed result cache across
+        # each tenant's recurring rounds
+        "tez.runtime.store.enabled": True,
+        "tez.runtime.store.quota.device-mb": 8,
+        "tez.runtime.store.quota.host-mb": 8,
+        "tez.runtime.store.quota.disk-mb": 8,
+        "tez.runtime.store.lineage.reuse": True,
+    }
+    # admission faults are process-wide: they fire in the AM's submit path
+    # and queue consumer, before any DAG-scoped rules exist.  fail:n=2
+    # deterministically sheds the first two submissions; delay stalls the
+    # consumer mid-promote without killing it.
+    faults.install("chaos", faults.parse_spec(
+        "am.admit.shed:fail:n=2;am.queue.delay:delay:ms=120,n=3"),
+        seed=seed)
+    import threading
+    errors: List[str] = []
+    completed: Dict[str, int] = {n: 0 for n in tenant_names}
+    barrier = threading.Barrier(tenants)
+
+    client = TezClient.create(f"tenantstorm{seed}", session_conf,
+                              session=True).start()
+
+    def submitter(t: int) -> None:
+        tenant = tenant_names[t]
+        rng = random.Random(seed * 7919 + t)
+        for r in range(rounds):
+            try:
+                barrier.wait(timeout=timeout)
+            except threading.BrokenBarrierError:
+                errors.append(f"{tenant}-r{r}: barrier broken "
+                              f"(another tenant thread died)")
+                return
+            name = f"{tenant}-r{r}"
+            result_path = os.path.join(results_dir, f"{name}.txt")
+            spec = rng.choice(TENANT_STORM_MENU) \
+                if rng.random() < 0.5 else ""
+            dag = _build_tenant_dag(name, result_path, salt=t,
+                                    tenant=tenant, fault_spec=spec,
+                                    fault_seed=seed * 100 + r, trace=True)
+            try:
+                dc = client.submit_dag_with_retry(
+                    dag, retries=10,
+                    backoff=ExponentialBackoff(base=0.05, cap=0.5,
+                                               jitter=True, rng=rng))
+                state = dc.wait_for_completion(timeout=timeout).state.name
+            except Exception as e:  # noqa: BLE001 — a loss, reported loudly
+                errors.append(f"{name}: {e!r}")
+                continue
+            if state != DAGStatusState.SUCCEEDED.name:
+                errors.append(f"{name}: finished {state} "
+                              f"(storm=[{spec or 'none'}])")
+                continue
+            got = b""
+            if os.path.exists(result_path):
+                with open(result_path, "rb") as fh:
+                    got = fh.read()
+            if got != baselines[t]:
+                errors.append(f"{name}: output diverged from tenant "
+                              f"baseline ({len(got)} vs "
+                              f"{len(baselines[t])} bytes)")
+                continue
+            completed[tenant] += 1
+
+    try:
+        threads = [threading.Thread(target=submitter, args=(t,),
+                                    name=f"tenant{t}-submitter",
+                                    daemon=True)
+                   for t in range(tenants)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=timeout * rounds)
+        qs = client.queue_status()
+        store = local_buffer_store()
+        tenant_bytes = store.tenant_bytes() if store is not None else {}
+        store_counters = store.stats()["counters"] if store is not None \
+            else {}
+    finally:
+        client.stop()
+        faults.clear_all()
+        reset_store()
+
+    if errors:
+        return False, f"{len(errors)} loss(es): " + "; ".join(errors[:4])
+    stats = qs.get("tenants", {})
+    shed = sum(ts.get("shed", 0) for ts in stats.values())
+    accepted = sum(ts.get("accepted", 0) for ts in stats.values())
+    for n in tenant_names:
+        ts = stats.get(n, {})
+        if completed[n] != rounds or ts.get("completed", 0) != rounds:
+            return False, (f"{n}: {completed[n]}/{rounds} rounds verified, "
+                           f"AM says completed={ts.get('completed', 0)} — "
+                           f"an accepted DAG was lost")
+        if ts.get("failed", 0):
+            return False, f"{n}: {ts['failed']} DAG(s) failed in the AM"
+    if shed < 2:
+        return False, (f"only {shed} shed(s) — the am.admit.shed fault "
+                       f"(n=2) did not bite")
+    if qs.get("queue_depth", 0) or not qs.get("consumer_alive", True):
+        return False, (f"session ended dirty: queue_depth="
+                       f"{qs.get('queue_depth')} consumer_alive="
+                       f"{qs.get('consumer_alive')}")
+    # cross-tenant store isolation: every byte the session holds must be
+    # attributed to a declared tenant — bytes under "" (anonymous) or an
+    # unknown name mean the tenant plumbing leaked somewhere
+    unknown = set(tenant_bytes) - set(tenant_names)
+    if unknown:
+        return False, (f"store bytes leaked outside declared tenants: "
+                       f"{sorted(unknown)} in {tenant_bytes}")
+    if store_counters.get("store.published", 0) < 1:
+        return False, "no output was ever published into the store"
+    # epoch fencing: concurrent DAGs share ONE AM incarnation; any fence
+    # event means dag-vs-dag state bled into the epoch plane
+    spans = tracing.snapshot()
+    fences = [s for s in spans if s.name == "fence.stale_epoch"]
+    fences += [n for s in spans for _, n, _ in s.events
+               if n == "fence.stale_epoch"]
+    tracing.clear_all()
+    if fences:
+        return False, f"{len(fences)} unexpected epoch-fence event(s)"
+    hists = metrics_mod.registry().histograms()
+    p95s = {}
+    for n in tenant_names:
+        h = hists.get(f"tenant.{n}.dag.latency")
+        if h is None or h.count < rounds:
+            return False, (f"{n}: latency histogram missing/short "
+                           f"({0 if h is None else h.count}/{rounds})")
+        p95s[n] = h.quantile(0.95) / 1000.0
+        if p95s[n] > p95_bound_s:
+            return False, (f"{n}: p95 latency {p95s[n]:.2f}s over the "
+                           f"declared {p95_bound_s:.0f}s bound")
+    queue_waits = hists.get("am.admit.queue_wait")
+    if queue_waits is None or queue_waits.count < 1:
+        return False, ("no submission ever took the QUEUE verdict — the "
+                       "barrier-synced rounds never contended")
+    p95_txt = " ".join(f"{n}={p95s[n]:.2f}s" for n in tenant_names)
+    return True, (f"{accepted} accepted / {shed} shed / "
+                  f"{sum(completed.values())} bit-exact over {tenants} "
+                  f"tenants x {rounds} rounds; {queue_waits.count} queued "
+                  f"(p95 wait {queue_waits.quantile(0.95):.0f}ms); "
+                  f"tenant bytes {sorted(tenant_bytes)}; p95 {p95_txt}")
+
+
 # ----------------------------------------------------------- commit storm
 
 class ChaosSinkCountProcessor(SimpleProcessor):
@@ -1008,6 +1282,25 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                          "output bit-exact vs a fault-free pull-only "
                          "baseline, with at least one push killed and one "
                          "landed")
+    ap.add_argument("--tenant-storm", action="store_true",
+                    help="run the multi-tenant session soak: one resident "
+                         "session AM takes barrier-synced recurring DAGs "
+                         "from --tenants submitter threads under forced "
+                         "am.admit.shed / am.queue.delay faults plus "
+                         "seeded task faults; every accepted DAG must "
+                         "complete bit-exact vs its tenant's baseline, "
+                         "shed submissions are the only (typed) losses, "
+                         "store bytes stay tenant-attributed, zero epoch "
+                         "fences, per-tenant p95 within --p95-bound")
+    ap.add_argument("--tenants", type=int, default=3,
+                    help="tenant submitter threads for --tenant-storm "
+                         "(default 3)")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="recurring DAGs per tenant for --tenant-storm "
+                         "(default 3)")
+    ap.add_argument("--p95-bound", type=float, default=30.0,
+                    help="per-tenant p95 completion-latency bound in "
+                         "seconds for --tenant-storm (default 30)")
     ap.add_argument("--exchange-skew", action="store_true",
                     help="run the skewed-key mesh-exchange scenario: a hot "
                          "partition over the round budget plus one chip "
@@ -1056,6 +1349,25 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                     failures += 1
                     print(f"REPRO: python -m tez_tpu.tools.chaos "
                           f"--store-pressure --seed {seed}")
+        finally:
+            if cleanup:
+                shutil.rmtree(workdir, ignore_errors=True)
+        return 1 if failures else 0
+    if args.tenant_storm:
+        failures = 0
+        try:
+            for seed in range(args.seed, args.seed + args.trials):
+                ok, detail = run_tenant_storm(seed, workdir,
+                                              timeout=args.timeout,
+                                              tenants=args.tenants,
+                                              rounds=args.rounds,
+                                              p95_bound_s=args.p95_bound)
+                print(("ok   " if ok else "FAIL ") +
+                      f"tenant-storm seed={seed}: {detail}")
+                if not ok:
+                    failures += 1
+                    print(f"REPRO: python -m tez_tpu.tools.chaos "
+                          f"--tenant-storm --seed {seed}")
         finally:
             if cleanup:
                 shutil.rmtree(workdir, ignore_errors=True)

@@ -92,6 +92,57 @@ EXCHANGE_PRESSURE_COUNTERS = MESH_EXCHANGE_PRESSURE_COUNTERS
 EXCHANGE_HISTS = ("mesh.exchange.round",)
 
 
+def tenant_summary(dags: Dict) -> Dict[str, Dict]:
+    """Per-tenant admission/latency roll-up over a whole session history:
+    {tenant: {submitted, completed, failed, queued, shed, p50_s, p95_s}}.
+    Latencies are exact per-DAG submit->finish walls sorted and read at the
+    quantile rank — NOT the registry's per-tenant dynamic histograms, which
+    deliberately stay out of the lint-checked ``*_HISTS`` tuples."""
+    out: Dict[str, Dict] = {}
+
+    def row(tenant: str) -> Dict:
+        return out.setdefault(tenant or "<anon>", {
+            "submitted": 0, "completed": 0, "failed": 0,
+            "queued": 0, "shed": 0, "latencies": []})
+
+    admission = []
+    for d in dags.values():
+        r = row(d.tenant)
+        r["submitted"] += 1
+        if d.state == "SUCCEEDED":
+            r["completed"] += 1
+        elif d.state:
+            r["failed"] += 1
+        if d.finish_time > d.submit_time > 0:
+            r["latencies"].append(d.finish_time - d.submit_time)
+        admission = d.admission_events or admission
+    for ev in admission:
+        row(ev["tenant"])["queued" if ev["event"] == "QUEUED"
+                          else "shed"] += 1
+    for r in out.values():
+        lats = sorted(r.pop("latencies"))
+        r["p50_s"] = lats[int(0.50 * (len(lats) - 1))] if lats else 0.0
+        r["p95_s"] = lats[int(0.95 * (len(lats) - 1))] if lats else 0.0
+    return out
+
+
+def diff_tenants(dags_a: Dict, dags_b: Dict,
+                 ) -> List[Tuple[str, Dict, Dict, bool]]:
+    """[(tenant, summary_a|{}, summary_b|{}, regressed)] for every tenant
+    in either session; regressed when B shed more, failed more, or its p95
+    latency crossed REGRESSION_RATIO x A's (shed growth = admission started
+    turning this tenant away; submitted/completed deltas are workload)."""
+    ta, tb = tenant_summary(dags_a), tenant_summary(dags_b)
+    out = []
+    for tenant in sorted(set(ta) | set(tb)):
+        a, b = ta.get(tenant, {}), tb.get(tenant, {})
+        regressed = bool(a and b and (
+            b["shed"] > a["shed"] or b["failed"] > a["failed"] or
+            (a["p95_s"] > 0 and b["p95_s"] >= REGRESSION_RATIO * a["p95_s"])))
+        out.append((tenant, a, b, regressed))
+    return out
+
+
 def diff_exchange(counters_a: Dict, counters_b: Dict,
                   ) -> List[Tuple[str, int, int, bool]]:
     """[(counter, a, b, regressed)] over the mesh-exchange section;
@@ -214,13 +265,14 @@ def main() -> int:
     if len(sys.argv) != 3:
         print("usage: counter_diff <history_a> <history_b>")
         return 2
-    runs = []
+    runs, sessions = [], []
     for path in sys.argv[1:]:
         dags = parse_jsonl_files([path])
         if not dags:
             print(f"no DAG in {path}")
             return 1
         runs.append(list(dags.values())[-1])
+        sessions.append(dags)
     a, b = runs
     fa, fb = flatten(a.counters), flatten(b.counters)
     print(f"{'counter':60} {'A':>14} {'B':>14} {'delta':>14}")
@@ -302,6 +354,24 @@ def main() -> int:
                 print(f"{name:32} {ms_a:14.1f} {ms_b:14.1f} "
                       f"{ms_b - ms_a:+12.1f}{flag}")
                 regressions += int(regressed)
+    tenants = diff_tenants(*sessions)
+    if any(t != "<anon>" or s.get("queued") or s.get("shed")
+           for t, sa, sb, _ in tenants for s in (sa, sb) if s):
+        print(f"\n{'tenant (admission + latency)':24} "
+              f"{'A sub/cmp/fail q/shed p50/p95':>40} "
+              f"{'B sub/cmp/fail q/shed p50/p95':>40}")
+
+        def _fmt_tenant(s: Dict) -> str:
+            if not s:
+                return f"{'-':>40}"
+            return (f"{s['submitted']:3d}/{s['completed']:3d}/"
+                    f"{s['failed']:2d} {s['queued']:2d}/{s['shed']:2d} "
+                    f"{s['p50_s']:6.2f}s/{s['p95_s']:6.2f}s")
+        for tenant, sa, sb, regressed in tenants:
+            flag = "  << REGRESSION" if regressed else ""
+            print(f"{tenant:24} {_fmt_tenant(sa):>40} "
+                  f"{_fmt_tenant(sb):>40}{flag}")
+            regressions += int(regressed)
     failover = diff_device_failover(a.counters, b.counters)
     if failover:
         print(f"\n{'device.failover (containment)':60} "
@@ -316,8 +386,8 @@ def main() -> int:
     if regressions:
         print(f"{regressions} regression(s) (latency p95 >= "
               f"{REGRESSION_RATIO}x baseline, containment event growth, "
-              f"store eviction/demotion churn growth, or exchange "
-              f"round/split growth)")
+              f"store eviction/demotion churn growth, exchange "
+              f"round/split growth, or tenant shed/failure growth)")
     return 0
 
 

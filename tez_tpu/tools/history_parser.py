@@ -14,6 +14,7 @@ import os
 from typing import Dict, List, Optional
 
 from tez_tpu.am.history import HistoryEvent, HistoryEventType
+from tez_tpu.am.recovery import decode_journal_line
 
 
 @dataclasses.dataclass
@@ -75,6 +76,7 @@ class VertexInfo:
 class DagInfo:
     dag_id: str
     name: str = ""
+    tenant: str = ""
     submit_time: float = 0.0
     start_time: float = 0.0
     finish_time: float = 0.0
@@ -90,6 +92,10 @@ class DagInfo:
     # DAG structure recovered from the journaled plan: list of
     # {"src": name, "dst": name, "movement": DataMovementType name}
     edges: List[Dict] = dataclasses.field(default_factory=list)
+    # session admission stream (QUEUED/SHED verdicts) in event order:
+    # {"event", "tenant", "dag_name", "reason", "time"} — session-scoped
+    # like containers, attached to every dag
+    admission_events: List[Dict] = dataclasses.field(default_factory=list)
 
     @property
     def duration(self) -> float:
@@ -111,6 +117,7 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
     dags: Dict[str, DagInfo] = {}
     containers: Dict[str, Dict] = {}
     node_events: List[Dict] = []
+    admission_events: List[Dict] = []
 
     def dag(ev: HistoryEvent) -> Optional[DagInfo]:
         if ev.dag_id is None:
@@ -119,9 +126,22 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
 
     for ev in events:
         t = ev.event_type
+        if t in (HistoryEventType.DAG_QUEUED,
+                 HistoryEventType.DAG_ADMISSION_SHED):
+            # session-scoped verdicts; DAG_QUEUED's dag_id is a submission
+            # id, not a real DAG — never materialize a phantom DagInfo
+            admission_events.append({
+                "event": ("QUEUED" if t is HistoryEventType.DAG_QUEUED
+                          else "SHED"),
+                "tenant": ev.data.get("tenant", ""),
+                "dag_name": ev.data.get("dag_name", ""),
+                "reason": ev.data.get("reason", ""),
+                "time": ev.timestamp})
+            continue
         d = dag(ev)
         if t is HistoryEventType.DAG_SUBMITTED and d:
             d.name = ev.data.get("dag_name", "")
+            d.tenant = ev.data.get("tenant", "")
             d.submit_time = ev.timestamp
             raw = ev.data.get("plan")
             if raw:
@@ -208,6 +228,7 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
     for d in dags.values():
         d.containers = containers
         d.node_events = node_events
+        d.admission_events = admission_events
     return dags
 
 
@@ -232,7 +253,11 @@ def parse_jsonl_files(paths: List[str]) -> Dict[str, DagInfo]:
                     line = line.strip()
                     if line:
                         try:
-                            events.append(HistoryEvent.from_json(line))
+                            # canonical journal framing: `crc32-hex SP
+                            # json` (recovery journals) OR legacy raw
+                            # JSON (history-store partitions) — the
+                            # decoder accepts both
+                            events.append(decode_journal_line(line))
                         except Exception:  # noqa: BLE001 — torn tail
                             pass
     events.sort(key=lambda e: e.timestamp)

@@ -6,7 +6,7 @@ PY ?= python
 OLD ?= BENCH_r05.json
 NEW ?= /tmp/bench_new.json
 
-.PHONY: test lint bench bench-new bench-diff bench-merge bench-store bench-sort bench-exchange chaos chaos-device-ooo chaos-device chaos-merge chaos-store chaos-push chaos-exchange soak docs
+.PHONY: test lint bench bench-new bench-diff bench-merge bench-store bench-sort bench-exchange chaos chaos-device-ooo chaos-device chaos-merge chaos-store chaos-push chaos-exchange soak docs doctor
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -91,3 +91,14 @@ chaos-exchange:
 
 docs:
 	$(PY) -m tez_tpu.tools.gen_config_docs > docs/configuration.md
+
+# causal auto-triage (docs/doctor.md): one flight-armed tenant-storm run,
+# then the doctor's cross-plane blame waterfall over its history journals
+# + flight dumps.  DOCTOR_DIR is kept so the artifacts can be re-examined
+# (doctor runs on the storm session's journals; the tsbase* warmup
+# baselines would otherwise dominate the straggler ranking).
+DOCTOR_DIR ?= /tmp/tez-doctor
+doctor:
+	rm -rf $(DOCTOR_DIR) && mkdir -p $(DOCTOR_DIR)
+	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --tenant-storm --trials 1 --dump-flight --workdir $(DOCTOR_DIR)
+	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.doctor $(DOCTOR_DIR)/tenantstorm0 $(DOCTOR_DIR)/flight_*.json

@@ -1,0 +1,512 @@
+"""Flight-recorder, SLO-watchdog, and doctor tests (docs/doctor.md).
+
+Ring semantics first (bounded overwrite order, disarm-mid-write safety,
+snapshot-while-appending consistency, dump budget + round-trip), then the
+auto-dump triggers (breaker-open, admission shed — the shed path must
+also latch an SLO breach that lands in the dump, the history journal,
+and GET /slo), the scrape-race regression (/metrics vs a concurrently
+retiring DAG), and the doctor's golden waterfall on a seeded two-vertex
+DAG where every plane percentage is known by construction.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from tez_tpu.am.admission import AdmissionController
+from tez_tpu.am.history import HistoryEventType
+from tez_tpu.am.web import _Handler
+from tez_tpu.client.errors import DAGRejectedError
+from tez_tpu.common import config as C
+from tez_tpu.common import metrics
+from tez_tpu.common.counters import TezCounters
+from tez_tpu.common.payload import ProcessorDescriptor
+from tez_tpu.dag.dag import DAG, Vertex
+from tez_tpu.obs import flight, slo
+from tez_tpu.tools import doctor
+from tez_tpu.tools.history_parser import (AttemptInfo, DagInfo, TaskInfo,
+                                          VertexInfo)
+from tests.trace_schema import check_trace
+
+
+@pytest.fixture(autouse=True)
+def _flight_clean():
+    """conftest resets the fault/trace/metrics planes but not this one."""
+    flight.clear_all()
+    yield
+    flight.clear_all()
+
+
+# ------------------------------------------------------------ ring semantics
+
+def test_disarmed_record_is_noop():
+    assert not flight.armed()
+    flight.record(flight.MARK, "nobody-home")
+    snap = flight.snapshot()
+    assert snap.events == [] and snap.dropped_before == 0
+
+
+def test_ring_bounded_overwrite_keeps_newest_in_order():
+    flight.install("t", capacity=16)
+    for i in range(50):
+        flight.record(flight.MARK, f"e{i}")
+    snap = flight.snapshot()
+    seqs = [e.seq for e in snap.events]
+    assert 0 < len(seqs) <= 16
+    # bounded-journal contract: the survivors are exactly the newest
+    # records, in append order, and the drop count is honest
+    assert seqs == list(range(51 - len(seqs), 51))
+    assert snap.dropped_before == seqs[0] - 1
+    assert snap.events[-1].name == "e49"
+    assert [e.name for e in snap.events] == \
+        [f"e{s - 1}" for s in seqs]
+
+
+def test_ring_capacity_floor():
+    flight.install("t", capacity=1)          # floored to 16
+    for i in range(16):
+        flight.record(flight.MARK, f"e{i}")
+    assert len(flight.snapshot().events) == 16
+
+
+def test_ring_survives_scope_clear_until_clear_all():
+    flight.install("a")
+    for i in range(3):
+        flight.record(flight.MARK, f"e{i}")
+    flight.clear("a")
+    assert not flight.armed()
+    flight.record(flight.MARK, "after-disarm")     # module fn: gated out
+    assert len(flight.snapshot().events) == 3      # ring retained
+    flight.clear_all()
+    assert flight.snapshot().events == []
+
+
+def test_snapshot_while_appending_is_consistent():
+    flight.install("t", capacity=64)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        try:
+            i = 0
+            while not stop.is_set() and i < 20000:
+                flight.plane().record(flight.MARK, f"w{i % 100}", a=i)
+                i += 1
+        except Exception as e:  # noqa: BLE001 — the test IS the catch
+            errors.append(e)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(50):
+            snap = flight.snapshot()
+            seqs = [e.seq for e in snap.events]
+            assert len(seqs) <= 64
+            assert seqs == sorted(set(seqs))       # unique, ascending
+            for e in snap.events:
+                # every name id in the copied bytes must resolve to the
+                # string that was interned for it — never garbage
+                assert e.name.startswith("w") and e.a % 100 == int(
+                    e.name[1:])
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errors
+
+
+def test_disarm_mid_write_is_safe():
+    errors = []
+    stop = threading.Event()
+
+    def writer():
+        try:
+            while not stop.is_set():
+                # raw plane path: the one that races a concurrent
+                # clear_all swapping the ring/name table out
+                flight.plane().record(flight.MARK, "racer", a=1)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(30):
+            flight.install("x", capacity=32)
+            flight.snapshot()
+            flight.clear_all()
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errors
+    assert not flight.armed()
+
+
+def test_dump_budget_and_roundtrip(tmp_path):
+    flight.install("t", dump_dir=str(tmp_path), max_dumps=2)
+    flight.record(flight.MARK, "payload", scope="s1", a=7, b=9)
+    p1 = flight.auto_dump("unit.reason", scope="s1")
+    p2 = flight.auto_dump("unit.reason")
+    assert p1 is not None and p2 is not None
+    # budget spent for this arm cycle
+    assert flight.auto_dump("unit.reason") is None
+    with open(p1) as fh:
+        payload = json.load(fh)
+    assert payload["reason"] == "unit.reason" and payload["scope"] == "s1"
+    snap = flight.load_dump(p1)
+    ev = next(e for e in snap.events if e.name == "payload")
+    assert (ev.kind, ev.scope, ev.a, ev.b) == (flight.MARK, "s1", 7, 9)
+    assert snap.anchor == pytest.approx(flight.snapshot().anchor)
+    # re-arming resets the budget
+    flight.install("t", dump_dir=str(tmp_path), max_dumps=2)
+    assert flight.auto_dump("unit.reason") is not None
+
+
+def test_dump_without_dir_returns_none():
+    flight.install("t")
+    assert flight.auto_dump("no.dir") is None
+
+
+def test_install_from_conf():
+    assert not flight.install_from_conf(C.TezConfiguration({}), "s")
+    assert not flight.armed()
+    conf = C.TezConfiguration({C.OBS_FLIGHT_ENABLED.name: True,
+                               C.OBS_FLIGHT_BUFFER_EVENTS.name: 32})
+    assert flight.install_from_conf(conf, "s")
+    assert flight.armed() and "s" in flight.plane().scopes
+
+
+def test_metrics_observe_feeds_ring():
+    flight.install("t")
+    metrics.observe("spill.write", 2.5)          # ms
+    evs = [e for e in flight.snapshot().events if e.name == "spill.write"]
+    assert len(evs) == 1
+    assert evs[0].kind == flight.COUNTER and evs[0].a == 2500  # µs
+
+
+def test_span_edge_feeds_ring_and_maps_to_plane():
+    from tez_tpu.common import clock
+    flight.install("t")
+    wall0, _ = clock.anchor()
+    flight.span_edge("fetch.block", wall0 + 1.0, 0.01, cat="fetch")
+    snap = flight.snapshot()
+    ivs = doctor.intervals_from_flight([snap])
+    assert len(ivs) == 1
+    s, e, plane, label = ivs[0]
+    assert plane == "transport" and label == "fetch.block"
+    assert e - s == pytest.approx(0.01, rel=1e-6)
+    assert s == pytest.approx(wall0 + 1.0, abs=1e-6)
+
+
+# ------------------------------------------------------- auto-dump triggers
+
+def test_breaker_open_auto_dumps(tmp_path):
+    from tez_tpu.ops.async_stage import CircuitBreaker
+    flight.install("t", dump_dir=str(tmp_path))
+    br = CircuitBreaker(failures=1, cooldown_ms=60_000.0)
+    br.record_failure()
+    files = sorted(tmp_path.glob("flight_device.breaker.open_*.json"))
+    assert len(files) == 1
+    snap = flight.load_dump(str(files[0]))
+    opens = [e for e in snap.events
+             if e.kind == flight.BREAKER and e.name == "open"]
+    assert len(opens) == 1 and opens[0].a == 1
+
+
+class _StubAM:
+    """Minimal DAGAppMaster surface for AdmissionController (the same
+    shape test_multitenancy uses): conf, app_id, history sink, and a
+    _start_dag that mints fresh ids."""
+
+    def __init__(self, conf=None):
+        self.conf = C.TezConfiguration(conf or {})
+        self.app_id = "app_flight_1"
+        self.events = []
+        self._seq = itertools.count(1)
+        self.slo_watchdog = None
+
+    def history(self, ev):
+        self.events.append(ev)
+
+    def _start_dag(self, plan, recovery_data, tenant):
+        return f"dag_{next(self._seq)}"
+
+    def of(self, t):
+        return [e for e in self.events if e.event_type is t]
+
+
+def _plan(name, tenant=""):
+    dag = DAG.create(name).add_vertex(Vertex.create(
+        "v", ProcessorDescriptor.create(
+            "tez_tpu.library.processors:SleepProcessor",
+            payload={"sleep_ms": 1}), 1))
+    if tenant:
+        dag.set_conf("tez.dag.tenant", tenant)
+    return dag.create_dag_plan({})
+
+
+def test_shed_auto_dumps_with_latched_slo_breach(tmp_path):
+    """The acceptance chain: a forced shed must surface the SLO breach in
+    the flight dump, the history journal, and GET /slo — and the latch
+    must hold one typed event per episode, not one per shed."""
+    am = _StubAM({"tez.am.session.max-concurrent-dags": 1,
+                  "tez.am.session.queue-size": 0,
+                  "tez.am.session.shed.retry-after-ms": 100,
+                  C.AM_SLO_SHED_RATE.name: 0.01,
+                  C.AM_SLO_MIN_COUNT.name: 1})
+    am.slo_watchdog = slo.from_conf(am.conf, journal=am.history)
+    assert am.slo_watchdog is not None
+    flight.install("t", dump_dir=str(tmp_path))
+    ac = AdmissionController(am)
+    try:
+        ac.submit(_plan("d1", tenant="acme"))
+        with pytest.raises(DAGRejectedError):
+            ac.submit(_plan("d2", tenant="acme"))
+        with pytest.raises(DAGRejectedError):
+            ac.submit(_plan("d3", tenant="acme"))
+    finally:
+        ac.stop()
+
+    # the flight dump: one per shed, each containing the ADMIT verdict
+    # and (shed #1) the SLO record written BEFORE the dump was cut
+    dumps = sorted(tmp_path.glob("flight_am.admit.shed_*.json"))
+    assert len(dumps) == 2
+    snap = flight.load_dump(str(dumps[0]))
+    sheds = [e for e in snap.events
+             if e.kind == flight.ADMIT and e.name == "shed"]
+    assert sheds and sheds[0].scope == "acme"
+    slos = [e for e in snap.events if e.kind == flight.SLO]
+    assert len(slos) == 1
+    assert slos[0].name == "slo.breach.shed_rate"
+    assert slos[0].scope == "acme"
+    assert slos[0].a == 5000 and slos[0].b == 100   # 0.5 / 0.01 in bp
+
+    # the history journal: exactly one typed breach (latched across the
+    # second shed, whose rate stays over target)
+    breaches = am.of(HistoryEventType.TENANT_SLO_BREACH)
+    assert len(breaches) == 1
+    assert breaches[0].data["tenant"] == "acme"
+    assert breaches[0].data["kind"] == slo.KIND_SHED_RATE
+    assert breaches[0].data["observed"] == pytest.approx(0.5)
+
+    # GET /slo: the breach is live in the watchdog surface
+    status = _Handler._slo(am)
+    assert status["enabled"] and status["total_breaches"] == 1
+    active = {(b["tenant"], b["kind"]) for b in status["active"]}
+    assert ("acme", slo.KIND_SHED_RATE) in active
+    assert metrics.registry().gauges()["slo.breach.total"] == 1.0
+
+
+def test_slo_surface_disabled_shape():
+    am = _StubAM()
+    status = _Handler._slo(am)
+    assert status == {"enabled": False, "targets": {}, "active": [],
+                      "total_breaches": 0, "log": []}
+
+
+# ------------------------------------------------------------ SLO watchdogs
+
+def test_slo_shed_rate_breach_then_clear():
+    journal = []
+    wd = slo.SloWatchdog(C.TezConfiguration({C.AM_SLO_SHED_RATE.name: 0.5,
+                                             C.AM_SLO_MIN_COUNT.name: 1}),
+                         journal=journal.append)
+    new = wd.evaluate({"t": {"accepted": 1, "shed": 3}})   # 0.75 > 0.5
+    assert len(new) == 1 and new[0]["tenant"] == "t"
+    assert wd.evaluate({"t": {"accepted": 1, "shed": 3}}) == []  # latched
+    assert wd.evaluate({"t": {"accepted": 9, "shed": 1}}) == []  # clears
+    st = wd.status()
+    assert st["active"] == [] and st["total_breaches"] == 1
+    assert [e["event"] for e in st["log"]] == ["breach", "clear"]
+    assert len(journal) == 1      # one typed event per episode
+
+
+def test_slo_queue_wait_is_session_wide():
+    wd = slo.SloWatchdog(C.TezConfiguration(
+        {C.AM_SLO_QUEUE_WAIT_P95_MS.name: 1.0,
+         C.AM_SLO_MIN_COUNT.name: 3}))
+    for _ in range(3):
+        metrics.observe("am.admit.queue_wait", 50.0)
+    new = wd.evaluate({})
+    assert len(new) == 1
+    assert new[0]["tenant"] == "*"
+    assert new[0]["kind"] == slo.KIND_QUEUE_WAIT
+    assert new[0]["observed"] > 1.0
+
+
+def test_slo_min_count_guards_single_observation():
+    wd = slo.SloWatchdog(C.TezConfiguration({C.AM_SLO_SHED_RATE.name: 0.01,
+                                             C.AM_SLO_MIN_COUNT.name: 4}))
+    assert wd.evaluate({"t": {"accepted": 1, "shed": 2}}) == []
+
+
+def test_slo_from_conf_none_when_no_target():
+    assert slo.from_conf(C.TezConfiguration({})) is None
+
+
+# --------------------------------------------------- /metrics scrape race
+
+def test_metrics_scrape_never_drops_a_retiring_dag():
+    """Regression for the scrape race: a DAG moving live->retired between
+    the two registry reads used to vanish from BOTH maps mid-scrape.
+    _metrics now snapshots under the AM's _dag_done lock, so a mover
+    thread toggling the DAG between the maps (under that lock, like the
+    real retire path) must never produce a scrape without its counters."""
+    counters = TezCounters()
+    counters.find_counter("shuffle", "FLIGHT_SCRAPE_RACE_BYTES").increment(7)
+    dag = SimpleNamespace(dag_id="dag_r", counters=counters, vertices={})
+    am = SimpleNamespace(_dag_done=threading.Condition(),
+                         live_dags={"dag_r": dag}, retired_dags={},
+                         current_dag=None, attempt=1, conf=None)
+    stop = threading.Event()
+
+    def mover():
+        while not stop.is_set():
+            with am._dag_done:                    # the retire path
+                am.retired_dags["dag_r"] = am.live_dags.pop("dag_r")
+            with am._dag_done:                    # and back again
+                am.live_dags["dag_r"] = am.retired_dags.pop("dag_r")
+
+    t = threading.Thread(target=mover)
+    t.start()
+    try:
+        for _ in range(300):
+            assert "FLIGHT_SCRAPE_RACE_BYTES" in _Handler._metrics(am)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+# ------------------------------------------------------ doctor golden path
+
+def _golden_dag():
+    """Two-vertex DAG with every boundary seeded: admission holds it
+    [1000.0, 1000.2], two map attempts run [1000.2, 1000.5] and
+    [1000.2, 1000.4], nothing is instrumented over [1000.5, 1000.6],
+    and the single reduce attempt runs [1000.6, 1001.0]."""
+    m = VertexInfo("v_m", name="map")
+    m.tasks["t0"] = TaskInfo("t0", "map", attempts={
+        "a0": AttemptInfo("attempt_m0", "t0", "map", start_time=1000.2,
+                          finish_time=1000.5, state="SUCCEEDED")})
+    m.tasks["t1"] = TaskInfo("t1", "map", attempts={
+        "a1": AttemptInfo("attempt_m1", "t1", "map", start_time=1000.2,
+                          finish_time=1000.4, state="SUCCEEDED")})
+    r = VertexInfo("v_r", name="reduce")
+    r.tasks["t2"] = TaskInfo("t2", "reduce", attempts={
+        "a2": AttemptInfo("attempt_r0", "t2", "reduce", start_time=1000.6,
+                          finish_time=1001.0, state="SUCCEEDED")})
+    return DagInfo("dag_golden", name="golden", tenant="acme",
+                   submit_time=1000.0, start_time=1000.2,
+                   finish_time=1001.0, state="SUCCEEDED",
+                   vertices={"v_m": m, "v_r": r})
+
+
+def test_doctor_golden_waterfall_history_only():
+    rep = doctor.diagnose(_golden_dag(), [], [])
+    assert rep["wall_s"] == pytest.approx(1.0)
+    assert rep["planes"]["admission"]["pct"] == pytest.approx(20.0)
+    assert rep["planes"]["compute"]["pct"] == pytest.approx(70.0)
+    assert rep["planes"]["control"]["pct"] == pytest.approx(10.0)
+    for p in ("exchange", "device", "store", "transport"):
+        assert rep["planes"][p]["pct"] == 0.0
+    # the acceptance criterion: the sweep partitions the window
+    assert rep["pct_total"] == pytest.approx(100.0, abs=0.01)
+    assert [(s["offset_s"], s["plane"]) for s in rep["waterfall"]] == [
+        (0.0, "admission"), (pytest.approx(0.2), "compute"),
+        (pytest.approx(0.5), "control"), (pytest.approx(0.6), "compute")]
+    assert rep["split"]["queue_wait_pct"] == pytest.approx(22.22, abs=0.01)
+    assert rep["split"]["compute_pct"] == pytest.approx(77.78, abs=0.01)
+    text = doctor.render_text(rep)
+    assert "plane blame" in text and "admission" in text
+
+
+def test_doctor_flight_intervals_fill_uncovered_gap():
+    """A store COUNTER observation covering exactly the control gap must
+    re-blame it: flight data is what turns 'uncovered' into a plane."""
+    snap = flight.FlightSnapshot(
+        events=[flight.FlightEvent(1, int(0.6e9), flight.COUNTER,
+                                   "store.fetch.wait", "", 100_000, 0)],
+        anchor=(1000.0, 0), dropped_before=0)
+    rep = doctor.diagnose(_golden_dag(), [snap], [])
+    assert rep["planes"]["store"]["pct"] == pytest.approx(10.0)
+    assert rep["planes"]["control"]["pct"] == pytest.approx(0.0)
+    assert rep["pct_total"] == pytest.approx(100.0, abs=0.01)
+    assert rep["sources"]["flight_events"] == 1
+
+
+def test_doctor_straggler_uses_fleet_median_for_thin_vertices():
+    dag = _golden_dag()
+    # in-DAG the single reduce attempt is its own median (1.0x) ...
+    solo = doctor.straggler_attempts(dag)
+    assert all(r["slowdown"] < 2.0 for r in solo)
+    # ... but against the fleet baseline it is named as the straggler
+    rows = doctor.straggler_attempts(dag, fleet={"reduce": 0.1})
+    assert rows[0]["attempt_id"] == "attempt_r0"
+    assert rows[0]["slowdown"] == pytest.approx(4.0)
+    rep = doctor.diagnose(dag, [], [], fleet={"reduce": 0.1})
+    assert "straggler attempt_r0" in rep["verdict"]
+
+
+def test_doctor_slo_breaches_reach_report_and_text():
+    breach = {"tenant": "acme", "kind": "shed_rate",
+              "observed": 0.5, "target": 0.01}
+    rep = doctor.diagnose(_golden_dag(), [], [breach])
+    assert rep["slo_breaches"] == [breach]
+    assert "1 SLO breach(es)" in rep["verdict"]
+    assert "tenant=acme shed_rate" in doctor.render_text(rep)
+
+
+def _uniform_dag(dag_id, t0, wall, n=3, dur=0.1, name="w"):
+    v = VertexInfo("v", name=name)
+    for i in range(n):
+        v.tasks[f"t{i}"] = TaskInfo(f"t{i}", name, attempts={
+            "a": AttemptInfo(f"{dag_id}_a{i}", f"t{i}", name,
+                             start_time=t0 + 0.1,
+                             finish_time=t0 + 0.1 + dur,
+                             state="SUCCEEDED")})
+    return DagInfo(dag_id, submit_time=t0, start_time=t0 + 0.1,
+                   finish_time=t0 + wall, state="SUCCEEDED",
+                   vertices={"v": v})
+
+
+def test_doctor_triage_prefers_failed_then_skew_then_wall():
+    failed = _uniform_dag("dag_f", 3000.0, 0.3)
+    failed.state = "FAILED"
+    dags = {"dag_a": _uniform_dag("dag_a", 1000.0, 1.5),
+            "dag_b": _uniform_dag("dag_b", 2000.0, 0.6, n=1, dur=0.5),
+            "dag_f": failed}
+    assert doctor._triage_pick(dags) == "dag_f"
+    # no failures: dag_b's lone 0.5 s attempt is 5x the fleet median for
+    # vertex "w", which outranks dag_a's longer but uniform wall
+    del dags["dag_f"]
+    assert doctor._triage_pick(dags) == "dag_b"
+    # no skew at all: longest wall wins
+    dags["dag_b"] = _uniform_dag("dag_b", 2000.0, 0.6)
+    assert doctor._triage_pick(dags) == "dag_a"
+
+
+def test_trace_export_flight_tracks_are_valid_perfetto():
+    from tez_tpu.tools import trace_export
+    snap = flight.FlightSnapshot(
+        events=[
+            flight.FlightEvent(1, int(1.0e9), flight.SPAN, "fetch.block",
+                               "fetch", int(0.9e9), int(0.1e9)),
+            flight.FlightEvent(2, int(1.2e9), flight.COUNTER,
+                               "store.publish", "", 2500, 0),
+            flight.FlightEvent(3, int(1.3e9), flight.ADMIT, "shed",
+                               "acme", 1, 0),
+        ],
+        anchor=(1000.0, 0), dropped_before=0)
+    trace = trace_export.flight_to_trace(snap)
+    assert check_trace(trace) >= 6      # 3 events + their lane metadata
+    by_name = {e["name"]: e for e in trace["traceEvents"]
+               if e["ph"] != "M"}
+    assert by_name["store.publish"]["dur"] == 2500
+    assert by_name["shed"]["ph"] == "i"
+    lanes = {e["args"]["name"] for e in trace["traceEvents"]
+             if e["ph"] == "M"}
+    assert {"flight:span:fetch", "flight:counter:store.publish",
+            "flight:admit"} <= lanes

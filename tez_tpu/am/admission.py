@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional
 from tez_tpu.client.errors import DAGRejectedError
 from tez_tpu.common import config as C
 from tez_tpu.common import faults, metrics
+from tez_tpu.obs import flight as _flight
 
 log = logging.getLogger(__name__)
 
@@ -117,12 +118,16 @@ class AdmissionController:
             if shed_reason is not None:
                 ts.shed += 1
                 depth, inflight = len(self._queue), ts.inflight()
+                _flight.record(_flight.ADMIT, "shed", tenant,
+                               a=depth, b=inflight)
             elif self._running < self.max_concurrent and not self._queue \
                     and self._draining is None:
                 ts.accepted += 1
                 ts.running += 1
                 self._running += 1
                 sub = None
+                _flight.record(_flight.ADMIT, "accept", tenant,
+                               a=0, b=self._running)
             else:
                 sub = _QueuedSubmission(
                     sub_id=f"{self._am.app_id}-sub{next(self._sub_seq)}",
@@ -132,9 +137,15 @@ class AdmissionController:
                 ts.queued += 1
                 self._queue.append(sub)
                 self._cond.notify_all()
+                _flight.record(_flight.ADMIT, "queue", tenant,
+                               a=len(self._queue), b=self._running)
             self._publish_gauges_locked()
         if shed_reason is not None:
             self._journal_shed(plan, tenant, shed_reason, depth, inflight)
+            self._slo_tick()
+            # snapshot AFTER the slo tick so a shed-forced breach lands in
+            # the dump the acceptance assertions read
+            _flight.auto_dump("am.admit.shed", scope=tenant)
             raise DAGRejectedError(
                 shed_reason, retry_after_s=self.retry_after_ms / 1000.0,
                 tenant=tenant, queue_depth=depth, tenant_inflight=inflight)
@@ -249,6 +260,7 @@ class AdmissionController:
                 self._publish_gauges_locked()
             metrics.observe("am.admit.queue_wait",
                             (time.monotonic() - sub.enqueued_at) * 1000.0)
+            self._slo_tick()
             sub.done.set()
 
     # -- AM lifecycle hooks ---------------------------------------------------
@@ -269,6 +281,20 @@ class AdmissionController:
         # counter_diff tenant section straight from the registry
         metrics.observe(f"tenant.{tenant or 'default'}.dag.latency",
                         latency_ms)
+        self._slo_tick()
+
+    def _slo_tick(self) -> None:
+        """Run one SLO watchdog sweep off the AM's own completion/shed
+        ticks (pull-based: no timer thread, no new lock ordering)."""
+        wd = getattr(self._am, "slo_watchdog", None)
+        if wd is None:
+            return
+        with self._lock:
+            stats = {t: ts.to_dict() for t, ts in self._tenants.items()}
+        try:
+            wd.evaluate(stats)
+        except Exception:  # noqa: BLE001 — diagnostics never fail admission
+            log.exception("SLO watchdog sweep failed")
 
     def stop(self) -> None:
         with self._lock:

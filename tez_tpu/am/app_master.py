@@ -129,6 +129,10 @@ class DAGAppMaster:
         self.completed_dag_names: Dict[str, str] = {}
         self._dag_seq = 0
         self._dag_done = threading.Condition()
+        from tez_tpu.obs import slo as _slo
+        #: None unless some tez.am.slo.* target is declared; the admission
+        #: controller ticks it on every completion/shed/queue-promotion
+        self.slo_watchdog = _slo.from_conf(conf, journal=self.history)
         from tez_tpu.am.admission import AdmissionController
         self.admission = AdmissionController(self)
         self._register_handlers()
@@ -373,6 +377,14 @@ class DAGAppMaster:
             sp.annotate(final_state=final.name)
             sp.finish()
         tracing.clear(str(dag.dag_id))
+        from tez_tpu.obs import flight
+        if flight.armed():
+            flight.record(flight.MARK, f"dag.finished:{final.name}",
+                          str(dag.dag_id))
+            if final is not DAGState.SUCCEEDED:
+                flight.auto_dump(f"dag.{final.name.lower()}",
+                                 scope=str(dag.dag_id))
+        flight.clear(str(dag.dag_id))
         with self._dag_done:
             self._retire_dag_locked(dag)
             self.completed_dags[str(dag.dag_id)] = final
@@ -463,6 +475,12 @@ class DAGAppMaster:
                 dag_id=str(dag_id), am_epoch=self.attempt)
             dag.trace_span = sp
             dag.trace_carrier = sp.context.carrier()
+        # flight recorder: armed per-DAG like the planes above; the ring
+        # survives disarm so tools/doctor.py and GET-time snapshots can
+        # read it after the run
+        from tez_tpu.obs import flight
+        if flight.install_from_conf(dag.conf, scope=str(dag_id)):
+            flight.record(flight.MARK, f"dag:{plan.name}", str(dag_id))
         self.dispatch(DAGEvent(DAGEventType.DAG_INIT, dag_id))
         self.dispatch(DAGEvent(DAGEventType.DAG_START, dag_id))
         return dag_id

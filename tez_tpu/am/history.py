@@ -61,6 +61,10 @@ class HistoryEventType(enum.Enum):
     # data — nodes are hosts, not DAG-scoped entities
     NODE_BLACKLISTED = enum.auto()
     NODE_FORCED_ACTIVE = enum.auto()
+    # SLO watchdog (obs/slo.py): one event per latched breach episode
+    # (tenant, kind, observed, target ride in data) so chaos/soak can
+    # assert on breaches straight from the journal
+    TENANT_SLO_BREACH = enum.auto()
 
 
 #: Events whose loss recovery cannot tolerate — flushed synchronously.
@@ -78,6 +82,7 @@ SUMMARY_EVENT_TYPES = frozenset({
     HistoryEventType.DAG_KILL_REQUEST,
     HistoryEventType.DAG_QUEUED,
     HistoryEventType.DAG_ADMISSION_SHED,
+    HistoryEventType.TENANT_SLO_BREACH,
 })
 
 

@@ -330,6 +330,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                                        default=str).encode())
         elif path == "/trace":
             self._send(200, json.dumps(self._trace(am)).encode())
+        elif path == "/slo":
+            self._send(200, json.dumps(self._slo(am)).encode())
         elif path == "/metrics":
             from tez_tpu.common import config as C
             conf = getattr(am, "conf", None)
@@ -368,6 +370,16 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         if store is not None:
             status["store_tenant_bytes"] = store.tenant_bytes()
         return status
+
+    @staticmethod
+    def _slo(am: Any) -> Dict[str, Any]:
+        """SLO watchdog surface: declared targets, latched active
+        breaches, and the bounded breach/clear transition log."""
+        wd = getattr(am, "slo_watchdog", None)
+        if wd is None:
+            return {"enabled": False, "targets": {}, "active": [],
+                    "total_breaches": 0, "log": []}
+        return wd.status()
 
     @staticmethod
     def _graph(am: Any) -> Dict[str, Any]:
@@ -468,8 +480,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         from tez_tpu.common import metrics
         # every live DAG contributes (concurrent session AM); an idle AM
         # falls back to the most recently retired DAG so post-completion
-        # scrapes still see the final counters
-        dags = list(getattr(am, "live_dags", {}).values())
+        # scrapes still see the final counters.  The registry snapshot is
+        # taken under the AM's _dag_done lock: a DAG retiring between an
+        # unlocked live_dags read and the fallback read could vanish from
+        # BOTH maps mid-scrape and silently drop its counters.
+        lock = getattr(am, "_dag_done", None)
+        if lock is not None:
+            with lock:
+                dags = list(am.live_dags.values())
+                if not dags:
+                    dags = list(am.retired_dags.values())[-1:]
+        else:
+            dags = list(getattr(am, "live_dags", {}).values())
         if not dags and am.current_dag is not None:
             dags = [am.current_dag]
         running = 0

@@ -1,0 +1,51 @@
+"""One shared monotonic clock for cross-plane event correlation.
+
+Every observability plane that timestamps events independently picks its
+own axis: spans use ``time.time()`` (epoch seconds, comparable across
+processes), stage pipelines use ``time.perf_counter()`` (monotonic,
+process-local), and the flight recorder needs integer nanoseconds cheap
+enough for a ~O(100ns) append.  This module anchors them to each other:
+a single ``(wall, monotonic_ns)`` pair captured at import lets any
+monotonic timestamp be projected onto the wall clock (and back), so the
+doctor can join flight events with history timestamps and span
+start/end times on one axis.
+
+The anchor is deliberately captured once — NTP steps after import skew
+the projection, but a *stable* mapping matters more than an exact one:
+all intra-process deltas stay exact, and the wall projection is only
+used to line flight events up against history/span times recorded in
+the same process lifetime.
+"""
+from __future__ import annotations
+
+import time
+from typing import Tuple
+
+#: (epoch seconds, monotonic ns) captured together at import — the one
+#: anchor every projection in this process uses.
+_ANCHOR: Tuple[float, int] = (time.time(), time.monotonic_ns())
+
+
+def mono_ns() -> int:
+    """Integer monotonic nanoseconds — the flight recorder's time axis."""
+    return time.monotonic_ns()
+
+
+def anchor() -> Tuple[float, int]:
+    """The process ``(wall_s, mono_ns)`` anchor pair.  Flight dumps embed
+    it so an offline reader can project event times onto the wall axis of
+    the history journal written by the same process."""
+    return _ANCHOR
+
+
+def mono_to_wall(ns: int, anchor_pair: Tuple[float, int] = None) -> float:
+    """Project a monotonic-ns timestamp onto epoch seconds."""
+    wall0, mono0 = anchor_pair if anchor_pair is not None else _ANCHOR
+    return wall0 + (ns - mono0) / 1e9
+
+
+def wall_to_mono_ns(wall_s: float,
+                    anchor_pair: Tuple[float, int] = None) -> int:
+    """Project epoch seconds back onto the monotonic-ns axis."""
+    wall0, mono0 = anchor_pair if anchor_pair is not None else _ANCHOR
+    return mono0 + int((wall_s - wall0) * 1e9)

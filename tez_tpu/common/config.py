@@ -244,6 +244,49 @@ TRACE_BUFFER_SPANS = _key(
     "tez.trace.buffer.spans", 32768, Scope.DAG,
     "Ring-buffer capacity of the span plane; oldest spans are evicted "
     "first once full")
+OBS_FLIGHT_ENABLED = _key(
+    "tez.obs.flight.enabled", False, Scope.DAG,
+    "Arm the cross-plane flight recorder for this DAG: a bounded binary "
+    "ring journal of span edges, histogram observations, breaker/watchdog "
+    "transitions, admission verdicts, store demotions, push admissions and "
+    "exchange round plans, snapshottable on demand and auto-dumped on DAG "
+    "failure / breaker-open / watchdog fire / admission shed "
+    "(tools/doctor.py reads the dumps — see docs/doctor.md).  Disarmed = "
+    "single module-flag check per call site, zero allocation")
+OBS_FLIGHT_BUFFER_EVENTS = _key(
+    "tez.obs.flight.buffer.events", 65536, Scope.DAG,
+    "Flight-ring capacity in events (44 bytes each, ~2.8 MiB at the "
+    "default); the ring overwrites oldest-first once full")
+OBS_FLIGHT_DUMP_DIR = _key(
+    "tez.obs.flight.dump.dir", "", Scope.DAG,
+    "Directory auto-dump snapshots are written to on DAG failure, "
+    "breaker-open, watchdog fire, or admission shed (empty = the "
+    "process temp dir)")
+OBS_FLIGHT_DUMP_MAX = _key(
+    "tez.obs.flight.dump.max", 8, Scope.DAG,
+    "Auto-dump budget per arm cycle: at most this many flight snapshots "
+    "are written before further triggers are dropped, bounding disk use "
+    "under a failure storm")
+AM_SLO_SUBMIT_P95_MS = _key(
+    "tez.am.slo.submit.p95-ms", 0.0, Scope.AM,
+    "Per-tenant SLO target on p95 submit-to-finish DAG latency in ms, "
+    "evaluated live from the tenant.<t>.dag.latency histograms; a breach "
+    "latches a TENANT_SLO_BREACH history event, bumps slo.breach.* "
+    "gauges and surfaces on GET /slo (0 = watchdog off; docs/doctor.md)")
+AM_SLO_QUEUE_WAIT_P95_MS = _key(
+    "tez.am.slo.queue-wait.p95-ms", 0.0, Scope.AM,
+    "Session-wide SLO target on p95 admission queue wait in ms, "
+    "evaluated from the am.admit.queue_wait histogram (0 = off)")
+AM_SLO_SHED_RATE = _key(
+    "tez.am.slo.shed-rate", 0.0, Scope.AM,
+    "Per-tenant SLO target on the admission shed fraction "
+    "shed/(accepted+shed), e.g. 0.1 breaches past 10% shedding "
+    "(0 = off)")
+AM_SLO_MIN_COUNT = _key(
+    "tez.am.slo.min-count", 3, Scope.AM,
+    "Minimum observations (completed DAGs / queue waits / admission "
+    "verdicts) before an SLO target is evaluated, so a single outlier "
+    "cannot latch a breach")
 METRICS_ENABLED = _key(
     "tez.metrics.enabled", True, Scope.AM,
     "Serve GET /metrics (Prometheus text: counters, latency histograms, "

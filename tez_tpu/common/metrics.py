@@ -24,6 +24,8 @@ import time
 from contextlib import contextmanager
 from typing import Any, Dict, Iterator, List, Mapping, Optional, Tuple
 
+from tez_tpu.obs import flight as _flight
+
 # Upper bounds of the finite buckets, in milliseconds: 1, 2, 4 ... 65536.
 BUCKET_BOUNDS_MS: Tuple[float, ...] = tuple(float(1 << i) for i in range(17))
 NUM_BUCKETS = len(BUCKET_BOUNDS_MS) + 1          # + overflow (+Inf)
@@ -148,7 +150,11 @@ WELL_KNOWN_HISTOGRAMS = ("shuffle.fetch.rtt", "spill.write", "shuffle.merge",
                          # session admission (am/admission.py): how long a
                          # QUEUE-verdict submission parks before the consumer
                          # promotes it to a running DAG
-                         "am.admit.queue_wait")
+                         "am.admit.queue_wait",
+                         # flight recorder (obs/flight.py): one snapshot
+                         # serialize + atomic write when a dump trigger
+                         # (DAG failure, breaker-open, watchdog, shed) fires
+                         "obs.flight.dump")
 
 
 class MetricsRegistry:
@@ -206,6 +212,8 @@ def observe(name: str, ms: float, counters: Any = None) -> None:
     bucket counters so the value aggregates task -> vertex -> DAG.
     """
     _REG.histogram(name).observe(ms)
+    if _flight.armed():
+        _flight.record(_flight.COUNTER, name, a=int(ms * 1000.0))
     if counters is not None:
         g = counters.group(HIST_GROUP_PREFIX + name)
         g.find_counter(_BUCKET_COUNTER_NAMES[bucket_index(ms)]).increment(1)

@@ -33,6 +33,8 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, Iterator, List, NamedTuple, Optional, Tuple
 
+from tez_tpu.obs import flight as _flight
+
 DEFAULT_BUFFER_SPANS = 32768
 
 _armed = False          # single-boolean fast path (see common/faults.py)
@@ -124,6 +126,9 @@ class Span:
         if error is not None:
             self.args["error"] = f"{type(error).__name__}: {error}"
         _PLANE.record(self)
+        if _flight.armed():
+            _flight.span_edge(self.name, self.start, self.end - self.start,
+                              cat=self.cat)
 
     # -- context-manager protocol (pushes onto the thread-local stack) ----
     def __enter__(self) -> "Span":

@@ -1,0 +1,16 @@
+"""Cross-plane observability: the flight recorder and SLO watchdogs.
+
+- :mod:`tez_tpu.obs.flight` — per-process bounded binary ring journal of
+  cross-plane events (span edges, histogram observations, breaker and
+  watchdog transitions, admission verdicts, store demotions, push
+  admissions, exchange round plans) on one shared monotonic clock, with
+  on-demand snapshots and auto-dump on DAG failure / breaker-open /
+  watchdog fire / admission shed.
+- :mod:`tez_tpu.obs.slo` — declarative per-tenant SLO targets evaluated
+  live from the metrics registry, surfaced on ``GET /slo`` and as typed
+  history events.
+
+Deliberately empty of imports: ``common/metrics.py`` and
+``common/tracing.py`` import ``tez_tpu.obs.flight`` on their hot paths,
+so this package must never pull in modules that import them back.
+"""

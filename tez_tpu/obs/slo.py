@@ -1,0 +1,184 @@
+"""Per-tenant SLO watchdogs: declarative targets evaluated live from the
+metrics registry, surfaced as breach gauges, ``GET /slo``, a typed
+history event, and flight-recorder entries.
+
+Targets (``tez.am.slo.*``, all disabled at 0):
+
+- ``submit.p95-ms`` — per-tenant p95 submit→finish wall, read from the
+  ``tenant.<t>.dag.latency`` histogram the admission controller already
+  feeds on every DAG completion;
+- ``queue-wait.p95-ms`` — p95 of ``am.admit.queue_wait`` (session-wide:
+  the queue is one FIFO, so queue wait is a property of the session, not
+  a tenant — reported under tenant ``*``);
+- ``shed-rate`` — shed / (accepted + shed) per tenant, from the
+  admission controller's live tenant stats.
+
+Evaluation is *edge-triggered and latched*: a (tenant, kind) pair
+breaches once when it crosses its target and clears once when it drops
+back under, so chaos/soak assertions see one typed
+``TENANT_SLO_BREACH`` history event per episode instead of one per DAG.
+``tez.am.slo.min-count`` guards against declaring a breach off a single
+observation.
+
+The watchdog is deliberately pull-based — it recomputes from histograms
+the planes already maintain, on the admission controller's own
+completion/shed ticks — so it adds no new lock ordering and costs
+nothing between ticks.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+#: bounded breach-transition log kept for GET /slo
+_HISTORY_LIMIT = 64
+
+KIND_SUBMIT = "submit_p95_ms"
+KIND_QUEUE_WAIT = "queue_wait_p95_ms"
+KIND_SHED_RATE = "shed_rate"
+
+
+class SloWatchdog:
+    """Evaluates ``tez.am.slo.*`` targets against live histograms."""
+
+    def __init__(self, conf: Any, journal: Any = None) -> None:
+        from tez_tpu.common import config as C
+        self.submit_p95_ms = float(conf.get(C.AM_SLO_SUBMIT_P95_MS) or 0.0)
+        self.queue_wait_p95_ms = float(
+            conf.get(C.AM_SLO_QUEUE_WAIT_P95_MS) or 0.0)
+        self.shed_rate = float(conf.get(C.AM_SLO_SHED_RATE) or 0.0)
+        self.min_count = max(1, int(conf.get(C.AM_SLO_MIN_COUNT) or 1))
+        self._journal = journal
+        self._lock = threading.Lock()
+        #: latched active breaches keyed (tenant, kind)
+        self._active: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._log: List[Dict[str, Any]] = []
+        self._total = 0
+        self._by_kind: Dict[str, int] = {}
+        self._evaluations = 0
+
+    def enabled(self) -> bool:
+        return (self.submit_p95_ms > 0 or self.queue_wait_p95_ms > 0
+                or self.shed_rate > 0)
+
+    def targets(self) -> Dict[str, float]:
+        return {KIND_SUBMIT: self.submit_p95_ms,
+                KIND_QUEUE_WAIT: self.queue_wait_p95_ms,
+                KIND_SHED_RATE: self.shed_rate}
+
+    # -- evaluation --------------------------------------------------------
+    def _checks(self, tenant_stats: Dict[str, Dict[str, int]]
+                ) -> List[Tuple[str, str, float, float]]:
+        """(tenant, kind, observed, target) tuples due for comparison."""
+        from tez_tpu.common import metrics
+        hists = metrics.registry().histograms()
+        out: List[Tuple[str, str, float, float]] = []
+        for tenant, ts in sorted(tenant_stats.items()):
+            label = tenant or "default"
+            if self.submit_p95_ms > 0:
+                h = hists.get(f"tenant.{label}.dag.latency")
+                if h is not None and h.count >= self.min_count:
+                    out.append((label, KIND_SUBMIT, h.quantile(0.95),
+                                self.submit_p95_ms))
+            if self.shed_rate > 0:
+                total = int(ts.get("accepted", 0)) + int(ts.get("shed", 0))
+                if total >= self.min_count:
+                    out.append((label, KIND_SHED_RATE,
+                                ts.get("shed", 0) / total, self.shed_rate))
+        if self.queue_wait_p95_ms > 0:
+            h = hists.get("am.admit.queue_wait")
+            if h is not None and h.count >= self.min_count:
+                out.append(("*", KIND_QUEUE_WAIT, h.quantile(0.95),
+                            self.queue_wait_p95_ms))
+        return out
+
+    def evaluate(self, tenant_stats: Dict[str, Dict[str, int]]
+                 ) -> List[Dict[str, Any]]:
+        """One sweep.  Returns the NEW breaches this sweep latched."""
+        if not self.enabled():
+            return []
+        new: List[Dict[str, Any]] = []
+        cleared: List[Dict[str, Any]] = []
+        now = time.time()
+        with self._lock:
+            self._evaluations += 1
+            for tenant, kind, observed, target in self._checks(tenant_stats):
+                key = (tenant, kind)
+                over = observed > target
+                active = self._active.get(key)
+                if over and active is None:
+                    breach = {"tenant": tenant, "kind": kind,
+                              "observed": round(observed, 4),
+                              "target": target, "since": now}
+                    self._active[key] = breach
+                    self._total += 1
+                    self._by_kind[kind] = self._by_kind.get(kind, 0) + 1
+                    new.append(dict(breach))
+                elif over:
+                    active["observed"] = round(observed, 4)
+                elif active is not None:
+                    del self._active[key]
+                    cleared.append({"tenant": tenant, "kind": kind,
+                                    "observed": round(observed, 4),
+                                    "target": target, "cleared_at": now})
+            for entry in new:
+                self._log.append(dict(entry, event="breach"))
+            for entry in cleared:
+                self._log.append(dict(entry, event="clear"))
+            del self._log[:-_HISTORY_LIMIT]
+            total = self._total
+        self._publish(new, cleared, total)
+        return new
+
+    def _publish(self, new: List[Dict[str, Any]],
+                 cleared: List[Dict[str, Any]], total: int) -> None:
+        from tez_tpu.common import metrics
+        from tez_tpu.obs import flight
+        if new or cleared:
+            metrics.set_gauge("slo.breach.total", float(total))
+            metrics.set_gauge("slo.breach.active", float(len(self._active)))
+        for b in new:
+            # a = observed, b = target — micro-units for latencies,
+            # basis points for rates, so both fit integer payload slots
+            scale = 1000.0 if b["kind"] != KIND_SHED_RATE else 10000.0
+            flight.record(flight.SLO, f"slo.breach.{b['kind']}",
+                          b["tenant"], a=int(b["observed"] * scale),
+                          b=int(b["target"] * scale))
+            log.warning("SLO breach: tenant=%s %s observed=%.2f target=%.2f",
+                        b["tenant"], b["kind"], b["observed"], b["target"])
+            if self._journal is not None:
+                from tez_tpu.am.history import (HistoryEvent,
+                                                HistoryEventType)
+                try:
+                    self._journal(HistoryEvent(
+                        HistoryEventType.TENANT_SLO_BREACH,
+                        data=dict(b)))
+                except Exception:  # noqa: BLE001 — diagnostics never fail
+                    log.exception("SLO breach journal write failed")
+        for c in cleared:
+            flight.record(flight.SLO, f"slo.clear.{c['kind']}", c["tenant"])
+
+    # -- the GET /slo surface ---------------------------------------------
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "enabled": self.enabled(),
+                "targets": self.targets(),
+                "min_count": self.min_count,
+                "active": [dict(b) for b in self._active.values()],
+                "total_breaches": self._total,
+                "breaches_by_kind": dict(self._by_kind),
+                "evaluations": self._evaluations,
+                "log": [dict(e) for e in self._log],
+            }
+
+
+def from_conf(conf: Any, journal: Any = None) -> Optional["SloWatchdog"]:
+    """Build a watchdog when any target is declared, else None (the AM
+    keeps a None attribute and every tick short-circuits)."""
+    wd = SloWatchdog(conf, journal=journal)
+    return wd if wd.enabled() else None

@@ -80,6 +80,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from tez_tpu.common import faults, metrics, tracing
+from tez_tpu.obs import flight as _flight
 
 log = logging.getLogger(__name__)
 
@@ -187,6 +188,7 @@ class CircuitBreaker:
                 self._probing = True
                 self.probes += 1
                 tracing.event("device.breaker.probe")
+                _flight.record(_flight.BREAKER, "half-open")
                 return True
             return False
 
@@ -200,6 +202,7 @@ class CircuitBreaker:
                 self.recoveries += 1
         if recovered:
             tracing.event("device.breaker.closed")
+            _flight.record(_flight.BREAKER, "closed")
             _count(counters, "device.breaker.recoveries")
 
     def record_failure(self, counters: Any = None) -> None:
@@ -222,6 +225,8 @@ class CircuitBreaker:
         if tripped:
             tracing.event("device.breaker.open",
                           consecutive=self._consecutive)
+            _flight.record(_flight.BREAKER, "open", a=self._consecutive)
+            _flight.auto_dump("device.breaker.open")
             _count(counters, "device.breaker.trips")
 
 
@@ -902,6 +907,8 @@ class AsyncSpanPipeline:
                "device.watchdog.readback_fires")
         tracing.event("device.watchdog.fired", stage=stage,
                       spans=repr(list(ids)))
+        _flight.record(_flight.WATCHDOG, stage, a=len(ids))
+        _flight.auto_dump(f"device.watchdog.{stage}")
         if stage == STAGE_DISPATCH:
             # the staging thread is stuck inside dispatch_fn: no further
             # group will ever be pulled — hand the queue to this monitor

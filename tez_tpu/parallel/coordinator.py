@@ -43,6 +43,7 @@ import numpy as np
 
 from tez_tpu.common import faults
 from tez_tpu.common.counters import MESH_EXCHANGE_GROUP
+from tez_tpu.obs import flight as _flight
 from tez_tpu.ops.keycodec import matrix_to_lanes, pad_to_matrix
 from tez_tpu.ops.runformat import KVBatch
 
@@ -596,6 +597,8 @@ class MeshExchangeCoordinator:
                   engine_reason)
         coded = (st.coded or "off") == "r2" and D > 1
         plan = plan_rounds(counts, per_round, D, legacy=self.legacy_sizing)
+        _flight.record(_flight.EXCHANGE, "plan", st.edge_id,
+                       a=len(plan), b=total)
 
         # rank of each row within its routing partition (arrival order)
         order = np.argsort(rdest, kind="stable")
@@ -719,6 +722,8 @@ class MeshExchangeCoordinator:
             metrics.observe("mesh.exchange.round",
                             (time.perf_counter() - t_round) * 1000.0,
                             st.counters)
+            _flight.record(_flight.EXCHANGE, "round", st.edge_id,
+                           a=r, b=n_round)
             sent_rows += n_round
             rounds_run += 1
             with self.lock:

@@ -48,6 +48,7 @@ import numpy as np
 
 from tez_tpu.common import faults, metrics
 from tez_tpu.common.counters import TaskCounter
+from tez_tpu.obs import flight as _flight
 from tez_tpu.common.epoch import EpochFencedError
 from tez_tpu.common.security import JobTokenSecretManager, hash_from_request
 from tez_tpu.ops.runformat import Run
@@ -145,16 +146,20 @@ class PushAdmissionController:
             # the source holds nothing — otherwise it could never push
             if held > 0 and held + nbytes > self.source_quota:
                 self.rejected += 1
+                _flight.record(_flight.PUSH, "reject.quota", source,
+                               a=nbytes)
                 raise PushRejected(
                     self.retry_after_ms,
                     f"source quota exhausted for {source} "
                     f"({held} + {nbytes} > {self.source_quota})")
             self._by_source[source] = held + nbytes
             self.admitted += 1
+        _flight.record(_flight.PUSH, "admit", source, a=nbytes)
 
     def _count_reject(self) -> None:
         with self._lock:
             self.rejected += 1
+        _flight.record(_flight.PUSH, "reject")
 
     def release_prefix(self, prefix: str) -> int:
         """Return the quota held by every source under ``prefix`` (called
@@ -370,6 +375,8 @@ class SpillPusher:
             rtt_ms = (time.perf_counter() - t0) * 1000.0
             metrics.observe("shuffle.push.rtt", rtt_ms,
                             counters=self.counters)
+            _flight.record(_flight.PUSH, "send", path, a=nbytes,
+                           b=int(admit_wait_ms * 1000.0))
             if self.counters is not None:
                 self.counters.increment(TaskCounter.SHUFFLE_PUSH_BYTES,
                                         nbytes)
@@ -384,6 +391,7 @@ class SpillPusher:
                 self.counters.increment(TaskCounter.SHUFFLE_PUSH_REJECTED)
             with self._cv:
                 self.pushes_rejected += 1
+            _flight.record(_flight.PUSH, "abandon", path, a=nbytes)
         finally:
             metrics.observe("shuffle.push.admit_wait", admit_wait_ms,
                             counters=self.counters)

@@ -41,6 +41,7 @@ import time
 from tez_tpu.common import epoch as epoch_registry
 from tez_tpu.common import metrics
 from tez_tpu.common.epoch import EpochFencedError
+from tez_tpu.obs import flight as _flight
 from tez_tpu.ops.runformat import FileRun, KVBatch, Run, save_run_partitioned
 
 DEVICE, HOST, DISK = "device", "host", "disk"
@@ -263,6 +264,8 @@ class ShuffleBufferStore:
             self._account(entry, +1)
             self._bump("store.published", counters)
             self._publish_gauges()
+        _flight.record(_flight.STORE, f"publish.{tier}", tenant,
+                       a=int(run.nbytes), b=spill_id)
         with metrics.timer("store.publish"):
             self._enforce_watermarks(counters)
 
@@ -301,6 +304,8 @@ class ShuffleBufferStore:
                 for k in list(entry.keys):
                     self._unlink_locked(k, entry)
                 self._bump("store.evictions.disk", counters)
+                _flight.record(_flight.STORE, "evict.disk", tenant,
+                               a=int(entry.run.nbytes))
             self._publish_gauges()
 
     @staticmethod
@@ -446,6 +451,8 @@ class ShuffleBufferStore:
             self._account(entry, +1)
             self._bump("store.demotions.device_to_host", counters)
             self._bump("store.evictions.device", counters)
+            _flight.record(_flight.STORE, "demote.device_to_host",
+                           entry.tenant, a=int(entry.run.nbytes))
 
     def _demote_host_entry(self, key: Tuple[str, int], entry: StoreEntry,
                            counters: Any) -> None:
@@ -480,6 +487,8 @@ class ShuffleBufferStore:
             self._account(entry, +1)
             self._bump("store.demotions.host_to_disk", counters)
             self._bump("store.evictions.host", counters)
+        _flight.record(_flight.STORE, "demote.host_to_disk", entry.tenant,
+                       a=int(frun.nbytes))
 
     def _evict_disk_locked(self, counters: Any) -> None:
         target = self.disk_capacity * self.low
@@ -491,6 +500,7 @@ class ShuffleBufferStore:
             for k in list(entry.keys):
                 self._unlink_locked(k, entry)
             self._bump("store.evictions.disk", counters)
+            _flight.record(_flight.STORE, "evict.disk", entry.tenant)
 
     def relieve_device_pressure(self, nbytes: int,
                                 counters: Any = None) -> int:

@@ -18,6 +18,13 @@ CI as ``make bench-diff OLD=... NEW=...``.  A 0.0 value is the bench's
 both runs carry the device pipeline's ``stage_ms`` breakdown the
 per-stage deltas are printed too (informational: stage attribution shifts
 between backends; the gate is the end-to-end value).
+
+``--armed-overhead FRAC`` switches to the flight-recorder overhead gate:
+OLD is a disarmed run, NEW the identical run with
+``tez.obs.flight.enabled``, and any shared metric more than FRAC worse
+(slower for s/ms-unit records, lower for throughputs) fails the diff —
+CI uses 0.03 to hold the recorder to its 3% tier-1 budget
+(docs/doctor.md).
 """
 from __future__ import annotations
 
@@ -88,8 +95,14 @@ def _stage_diff(old: Dict, new: Dict) -> List[str]:
     return lines
 
 
+#: units where LOWER is better (wall/latency records, e.g. a tier-1 suite
+#: wall measured armed vs disarmed); everything else is a throughput
+LOWER_IS_BETTER_UNITS = frozenset({"s", "sec", "seconds", "ms"})
+
+
 def diff(old_path: str, new_path: str,
-         threshold: float = DEFAULT_THRESHOLD) -> int:
+         threshold: float = DEFAULT_THRESHOLD,
+         armed_overhead: Optional[float] = None) -> int:
     old, new = load_metrics(old_path), load_metrics(new_path)
     if not old or not new:
         print(f"no metrics parsed from "
@@ -108,7 +121,19 @@ def diff(old_path: str, new_path: str,
             continue
         ratio = vb / va
         flag = ""
-        if ratio < 1.0 - threshold:
+        if armed_overhead is not None:
+            # armed-vs-disarmed gate (OLD = disarmed, NEW = armed): the
+            # flight recorder buys its always-on ring by promising a
+            # bounded cost — flag any metric that pays more than the
+            # declared overhead, in the unit's own "worse" direction
+            worse = ratio > 1.0 + armed_overhead \
+                if unit in LOWER_IS_BETTER_UNITS \
+                else ratio < 1.0 - armed_overhead
+            if worse:
+                flag = (f"  << ARMED OVERHEAD "
+                        f"(>{armed_overhead:.0%} vs disarmed)")
+                regressions += 1
+        elif ratio < 1.0 - threshold:
             flag = f"  << REGRESSION (>{threshold:.0%} drop)"
             regressions += 1
         print(f"{key:52} {va:10.2f} {vb:10.2f} {ratio:6.2f}x "
@@ -135,11 +160,13 @@ def diff(old_path: str, new_path: str,
             print(f"{key:52} vs_baseline {float(vs):.2f}x below floor "
                   f"{float(floor):.2f}x  << REGRESSION (ratio floor)")
             regressions += 1
+    bound = armed_overhead if armed_overhead is not None else threshold
+    what = "armed overhead" if armed_overhead is not None else "regression"
     if regressions:
-        print(f"\n{regressions} metric(s) regressed more than "
-              f"{threshold:.0%}")
+        print(f"\n{regressions} metric(s) over the {bound:.0%} "
+              f"{what} bound")
         return 1
-    print(f"\nno regression beyond {threshold:.0%} across "
+    print(f"\nno {what} beyond {bound:.0%} across "
           f"{len(shared)} shared metric(s)")
     return 0
 
@@ -153,8 +180,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
                     help="relative drop that counts as a regression "
                          "(default 0.20 = 20%%)")
+    ap.add_argument("--armed-overhead", type=float, default=None,
+                    metavar="FRAC",
+                    help="flight-recorder gate: OLD is a disarmed run, "
+                         "NEW the same run with tez.obs.flight.enabled; "
+                         "fail when any shared metric is worse than FRAC "
+                         "(use 0.03 for the 3%% tier-1 budget) — seconds/"
+                         "ms units gate on slowdown, throughputs on drop")
     args = ap.parse_args(argv)
-    return diff(args.old, args.new, threshold=args.threshold)
+    return diff(args.old, args.new, threshold=args.threshold,
+                armed_overhead=args.armed_overhead)
 
 
 if __name__ == "__main__":

@@ -30,7 +30,7 @@ import shutil
 import sys
 import tempfile
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from tez_tpu.client.dag_client import DAGStatusState
 from tez_tpu.client.tez_client import TezClient
@@ -353,7 +353,10 @@ class ChaosTenantEmitProcessor(SimpleProcessor):
 #: because they fire before the DAG exists to carry a conf).
 TENANT_STORM_MENU = (
     "task.run:fail:n=1,exc=runtime",
-    "task.run:delay:ms=250,n=1",
+    # the delay entry pairs a task-level stall with a device-plane
+    # dispatch delay: whichever engine runs the attempt, the round gets a
+    # genuine straggler for tools/doctor.py to name in its waterfall
+    "task.run:delay:ms=250,n=1;device.dispatch.delay:delay:ms=250,n=2",
     "shuffle.fetch.read:fail:n=1,exc=io",
 )
 
@@ -469,6 +472,12 @@ def run_tenant_storm(seed: int, workdir: str, timeout: float = 120.0,
         "tez.runtime.store.quota.host-mb": 8,
         "tez.runtime.store.quota.disk-mb": 8,
         "tez.runtime.store.lineage.reuse": True,
+        # declarative SLO targets (obs/slo.py): the forced am.admit.shed
+        # faults must surface as a typed shed-rate breach in GET /slo,
+        # the history journal, and (when --dump-flight armed the
+        # recorder) the flight dump — the doctor acceptance path
+        "tez.am.slo.shed-rate": 0.01,
+        "tez.am.slo.min-count": 2,
     }
     # admission faults are process-wide: they fire in the AM's submit path
     # and queue consumer, before any DAG-scoped rules exist.  fail:n=2
@@ -1199,6 +1208,18 @@ def _export_trace(path: str) -> None:
     tracing.clear_all()
 
 
+def _flight_dump_scenario(tag: str, seed: Any, ok: bool) -> None:
+    """--dump-flight: one snapshot per scenario so tools/doctor.py always
+    has flight data; a failed scenario announces its attached artifact."""
+    from tez_tpu.obs import flight
+    if not flight.armed():
+        return
+    path = flight.plane().dump(
+        f"{tag}.seed{seed}.{'ok' if ok else 'FAIL'}")
+    if path is not None and not ok:
+        print(f"flight: snapshot attached -> {path}")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """Every chaos scenario runs under the runtime lock-order witness
     (tez.debug.lockorder plane): nested lock acquisitions recorded during
@@ -1312,7 +1333,23 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                     help="arm the tracing plane (tez.trace.enabled) on the "
                          "storm DAGs and write a Perfetto trace_event JSON "
                          "of the recorded spans to PATH")
+    ap.add_argument("--dump-flight", action="store_true",
+                    help="arm the flight recorder process-wide for the run "
+                         "and dump snapshots into the workdir: auto-dumps "
+                         "on every shed/breaker/watchdog trigger plus one "
+                         "end-of-scenario snapshot, so every failed "
+                         "scenario keeps a flight artifact and "
+                         "tools/doctor.py can attribute the run (the "
+                         "workdir is kept, never cleaned up)")
     args = ap.parse_args(argv)
+    if args.dump_flight:
+        # artifacts must survive the run: pin a kept workdir before any
+        # branch computes its own throwaway tempdir
+        if args.workdir is None:
+            args.workdir = tempfile.mkdtemp(prefix="tez-chaos-")
+            print(f"flight: workdir {args.workdir} (kept)")
+        from tez_tpu.obs import flight
+        flight.install("chaos", dump_dir=args.workdir, max_dumps=32)
 
     device_scenarios = [
         (args.device_ooo, "device-ooo", run_device_ooo),
@@ -1330,6 +1367,7 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                 ok, detail = fn(seed)
                 print(("ok   " if ok else "FAIL ") +
                       f"{tag} seed={seed}: {detail}")
+                _flight_dump_scenario(tag, seed, ok)
                 if not ok:
                     failures += 1
                     print(f"REPRO: python -m tez_tpu.tools.chaos --{tag} "
@@ -1345,6 +1383,7 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                                                 timeout=args.timeout)
                 print(("ok   " if ok else "FAIL ") +
                       f"store-pressure seed={seed}: {detail}")
+                _flight_dump_scenario("store-pressure", seed, ok)
                 if not ok:
                     failures += 1
                     print(f"REPRO: python -m tez_tpu.tools.chaos "
@@ -1364,6 +1403,7 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                                               p95_bound_s=args.p95_bound)
                 print(("ok   " if ok else "FAIL ") +
                       f"tenant-storm seed={seed}: {detail}")
+                _flight_dump_scenario("tenant-storm", seed, ok)
                 if not ok:
                     failures += 1
                     print(f"REPRO: python -m tez_tpu.tools.chaos "
@@ -1380,6 +1420,7 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                                             timeout=args.timeout)
                 print(("ok   " if ok else "FAIL ") +
                       f"push-storm seed={seed}: {detail}")
+                _flight_dump_scenario("push-storm", seed, ok)
                 if not ok:
                     failures += 1
                     print(f"REPRO: python -m tez_tpu.tools.chaos "
@@ -1398,6 +1439,7 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
             if cleanup:
                 shutil.rmtree(workdir, ignore_errors=True)
         print(("ok   " if ok else "FAIL ") + f"commit-storm: {detail}")
+        _flight_dump_scenario("commit-storm", args.seed, ok)
         if not ok:
             print("REPRO: python -m tez_tpu.tools.chaos --commit-storm")
         return 0 if ok else 1
@@ -1415,6 +1457,7 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                                          trace=bool(args.trace_out))
             tag = "ok  " if ok else "FAIL"
             print(f"{tag} seed={seed} storm=[{spec}] {detail}")
+            _flight_dump_scenario("storm", seed, ok)
             if not ok:
                 failures += 1
                 print(f"REPRO: python -m tez_tpu.tools.chaos --seed {seed}")

@@ -92,6 +92,14 @@ EXCHANGE_PRESSURE_COUNTERS = MESH_EXCHANGE_PRESSURE_COUNTERS
 EXCHANGE_HISTS = ("mesh.exchange.round",)
 
 
+#: Observability plane (obs/flight.py, am/admission.py).  Queue wait is
+#: admission pressure — growth means submissions parked longer before
+#: promotion; flight-dump wall is the recorder's own cost, which must
+#: stay negligible (a dump storm in B that A never paid shows up here
+#: before it shows up anywhere else).
+OBS_HISTS = ("am.admit.queue_wait", "obs.flight.dump")
+
+
 def tenant_summary(dags: Dict) -> Dict[str, Dict]:
     """Per-tenant admission/latency roll-up over a whole session history:
     {tenant: {submitted, completed, failed, queued, shed, p50_s, p95_s}}.
@@ -354,6 +362,15 @@ def main() -> int:
                 print(f"{name:32} {ms_a:14.1f} {ms_b:14.1f} "
                       f"{ms_b - ms_a:+12.1f}{flag}")
                 regressions += int(regressed)
+    obs = diff_device_stages(a.counters, b.counters, names=OBS_HISTS)
+    if obs:
+        print(f"\n{'observability (wall ms)':32} "
+              f"{'A':>14} {'B':>14} {'delta':>12}")
+        for name, ms_a, ms_b, regressed in obs:
+            flag = "  << REGRESSION" if regressed else ""
+            print(f"{name:32} {ms_a:14.1f} {ms_b:14.1f} "
+                  f"{ms_b - ms_a:+12.1f}{flag}")
+            regressions += int(regressed)
     tenants = diff_tenants(*sessions)
     if any(t != "<anon>" or s.get("queued") or s.get("shed")
            for t, sa, sb, _ in tenants for s in (sa, sb) if s):

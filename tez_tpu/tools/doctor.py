@@ -1,0 +1,454 @@
+"""graft doctor: cross-plane causal triage for one DAG.
+
+Joins the three observability planes this repo grew in PRs 3-15 —
+history journals (wall-clock truth for DAG/vertex/attempt lifecycles and
+admission verdicts), flight-recorder dumps (typed cross-plane events +
+every histogram observation, on the shared monotonic clock of
+``common/clock.py``), and optionally an exported span buffer — into one
+per-DAG timeline, then answers the question a pager wants answered:
+*which plane ate the wall clock?*
+
+Attribution is a **plane-priority timeline sweep**, not a sum of
+per-plane busy time: the DAG's submit→finish window is cut at every
+interval boundary, and each elementary segment is blamed on the
+highest-priority plane active in it (admission > exchange > device >
+store > transport > compute; anything uncovered is ``control``).
+Because the segments partition the window, per-plane percentages sum to
+exactly 100% of the DAG wall clock — overlap-heavy pipelines (the whole
+point of the async planes) never double-count.
+
+Report sections:
+
+- **waterfall** — time-ordered merged segments with bars, the wall-clock
+  shape of the run;
+- **plane blame** — per-plane % + seconds;
+- **split** — queue-wait vs compute vs transport, the three-way summary
+  the SLO watchdogs alarm on;
+- **stragglers** — top-3 attempts by slowdown vs their vertex median
+  (an injected ``device.dispatch.delay`` surfaces here by name);
+- **slo breaches** — TENANT_SLO_BREACH journal events joined with the
+  flight ring's ``slo.breach.*`` records.
+
+CLI (also ``make doctor``):
+  python -m tez_tpu.tools.doctor WORKDIR [--dag ID] [--json]
+                                 [--perfetto out.json]
+
+WORKDIR is scanned recursively for ``*.jsonl`` journals and
+``flight_*.json`` dumps (exactly what ``chaos.py --dump-flight`` leaves
+behind).  See docs/doctor.md.
+"""
+from __future__ import annotations
+
+import argparse
+import glob as globlib
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# planes in blame-priority order; "control" is the uncovered residual
+PLANES = ("admission", "exchange", "device", "store", "transport",
+          "compute", "control")
+
+#: histogram-name prefix -> plane (first match wins; None = not blamed,
+#: e.g. the flight recorder's own dump timer)
+PREFIX_PLANE: Tuple[Tuple[str, Optional[str]], ...] = (
+    ("am.admit.queue_wait", "admission"),
+    ("am.heartbeat", None),
+    ("obs.", None),
+    ("mesh.", "exchange"),
+    ("device.", "device"),
+    ("store.", "store"),
+    ("spill.", "store"),
+    ("commit.", "store"),
+    ("shuffle.merge", "compute"),
+    ("shuffle.", "transport"),
+)
+
+#: span cat -> plane, for flight SPAN edges (cat rides in the scope slot)
+SPAN_CAT_PLANE = {"fetch": "transport", "shuffle": "transport",
+                  "task": "compute", "attempt": "compute",
+                  "vertex": "compute", "commit": "store",
+                  "admission": "admission"}
+
+
+def plane_for_name(name: str) -> Optional[str]:
+    for prefix, plane in PREFIX_PLANE:
+        if name.startswith(prefix):
+            return plane
+    return None
+
+
+# --------------------------------------------------------------------------
+# Artifact discovery
+# --------------------------------------------------------------------------
+
+def find_artifacts(paths: List[str]) -> Tuple[List[str], List[str]]:
+    """(journal files, flight dumps) under the given files/directories."""
+    journals: List[str] = []
+    dumps: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            journals.extend(sorted(globlib.glob(
+                os.path.join(p, "**", "*.jsonl"), recursive=True)))
+            dumps.extend(sorted(globlib.glob(
+                os.path.join(p, "**", "flight_*.json"), recursive=True)))
+        elif os.path.basename(p).startswith("flight_"):
+            dumps.append(p)
+        else:
+            journals.append(p)
+    return journals, dumps
+
+
+def load_flight_dumps(paths: List[str]) -> List[Any]:
+    from tez_tpu.obs import flight
+    snaps = []
+    for p in paths:
+        try:
+            snaps.append(flight.load_dump(p))
+        except (OSError, ValueError, KeyError) as e:
+            print(f"doctor: skipping unreadable dump {p}: {e}",
+                  file=sys.stderr)
+    return snaps
+
+
+def load_slo_breaches(journal_files: List[str]) -> List[Dict[str, Any]]:
+    """TENANT_SLO_BREACH events straight off the journal lines (DagInfo
+    aggregation drops session-scoped events we want verbatim)."""
+    from tez_tpu.am.recovery import decode_journal_line
+    out: List[Dict[str, Any]] = []
+    for path in journal_files:
+        try:
+            with open(path) as fh:
+                lines = fh.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = decode_journal_line(line)
+            except Exception:  # noqa: BLE001 — torn tail lines etc.
+                continue
+            if ev.event_type.name == "TENANT_SLO_BREACH":
+                out.append(dict(ev.data, time=ev.timestamp))
+    return out
+
+
+# --------------------------------------------------------------------------
+# Interval extraction
+# --------------------------------------------------------------------------
+
+def intervals_from_history(dag: Any) -> List[Tuple[float, float, str, str]]:
+    """(start, end, plane, label) intervals from the DagInfo."""
+    out: List[Tuple[float, float, str, str]] = []
+    if dag.submit_time and dag.start_time > dag.submit_time:
+        out.append((dag.submit_time, dag.start_time, "admission",
+                    "admission:queue-wait"))
+    for a in dag.all_attempts():
+        if a.start_time and a.finish_time > a.start_time:
+            out.append((a.start_time, a.finish_time, "compute",
+                        f"attempt:{a.attempt_id}"))
+    return out
+
+
+def intervals_from_flight(snaps: List[Any]
+                          ) -> List[Tuple[float, float, str, str]]:
+    """(start, end, plane, label) intervals from flight snapshots: every
+    COUNTER observation becomes a busy interval ending at its record
+    time; SPAN edges map through their cat."""
+    from tez_tpu.common import clock
+    from tez_tpu.obs import flight as fl
+    out: List[Tuple[float, float, str, str]] = []
+    for snap in snaps:
+        anchor = snap.anchor
+        for e in snap.events:
+            if e.kind == fl.COUNTER:
+                plane = plane_for_name(e.name)
+                if plane is None or e.a <= 0:
+                    continue
+                end = clock.mono_to_wall(e.t_ns, anchor)
+                out.append((end - e.a / 1e6, end, plane, e.name))
+            elif e.kind == fl.SPAN:
+                plane = SPAN_CAT_PLANE.get(e.scope)
+                if plane is None or e.b <= 0:
+                    continue
+                start = clock.mono_to_wall(e.a, anchor)
+                out.append((start, start + e.b / 1e9, plane, e.name))
+    return out
+
+
+# --------------------------------------------------------------------------
+# The sweep
+# --------------------------------------------------------------------------
+
+def blame_sweep(t0: float, t1: float,
+                intervals: List[Tuple[float, float, str, str]]
+                ) -> List[Tuple[float, float, str]]:
+    """Partition [t0, t1] into (start, end, plane) segments, each blamed
+    on the highest-priority plane active in it; uncovered time is
+    ``control``.  Segments partition the window exactly, so per-plane
+    sums always add up to the full wall clock."""
+    rank = {p: i for i, p in enumerate(PLANES)}
+    clipped = []
+    for s, e, plane, _label in intervals:
+        s, e = max(s, t0), min(e, t1)
+        if e > s:
+            clipped.append((s, e, plane))
+    cuts = sorted({t0, t1, *(s for s, _, _ in clipped),
+                   *(e for _, e, _ in clipped)})
+    segments: List[Tuple[float, float, str]] = []
+    for lo, hi in zip(cuts, cuts[1:]):
+        mid = (lo + hi) / 2.0
+        active = [p for s, e, p in clipped if s <= mid < e]
+        plane = min(active, key=lambda p: rank[p]) if active else "control"
+        if segments and segments[-1][2] == plane:
+            segments[-1] = (segments[-1][0], hi, plane)
+        else:
+            segments.append((lo, hi, plane))
+    return segments
+
+
+def vertex_fleet_medians(dags: Dict[str, Any]) -> Dict[str, float]:
+    """Median attempt duration per vertex NAME across every parsed DAG.
+    Recurring DAGs (the multi-tenant session shape) share vertex names,
+    so this is the cross-run baseline a single-task vertex lacks."""
+    by_name: Dict[str, List[float]] = {}
+    for dag in dags.values():
+        for v in dag.vertices.values():
+            for t in v.tasks.values():
+                for a in t.attempts.values():
+                    if a.duration > 0:
+                        by_name.setdefault(v.name, []).append(a.duration)
+    return {n: sorted(ds)[len(ds) // 2] for n, ds in by_name.items()}
+
+
+def straggler_attempts(dag: Any, top: int = 3,
+                       fleet: Optional[Dict[str, float]] = None
+                       ) -> List[Dict[str, Any]]:
+    """Top attempts by slowdown vs their vertex's median duration.  A
+    vertex with fewer than 3 timed attempts has no in-DAG median worth
+    trusting (with 1-2 attempts the slow one IS the median), so the
+    fleet-wide per-vertex median stands in when available."""
+    rows: List[Dict[str, Any]] = []
+    for v in dag.vertices.values():
+        durs = sorted(a.duration for t in v.tasks.values()
+                      for a in t.attempts.values() if a.duration > 0)
+        if not durs:
+            continue
+        median = durs[len(durs) // 2]
+        if len(durs) < 3 and fleet and fleet.get(v.name):
+            median = fleet[v.name]
+        for t in v.tasks.values():
+            for a in t.attempts.values():
+                if a.duration <= 0:
+                    continue
+                rows.append({
+                    "attempt_id": a.attempt_id, "vertex": v.name,
+                    "duration_s": round(a.duration, 4),
+                    "vertex_median_s": round(median, 4),
+                    "slowdown": round(a.duration / max(median, 1e-9), 2),
+                })
+    rows.sort(key=lambda r: (-r["slowdown"], -r["duration_s"]))
+    return rows[:top]
+
+
+# --------------------------------------------------------------------------
+# Report
+# --------------------------------------------------------------------------
+
+def diagnose(dag: Any, snaps: List[Any],
+             slo_breaches: List[Dict[str, Any]],
+             fleet: Optional[Dict[str, float]] = None) -> Dict[str, Any]:
+    t0 = dag.submit_time or dag.start_time
+    t1 = dag.finish_time
+    intervals = intervals_from_history(dag) + intervals_from_flight(snaps)
+    if not t1:
+        t1 = max((e for _, e, _, _ in intervals), default=t0)
+    wall = max(0.0, t1 - t0)
+    if wall <= 0:
+        return {"dag_id": dag.dag_id, "error": "no wall-clock window "
+                "(missing submit/finish times)"}
+    segments = blame_sweep(t0, t1, intervals)
+    plane_s = {p: 0.0 for p in PLANES}
+    for s, e, p in segments:
+        plane_s[p] += e - s
+    planes = {p: {"seconds": round(sec, 4),
+                  "pct": round(100.0 * sec / wall, 2)}
+              for p, sec in plane_s.items()}
+    # three-way summary: queue-wait vs compute vs transport.  "transport"
+    # pools everything that moves or parks bytes between compute steps.
+    q = plane_s["admission"]
+    comp = plane_s["compute"] + plane_s["device"]
+    trans = plane_s["exchange"] + plane_s["store"] + plane_s["transport"]
+    three = max(q + comp + trans, 1e-9)
+    stragglers = straggler_attempts(dag, fleet=fleet)
+    blamed = max(((p, s) for p, s in plane_s.items() if p != "control"),
+                 key=lambda ps: ps[1], default=("control", 0.0))
+    verdict = (f"{blamed[0]} dominates instrumented time "
+               f"({planes[blamed[0]]['pct']}% of wall)")
+    if stragglers and stragglers[0]["slowdown"] >= 2.0:
+        verdict += (f"; straggler {stragglers[0]['attempt_id']} ran "
+                    f"{stragglers[0]['slowdown']}x its vertex median")
+    if slo_breaches:
+        verdict += f"; {len(slo_breaches)} SLO breach(es) on record"
+    return {
+        "dag_id": dag.dag_id, "name": dag.name, "tenant": dag.tenant,
+        "state": dag.state, "wall_s": round(wall, 4),
+        "window": [t0, t1],
+        "planes": planes,
+        "pct_total": round(sum(v["pct"] for v in planes.values()), 2),
+        "split": {
+            "queue_wait_pct": round(100.0 * q / three, 2),
+            "compute_pct": round(100.0 * comp / three, 2),
+            "transport_pct": round(100.0 * trans / three, 2),
+        },
+        "waterfall": [{"offset_s": round(s - t0, 4),
+                       "dur_s": round(e - s, 4), "plane": p}
+                      for s, e, p in segments],
+        "stragglers": stragglers,
+        "slo_breaches": slo_breaches,
+        "verdict": verdict,
+        "sources": {
+            "flight_dumps": len(snaps),
+            "flight_events": sum(len(s.events) for s in snaps),
+            "intervals": len(intervals),
+        },
+    }
+
+
+def _bar(frac: float, width: int = 28) -> str:
+    n = int(round(frac * width))
+    return "█" * n + "░" * (width - n)
+
+
+def render_text(rep: Dict[str, Any]) -> str:
+    if "error" in rep:
+        return f"doctor: dag {rep['dag_id']}: {rep['error']}"
+    L: List[str] = []
+    L.append(f"== graft doctor: {rep['dag_id']} "
+             f"({rep['name'] or 'unnamed'}, tenant={rep['tenant'] or '-'}, "
+             f"{rep['state'] or '?'}) ==")
+    L.append(f"wall clock: {rep['wall_s']:.3f} s   "
+             f"[{rep['sources']['flight_dumps']} flight dump(s), "
+             f"{rep['sources']['flight_events']} events, "
+             f"{rep['sources']['intervals']} intervals]")
+    L.append("")
+    L.append(f"plane blame (priority sweep, sums to {rep['pct_total']}%):")
+    for p in PLANES:
+        v = rep["planes"][p]
+        L.append(f"  {p:<10} {_bar(v['pct'] / 100.0)} "
+                 f"{v['pct']:6.2f}%  {v['seconds']:.3f} s")
+    s = rep["split"]
+    L.append("")
+    L.append(f"queue-wait / compute / transport: "
+             f"{s['queue_wait_pct']}% / {s['compute_pct']}% / "
+             f"{s['transport_pct']}%")
+    L.append("")
+    L.append("waterfall:")
+    for seg in rep["waterfall"]:
+        frac = seg["dur_s"] / max(rep["wall_s"], 1e-9)
+        L.append(f"  +{seg['offset_s']:8.3f}s  {seg['plane']:<10} "
+                 f"{_bar(frac, 20)} {seg['dur_s']:.3f} s")
+    if rep["stragglers"]:
+        L.append("")
+        L.append("top straggler attempts:")
+        for r in rep["stragglers"]:
+            L.append(f"  {r['attempt_id']} (vertex {r['vertex']}): "
+                     f"{r['duration_s']:.3f} s vs median "
+                     f"{r['vertex_median_s']:.3f} s  "
+                     f"({r['slowdown']}x)")
+    if rep["slo_breaches"]:
+        L.append("")
+        L.append("slo breaches:")
+        for b in rep["slo_breaches"]:
+            L.append(f"  tenant={b.get('tenant', '?')} "
+                     f"{b.get('kind', '?')} observed="
+                     f"{b.get('observed', '?')} target="
+                     f"{b.get('target', '?')}")
+    L.append("")
+    L.append(f"verdict: {rep['verdict']}")
+    return "\n".join(L)
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+def _triage_pick(dags: Dict[str, Any]) -> str:
+    """Auto-triage default: a failed DAG if any (most recent first), then
+    the DAG with the worst intra-vertex straggler skew (one attempt far
+    over its siblings' median — the shape injected faults leave), then
+    the longest submit→finish wall."""
+    failed = [d for d in dags
+              if dags[d].state not in ("", "SUCCEEDED", None)]
+    if failed:
+        return sorted(failed,
+                      key=lambda d: dags[d].finish_time or 0.0)[-1]
+
+    fleet = vertex_fleet_medians(dags)
+
+    def skew_then_wall(d: str) -> Tuple[float, float]:
+        info = dags[d]
+        worst = straggler_attempts(info, top=1, fleet=fleet)
+        skew = worst[0]["slowdown"] if worst else 0.0
+        t0 = info.submit_time or info.start_time or 0.0
+        wall = max(0.0, (info.finish_time or 0.0) - t0)
+        # uniform DAGs all sit near 1.0x: treat skew under 2x as noise so
+        # the fallback stays "slowest wall", not "noisiest median"
+        return (skew if skew >= 2.0 else 0.0, wall)
+    return sorted(dags, key=skew_then_wall)[-1]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Cross-plane causal triage: blame waterfall from "
+                    "history journals + flight dumps (see docs/doctor.md)")
+    ap.add_argument("paths", nargs="+",
+                    help="workdirs and/or journal / flight_*.json files")
+    ap.add_argument("--dag", default="",
+                    help="dag_id to diagnose (default auto-triage: a "
+                         "failed DAG if any, else the slowest wall clock)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of text")
+    ap.add_argument("--perfetto", default="",
+                    help="also write a merged Perfetto trace (history "
+                         "lanes + flight per-plane tracks) to this path")
+    args = ap.parse_args(argv)
+
+    journals, dump_files = find_artifacts(args.paths)
+    if not journals:
+        print("doctor: no *.jsonl journals found", file=sys.stderr)
+        return 1
+    from tez_tpu.tools.history_parser import parse_jsonl_files
+    dags = parse_jsonl_files(journals)
+    if not dags:
+        print("doctor: journals contained no DAGs", file=sys.stderr)
+        return 1
+    dag_id = args.dag or _triage_pick(dags)
+    if dag_id not in dags:
+        print(f"doctor: dag {dag_id} not in {sorted(dags)}",
+              file=sys.stderr)
+        return 1
+    dag = dags[dag_id]
+    snaps = load_flight_dumps(dump_files)
+    breaches = load_slo_breaches(journals)
+
+    rep = diagnose(dag, snaps, breaches,
+                   fleet=vertex_fleet_medians(dags))
+    if args.perfetto:
+        from tez_tpu.tools import trace_export
+        events = trace_export.history_to_events(dag)
+        for snap in snaps:
+            events.extend(trace_export.flight_to_events(snap))
+        trace_export.write_trace(
+            {"traceEvents": events, "displayTimeUnit": "ms"},
+            args.perfetto)
+        rep["perfetto"] = args.perfetto
+    print(json.dumps(rep, indent=1) if args.json else render_text(rep))
+    return 0 if "error" not in rep else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

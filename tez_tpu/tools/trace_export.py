@@ -118,11 +118,89 @@ def history_to_events(dag: "Any") -> List[Dict[str, Any]]:
                        "tid": lane(a.container_id or a.node_id or "task"),
                        "args": {"vertex": a.vertex_name, "state": a.state,
                                 "node": a.node_id}})
+    # admission plane (post-PR-11): the queue-wait window between submit
+    # and start, plus the session's QUEUED/SHED verdict stream — without
+    # this lane a parked DAG's wait was silently absent from the export
+    if dag.submit_time and dag.start_time > dag.submit_time:
+        events.append({"name": "admission:queue-wait", "cat": "admission",
+                       "ph": "X", "ts": _us(dag.submit_time),
+                       "dur": max(1, _us(dag.start_time) -
+                                  _us(dag.submit_time)),
+                       "pid": _PID, "tid": lane("admission"),
+                       "args": {"dag_id": dag.dag_id,
+                                "tenant": dag.tenant}})
+    for ev in dag.admission_events:
+        t = ev.get("time", 0.0)
+        if not t:
+            continue
+        events.append({"name": f"admission:{ev.get('event', '?')}",
+                       "cat": "admission", "ph": "i", "s": "t",
+                       "ts": _us(t), "pid": _PID, "tid": lane("admission"),
+                       "args": {k: v for k, v in ev.items() if k != "time"}})
     return events
 
 
 def history_to_trace(dag: "Any") -> Dict[str, Any]:
     return {"traceEvents": history_to_events(dag), "displayTimeUnit": "ms"}
+
+
+# --------------------------------------------------------------------------
+# Flight-recorder tracks (planes with no span coverage: store, push,
+# exchange, admission verdicts, breaker/watchdog, SLO)
+# --------------------------------------------------------------------------
+
+def flight_to_events(snap: "Any") -> List[Dict[str, Any]]:
+    """FlightSnapshot -> trace_event dicts, one lane per plane.
+
+    Span edges re-render as complete events (useful when the dump is the
+    only artifact — no live span buffer post-mortem); every histogram
+    observation becomes a complete event on a per-name counter lane (the
+    store publish/fetch/demote, push rtt, exchange round, and admission
+    queue-wait tracks); typed plane events render as instants on their
+    plane's lane.  Timestamps project onto the wall clock through the
+    anchor embedded in the snapshot, so these tracks line up with
+    history/span tracks from the same process."""
+    from tez_tpu.common import clock
+    from tez_tpu.obs import flight as fl
+    events: List[Dict[str, Any]] = []
+    lanes: Dict[int, str] = {}
+
+    def lane(name: str) -> int:
+        tid = _tid(name)
+        if tid not in lanes:
+            lanes[tid] = name
+            events.append({"name": "thread_name", "ph": "M", "pid": _PID,
+                           "tid": tid, "args": {"name": name}})
+        return tid
+
+    anchor = snap.anchor
+    for e in snap.events:
+        wall = clock.mono_to_wall(e.t_ns, anchor)
+        if e.kind == fl.SPAN:
+            start = clock.mono_to_wall(e.a, anchor)
+            events.append({"name": e.name, "cat": e.scope or "span",
+                           "ph": "X", "ts": _us(start),
+                           "dur": max(1, e.b // 1000), "pid": _PID,
+                           "tid": lane(f"flight:span:{e.scope or 'span'}"),
+                           "args": {"seq": e.seq}})
+        elif e.kind == fl.COUNTER:
+            dur = max(1, e.a)          # a = observed microseconds
+            events.append({"name": e.name, "cat": "counter", "ph": "X",
+                           "ts": _us(wall) - dur, "dur": dur, "pid": _PID,
+                           "tid": lane(f"flight:counter:{e.name}"),
+                           "args": {"seq": e.seq, "observed_us": e.a}})
+        else:
+            events.append({"name": e.name or e.kind_name,
+                           "cat": e.kind_name, "ph": "i", "s": "t",
+                           "ts": _us(wall), "pid": _PID,
+                           "tid": lane(f"flight:{e.kind_name}"),
+                           "args": {"seq": e.seq, "scope": e.scope,
+                                    "a": e.a, "b": e.b}})
+    return events
+
+
+def flight_to_trace(snap: "Any") -> Dict[str, Any]:
+    return {"traceEvents": flight_to_events(snap), "displayTimeUnit": "ms"}
 
 
 def write_trace(trace: Dict[str, Any], path: str) -> str:
@@ -216,13 +294,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--live", action="store_true",
                     help="export the in-process span buffer instead of "
                          "history files")
+    ap.add_argument("--flight", nargs="*", default=[], metavar="DUMP",
+                    help="flight_*.json dumps whose per-plane tracks "
+                         "(store/push/exchange/admission/breaker) are "
+                         "merged into the export")
     args = ap.parse_args(argv)
     if args.live:
         from tez_tpu.common import tracing
         trace = spans_to_trace(tracing.snapshot())
-    else:
-        if not args.journals:
-            ap.error("either journal files or --live required")
+    elif args.journals:
         from tez_tpu.tools.history_parser import parse_jsonl_files
         dags = parse_jsonl_files(args.journals)
         if not dags:
@@ -233,6 +313,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"dag {dag_id} not in {sorted(dags)}", file=sys.stderr)
             return 1
         trace = history_to_trace(dags[dag_id])
+    elif args.flight:
+        trace = {"traceEvents": [], "displayTimeUnit": "ms"}
+    else:
+        ap.error("journal files, --flight dumps, or --live required")
+    if args.flight:
+        from tez_tpu.obs import flight as fl
+        for path in args.flight:
+            trace["traceEvents"].extend(
+                flight_to_events(fl.load_dump(path)))
     write_trace(trace, args.out)
     print(f"wrote {len(trace['traceEvents'])} events to {args.out}")
     return 0

@@ -6,7 +6,7 @@ PY ?= python
 OLD ?= BENCH_r05.json
 NEW ?= /tmp/bench_new.json
 
-.PHONY: test lint bench bench-new bench-diff bench-merge bench-store bench-sort bench-exchange chaos chaos-device-ooo chaos-device chaos-merge chaos-store chaos-push chaos-exchange soak docs doctor
+.PHONY: test lint bench bench-new bench-diff bench-merge bench-store bench-sort bench-exchange chaos chaos-device-ooo chaos-device chaos-merge chaos-store chaos-push chaos-exchange chaos-ha soak docs doctor
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -74,6 +74,13 @@ chaos-store:
 # bit-exact vs a fault-free pull-only baseline
 chaos-push:
 	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --push-storm --trials 3
+
+# AM crash survival: SIGKILL the session AM with one DAG mid-run and two
+# parked in the admission queue, reattach, replay — every DAG bit-exact,
+# parked losses typed, zombies fenced; plus the coded push-replica
+# failover leg (store.replica.lost, zero producer re-execution)
+chaos-ha:
+	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --am-kill --trials 3
 
 # multi-tenant session soak: one resident session AM under barrier-synced
 # recurring DAGs from 3 tenants, forced am.admit.shed / am.queue.delay

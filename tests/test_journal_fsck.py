@@ -117,3 +117,188 @@ def test_fsck_real_am_journal(tmp_staging):
     assert report.dags[str(dag_id)].inferred_terminal == "SUCCEEDED"
     assert journal_fsck.main(["--staging", tmp_staging,
                               "--app", "app_1_fsck"]) == 0
+
+
+# ---------------------------------------------------- admission-queue pairing
+
+def _mini_plan_hex(name="qd"):
+    v = Vertex.create("v", ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor",
+        payload={"sleep_ms": 1}), 1)
+    return DAG.create(name).add_vertex(v).create_dag_plan().serialize().hex()
+
+
+def _queued(sub_id, plan_hex, name="qd"):
+    return HistoryEvent(HistoryEventType.DAG_QUEUED, dag_id=sub_id,
+                        data={"dag_name": name, "tenant": "tA",
+                              "plan": plan_hex})
+
+
+def _requeued(sub_id, plan_hex, name="qd"):
+    return HistoryEvent(HistoryEventType.DAG_REQUEUED_ON_RECOVERY,
+                        dag_id=sub_id,
+                        data={"dag_name": name, "tenant": "tA",
+                              "plan": plan_hex, "attempt": 2})
+
+
+def _promoted(sub_id, dag_id="dag_1_q_1"):
+    return HistoryEvent(HistoryEventType.DAG_SUBMITTED, dag_id=dag_id,
+                        data={"dag_name": "qd", "sub_id": sub_id})
+
+
+def test_fsck_admission_clean_pair_and_unresolved(tmp_path):
+    hexp = _mini_plan_hex()
+    # queued -> promoted: clean, and the sub never materializes a DAG ledger
+    p = _write_journal(str(tmp_path / "j.jsonl"), [
+        _queued("app-sub1", hexp), _promoted("app-sub1"),
+        HistoryEvent(HistoryEventType.DAG_FINISHED, dag_id="dag_1_q_1",
+                     data={"state": "SUCCEEDED"})])
+    report = journal_fsck.fsck_files([p])
+    assert report.ok, report.errors
+    assert report.subs["app-sub1"].inferred == "PROMOTED"
+    assert "app-sub1" not in report.dags     # sub_id is not a DAG
+    # queued with no promotion: legal — that's exactly the replay case
+    p2 = _write_journal(str(tmp_path / "j2.jsonl"),
+                        [_queued("app-sub2", hexp)])
+    report = journal_fsck.fsck_files([p2])
+    assert report.ok
+    assert "UNRESOLVED" in report.subs["app-sub2"].inferred
+
+
+def test_fsck_admission_requeue_threads_across_attempts(tmp_path):
+    """Attempt 1 queues, attempt 2 requeues and promotes: one ledger."""
+    hexp = _mini_plan_hex()
+    rec = tmp_path / "recovery"
+    _write_journal(str(rec / "1" / "journal.jsonl"),
+                   [_queued("app-sub1", hexp)])
+    _write_journal(str(rec / "2" / "journal.jsonl"), [
+        _requeued("app-sub1", hexp), _promoted("app-sub1")])
+    files = journal_fsck.discover_journals(str(rec))
+    report = journal_fsck.fsck_files(files)
+    assert report.ok, report.errors
+    led = report.subs["app-sub1"]
+    assert led.queued == 1 and led.requeued == 1 and led.promoted
+
+
+def test_fsck_admission_pairing_violations(tmp_path):
+    hexp = _mini_plan_hex()
+    # duplicate DAG_QUEUED for one sub_id
+    p = _write_journal(str(tmp_path / "a.jsonl"),
+                       [_queued("s1", hexp), _queued("s1", hexp)])
+    report = journal_fsck.fsck_files([p])
+    assert any("duplicate DAG_QUEUED" in e for e in report.errors)
+    # requeue for a submission never queued
+    p = _write_journal(str(tmp_path / "b.jsonl"), [_requeued("s2", hexp)])
+    report = journal_fsck.fsck_files([p])
+    assert any("never DAG_QUEUED" in e for e in report.errors)
+    # DAG_QUEUED arriving after a requeue: attempt order violated
+    p = _write_journal(str(tmp_path / "c.jsonl"),
+                       [_queued("s3", hexp), _requeued("s3", hexp),
+                        _queued("s3", hexp)])
+    report = journal_fsck.fsck_files([p])
+    assert any("attempt order violated" in e for e in report.errors)
+    # queue record after its promotion
+    p = _write_journal(str(tmp_path / "d.jsonl"),
+                       [_queued("s4", hexp), _promoted("s4"),
+                        _requeued("s4", hexp)])
+    report = journal_fsck.fsck_files([p])
+    assert any("after its promotion" in e for e in report.errors)
+    # promotion of a sub_id the journal never queued
+    p = _write_journal(str(tmp_path / "e.jsonl"), [_promoted("ghost")])
+    report = journal_fsck.fsck_files([p])
+    assert any("never DAG_QUEUED" in e for e in report.errors)
+    # duplicate promotion
+    p = _write_journal(str(tmp_path / "f.jsonl"),
+                       [_queued("s5", hexp), _promoted("s5"),
+                        _promoted("s5", dag_id="dag_1_q_2")])
+    report = journal_fsck.fsck_files([p])
+    assert any("duplicate promotion" in e for e in report.errors)
+    # queue record with no sub_id at all
+    p = _write_journal(str(tmp_path / "g.jsonl"), [
+        HistoryEvent(HistoryEventType.DAG_QUEUED, dag_id=None,
+                     data={"dag_name": "x"})])
+    report = journal_fsck.fsck_files([p])
+    assert any("without a sub_id" in e for e in report.errors)
+
+
+def test_fsck_admission_undecodable_plan(tmp_path):
+    # unresolved + undecodable: lost work, an error
+    p = _write_journal(str(tmp_path / "u.jsonl"), [
+        HistoryEvent(HistoryEventType.DAG_QUEUED, dag_id="s6",
+                     data={"dag_name": "broken", "plan": "deadbeef"})])
+    report = journal_fsck.fsck_files([p])
+    assert any("replay impossible" in e for e in report.errors)
+    assert "LOST" in report.subs["s6"].inferred
+    assert journal_fsck.main([p]) == 1
+    # promoted + undecodable: the live object made it through — warning only
+    p = _write_journal(str(tmp_path / "v.jsonl"), [
+        HistoryEvent(HistoryEventType.DAG_QUEUED, dag_id="s7",
+                     data={"dag_name": "odd", "plan": "deadbeef"}),
+        _promoted("s7")])
+    report = journal_fsck.fsck_files([p])
+    assert report.ok
+    assert any("promoted anyway" in w for w in report.warnings)
+
+
+def test_fsck_real_crashed_session_journal(tmp_staging):
+    """A journal pair written by a real crash + replay passes fsck CLEAN
+    with the parked submission reported PROMOTED."""
+    import threading
+    import time as _time
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging,
+                               "tez.am.max.app.attempts": 3,
+                               "tez.am.local.num-containers": 2,
+                               "tez.am.session.max-concurrent-dags": 1,
+                               "tez.am.session.queue-size": 4})
+    am1 = DAGAppMaster("app_1_fsckha", conf, attempt=1)
+    am1.start()
+    hold = Vertex.create("v", ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor",
+        payload={"sleep_ms": 20_000}), 1)
+    first = am1.submit_dag(
+        DAG.create("hold").add_vertex(hold).create_dag_plan())
+    quick = Vertex.create("v", ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor",
+        payload={"sleep_ms": 1}), 1)
+    parked_plan = DAG.create("parked").add_vertex(quick).create_dag_plan()
+    t = threading.Thread(target=lambda: _try_submit(am1, parked_plan),
+                         daemon=True)
+    t.start()
+    deadline = _time.time() + 20
+    while not am1.logging_service.of_type(HistoryEventType.DAG_QUEUED):
+        assert _time.time() < deadline, "submission never journaled"
+        _time.sleep(0.02)
+    am1.crash()
+    t.join(timeout=10)
+
+    am2 = DAGAppMaster("app_1_fsckha", conf, attempt=2)
+    am2.start()
+    recovered = am2.recover_and_resume()
+    am2.kill_dag(recovered)
+    am2.wait_for_dag(recovered, timeout=30)
+    deadline = _time.time() + 30
+    while am2.find_dag_id_by_name("parked") is None:
+        assert _time.time() < deadline, "parked DAG never promoted"
+        _time.sleep(0.05)
+    dag_id = am2.find_dag_id_by_name("parked")
+    assert am2.wait_for_dag(dag_id, timeout=30) is DAGState.SUCCEEDED
+    am2.stop()
+
+    files = journal_fsck.discover_journals(
+        os.path.join(tmp_staging, "app_1_fsckha", "recovery"))
+    assert len(files) == 2
+    report = journal_fsck.fsck_files(files)
+    assert report.ok, report.errors
+    [sub_id] = report.sub_order
+    led = report.subs[sub_id]
+    assert led.queued == 1 and led.requeued == 1 and led.promoted
+    assert led.inferred == "PROMOTED"
+    assert journal_fsck.main(["--staging", tmp_staging,
+                              "--app", "app_1_fsckha"]) == 0
+
+
+def _try_submit(am, plan):
+    try:
+        am.submit_dag(plan)
+    except Exception:   # noqa: BLE001 — AMCrashedError expected on crash
+        pass

@@ -90,7 +90,7 @@ class _StubAM:
     def history(self, ev):
         self.events.append(ev)
 
-    def _start_dag(self, plan, recovery_data, tenant):
+    def _start_dag(self, plan, recovery_data, tenant, sub_id=None):
         if self.start_exc is not None:
             raise self.start_exc
         return f"dag_{next(self._seq)}"

@@ -1,0 +1,353 @@
+"""AM crash survival (docs/recovery.md): admission-queue replay across
+incarnations, client re-attach, zombie fencing, and coded push replicas.
+
+The contract under test: a SIGKILLed session AM loses NOTHING that was
+journaled — parked submissions replay under the successor incarnation with
+their original sub_id/tenant/arrival order, live handles re-bind, stale
+heartbeats are fenced, and a pushed spill whose primary store copy dies is
+reconstructed from its coded buddy without re-running the producer."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tez_tpu.am.app_master import DAGAppMaster
+from tez_tpu.am.dag_impl import DAGState
+from tez_tpu.am.history import HistoryEvent, HistoryEventType
+from tez_tpu.client.dag_client import DAGStatusState
+from tez_tpu.client.errors import AMCrashedError, DAGLostError
+from tez_tpu.client.tez_client import TezClient
+from tez_tpu.common import config as C
+from tez_tpu.common import faults
+from tez_tpu.common.payload import ProcessorDescriptor
+from tez_tpu.dag.dag import DAG, Vertex
+
+
+def _plan(name, sleep_ms=1, tasks=2):
+    v = Vertex.create("v", ProcessorDescriptor.create(
+        "tez_tpu.library.processors:SleepProcessor",
+        payload={"sleep_ms": sleep_ms}), tasks)
+    return DAG.create(name).add_vertex(v).create_dag_plan()
+
+
+def _session_conf(tmp_staging, **extra):
+    base = {"tez.staging-dir": tmp_staging,
+            "tez.am.local.num-containers": 2,
+            "tez.am.max.app.attempts": 3,
+            "tez.am.session.max-concurrent-dags": 1,
+            "tez.am.session.queue-size": 4}
+    base.update(extra)
+    return C.TezConfiguration(base)
+
+
+def _park(am, plan, errors, crashed):
+    """Submit on a thread; the parked submitter must observe a typed
+    AMCrashedError when the AM dies under it."""
+
+    def run():
+        try:
+            am.submit_dag(plan)
+            errors.append(f"{plan.name}: promoted instead of crashed")
+        except AMCrashedError as e:
+            crashed.append(e)
+        except BaseException as e:  # noqa: BLE001 — typed verdicts only
+            errors.append(f"{plan.name}: {type(e).__name__}: {e}")
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def _wait_journaled(am, n, timeout=20.0):
+    deadline = time.time() + timeout
+    while len(am.logging_service.of_type(HistoryEventType.DAG_QUEUED)) < n:
+        if time.time() > deadline:
+            pytest.fail(f"{n} DAG_QUEUED records never journaled")
+        time.sleep(0.02)
+
+
+def _wait_name(am, name, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        dag_id = am.find_dag_id_by_name(name)
+        if dag_id is not None:
+            return dag_id
+        time.sleep(0.05)
+    pytest.fail(f"DAG {name} never promoted on the successor AM")
+
+
+def test_crash_replays_parked_admission_queue(tmp_staging):
+    """Parked submissions die with AMCrashedError; the successor incarnation
+    rebuilds the queue from unresolved DAG_QUEUED records — original sub_id,
+    tenant, and arrival order — under DAG_REQUEUED_ON_RECOVERY events."""
+    conf = _session_conf(tmp_staging)
+    am1 = DAGAppMaster("app_1_qrep", conf, attempt=1)
+    am1.start()
+    am1.submit_dag(_plan("qa", sleep_ms=20_000))   # holds the only slot
+    errors, crashed = [], []
+    t_b = _park(am1, _plan("qb"), errors, crashed)
+    t_c = _park(am1, _plan("qc"), errors, crashed)
+    _wait_journaled(am1, 2)
+    am1.crash()
+    t_b.join(timeout=10)
+    t_c.join(timeout=10)
+    assert not errors, errors
+    assert len(crashed) == 2
+    queued_ids = [e.dag_id for e in
+                  am1.logging_service.of_type(HistoryEventType.DAG_QUEUED)]
+
+    am2 = DAGAppMaster("app_1_qrep", conf, attempt=2)
+    am2.start()
+    recovered = am2.recover_and_resume()
+    assert recovered is not None          # the mid-run qa resubmitted
+    requeued = am2.logging_service.of_type(
+        HistoryEventType.DAG_REQUEUED_ON_RECOVERY)
+    # original sub_ids, original arrival order, replay attempt stamped
+    assert [e.dag_id for e in requeued] == queued_ids
+    assert all(e.data["attempt"] == 2 for e in requeued)
+    assert [e.data["dag_name"] for e in requeued] == ["qb", "qc"]
+    # qa still sleeps 20s; kill it to free the slot, then the replayed
+    # queue drains in order
+    am2.kill_dag(recovered)
+    assert am2.wait_for_dag(recovered, timeout=30) is DAGState.KILLED
+    for name in ("qb", "qc"):
+        dag_id = _wait_name(am2, name)
+        assert am2.wait_for_dag(dag_id, timeout=30) is DAGState.SUCCEEDED
+    # promotions resolved the replayed records: a third incarnation would
+    # have nothing left to replay
+    from tez_tpu.am.recovery import RecoveryParser
+    parser = RecoveryParser(tmp_staging, "app_1_qrep")
+    assert parser.queued_submissions() == []
+    am2.stop()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_popped_but_unstarted_submission_replays(tmp_staging):
+    """The am.queue.delay window: the consumer pops a submission and dies
+    before _start_dag.  Its DAG_QUEUED record is the only surviving trace —
+    the successor incarnation must still replay it."""
+    conf = _session_conf(tmp_staging)
+    am1 = DAGAppMaster("app_1_qpop", conf, attempt=1)
+    am1.start()
+    first = am1.submit_dag(_plan("pa", sleep_ms=20_000))
+    errors, crashed = [], []
+    t_b = _park(am1, _plan("pb"), errors, crashed)
+    _wait_journaled(am1, 1)
+    faults.install("t", faults.parse_spec("am.queue.delay:fail:n=1"), seed=1)
+    try:
+        # freeing the slot makes the consumer pop pb — and die mid-drain
+        am1.kill_dag(first)
+        assert am1.wait_for_dag(first, timeout=30) is DAGState.KILLED
+        deadline = time.time() + 10
+        while am1.admission.consumer_alive():
+            if time.time() > deadline:
+                pytest.fail("consumer survived the am.queue.delay fault")
+            time.sleep(0.02)
+        # popped-but-unstarted: still visible as unresolved
+        assert len(am1.admission.unresolved()) == 1
+        am1.crash()
+    finally:
+        faults.clear_all()
+    t_b.join(timeout=10)
+    assert not errors, errors
+    assert len(crashed) == 1
+
+    am2 = DAGAppMaster("app_1_qpop", conf, attempt=2)
+    am2.start()
+    am2.recover_and_resume()
+    requeued = am2.logging_service.of_type(
+        HistoryEventType.DAG_REQUEUED_ON_RECOVERY)
+    assert [e.data["dag_name"] for e in requeued] == ["pb"]
+    assert requeued[0].dag_id == crashed[0].sub_id   # original sub_id kept
+    dag_id = _wait_name(am2, "pb")
+    assert am2.wait_for_dag(dag_id, timeout=30) is DAGState.SUCCEEDED
+    am2.stop()
+
+
+def test_client_reattach_rebinds_live_handles(tmp_staging):
+    """TezClient.reattach(): the successor AM replays the journal, live
+    DAGClient handles re-bind by dag_id (finished DAGs included — their
+    journaled verdict survives the restart), and attach_dag recovers the
+    handle for a submission whose submitter observed AMCrashedError."""
+    c = TezClient.create("ha", {"tez.staging-dir": tmp_staging,
+                                "tez.am.local.num-containers": 2,
+                                "tez.am.max.app.attempts": 3,
+                                "tez.am.session.max-concurrent-dags": 1,
+                                "tez.am.session.queue-size": 4},
+                         session=True).start()
+    try:
+        done = c.submit_dag(DAG.create("done").add_vertex(Vertex.create(
+            "v", ProcessorDescriptor.create(
+                "tez_tpu.library.processors:SleepProcessor",
+                payload={"sleep_ms": 1}), 2)))
+        assert done.wait_for_completion(
+            timeout=30).state is DAGStatusState.SUCCEEDED
+        live = c.submit_dag(DAG.create("live").add_vertex(Vertex.create(
+            "v", ProcessorDescriptor.create(
+                "tez_tpu.library.processors:SleepProcessor",
+                payload={"sleep_ms": 3000}), 2)))
+        errors, crashed = [], []
+        am1 = c.framework_client.am
+        t = _park(am1, _plan("parked"), errors, crashed)
+        _wait_journaled(am1, 1)
+        am1.crash()
+        t.join(timeout=10)
+        assert not errors and len(crashed) == 1
+
+        c.reattach()
+        am2 = c.framework_client.am
+        assert am2 is not am1 and am2.attempt == am1.attempt + 1
+        # the mid-run handle re-bound transparently: same object, new AM
+        assert live._am is am2
+        assert live.wait_for_completion(
+            timeout=60).state is DAGStatusState.SUCCEEDED
+        # the finished handle answers from the rolled-forward registry
+        assert done.get_dag_status().state is DAGStatusState.SUCCEEDED
+        # the parked submission replays; attach_dag recovers its handle
+        parked = c.attach_dag("parked", timeout=30)
+        assert parked.wait_for_completion(
+            timeout=30).state is DAGStatusState.SUCCEEDED
+        # a name the journal never saw is typed lost, not a timeout
+        with pytest.raises(DAGLostError):
+            c.attach_dag("never-submitted", timeout=5)
+    finally:
+        c.stop()
+
+
+def test_zombie_fence_counts_journals_and_flight_marks(tmp_staging):
+    """A heartbeat stamped with the dead incarnation's epoch is ordered to
+    die, counted, journaled as ATTEMPT_FENCED, and visible in the flight
+    recorder (the chaos --am-kill acceptance surface)."""
+    from tez_tpu.am.task_comm import HeartbeatRequest
+    from tez_tpu.common.ids import DAGId
+    from tez_tpu.obs import flight
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging,
+                               "tez.am.max.app.attempts": 3})
+    flight.install("t")
+    am1 = DAGAppMaster("app_1_zfen", conf, attempt=1)
+    am2 = DAGAppMaster("app_1_zfen", conf, attempt=2)   # supersedes am1
+    try:
+        am2.start()
+        zombie = DAGId("app_1_zfen", 1).vertex(0).task(0).attempt(0)
+        resp = am2.task_comm.heartbeat(
+            HeartbeatRequest(zombie, [], epoch=1))
+        assert resp.should_die
+        assert am2.task_comm.fenced_count == 1
+        fenced = am2.logging_service.of_type(HistoryEventType.ATTEMPT_FENCED)
+        assert len(fenced) == 1
+        assert fenced[0].data["msg_epoch"] == 1
+        assert fenced[0].data["am_epoch"] == 2
+        marks = [e for e in flight.snapshot().events
+                 if e.name == "fence.stale_epoch"]
+        assert marks, "fence left no flight-recorder mark"
+    finally:
+        am2.stop()
+        am1.stop()
+        flight.clear_all()
+
+
+def test_queued_plan_roundtrip_across_process_boundary(tmp_staging):
+    """The journaled DAG_QUEUED plan must replay in a FRESH interpreter —
+    the successor AM is a different process in production.  A subprocess
+    parses the journal with RecoveryParser and deserializes the plan; the
+    round-tripped bytes must match bit-exact."""
+    conf = C.TezConfiguration({"tez.staging-dir": tmp_staging,
+                               "tez.am.max.app.attempts": 3})
+    plan = _plan("xproc", tasks=3)
+    am1 = DAGAppMaster("app_1_xproc", conf, attempt=1)
+    am1.start()
+    am1.history(HistoryEvent(
+        HistoryEventType.DAG_QUEUED, dag_id="app_1_xproc-sub1",
+        data={"dag_name": plan.name, "tenant": "tA",
+              "plan": plan.serialize().hex()}))
+    am1.crash()
+
+    script = (
+        "import json, sys\n"
+        "from tez_tpu.am.recovery import RecoveryParser\n"
+        "from tez_tpu.dag.plan import DAGPlan\n"
+        "staging, app_id = sys.argv[1], sys.argv[2]\n"
+        "recs = RecoveryParser(staging, app_id).queued_submissions()\n"
+        "[rec] = recs\n"
+        "plan = DAGPlan.deserialize(bytes.fromhex(rec['plan']))\n"
+        "print(json.dumps({\n"
+        "    'sub_id': rec['sub_id'], 'tenant': rec['tenant'],\n"
+        "    'decode_error': rec['decode_error'], 'name': plan.name,\n"
+        "    'vertices': [v.name for v in plan.vertices],\n"
+        "    'num_tasks': [v.parallelism for v in plan.vertices],\n"
+        "    'reserialized': plan.serialize().hex()}))\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", script, tmp_staging, "app_1_xproc"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["sub_id"] == "app_1_xproc-sub1"
+    assert out["tenant"] == "tA"
+    assert out["decode_error"] == ""
+    assert out["name"] == "xproc"
+    assert out["vertices"] == ["v"] and out["num_tasks"] == [3]
+    assert out["reserialized"] == plan.serialize().hex()
+
+
+def test_push_replica_failover_serves_without_producer(tmp_path):
+    """replicas=2 lands a coded buddy copy; when the primary store entry
+    and the producer's registration both die (store.replica.lost), the
+    fetch chain reconstructs from the replica and accounts the failover."""
+    from tez_tpu.common.counters import TezCounters
+    from tez_tpu.ops.sorter import DeviceSorter
+    from tez_tpu.shuffle.push import PushAdmissionController
+    from tez_tpu.shuffle.service import ShuffleDataNotFound, ShuffleService
+    from tez_tpu.store.buffer_store import ShuffleBufferStore
+
+    def make_service(subdir):
+        service = ShuffleService()
+        store = ShuffleBufferStore(device_capacity=0, host_capacity=8 << 20,
+                                   disk_dir=str(tmp_path / subdir))
+        service.attach_buffer_store(store)
+        service.attach_push_admission(PushAdmissionController(
+            lambda: store, source_quota_bytes=4 << 20))
+        return service
+
+    sorter = DeviceSorter(num_partitions=3)
+    for i in range(60):
+        sorter.write(f"k{i:04d}".encode(), f"v{i}".encode())
+    run = sorter.flush()
+
+    service = make_service("repl")
+    counters = TezCounters()
+    service.register("dagHA/a_1/c", 0, run, use_store=False)
+    service.push_publish("dagHA/a_1/c", 0, run, replicas=2, counters=counters)
+    group = counters.to_dict().get("ShuffleStore", {})
+    assert group.get("store.replica.bytes", 0) >= run.nbytes
+
+    faults.install("t", faults.parse_spec("store.replica.lost:fail:n=1"),
+                   seed=1)
+    try:
+        got = service.fetch_partition("dagHA/a_1/c", 0, 1, counters=counters)
+    finally:
+        faults.clear_all()
+    assert list(got.iter_pairs()) == list(run.partition(1).iter_pairs())
+    group = counters.to_dict().get("ShuffleStore", {})
+    assert group.get("store.replica.failover", 0) == 1
+
+    # contrast: without the replica the same loss is fatal — the replica
+    # is what stands between a dead store and a producer re-run
+    bare = make_service("bare")
+    bare.register("dagHA/a_1/c", 0, run, use_store=False)
+    bare.push_publish("dagHA/a_1/c", 0, run)   # replicas=1 (default)
+    faults.install("t", faults.parse_spec("store.replica.lost:fail:n=1"),
+                   seed=1)
+    try:
+        with pytest.raises(ShuffleDataNotFound):
+            bare.fetch_partition("dagHA/a_1/c", 0, 1)
+    finally:
+        faults.clear_all()

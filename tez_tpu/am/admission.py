@@ -158,10 +158,28 @@ class AdmissionController:
         # QUEUE: journal the parked plan FIRST (summary event, fsync'd) so
         # a consumer crash cannot silently drop it, then block.
         from tez_tpu.am.history import HistoryEvent, HistoryEventType
-        self._am.history(HistoryEvent(
-            HistoryEventType.DAG_QUEUED, dag_id=sub.sub_id,
-            data={"dag_name": plan.name, "tenant": tenant,
-                  "plan": plan.serialize().hex()}))
+        try:
+            self._am.history(HistoryEvent(
+                HistoryEventType.DAG_QUEUED, dag_id=sub.sub_id,
+                data={"dag_name": plan.name, "tenant": tenant,
+                      "plan": plan.serialize().hex()}))
+        except BaseException as e:
+            # un-journaled = not accepted: the lossless ledger only covers
+            # records that landed, so pull the park back out and surface a
+            # typed verdict — AMCrashedError when the AM died under the
+            # append (its journal fd closes mid-write), else the original
+            with self._lock:
+                try:
+                    self._queue.remove(sub)
+                    ts.queued -= 1
+                    ts.accepted -= 1
+                except ValueError:
+                    pass     # consumer already popped it; it may promote
+            if self._stopped:
+                from tez_tpu.client.errors import AMCrashedError
+                raise AMCrashedError(sub.sub_id,
+                                     dag_name=plan.name) from e
+            raise
         log.info("dag %s (tenant=%s): QUEUED as %s behind %d running",
                  plan.name, tenant or "<anon>", sub.sub_id, self._running)
         sub.done.wait()
@@ -250,7 +268,8 @@ class AdmissionController:
             faults.fire("am.queue.delay", sub.sub_id)
             try:
                 sub.dag_id = self._am._start_dag(
-                    sub.plan, sub.recovery_data, sub.tenant)
+                    sub.plan, sub.recovery_data, sub.tenant,
+                    sub_id=sub.sub_id)
             except BaseException as e:  # noqa: BLE001 — fail loudly, not drop
                 log.exception("queued dag %s failed to start", sub.sub_id)
                 sub.error = e
@@ -261,6 +280,66 @@ class AdmissionController:
             metrics.observe("am.admit.queue_wait",
                             (time.monotonic() - sub.enqueued_at) * 1000.0)
             self._slo_tick()
+            sub.done.set()
+
+    # -- crash recovery -------------------------------------------------------
+    def requeue(self, plan: Any, tenant: str, sub_id: str) -> None:
+        """Re-park a journaled-but-unpromoted submission from a dead AM
+        incarnation (docs/recovery.md).  Non-blocking — the original
+        submitter is gone; it re-attaches and waits by dag name.  Keeps
+        the ORIGINAL sub_id so the journal's queued/promoted pairing spans
+        incarnations, and journals a ``DAG_REQUEUED_ON_RECOVERY`` record
+        (plan included) so a second crash replays from THIS record."""
+        tenant = str(tenant or "")
+        sub = _QueuedSubmission(
+            sub_id=sub_id, plan=plan, tenant=tenant, recovery_data=None,
+            enqueued_at=time.monotonic())
+        with self._lock:
+            # future fresh submissions must never collide with a replayed
+            # sub_id: advance the sequence past the replayed number
+            tail = sub_id.rsplit("-sub", 1)
+            if len(tail) == 2 and tail[1].isdigit():
+                nxt = next(self._sub_seq)
+                if nxt <= int(tail[1]):
+                    self._sub_seq = itertools.count(int(tail[1]) + 1)
+                else:
+                    self._sub_seq = itertools.count(nxt)
+            ts = self._tenants.setdefault(tenant, _TenantStats())
+            ts.accepted += 1
+            ts.queued += 1
+            self._queue.append(sub)
+            self._cond.notify_all()
+            _flight.record(_flight.ADMIT, "requeue", tenant,
+                           a=len(self._queue), b=self._running)
+            self._publish_gauges_locked()
+        from tez_tpu.am.history import HistoryEvent, HistoryEventType
+        self._am.history(HistoryEvent(
+            HistoryEventType.DAG_REQUEUED_ON_RECOVERY, dag_id=sub_id,
+            data={"dag_name": plan.name, "tenant": tenant,
+                  "plan": plan.serialize().hex(),
+                  "attempt": getattr(self._am, "attempt", 0)}))
+        log.info("dag %s (tenant=%s): REQUEUED on recovery as %s",
+                 plan.name, tenant or "<anon>", sub_id)
+
+    def crash(self) -> None:
+        """SIGKILL analog for tests/chaos: abandon the queue WITHOUT the
+        graceful resolution ``stop()`` performs.  Parked submitters get a
+        typed :class:`~tez_tpu.client.errors.AMCrashedError` (their
+        ``DAG_QUEUED`` records stay unresolved in the journal — the
+        successor incarnation replays them); nothing terminal is
+        journaled."""
+        from tez_tpu.client.errors import AMCrashedError
+        with self._lock:
+            self._stopped = True
+            parked = list(self._queue)
+            if self._draining is not None and \
+                    not self._draining.done.is_set():
+                parked.append(self._draining)
+            self._queue.clear()
+            self._cond.notify_all()
+        for sub in parked:
+            sub.error = AMCrashedError(
+                sub.sub_id, dag_name=getattr(sub.plan, "name", ""))
             sub.done.set()
 
     # -- AM lifecycle hooks ---------------------------------------------------
@@ -320,6 +399,16 @@ class AdmissionController:
             if self._draining is not None and \
                     not self._draining.done.is_set():
                 out.append(self._draining.sub_id)
+            return out
+
+    def queued_names(self) -> List[str]:
+        """DAG names parked in the queue, arrival order (client re-attach
+        probes these before declaring a DAG lost)."""
+        with self._lock:
+            out = [getattr(s.plan, "name", "") for s in self._queue]
+            if self._draining is not None and \
+                    not self._draining.done.is_set():
+                out.append(getattr(self._draining.plan, "name", ""))
             return out
 
     def status(self) -> Dict[str, Any]:

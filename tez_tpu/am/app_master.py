@@ -127,6 +127,11 @@ class DAGAppMaster:
         self.retired_dags: Dict[str, DAGImpl] = {}
         self.completed_dags: Dict[str, DAGState] = {}
         self.completed_dag_names: Dict[str, str] = {}
+        #: dag name -> latest dag_id that ran under it (client re-attach
+        #: resolves recovered DAGs by name — dag ids are AM-assigned and a
+        #: successor incarnation reassigns them deterministically, but the
+        #: NAME is the client-stable handle; docs/recovery.md)
+        self.dag_ids_by_name: Dict[str, str] = {}
         self._dag_seq = 0
         self._dag_done = threading.Condition()
         from tez_tpu.obs import slo as _slo
@@ -161,6 +166,23 @@ class DAGAppMaster:
         if dag is None and include_retired:
             dag = self.retired_dags.get(str(dag_id))
         return dag
+
+    def find_dag_id_by_name(self, name: str) -> Optional[str]:
+        """Latest dag_id that ran (or finished) under `name`; falls back to
+        the completed-name registry so recovery roll-forwards — which never
+        re-instantiate a DAGImpl — are still re-attachable."""
+        dag_id = self.dag_ids_by_name.get(name)
+        if dag_id is not None:
+            return dag_id
+        for did, dag_name in self.completed_dag_names.items():
+            if dag_name == name:
+                dag_id = did    # latest wins (insertion order)
+        return dag_id
+
+    def queued_dag_names(self) -> List[str]:
+        """Names currently parked in the admission queue (re-attach probes
+        these before declaring a DAG lost)."""
+        return self.admission.queued_names()
 
     def _retire_dag_locked(self, dag: DAGImpl) -> None:
         self.live_dags.pop(str(dag.dag_id), None)
@@ -208,6 +230,48 @@ class DAGAppMaster:
             self.recovery_service.stop()
         self.logging_service.stop()
         self._started = False
+
+    def crash(self) -> None:
+        """SIGKILL analog (tests/chaos): die WITHOUT the graceful niceties.
+
+        Unlike stop(), nothing terminal is journaled, queued submissions are
+        abandoned with AMCrashedError instead of resolved, and no deletion
+        tracking runs — live DAGs' journals stay exactly as the crash left
+        them, which is what recover_and_resume on the successor incarnation
+        (attempt+1) is built to consume.  The `am.crash` fault point fires
+        first so chaos specs can widen the kill window deterministically."""
+        from tez_tpu.common import faults
+        try:
+            faults.fire("am.crash", detail=f"attempt={self.attempt}")
+        except BaseException:  # noqa: BLE001 — a fail rule still crashes us
+            pass
+        if self.web_ui is not None:
+            self.web_ui.stop()
+        self.thread_dumper.stop()
+        self.heartbeat_monitor.stop()
+        # abandon — not resolve — the admission queue: parked submitters
+        # get AMCrashedError and must re-attach; their DAG_QUEUED records
+        # stay unresolved in the journal, which is the replay contract
+        self.admission.crash()
+        for dag in list(self.live_dags.values()):
+            speculator = getattr(dag, "speculator", None)
+            if speculator is not None:   # thread hygiene, not graceful state
+                speculator.stop()
+        self.task_scheduler.shutdown()
+        self.runner_pool.shutdown()
+        if self.umbilical_server is not None:
+            self.umbilical_server.stop()
+        self.dispatcher.stop()
+        self.executor.shutdown(wait=False)
+        # the in-process close flushes buffered journal lines — a superset
+        # of what a real SIGKILL leaves; recovery only ever depends on the
+        # fsync'd summary prefix, so the extra tail is harmless
+        if self.recovery_service is not None:
+            self.recovery_service.stop()
+        self.logging_service.stop()
+        self._started = False
+        log.warning("AM %s attempt %d: CRASHED (simulated SIGKILL)",
+                    self.app_id, self.attempt)
 
     def _register_handlers(self) -> None:
         from tez_tpu.am.events import (DAGEventType, LauncherEventType,
@@ -410,7 +474,7 @@ class DAGAppMaster:
         return self.admission.submit(plan, recovery_data)
 
     def _start_dag(self, plan: DAGPlan, recovery_data: Any,
-                   tenant: str) -> DAGId:
+                   tenant: str, sub_id: str = "") -> DAGId:
         """Instantiate + start an admitted DAG (AdmissionController only)."""
         with self._dag_done:
             self._dag_seq += 1
@@ -418,15 +482,23 @@ class DAGAppMaster:
         plan_hex = plan.serialize().hex()
         # per-DAG logging switch must be known before the first dag event
         self.history_handler.set_dag_conf(dag_id, plan.dag_conf)
+        submit_data = {"dag_name": plan.name, "tenant": tenant,
+                       "plan": plan_hex}
+        if sub_id:
+            # resolves the DAG_QUEUED / DAG_REQUEUED_ON_RECOVERY admission
+            # record: the journal now proves this submission was promoted,
+            # so a successor AM must NOT requeue it (and journal_fsck can
+            # pair the records like commit-ledger brackets)
+            submit_data["sub_id"] = sub_id
         self.history(HistoryEvent(
             HistoryEventType.DAG_SUBMITTED, dag_id=str(dag_id),
-            data={"dag_name": plan.name, "tenant": tenant,
-                  "plan": plan_hex}))
+            data=submit_data))
         dag = DAGImpl(dag_id, plan, self, recovery_data=recovery_data)
         dag.tenant = tenant
         dag.submit_monotonic = time.monotonic()
         with self._dag_done:
             self.live_dags[str(dag_id)] = dag
+            self.dag_ids_by_name[plan.name] = str(dag_id)
         # DAG-scoped knob: per-DAG conf overrides the AM conf
         if dag.conf.get(C.GENERATE_DEBUG_ARTIFACTS):
             # reference: the AM writes the expanded dag plan text into
@@ -524,12 +596,50 @@ class DAGAppMaster:
         (RecoveryParser.parseRecoveryData:658 semantics; if the restored
         output data died with the runner, the fetch-failure -> producer-rerun
         path recovers, as it does in the reference on node loss).
+
+        A session AM may die with SEVERAL DAGs live plus a parked admission
+        queue; every journaled DAG is recovered in submit order and every
+        unresolved DAG_QUEUED record is re-parked (admission replay,
+        docs/recovery.md).  Returns the last recovered dag_id — the
+        single-DAG surface older callers expect.
         """
         from tez_tpu.am.recovery import RecoveryParser
         parser = RecoveryParser(self.conf.get(C.STAGING_DIR), self.app_id)
-        data = parser.parse()
-        if data is None or data.dag_state is not None:
-            return None   # nothing in flight
+        last: Optional[DAGId] = None
+        for data in parser.parse_all():
+            if data.dag_state is not None:
+                # finished before the crash: nothing to re-run, but two
+                # things must survive into this incarnation.  First the id
+                # sequence — a replayed queued submission must never be
+                # assigned a dead DAG's id, or its journal records alias.
+                try:
+                    seq = int(data.dag_id.rsplit("_", 1)[1])
+                    self._dag_seq = max(self._dag_seq, seq)
+                except (ValueError, IndexError):
+                    pass
+                # Second the journaled verdict — a client handle re-bound
+                # by reattach() resolves against completed_dags, so
+                # DAGLostError keeps meaning "never reached a replayable
+                # state", not "finished too early"
+                try:
+                    final = DAGState[data.dag_state]
+                except KeyError:
+                    continue
+                with self._dag_done:
+                    self.completed_dags.setdefault(data.dag_id, final)
+                    if data.plan is not None:
+                        self.completed_dag_names.setdefault(
+                            data.dag_id, data.plan.name)
+                    self._dag_done.notify_all()
+                continue
+            recovered = self._recover_one(data)
+            if recovered is not None:
+                last = recovered
+        self._replay_admission_queue(parser)
+        return last
+
+    def _recover_one(self, data: Any) -> Optional[DAGId]:
+        """Recover a single journaled DAG (see recover_and_resume)."""
         try:
             seq = int(data.dag_id.rsplit("_", 1)[1])
         except (ValueError, IndexError):
@@ -541,15 +651,18 @@ class DAGAppMaster:
             self._dag_seq = max(self._dag_seq, seq)
             self._finish_recovered(
                 data.dag_id, DAGState.SUCCEEDED,
-                "commit finished before AM failure; rolled forward")
+                "commit finished before AM failure; rolled forward",
+                name=data.plan.name if data.plan is not None else "")
             return dag_id
         if data.commit_state == "ABORTED":
             log.warning("dag %s: commit had ABORTED before AM crash; "
                         "re-running aborts -> FAILED", data.dag_id)
             self._dag_seq = max(self._dag_seq, seq)
             self._abort_recovered(data)
-            self._finish_recovered(data.dag_id, DAGState.FAILED,
-                                   "commit aborted before AM failure")
+            self._finish_recovered(
+                data.dag_id, DAGState.FAILED,
+                "commit aborted before AM failure",
+                name=data.plan.name if data.plan is not None else "")
             return dag_id
         policy = str(self.conf.get(C.AM_COMMIT_RECOVERY_POLICY) or "resume")
         if data.commit_state == "STARTED" and policy == "resume" and \
@@ -567,8 +680,10 @@ class DAGAppMaster:
                     HistoryEventType.DAG_COMMIT_ABORTED, dag_id=data.dag_id,
                     data={"reason": "commit in flight during AM failure"}))
                 self._abort_recovered(data)
-            self._finish_recovered(data.dag_id, DAGState.FAILED,
-                                   "commit in flight during AM failure")
+            self._finish_recovered(
+                data.dag_id, DAGState.FAILED,
+                "commit in flight during AM failure",
+                name=data.plan.name if data.plan is not None else "")
             self._dag_seq = max(self._dag_seq, seq)
             return dag_id
         if data.plan is None:
@@ -584,8 +699,33 @@ class DAGAppMaster:
         # don't pin the whole prior journal in AM memory for the DAG lifetime
         return self.submit_dag(data.plan, recovery_data=data)
 
+    def _replay_admission_queue(self, parser: Any) -> None:
+        """Re-park every unresolved admission record from prior attempts.
+
+        The lossless-admission contract (docs/multitenancy.md) journals a
+        DAG_QUEUED record — plan included — BEFORE the submitter blocks, and
+        the `unresolved()` window covers popped-but-unstarted submissions
+        too (the am.queue.delay lever).  Here the successor incarnation
+        cashes that contract in: each unresolved record re-enters the queue
+        with its ORIGINAL sub_id, tenant, and arrival order, under a
+        DAG_REQUEUED_ON_RECOVERY event (docs/recovery.md)."""
+        if not bool(self.conf.get(C.AM_RECOVERY_QUEUE_REPLAY)):
+            return
+        for rec in parser.queued_submissions():
+            if rec.get("decode_error"):
+                # flagged, not silently dropped: journal_fsck reports the
+                # same record, and the submitter's re-attach gets DAGLost
+                log.error("queued submission %s (%s): plan undecodable, "
+                          "cannot replay: %s", rec["sub_id"],
+                          rec.get("dag_name") or "<unnamed>",
+                          rec["decode_error"])
+                continue
+            plan = DAGPlan.deserialize(bytes.fromhex(rec["plan"]))
+            self.admission.requeue(plan, rec.get("tenant") or "",
+                                   rec["sub_id"])
+
     def _finish_recovered(self, dag_id: str, final: DAGState,
-                          diagnostics: str) -> None:
+                          diagnostics: str, name: str = "") -> None:
         """Journal the terminal record for a DAG resolved during recovery
         (it never re-instantiates as a DAGImpl), run the same deletion
         tracking a normally-finished DAG gets — the crashed attempt's
@@ -594,6 +734,9 @@ class DAGAppMaster:
         self.history(HistoryEvent(
             HistoryEventType.DAG_FINISHED, dag_id=dag_id,
             data={"state": final.name, "diagnostics": diagnostics}))
+        if name:
+            with self._dag_done:
+                self.completed_dag_names[dag_id] = name
         from tez_tpu.shuffle.service import local_shuffle_service
         n = local_shuffle_service().unregister_prefix(dag_id)
         if n:
@@ -657,14 +800,16 @@ class DAGAppMaster:
                 except BaseException:  # noqa: BLE001
                     log.exception("recovery abort of %s failed", name)
             self._finish_recovered(data.dag_id, DAGState.FAILED,
-                                   f"commit resume failed: {e!r}")
+                                   f"commit resume failed: {e!r}",
+                                   name=data.plan.name)
             return dag_id
         self.history(HistoryEvent(
             HistoryEventType.DAG_COMMIT_FINISHED, dag_id=data.dag_id,
             data={"resumed": True}))
         self._finish_recovered(
             data.dag_id, DAGState.SUCCEEDED,
-            "commit resumed and rolled forward after AM restart")
+            "commit resumed and rolled forward after AM restart",
+            name=data.plan.name)
         return dag_id
 
     def dag_status(self, dag_id: DAGId) -> Dict[str, Any]:

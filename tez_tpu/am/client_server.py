@@ -23,7 +23,8 @@ log = logging.getLogger(__name__)
 
 _METHODS = frozenset({"submit_dag", "dag_status", "kill_dag", "wait_for_dag",
                       "web_ui_address", "shutdown_session", "prewarm",
-                      "queue_status"})
+                      "queue_status", "find_dag_id_by_name",
+                      "queued_dag_names"})
 
 
 class _Handler(socketserver.StreamRequestHandler):

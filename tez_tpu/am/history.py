@@ -44,6 +44,13 @@ class HistoryEventType(enum.Enum):
     # contract (docs/multitenancy.md).
     DAG_QUEUED = enum.auto()
     DAG_ADMISSION_SHED = enum.auto()
+    # AM crash survival (docs/recovery.md): REQUEUED_ON_RECOVERY marks a
+    # journaled-but-unpromoted submission re-entering the admission queue
+    # under a successor AM incarnation (it carries the plan again, so a
+    # second crash replays from IT); ATTEMPT_FENCED records a zombie task
+    # attempt from a superseded incarnation rejected at the umbilical.
+    DAG_REQUEUED_ON_RECOVERY = enum.auto()
+    ATTEMPT_FENCED = enum.auto()
     VERTEX_INITIALIZED = enum.auto()
     VERTEX_STARTED = enum.auto()
     VERTEX_CONFIGURE_DONE = enum.auto()
@@ -82,6 +89,8 @@ SUMMARY_EVENT_TYPES = frozenset({
     HistoryEventType.DAG_KILL_REQUEST,
     HistoryEventType.DAG_QUEUED,
     HistoryEventType.DAG_ADMISSION_SHED,
+    HistoryEventType.DAG_REQUEUED_ON_RECOVERY,
+    HistoryEventType.ATTEMPT_FENCED,
     HistoryEventType.TENANT_SLO_BREACH,
 })
 

@@ -303,20 +303,82 @@ class RecoveryParser:
     def parse(self) -> Optional[DAGRecoveryData]:
         """Returns recovery data for the last in-progress DAG, or None when
         there is nothing to recover (no DAG, or last DAG finished)."""
+        all_dags = self.parse_all()
+        return all_dags[-1] if all_dags else None
+
+    def parse_all(self) -> List[DAGRecoveryData]:
+        """Recovery data for EVERY submitted DAG, in submit order.  The
+        resident session AM recovers all of them: finished DAGs roll
+        forward to their terminal record, in-flight ones are resubmitted
+        (docs/recovery.md)."""
         events = self.read_events()
-        if not events:
-            return None
-        # find last submitted DAG
-        last_dag_id: Optional[str] = None
-        plan: Optional[DAGPlan] = None
+        submitted: List[str] = []               # dag ids in submit order
+        plans: Dict[str, Optional[DAGPlan]] = {}
         for ev in events:
             if ev.event_type is HistoryEventType.DAG_SUBMITTED:
-                last_dag_id = ev.dag_id
+                if ev.dag_id not in plans:
+                    submitted.append(ev.dag_id)
                 raw = ev.data.get("plan")
-                plan = DAGPlan.deserialize(bytes.fromhex(raw)) if raw else None
-        if last_dag_id is None:
-            return None
-        dag_events = [e for e in events if e.dag_id == last_dag_id]
+                plans[ev.dag_id] = \
+                    DAGPlan.deserialize(bytes.fromhex(raw)) if raw else None
+        return [self._parse_dag(dag_id, plans[dag_id], events)
+                for dag_id in submitted]
+
+    def queued_submissions(self) -> List[Dict[str, Any]]:
+        """Unresolved admission-queue records, in arrival order.
+
+        A ``DAG_QUEUED`` (or a successor attempt's
+        ``DAG_REQUEUED_ON_RECOVERY``) record is resolved by a later
+        ``DAG_SUBMITTED`` stamped with its ``sub_id`` — the promotion.  A
+        record with no promotion is a submission the dead AM accepted but
+        never started (parked in the queue OR popped by the consumer and
+        lost mid-promote — the ``am.queue.delay`` window); the successor
+        incarnation must replay it.  Each entry:
+        ``{"sub_id", "dag_name", "tenant", "plan" (hex str or None),
+        "decode_error" (str or "")}``.
+        """
+        events = self.read_events()
+        queued: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        for ev in events:
+            t = ev.event_type
+            if t in (HistoryEventType.DAG_QUEUED,
+                     HistoryEventType.DAG_REQUEUED_ON_RECOVERY):
+                sub_id = ev.dag_id
+                if sub_id not in queued:
+                    order.append(sub_id)
+                # latest record wins: a requeue carries the plan again, so
+                # a second crash replays from it
+                queued[sub_id] = {
+                    "sub_id": sub_id,
+                    "dag_name": ev.data.get("dag_name", ""),
+                    "tenant": ev.data.get("tenant", ""),
+                    "plan": ev.data.get("plan"),
+                    "decode_error": "",
+                }
+            elif t is HistoryEventType.DAG_SUBMITTED:
+                sub_id = ev.data.get("sub_id")
+                if sub_id:
+                    queued.pop(sub_id, None)
+        out = []
+        for sub_id in order:
+            rec = queued.get(sub_id)
+            if rec is None:
+                continue
+            raw = rec["plan"]
+            if raw:
+                try:
+                    DAGPlan.deserialize(bytes.fromhex(raw))
+                except Exception as e:  # noqa: BLE001 — flagged, not fatal
+                    rec["decode_error"] = repr(e)
+            else:
+                rec["decode_error"] = "queued record carries no plan"
+            out.append(rec)
+        return out
+
+    def _parse_dag(self, dag_id: str, plan: Optional[DAGPlan],
+                   events: List[HistoryEvent]) -> DAGRecoveryData:
+        dag_events = [e for e in events if e.dag_id == dag_id]
         dag_state = None
         commit_state: Optional[str] = None
         # per-vertex commits are in flight only until that vertex's
@@ -383,7 +445,7 @@ class RecoveryParser:
                 "counters": att.get("counters", {}),
             }
         return DAGRecoveryData(
-            dag_id=last_dag_id, plan=plan, dag_state=dag_state,
+            dag_id=dag_id, plan=plan, dag_state=dag_state,
             commit_in_flight=(commit_state == "STARTED"
                               or bool(pending_vertex_commits)
                               or bool(pending_group_commits))

@@ -76,6 +76,9 @@ class TaskCommunicatorManager:
         conf = getattr(ctx, "conf", None)
         self._fencing = bool(conf.get(C.AM_EPOCH_FENCING_ENABLED)) \
             if conf is not None else True
+        #: fencing rejections served by this incarnation (status surface;
+        #: counter_diff reads the journaled ATTEMPT_FENCED records instead)
+        self.fenced_count = 0
         if self.epoch > 0:
             epoch_registry.register(getattr(ctx, "app_id", ""), self.epoch)
 
@@ -91,6 +94,7 @@ class TaskCommunicatorManager:
                           am_epoch=self.epoch, detail=detail)
             log.warning("fenced stale-epoch message (epoch %d < %d): %s",
                         msg_epoch, self.epoch, detail)
+            self._record_fence("stale_sender", msg_epoch, detail)
             return True
         if epoch_registry.is_stale(app_id, self.epoch):
             faults.fire("fence.stale_epoch", detail=detail)
@@ -100,8 +104,31 @@ class TaskCommunicatorManager:
                           detail=detail)
             log.warning("AM epoch %d superseded by %d; refusing: %s",
                         self.epoch, epoch_registry.current(app_id), detail)
+            self._record_fence("superseded_am", msg_epoch, detail)
             return True
         return False
+
+    def _record_fence(self, reason: str, msg_epoch: int, detail: str) -> None:
+        """Make every fencing rejection forensically visible: a flight MARK
+        (acceptance surface for chaos --am-kill) plus an ATTEMPT_FENCED
+        journal record (counter_diff's zombie-fenced tally).  Rare by
+        construction — a zombie runner dies on its first fenced heartbeat —
+        so a journal record per rejection is cheap."""
+        self.fenced_count += 1
+        from tez_tpu.obs import flight
+        flight.record(flight.MARK, "fence.stale_epoch", detail,
+                      a=msg_epoch, b=self.epoch)
+        history = getattr(self.ctx, "history", None)
+        if history is None:
+            return
+        try:
+            from tez_tpu.am.history import HistoryEvent, HistoryEventType
+            history(HistoryEvent(
+                HistoryEventType.ATTEMPT_FENCED,
+                data={"reason": reason, "msg_epoch": msg_epoch,
+                      "am_epoch": self.epoch, "detail": detail}))
+        except Exception:  # noqa: BLE001 — forensics never block fencing
+            log.exception("ATTEMPT_FENCED journaling failed")
 
     # -- runner-facing API (called from runner threads) ----------------------
     def get_task(self, container_id: ContainerId, timeout: float = 1.0,

@@ -47,3 +47,43 @@ class DAGRejectedError(RuntimeError):
                 f"inflight={self.tenant_inflight} "
                 f"queue_depth={self.queue_depth} "
                 f"RETRY-AFTER {self.retry_after_s:.3f}s")
+
+
+class AMCrashedError(RuntimeError):
+    """The AM died with this submission accepted but not yet started.
+
+    NOT a loss: the submission's ``DAG_QUEUED`` record survives in the
+    recovery journal and a successor AM incarnation replays it
+    (docs/recovery.md).  The client should ``reattach()`` and re-bind by
+    dag name instead of resubmitting — a resubmit would run the DAG
+    twice."""
+
+    def __init__(self, sub_id: str, dag_name: str = ""):
+        super().__init__(
+            f"AM crashed with submission {sub_id} "
+            f"({dag_name or '<unnamed>'}) journaled but not started; "
+            f"reattach and wait — do not resubmit")
+        self.sub_id = sub_id
+        self.dag_name = dag_name
+
+    def __reduce__(self):
+        return (AMCrashedError, (self.sub_id, self.dag_name))
+
+
+class DAGLostError(RuntimeError):
+    """Re-attach failed for a DAG the recovered journal cannot replay.
+
+    Raised ONLY when the journal proves the DAG never reached a
+    replayable state (no unresolved ``DAG_QUEUED`` record and no
+    ``DAG_SUBMITTED`` record under the recovered registry) — every other
+    case re-binds or replays (docs/recovery.md)."""
+
+    def __init__(self, dag_ref: str, reason: str = ""):
+        super().__init__(
+            f"DAG {dag_ref} lost across AM restart"
+            + (f": {reason}" if reason else ""))
+        self.dag_ref = dag_ref
+        self.reason = reason
+
+    def __reduce__(self):
+        return (DAGLostError, (self.dag_ref, self.reason))

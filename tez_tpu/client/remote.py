@@ -60,6 +60,12 @@ class RemoteAMProxy(FramedClient):
         web UI): per-tenant in-flight/queued/shed counts + queue depth."""
         return self._call("queue_status")
 
+    def find_dag_id_by_name(self, name: str) -> Optional[str]:
+        return self._call("find_dag_id_by_name", name)
+
+    def queued_dag_names(self) -> Any:
+        return self._call("queued_dag_names")
+
     def web_ui_address(self) -> Optional[str]:
         return self._call("web_ui_address")
 
@@ -97,6 +103,11 @@ class RemoteFrameworkClient:
         rpc_timeout = max(
             float(self.conf.get("tez.client.timeout-ms", 60_000)) / 1000.0,
             1.0)
+        # captured for reattach(): rediscovering a restarted AM must not
+        # depend on the conf still carrying the address/token verbatim
+        self._secrets = secrets
+        self._ssl_ctx = ssl_ctx
+        self._rpc_timeout = rpc_timeout
         start_wait = float(self.conf.get(
             "tez.session.client.timeout.secs", 120))
         deadline = time.time() + max(start_wait, 0)
@@ -174,3 +185,39 @@ class RemoteFrameworkClient:
 
     def submit_dag(self, plan: Any) -> Any:
         return self.am.submit_dag(plan)
+
+    def reattach(self) -> Any:
+        """Rediscover a restarted AM at the address captured at start().
+
+        Bounded full-jitter retry (tez.am.recovery.reattach.{retries,
+        backoff-ms}) covers the supervisor's restart window; the successor
+        incarnation replays the journal before accepting clients, so a
+        successful reconnect already sees the recovered registry
+        (docs/recovery.md)."""
+        from tez_tpu.common import config as C
+        from tez_tpu.utils.backoff import ExponentialBackoff, retry_call
+        if self._am_addr is None:
+            raise RuntimeError("reattach before start(): no captured AM "
+                               "address")
+        if self.am is not None:
+            try:
+                self.am.close()
+            except Exception:  # noqa: BLE001 — the old AM is dead anyway
+                pass
+            self.am = None
+        host, port = self._am_addr
+        retries = max(1, int(self.conf.get(
+            C.AM_RECOVERY_REATTACH_RETRIES) or 5))
+        base_s = max(0.01, float(self.conf.get(
+            C.AM_RECOVERY_REATTACH_BACKOFF_MS) or 200.0) / 1000.0)
+
+        def connect() -> RemoteAMProxy:
+            return RemoteAMProxy(host, port, self._secrets,
+                                 timeout=self._rpc_timeout,
+                                 ssl_context=self._ssl_ctx)
+
+        self.am = retry_call(
+            connect, retries, retryable=(OSError,),
+            backoff=ExponentialBackoff(base=base_s, cap=10.0, jitter=True))
+        log.info("re-attached to AM at %s:%d", host, port)
+        return self.am

@@ -63,9 +63,12 @@ class LocalFrameworkClient(FrameworkClient):
         self.conf = conf
         self.app_id = new_app_id()
         self.am: Optional[DAGAppMaster] = None
+        self._attempt = 0
 
     def start(self) -> None:
-        self.am = DAGAppMaster(self.app_id, self.conf)
+        self._attempt = 1
+        self.am = DAGAppMaster(self.app_id, self.conf,
+                               attempt=self._attempt)
         self.am.start()
 
     def stop(self) -> None:
@@ -76,6 +79,18 @@ class LocalFrameworkClient(FrameworkClient):
     def submit_dag(self, plan: Any) -> Any:
         return self.am.submit_dag(plan)
 
+    def reattach(self) -> Any:
+        """Successor incarnation of a crashed in-process AM: attempt+1
+        (which zombie-fences the dead incarnation's attempts via the epoch
+        registry), journal replay, admission-queue rebuild — the local
+        analog of reconnecting to a supervisor-restarted AM."""
+        self._attempt += 1
+        self.am = DAGAppMaster(self.app_id, self.conf,
+                               attempt=self._attempt)
+        self.am.start()
+        self.am.recover_and_resume()
+        return self.am
+
 
 class TezClient:
     def __init__(self, name: str, conf: Optional[Dict[str, Any]] = None,
@@ -85,6 +100,9 @@ class TezClient:
         self.session_mode = session or self.conf.get(C.SESSION_MODE)
         self.framework_client: Optional[FrameworkClient] = None
         self._started = False
+        #: weakrefs to every DAGClient this client issued — reattach()
+        #: re-binds the live ones against the recovered AM registry
+        self._handles: list = []
 
     @staticmethod
     def create(name: str, conf: Optional[Dict[str, Any]] = None,
@@ -114,7 +132,13 @@ class TezClient:
                 if k not in self._CLIENT_ONLY_KEYS}
         plan = dag.create_dag_plan(conf)
         dag_id = self.framework_client.submit_dag(plan)
-        return DAGClient(self.framework_client.am, dag_id)
+        return self._track(DAGClient(self.framework_client.am, dag_id))
+
+    def _track(self, handle: DAGClient) -> DAGClient:
+        import weakref
+        self._handles = [r for r in self._handles if r() is not None]
+        self._handles.append(weakref.ref(handle))
+        return handle
 
     def submit_dag_with_retry(self, dag: DAG, retries: int = 5,
                               backoff: Optional[ExponentialBackoff] = None,
@@ -144,6 +168,79 @@ class TezClient:
         """The AM's admission/queue snapshot (works for local and remote
         framework clients — the remote proxy has the same method)."""
         return self.framework_client.am.queue_status()
+
+    # -- AM crash survival (docs/recovery.md) --------------------------------
+    def reattach(self) -> "TezClient":
+        """Recover from an AM crash: rediscover/restart the AM and re-bind
+        every live DAGClient handle against the recovered registry.
+
+        Local framework client: constructs the successor incarnation
+        (attempt+1) and runs journal replay inline.  Remote: bounded
+        full-jitter reconnect to the captured AM address — the supervisor
+        restarts the process, the successor replays before serving.
+        Handles whose dag_id the recovered registry cannot resolve raise a
+        typed :class:`DAGLostError` — by then the journal has been replayed,
+        so an unknown dag_id is proof the DAG never reached a replayable
+        state."""
+        assert self._started, "client not started"
+        am = self.framework_client.reattach()
+        from tez_tpu.client.errors import DAGLostError
+        lost = []
+        for ref in list(self._handles):
+            handle = ref()
+            if handle is None:
+                continue
+            handle._am = am
+            # registry validation is local-AM only: a remote proxy answers
+            # per-call (an unknown dag_id reports state UNKNOWN instead)
+            find = getattr(am, "find_dag", None)
+            if find is None:
+                continue
+            dag_id = str(handle.dag_id)
+            if find(handle.dag_id, include_retired=True) is None and \
+                    dag_id not in am.completed_dags:
+                lost.append(dag_id)
+        if lost:
+            raise DAGLostError(
+                ", ".join(lost),
+                reason="no journal record reached a replayable state "
+                       "(not recovered, not requeued, not completed)")
+        return self
+
+    def attach_dag(self, name: str, timeout: float = 60.0,
+                   poll: float = 0.05) -> DAGClient:
+        """Re-bind to a DAG by NAME after reattach() — the handle for a
+        submission whose original submitter observed AMCrashedError.
+
+        dag ids are AM-assigned, so a submission that died parked in the
+        admission queue never had one; its journaled DAG_QUEUED record
+        replays under the successor AM and eventually promotes to a real
+        dag_id, which this polls for.  Raises :class:`DAGLostError` once
+        the name is provably absent everywhere — not running, not retired,
+        not parked in the recovered queue."""
+        assert self._started, "client not started"
+        from tez_tpu.client.errors import DAGLostError
+        am = self.framework_client.am
+        deadline = time.time() + timeout
+        missing_since: Optional[float] = None
+        while True:
+            dag_id = am.find_dag_id_by_name(name)
+            if dag_id is not None:
+                return self._track(DAGClient(am, dag_id))
+            if name in (am.queued_dag_names() or []):
+                missing_since = None   # parked: promotion is coming
+            elif missing_since is None:
+                missing_since = time.time()
+            elif time.time() - missing_since > 0.5:
+                # absent from registry AND queue across multiple probes —
+                # the replayed journal holds no trace of this name
+                raise DAGLostError(
+                    name, reason="recovered AM has no queued or submitted "
+                                 "record under this name")
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"DAG {name} not re-attachable within {timeout}s")
+            time.sleep(poll)
 
     def pre_warm(self) -> None:
         """Spin runners up before the first DAG (reference: preWarm:897).

@@ -195,6 +195,22 @@ AM_EPOCH_FENCING_ENABLED = _key(
     "Reject umbilical/commit/shuffle traffic stamped with an older AM "
     "attempt epoch, and stop acting once this AM is itself superseded "
     "(zombie fencing across AM restarts; see docs/recovery.md)")
+AM_RECOVERY_QUEUE_REPLAY = _key(
+    "tez.am.recovery.queue-replay.enabled", True, Scope.AM,
+    "on AM restart, rebuild the admission queue from unresolved "
+    "DAG_QUEUED / DAG_REQUEUED_ON_RECOVERY journal records (original "
+    "tenant + arrival order preserved; each replay journals a "
+    "DAG_REQUEUED_ON_RECOVERY event) — the redeem side of the "
+    "lossless-admission contract (docs/recovery.md)")
+AM_RECOVERY_REATTACH_RETRIES = _key(
+    "tez.am.recovery.reattach.retries", 5, Scope.CLIENT,
+    "client re-attach: connection attempts against the captured AM "
+    "address before giving up (full-jitter exponential backoff between "
+    "tries) — covers the restart window of a crashed AM")
+AM_RECOVERY_REATTACH_BACKOFF_MS = _key(
+    "tez.am.recovery.reattach.backoff-ms", 200.0, Scope.CLIENT,
+    "client re-attach: base of the full-jitter exponential backoff "
+    "between connection attempts")
 AM_COMMIT_RECOVERY_POLICY = _key(
     "tez.am.commit.recovery.policy", "resume", Scope.AM,
     "What recovery does with a DAG whose commit ledger shows "
@@ -750,6 +766,15 @@ PUSH_EAGER_MERGE_THRESHOLD = _key(
     "mem->disk merge once committed memory crosses this fraction of the "
     "merge budget (instead of only at tez.runtime.shuffle.merge.percent) "
     "so merge work overlaps the map wave; 0 disables early merging")
+PUSH_REPLICAS = _key(
+    "tez.runtime.shuffle.push.replicas", 1, Scope.VERTEX,
+    "copies of each pushed spill landed in the store: 1 = primary only "
+    "(historical behavior); 2 = every push also lands on the coded-buddy "
+    "replica key, and a consumer whose primary store entry is lost fails "
+    "over to the buddy instead of re-running the producer (Coded "
+    "TeraSort-style recovery-without-recomputation; the "
+    "store.replica.{bytes,failover} counters account for it — "
+    "docs/recovery.md, docs/push_shuffle.md)")
 DAG_TENANT = _key(
     "tez.dag.tenant", "", Scope.DAG,
     "tenant id stamped onto the DAG plan at submit (and onto every "

@@ -137,6 +137,17 @@ KNOWN_POINTS: Dict[str, str] = {
         "is promoted to submit, stretching queue latency; fail mode "
         "crashes the consumer thread mid-drain (the lossless-admission "
         "ledger regression lever)",
+    "am.crash":
+        "am/app_master.py DAGAppMaster.crash() entry (detail = "
+        "attempt=<n>); fires as the simulated SIGKILL begins — delay mode "
+        "widens the kill window deterministically, any raise is swallowed "
+        "(the AM is dying regardless).  The --am-kill chaos lever",
+    "store.replica.lost":
+        "shuffle/service.py consumer-side fetch chain (detail = "
+        "path/spill); fail mode declares the PRIMARY copies lost — store "
+        "entry and local registration both — so the fetch must "
+        "reconstruct from the coded push replica (store.replica.failover "
+        "proves no producer re-ran)",
 }
 
 _EXC_KINDS = {

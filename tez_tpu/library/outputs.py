@@ -210,7 +210,9 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                 counters=ctx.counters,
                 epoch=getattr(ctx, "am_epoch", 0),
                 app_id=getattr(ctx, "app_id", ""),
-                tenant=getattr(ctx, "tenant", ""))
+                tenant=getattr(ctx, "tenant", ""),
+                replicas=int(_conf_get(
+                    ctx, "tez.runtime.shuffle.push.replicas", 1)))
         store = self.service.buffer_store()
         if self._lineage and store is not None:
             # a non-pipelined output seals exactly one run (spill -1);

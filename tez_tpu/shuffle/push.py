@@ -69,6 +69,22 @@ def push_key(path_component: str, partition: int) -> str:
     return f"{path_component}#p{partition}"
 
 
+def replica_key(key_path: str) -> str:
+    """Store key for the coded buddy copy of a pushed spill.
+
+    With ``tez.runtime.shuffle.push.replicas=2`` every push also lands
+    under ``<key>#r`` — the buddy slot.  In a multi-host deployment the
+    buddy STORE is the one owning partition ``coded_buddy(p, n)``
+    (parallel/mesh.py, the PR-10 coded-exchange placement); the in-process
+    simulation keys both copies into the host-wide store under distinct
+    namespaces instead, which exercises the identical failover chain: a
+    consumer whose primary entry is lost reconstructs from ``<key>#r``
+    without re-running the producer (docs/recovery.md).  '#' never
+    appears in attempt path components and the prefix match in
+    ``unregister_prefix`` reclaims replica keys with their DAG."""
+    return f"{key_path}#r"
+
+
 class PushRejected(Exception):
     """Admission said no (quota / watermark / no landing zone).  Carries
     the retry-after hint; the pusher sleeps it and retries, then falls
@@ -262,11 +278,15 @@ class SpillPusher:
                  counters: Any = None, epoch: int = 0, app_id: str = "",
                  tenant: str = "",
                  secrets: Optional[JobTokenSecretManager] = None,
-                 backoff_base: float = 0.05, rng: Any = None):
+                 backoff_base: float = 0.05, rng: Any = None,
+                 replicas: int = 1):
         self.service = service
         self.retries = max(1, int(retries))
         self.inflight_limit = int(inflight_limit_bytes)
         self.counters = counters
+        #: copies per pushed spill (tez.runtime.shuffle.push.replicas);
+        #: >1 lands a coded buddy copy alongside every primary push
+        self.replicas = max(1, int(replicas))
         self.epoch = epoch
         self.app_id = app_id
         self.tenant = tenant
@@ -346,7 +366,7 @@ class SpillPusher:
                     self.service.push_publish(
                         path, spill_id, run, epoch=self.epoch,
                         app_id=self.app_id, tenant=self.tenant,
-                        counters=self.counters)
+                        counters=self.counters, replicas=self.replicas)
                 else:
                     if self.secrets is None:
                         raise PermissionError(

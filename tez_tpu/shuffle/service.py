@@ -22,7 +22,7 @@ from tez_tpu.common import epoch as epoch_registry
 from tez_tpu.common import faults
 from tez_tpu.common.epoch import EpochFencedError
 from tez_tpu.ops.runformat import KVBatch, Run, RUN_HEADER_NBYTES
-from tez_tpu.shuffle.push import PushRejected, push_key
+from tez_tpu.shuffle.push import PushRejected, push_key, replica_key
 
 
 def _maybe_corrupt(path_component: str, spill_id: int,
@@ -158,7 +158,7 @@ class ShuffleService:
     def push_publish(self, path_component: str, spill_id: int, run: Any,
                      partition: Optional[int] = None, epoch: int = 0,
                      app_id: str = "", tenant: str = "",
-                     counters: Any = None) -> None:
+                     counters: Any = None, replicas: int = 1) -> None:
         """Eager-push landing zone (docs/push_shuffle.md).
 
         Admission-checked publish into the buffer store.  ``partition``
@@ -166,7 +166,10 @@ class ShuffleService:
         ``(path, spill)`` key (complete — every partition — so a consumer
         probe can never be served a partial view); an int = one remotely
         pushed partition under ``push_key(path, partition)`` holding a
-        single-partition run.  Raises PushRejected (admission said no —
+        single-partition run.  ``replicas`` > 1 additionally lands a coded
+        buddy copy under ``replica_key(...)`` — best-effort (quota refusal
+        skips the copy, the primary stands) and charged to the primary's
+        admission grant.  Raises PushRejected (admission said no —
         caller retries then falls back to pull) or EpochFencedError (a
         re-attempted mapper's stale push, rejected exactly like a stale
         register)."""
@@ -199,10 +202,31 @@ class ShuffleService:
             # surfaces like any admission refusal: the pusher backs off,
             # retries, then abandons to the pull backstop
             raise PushRejected(0.0, str(e)) from e
+        buddy = -1
+        if replicas > 1:
+            # coded buddy copy (docs/recovery.md): placement follows the
+            # PR-10 coded-exchange ring — the buddy store for a whole-run
+            # push is the one owning coded_buddy(p, n) per partition; the
+            # in-process simulation keys both copies into the host store
+            # under distinct namespaces, same failover chain.  Best-effort
+            # — a quota refusal keeps the primary, just without the
+            # redundancy.
+            n = int(getattr(run, "num_partitions", 0) or 0)
+            if n > 1:
+                from tez_tpu.parallel.mesh import coded_buddy
+                buddy = coded_buddy(0 if partition is None else partition, n)
+            try:
+                self._buffer.publish(replica_key(key_path), spill_id, run,
+                                     epoch=epoch, app_id=app_id,
+                                     tenant=tenant, counters=counters,
+                                     replica=True)
+            except StoreQuotaExceeded:
+                pass
         from tez_tpu.common import tracing
         tracing.event("shuffle.push", src=f"{path_component}/{spill_id}",
                       nbytes=nbytes,
-                      partition=-1 if partition is None else partition)
+                      partition=-1 if partition is None else partition,
+                      replicas=replicas, buddy=buddy)
         for fn in list(self._push_listeners):
             try:
                 fn(path_component, spill_id)
@@ -242,42 +266,75 @@ class ShuffleService:
             run = self._buffer.get(path_component, spill_id)
         return run
 
+    def _store_probe(self, key_path: str, spill_id: int, partition: int,
+                     counters: Any) -> Optional[KVBatch]:
+        """One buffer-store probe, miss -> None (StoreKeyNotFound and a
+        concurrently-deleted backing file both count as a miss — the next
+        link in the fetch chain decides what a total miss means)."""
+        try:
+            return self._buffer.fetch_partition(
+                key_path, spill_id, partition, counters=counters)
+        except FileNotFoundError:
+            return None
+        except Exception as e:
+            if type(e).__name__ != "StoreKeyNotFound":
+                raise
+            return None
+
+    def _replica_probe(self, key_path: str, spill_id: int, partition: int,
+                       counters: Any) -> Optional[KVBatch]:
+        """Failover to the coded buddy copy of a lost primary entry.  A
+        hit is accounted as a store.replica.failover — a producer re-run
+        avoided (docs/recovery.md)."""
+        batch = self._store_probe(replica_key(key_path), spill_id,
+                                  partition, counters)
+        if batch is not None:
+            self._buffer.note_replica_failover(
+                f"{key_path}/{spill_id}", counters=counters)
+        return batch
+
     def fetch_partition(self, path_component: str, spill_id: int,
                         partition: int, counters: Any = None) -> KVBatch:
+        # store.replica.lost seam (consumer side): fail mode declares the
+        # PRIMARY copies gone — store entries and the producer's local
+        # registration both — forcing the coded-replica failover path, the
+        # chaos lever proving reconstruction without producer re-execution
+        primary_lost = False
+        try:
+            faults.fire("store.replica.lost",
+                        detail=f"{path_component}/{spill_id}")
+        except Exception:
+            primary_lost = True
         if self._buffer is not None:
-            try:
-                batch = self._buffer.fetch_partition(
-                    path_component, spill_id, partition, counters=counters)
-            except FileNotFoundError:
-                raise ShuffleDataNotFound(
-                    f"{path_component}/{spill_id}") from None
-            except Exception as e:
-                if type(e).__name__ != "StoreKeyNotFound":
-                    raise
-                batch = None
+            batch = None
+            if not primary_lost:
+                batch = self._store_probe(path_component, spill_id,
+                                          partition, counters)
+            if batch is None:
+                batch = self._replica_probe(path_component, spill_id,
+                                            partition, counters)
             if batch is not None:
                 if faults.armed():
                     batch = _maybe_corrupt(path_component, spill_id, batch)
                 return batch
-        with self._lock:
-            run = self._runs.get((path_component, spill_id))
+        run = None
+        if not primary_lost:
+            with self._lock:
+                run = self._runs.get((path_component, spill_id))
         if run is None:
             # third probe: a remotely PUSHED partition — the producer has
             # no local registration here, but its pusher may have landed
             # this partition under push_key (a single-partition run, so
-            # partition index 0 inside the stored run)
+            # partition index 0 inside the stored run); its coded replica
+            # is the last resort
             if self._buffer is not None:
-                try:
-                    batch = self._buffer.fetch_partition(
-                        push_key(path_component, partition), spill_id, 0,
-                        counters=counters)
-                except FileNotFoundError:
-                    raise ShuffleDataNotFound(
-                        f"{path_component}/{spill_id}") from None
-                except Exception as e:
-                    if type(e).__name__ != "StoreKeyNotFound":
-                        raise
-                else:
+                pk = push_key(path_component, partition)
+                batch = None
+                if not primary_lost:
+                    batch = self._store_probe(pk, spill_id, 0, counters)
+                if batch is None:
+                    batch = self._replica_probe(pk, spill_id, 0, counters)
+                if batch is not None:
                     if faults.armed():
                         batch = _maybe_corrupt(path_component, spill_id,
                                                batch)

@@ -170,6 +170,10 @@ class ShuffleBufferStore:
             "store.result_cache.expired": 0,
             "store.result_cache.evicted": 0,
             "store.result_cache.deferred": 0,
+            # coded push replicas (docs/recovery.md): bytes landed on buddy
+            # keys, and fetches served from a buddy after the primary entry
+            # was lost — each failover is a producer re-run avoided
+            "store.replica.bytes": 0, "store.replica.failover": 0,
         }
 
     # -- accounting helpers (call with lock held) ----------------------------
@@ -203,11 +207,20 @@ class ShuffleBufferStore:
         if counters is not None:
             counters.group(COUNTER_GROUP).find_counter(name).increment(n)
 
+    def note_replica_failover(self, detail: str = "",
+                              counters: Any = None) -> None:
+        """Account one primary->buddy failover (ShuffleService's fetch
+        chain calls this when a lost primary entry is served from its
+        coded replica key instead of re-running the producer)."""
+        self._bump("store.replica.failover", counters)
+        _flight.record(_flight.STORE, "replica.failover", detail)
+
     # -- producer side -------------------------------------------------------
 
     def publish(self, path_component: str, spill_id: int, run: Any,
                 epoch: int = 0, app_id: str = "", lineage: str = "",
-                tenant: str = "", counters: Any = None) -> None:
+                tenant: str = "", counters: Any = None,
+                replica: bool = False) -> None:
         """Insert a run under (path_component, spill_id).
 
         Epoch-fenced like ShuffleService.register: a stamped publish from
@@ -215,7 +228,9 @@ class ShuffleBufferStore:
         output.  ``lineage`` tags the entry for session-mode sealing;
         ``tenant`` charges the bytes to that tenant's quota (device
         over-quota lands on host instead; host/disk over-quota raise
-        :class:`StoreQuotaExceeded` — the producer keeps its own copy)."""
+        :class:`StoreQuotaExceeded` — the producer keeps its own copy).
+        ``replica=True`` marks a coded buddy copy of an already-published
+        run (accounted under store.replica.bytes; docs/recovery.md)."""
         if epoch > 0 and epoch_registry.is_stale(app_id, epoch):
             raise EpochFencedError(
                 f"store publish from stale epoch {epoch} "
@@ -263,9 +278,13 @@ class ShuffleBufferStore:
             entry.keys.append(key)
             self._account(entry, +1)
             self._bump("store.published", counters)
+            if replica:
+                self._bump("store.replica.bytes", counters,
+                           int(run.nbytes))
             self._publish_gauges()
-        _flight.record(_flight.STORE, f"publish.{tier}", tenant,
-                       a=int(run.nbytes), b=spill_id)
+        _flight.record(_flight.STORE,
+                       "publish.replica" if replica else f"publish.{tier}",
+                       tenant, a=int(run.nbytes), b=spill_id)
         with metrics.timer("store.publish"):
             self._enforce_watermarks(counters)
 

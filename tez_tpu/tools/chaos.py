@@ -777,6 +777,254 @@ def run_commit_storm(workdir: str, timeout: float = 120.0,
                   f"({len(got) - 1} part file(s) + _SUCCESS)")
 
 
+def run_am_kill(seed: int, workdir: str,
+                timeout: float = 120.0) -> Tuple[bool, str]:
+    """AM crash-survival scenario (``make chaos-ha``). Returns (ok, detail).
+
+    Leg 1 — admission-queue replay + re-attach.  A session AM
+    (max-concurrent-dags=1) takes tenant A's DAG mid-run (a seeded
+    ``task.run`` delay keeps it running) while tenants B and C park in
+    the admission queue; with >=2 submissions queued and one mid-run the
+    AM is SIGKILLed (``crash()`` — no graceful resolution, no terminal
+    records).  Parked submitters must observe a typed
+    :class:`AMCrashedError`.  The client then ``reattach()``s: the
+    successor incarnation replays the journal, resubmits A under its old
+    dag_id, and requeues B and C from their unresolved ``DAG_QUEUED``
+    records.  Every DAG — mid-run and queued alike — must complete
+    bit-exact vs its tenant's fault-free baseline, with exactly two
+    ``DAG_REQUEUED_ON_RECOVERY`` records journaled.  A zombie heartbeat
+    stamped with the dead incarnation's epoch must be fenced
+    (``should_die``) and journaled as ``ATTEMPT_FENCED``.
+
+    Leg 2 — coded push replicas.  A multi-spill push DAG runs with
+    ``tez.runtime.shuffle.push.replicas=2`` while a seeded
+    ``store.replica.lost`` fault declares a fetch's primary copies dead;
+    the consumer must reconstruct from the buddy replica
+    (``store.replica.failover``) with ZERO producer re-execution —
+    enforced hard by ``tez.am.task.max.failed.attempts=1`` plus an exact
+    attempt count off the history journal."""
+    import threading
+
+    from tez_tpu.am.history import HistoryEventType
+    from tez_tpu.am.task_comm import HeartbeatRequest
+    from tez_tpu.client.errors import AMCrashedError
+    from tez_tpu.common.ids import DAGId, TaskAttemptId
+    from tez_tpu.store import local_buffer_store, reset_store
+
+    reset_store()
+    tenants = 3
+    tenant_names = [f"tenant{t}" for t in range(tenants)]
+
+    # fault-free per-tenant baselines, each on its own throwaway AM
+    baselines: List[bytes] = []
+    for t in range(tenants):
+        base = os.path.join(workdir, f"hakbase{seed}-t{t}")
+        result_path = os.path.join(base, "result.txt")
+        os.makedirs(base, exist_ok=True)
+        client = TezClient.create(f"hakbase{t}", {
+            "tez.staging-dir": os.path.join(base, "staging"),
+            "tez.am.local.num-containers": 4}).start()
+        try:
+            dag = _build_tenant_dag(f"hakbase{seed}-t{t}", result_path,
+                                    salt=t)
+            status = client.submit_dag(dag).wait_for_completion(
+                timeout=timeout)
+        finally:
+            client.stop()
+        if status.state.name != DAGStatusState.SUCCEEDED.name or \
+                not os.path.exists(result_path):
+            return False, (f"tenant {t} baseline failed "
+                           f"(state={status.state.name})")
+        with open(result_path, "rb") as fh:
+            baselines.append(fh.read())
+
+    storm_dir = os.path.join(workdir, f"amkill{seed}")
+    results_dir = os.path.join(storm_dir, "results")
+    staging = os.path.join(storm_dir, "staging")
+    os.makedirs(results_dir, exist_ok=True)
+    session_conf = {
+        "tez.staging-dir": staging,
+        "tez.am.local.num-containers": 4,
+        # ONE slot: A occupies it mid-run, B and C must park in the queue
+        "tez.am.session.max-concurrent-dags": 1,
+        "tez.am.session.queue-size": 8,
+    }
+    client = TezClient.create(f"amkill{seed}", session_conf,
+                              session=True).start()
+    crashed_errors: List[str] = []
+    thread_errors: List[str] = []
+
+    def parked_submitter(t: int) -> None:
+        tenant = tenant_names[t]
+        name = f"{tenant}-hak{seed}"
+        result_path = os.path.join(results_dir, f"{name}.txt")
+        dag = _build_tenant_dag(name, result_path, salt=t, tenant=tenant)
+        try:
+            client.submit_dag(dag)
+        except AMCrashedError:
+            crashed_errors.append(name)
+        except Exception as e:  # noqa: BLE001 — wrong type is a failure
+            thread_errors.append(f"{name}: {e!r}")
+        else:
+            thread_errors.append(f"{name}: promoted before the crash")
+
+    ok = False
+    try:
+        # tenant A mid-run: one producer parks on a seeded task delay long
+        # enough to hold the single slot through the kill window
+        name_a = f"tenant0-hak{seed}"
+        result_a = os.path.join(results_dir, f"{name_a}.txt")
+        dag_a = _build_tenant_dag(
+            name_a, result_a, salt=0, tenant=tenant_names[0],
+            fault_spec="task.run:delay:ms=4000,n=1", fault_seed=seed)
+        dc_a = client.submit_dag(dag_a)
+
+        threads = [threading.Thread(target=parked_submitter, args=(t,),
+                                    name=f"hak-submitter-{t}", daemon=True)
+                   for t in (1, 2)]
+        for th in threads:
+            th.start()
+        # wait for the parked submissions' DAG_QUEUED records to LAND (the
+        # queue-depth gauge goes up before the journal append finishes —
+        # crashing in that window would race the lossless ledger)
+        am1 = client.framework_client.am
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(am1.logging_service.of_type(
+                    HistoryEventType.DAG_QUEUED)) >= 2:
+                break
+            time.sleep(0.02)
+        else:
+            return False, "B/C never reached the admission queue"
+
+        am1.crash()
+        for th in threads:
+            th.join(timeout=timeout)
+        if thread_errors:
+            return False, ("parked submitters did not fail typed: "
+                           + "; ".join(thread_errors[:2]))
+        if len(crashed_errors) != 2:
+            return False, (f"expected 2 typed AMCrashedError losses, "
+                           f"got {len(crashed_errors)}")
+
+        client.reattach()
+        am2 = client.framework_client.am
+        requeued = am2.logging_service.of_type(
+            HistoryEventType.DAG_REQUEUED_ON_RECOVERY)
+        if len(requeued) != 2:
+            return False, (f"{len(requeued)} DAG_REQUEUED_ON_RECOVERY "
+                           f"record(s), expected 2; "
+                           f"{_fsck_summary(staging, am2.app_id)}")
+
+        # zombie fencing: a heartbeat stamped with the dead incarnation's
+        # epoch must be told to die and leave a typed journal record
+        zombie = TaskAttemptId(DAGId(am2.app_id, 1).vertex(0).task(0), 0)
+        resp = am2.task_comm.heartbeat(HeartbeatRequest(
+            attempt_id=zombie, events=[], epoch=1))
+        if not resp.should_die:
+            return False, "stale-epoch heartbeat was not fenced"
+        if am2.task_comm.fenced_count < 1:
+            return False, "fence was not counted"
+        if not am2.logging_service.of_type(HistoryEventType.ATTEMPT_FENCED):
+            return False, "fence left no ATTEMPT_FENCED journal record"
+
+        # the mid-run DAG completes on its ORIGINAL handle, re-bound by
+        # reattach; the queued DAGs are re-attached by name
+        state = dc_a.wait_for_completion(timeout=timeout).state.name
+        if state != DAGStatusState.SUCCEEDED.name:
+            return False, (f"recovered mid-run DAG finished {state}; "
+                           f"{_fsck_summary(staging, am2.app_id)}")
+        for t in (1, 2):
+            name = f"{tenant_names[t]}-hak{seed}"
+            dc = client.attach_dag(name, timeout=timeout)
+            state = dc.wait_for_completion(timeout=timeout).state.name
+            if state != DAGStatusState.SUCCEEDED.name:
+                return False, f"replayed DAG {name} finished {state}"
+        for t in range(tenants):
+            path = os.path.join(results_dir,
+                                f"{tenant_names[t]}-hak{seed}.txt")
+            got = b""
+            if os.path.exists(path):
+                with open(path, "rb") as fh:
+                    got = fh.read()
+            if got != baselines[t]:
+                return False, (f"tenant {t} output diverged after replay "
+                               f"({len(got)} vs {len(baselines[t])} bytes)")
+        ok = True
+    finally:
+        client.stop()
+        faults.clear_all()
+        reset_store()
+    if not ok:
+        return False, "unreachable"
+
+    # ---- leg 2: coded push replicas outlive a dead primary store --------
+    reset_store()
+    try:
+        state, baseline = _run_dag(workdir, f"replbase{seed}",
+                                   timeout=timeout,
+                                   extra_conf={"tez.runtime.io.sort.mb": 1},
+                                   producer_cls=ChaosPushEmitProcessor)
+        if state != DAGStatusState.SUCCEEDED.name or not baseline:
+            return False, f"replica baseline failed (state={state})"
+        name = f"replkill{seed}"
+        result_path = os.path.join(workdir, name, "result.txt")
+        os.makedirs(os.path.dirname(result_path), exist_ok=True)
+        conf = {
+            "tez.staging-dir": os.path.join(workdir, name, "staging"),
+            "tez.am.local.num-containers": 4,
+            # ZERO retry headroom: any producer/consumer re-execution
+            # fails the DAG outright, so SUCCEEDED proves the failover
+            # reconstructed from the replica without re-running anything
+            "tez.am.task.max.failed.attempts": 1,
+            "tez.runtime.io.sort.mb": 1,
+            "tez.runtime.shuffle.push.enabled": True,
+            "tez.runtime.shuffle.push.replicas": 2,
+            "tez.runtime.store.enabled": True,
+            "tez.runtime.store.lineage.reuse": False,
+        }
+        rclient = TezClient.create(name, conf).start()
+        try:
+            dag = _build_dag(name, result_path,
+                             fault_spec="store.replica.lost:fail:n=1",
+                             fault_seed=seed,
+                             producer_cls=ChaosPushEmitProcessor)
+            dc = rclient.submit_dag(dag)
+            state = dc.wait_for_completion(timeout=timeout).state.name
+            attempts = len(rclient.framework_client.am.logging_service
+                           .of_type(HistoryEventType.TASK_ATTEMPT_STARTED))
+            store = local_buffer_store()
+            sc = store.stats()["counters"] if store is not None else {}
+        finally:
+            rclient.stop()
+            faults.clear_all()
+        if state != DAGStatusState.SUCCEEDED.name:
+            return False, (f"replica-failover DAG finished {state} "
+                           f"(failover={sc.get('store.replica.failover', 0)})")
+        got = b""
+        if os.path.exists(result_path):
+            with open(result_path, "rb") as fh:
+                got = fh.read()
+        if got != baseline:
+            return False, (f"replica-failover output diverged "
+                           f"({len(got)} vs {len(baseline)} bytes)")
+        if attempts != NUM_PRODUCERS + 1:
+            return False, (f"{attempts} task attempts ran, expected "
+                           f"{NUM_PRODUCERS + 1} — a producer re-executed")
+        if sc.get("store.replica.bytes", 0) < 1:
+            return False, "no replica bytes were ever published"
+        if sc.get("store.replica.failover", 0) < 1:
+            return False, ("store.replica.lost never forced a failover — "
+                           "the fault did not bite")
+        return True, (f"2 requeued + mid-run replayed bit-exact, zombie "
+                      f"fenced; replica leg bit-exact with "
+                      f"{sc['store.replica.failover']} failover(s), "
+                      f"{sc['store.replica.bytes']} replica byte(s), "
+                      f"0 re-executions")
+    finally:
+        reset_store()
+
+
 def run_device_ooo(seed: int, spans: int = 4,
                    records: int = 1500) -> Tuple[bool, str]:
     """Out-of-order device-completion scenario: the async double-buffered
@@ -1322,6 +1570,16 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--p95-bound", type=float, default=30.0,
                     help="per-tenant p95 completion-latency bound in "
                          "seconds for --tenant-storm (default 30)")
+    ap.add_argument("--am-kill", action="store_true",
+                    help="run the AM crash-survival scenario: SIGKILL the "
+                         "session AM with one DAG mid-run and two parked "
+                         "in the admission queue, then reattach — the "
+                         "successor replays the journal, requeues the "
+                         "parked submissions, fences the dead "
+                         "incarnation's zombies, and every DAG completes "
+                         "bit-exact; plus the coded push-replica leg "
+                         "(store.replica.lost forces a buddy failover "
+                         "with zero producer re-execution)")
     ap.add_argument("--exchange-skew", action="store_true",
                     help="run the skewed-key mesh-exchange scenario: a hot "
                          "partition over the round budget plus one chip "
@@ -1408,6 +1666,23 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                     failures += 1
                     print(f"REPRO: python -m tez_tpu.tools.chaos "
                           f"--tenant-storm --seed {seed}")
+        finally:
+            if cleanup:
+                shutil.rmtree(workdir, ignore_errors=True)
+        return 1 if failures else 0
+    if args.am_kill:
+        failures = 0
+        try:
+            for seed in range(args.seed, args.seed + args.trials):
+                ok, detail = run_am_kill(seed, workdir,
+                                         timeout=args.timeout)
+                print(("ok   " if ok else "FAIL ") +
+                      f"am-kill seed={seed}: {detail}")
+                _flight_dump_scenario("am-kill", seed, ok)
+                if not ok:
+                    failures += 1
+                    print(f"REPRO: python -m tez_tpu.tools.chaos "
+                          f"--am-kill --seed {seed}")
         finally:
             if cleanup:
                 shutil.rmtree(workdir, ignore_errors=True)

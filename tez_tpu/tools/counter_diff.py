@@ -92,6 +92,15 @@ EXCHANGE_PRESSURE_COUNTERS = MESH_EXCHANGE_PRESSURE_COUNTERS
 EXCHANGE_HISTS = ("mesh.exchange.round",)
 
 
+#: AM crash-survival (am/recovery.py queue replay, task_comm.py epoch
+#: fencing, coded push replicas).  Requeued submissions and zombie-fenced
+#: attempts come off the session recovery stream; replica traffic off the
+#: ShuffleStore group.  A fault-free run has none of the first three, so
+#: any growth is flagged; replica BYTES are workload-shaped (replicas=2
+#: pays them on purpose, like coded duplicate exchange — never flagged).
+RECOVERY_REPLICA_COUNTERS = ("store.replica.bytes", "store.replica.failover")
+
+
 #: Observability plane (obs/flight.py, am/admission.py).  Queue wait is
 #: admission pressure — growth means submissions parked longer before
 #: promotion; flight-dump wall is the recorder's own cost, which must
@@ -148,6 +157,41 @@ def diff_tenants(dags_a: Dict, dags_b: Dict,
             b["shed"] > a["shed"] or b["failed"] > a["failed"] or
             (a["p95_s"] > 0 and b["p95_s"] >= REGRESSION_RATIO * a["p95_s"])))
         out.append((tenant, a, b, regressed))
+    return out
+
+
+def recovery_summary(dags: Dict) -> Dict[str, int]:
+    """Session recovery roll-up off the recovery stream:
+    ``{"requeued": n, "fenced": n}``."""
+    events: List[Dict] = []
+    for d in dags.values():
+        events = d.recovery_events or events
+    return {"requeued": sum(1 for e in events if e["event"] == "REQUEUED"),
+            "fenced": sum(1 for e in events if e["event"] == "FENCED")}
+
+
+def diff_recovery(dags_a: Dict, dags_b: Dict,
+                  counters_a: Dict, counters_b: Dict,
+                  ) -> List[Tuple[str, int, int, bool]]:
+    """[(name, a, b, regressed)] for the crash-survival section: requeued
+    submissions, zombie-fenced attempts, and replica failovers — any
+    growth is flagged (these are zero on a healthy fault-free run);
+    replica bytes are reported but never flagged."""
+    ra, rb = recovery_summary(dags_a), recovery_summary(dags_b)
+    ga = counters_a.get(STORE_GROUP, {})
+    gb = counters_b.get(STORE_GROUP, {})
+    out = []
+    for name, va, vb in (
+            ("dags.requeued_on_recovery", ra["requeued"], rb["requeued"]),
+            ("attempts.zombie_fenced", ra["fenced"], rb["fenced"])):
+        if va or vb:
+            out.append((name, va, vb, vb > va))
+    for name in RECOVERY_REPLICA_COUNTERS:
+        if name not in ga and name not in gb:
+            continue
+        va, vb = int(ga.get(name, 0)), int(gb.get(name, 0))
+        out.append((name, va, vb,
+                    name == "store.replica.failover" and vb > va))
     return out
 
 
@@ -389,6 +433,15 @@ def main() -> int:
             print(f"{tenant:24} {_fmt_tenant(sa):>40} "
                   f"{_fmt_tenant(sb):>40}{flag}")
             regressions += int(regressed)
+    recovery = diff_recovery(sessions[0], sessions[1],
+                             a.counters, b.counters)
+    if recovery:
+        print(f"\n{'recovery (requeues/fences/replica failover)':60} "
+              f"{'A':>14} {'B':>14}")
+        for name, va, vb, regressed in recovery:
+            flag = "  << REGRESSION" if regressed else ""
+            print(f"{name:60} {va:14d} {vb:14d}{flag}")
+            regressions += int(regressed)
     failover = diff_device_failover(a.counters, b.counters)
     if failover:
         print(f"\n{'device.failover (containment)':60} "
@@ -404,7 +457,8 @@ def main() -> int:
         print(f"{regressions} regression(s) (latency p95 >= "
               f"{REGRESSION_RATIO}x baseline, containment event growth, "
               f"store eviction/demotion churn growth, exchange "
-              f"round/split growth, or tenant shed/failure growth)")
+              f"round/split growth, tenant shed/failure growth, or "
+              f"recovery requeue/fence/failover growth)")
     return 0
 
 

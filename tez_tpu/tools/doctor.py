@@ -46,9 +46,12 @@ import os
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
-# planes in blame-priority order; "control" is the uncovered residual
-PLANES = ("admission", "exchange", "device", "store", "transport",
-          "compute", "control")
+# planes in blame-priority order; "control" is the uncovered residual.
+# "recovery" outranks everything: an AM-incarnation bump inside the
+# blamed window means the session itself died and replayed — no amount
+# of store or compute activity explains that wall clock better.
+PLANES = ("recovery", "admission", "exchange", "device", "store",
+          "transport", "compute", "control")
 
 #: histogram-name prefix -> plane (first match wins; None = not blamed,
 #: e.g. the flight recorder's own dump timer)
@@ -133,6 +136,46 @@ def load_slo_breaches(journal_files: List[str]) -> List[Dict[str, Any]]:
                 continue
             if ev.event_type.name == "TENANT_SLO_BREACH":
                 out.append(dict(ev.data, time=ev.timestamp))
+    return out
+
+
+def load_am_restarts(journal_files: List[str]) -> List[Dict[str, Any]]:
+    """AM incarnation bumps: every ``AM_STARTED`` with ``attempt > 1`` is
+    a restart.  The recovery window runs from that record until the first
+    DAG-scoped event the new incarnation journals (replay done, real work
+    resumed); if nothing follows, to the stream's last record.  Entries:
+    ``{"time", "end", "attempt"}``."""
+    from tez_tpu.am.recovery import decode_journal_line
+    out: List[Dict[str, Any]] = []
+    for path in journal_files:
+        try:
+            with open(path) as fh:
+                lines = fh.readlines()
+        except OSError:
+            continue
+        pending: Optional[Dict[str, Any]] = None
+        last_t = 0.0
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = decode_journal_line(line)
+            except Exception:  # noqa: BLE001 — torn tail lines etc.
+                continue
+            last_t = max(last_t, ev.timestamp or 0.0)
+            if ev.event_type.name == "AM_STARTED":
+                if int(ev.data.get("attempt", 1) or 1) > 1:
+                    pending = {"time": ev.timestamp,
+                               "end": ev.timestamp,
+                               "attempt": int(ev.data["attempt"])}
+                    out.append(pending)
+                continue
+            if pending is not None and ev.dag_id is not None:
+                pending["end"] = max(pending["time"], ev.timestamp)
+                pending = None
+        if pending is not None:
+            pending["end"] = max(pending["time"], last_t)
     return out
 
 
@@ -260,10 +303,16 @@ def straggler_attempts(dag: Any, top: int = 3,
 
 def diagnose(dag: Any, snaps: List[Any],
              slo_breaches: List[Dict[str, Any]],
-             fleet: Optional[Dict[str, float]] = None) -> Dict[str, Any]:
+             fleet: Optional[Dict[str, float]] = None,
+             am_restarts: Optional[List[Dict[str, Any]]] = None
+             ) -> Dict[str, Any]:
     t0 = dag.submit_time or dag.start_time
     t1 = dag.finish_time
     intervals = intervals_from_history(dag) + intervals_from_flight(snaps)
+    for r in (am_restarts or []):
+        if r["end"] > r["time"]:
+            intervals.append((r["time"], r["end"], "recovery",
+                              f"am-restart:attempt={r['attempt']}"))
     if not t1:
         t1 = max((e for _, e, _, _ in intervals), default=t0)
     wall = max(0.0, t1 - t0)
@@ -291,6 +340,12 @@ def diagnose(dag: Any, snaps: List[Any],
     if stragglers and stragglers[0]["slowdown"] >= 2.0:
         verdict += (f"; straggler {stragglers[0]['attempt_id']} ran "
                     f"{stragglers[0]['slowdown']}x its vertex median")
+    in_window = [r for r in (am_restarts or [])
+                 if t0 <= r["time"] <= t1]
+    if in_window:
+        verdict += (f"; AM restarted inside the window (attempt "
+                    f"{in_window[-1]['attempt']}) — recovery replay, "
+                    f"not a data-plane stall")
     if slo_breaches:
         verdict += f"; {len(slo_breaches)} SLO breach(es) on record"
     return {
@@ -309,6 +364,7 @@ def diagnose(dag: Any, snaps: List[Any],
                       for s, e, p in segments],
         "stragglers": stragglers,
         "slo_breaches": slo_breaches,
+        "am_restarts": in_window,
         "verdict": verdict,
         "sources": {
             "flight_dumps": len(snaps),
@@ -359,6 +415,13 @@ def render_text(rep: Dict[str, Any]) -> str:
                      f"{r['duration_s']:.3f} s vs median "
                      f"{r['vertex_median_s']:.3f} s  "
                      f"({r['slowdown']}x)")
+    if rep.get("am_restarts"):
+        L.append("")
+        L.append("am restarts (recovery plane):")
+        for r in rep["am_restarts"]:
+            L.append(f"  attempt {r['attempt']}: "
+                     f"+{r['time'] - rep['window'][0]:.3f}s into the "
+                     f"window, replay took {r['end'] - r['time']:.3f} s")
     if rep["slo_breaches"]:
         L.append("")
         L.append("slo breaches:")
@@ -434,9 +497,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     dag = dags[dag_id]
     snaps = load_flight_dumps(dump_files)
     breaches = load_slo_breaches(journals)
+    restarts = load_am_restarts(journals)
 
     rep = diagnose(dag, snaps, breaches,
-                   fleet=vertex_fleet_medians(dags))
+                   fleet=vertex_fleet_medians(dags),
+                   am_restarts=restarts)
     if args.perfetto:
         from tez_tpu.tools import trace_export
         events = trace_export.history_to_events(dag)

@@ -96,6 +96,12 @@ class DagInfo:
     # {"event", "tenant", "dag_name", "reason", "time"} — session-scoped
     # like containers, attached to every dag
     admission_events: List[Dict] = dataclasses.field(default_factory=list)
+    # session recovery stream (AM-restart replay + zombie fencing) in
+    # event order: REQUEUED entries {"event", "sub_id", "tenant",
+    # "dag_name", "attempt", "time"}; FENCED entries {"event", "reason",
+    # "detail", "msg_epoch", "am_epoch", "time"} — session-scoped,
+    # attached to every dag
+    recovery_events: List[Dict] = dataclasses.field(default_factory=list)
 
     @property
     def duration(self) -> float:
@@ -118,6 +124,7 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
     containers: Dict[str, Dict] = {}
     node_events: List[Dict] = []
     admission_events: List[Dict] = []
+    recovery_events: List[Dict] = []
 
     def dag(ev: HistoryEvent) -> Optional[DagInfo]:
         if ev.dag_id is None:
@@ -137,6 +144,28 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
                 "dag_name": ev.data.get("dag_name", ""),
                 "reason": ev.data.get("reason", ""),
                 "time": ev.timestamp})
+            continue
+        if t in (HistoryEventType.DAG_REQUEUED_ON_RECOVERY,
+                 HistoryEventType.ATTEMPT_FENCED):
+            # session-scoped recovery stream: a requeue's dag_id is the
+            # original submission id and a fence has no DAG at all —
+            # neither may materialize a phantom DagInfo
+            if t is HistoryEventType.DAG_REQUEUED_ON_RECOVERY:
+                recovery_events.append({
+                    "event": "REQUEUED",
+                    "sub_id": ev.dag_id or "",
+                    "tenant": ev.data.get("tenant", ""),
+                    "dag_name": ev.data.get("dag_name", ""),
+                    "attempt": ev.data.get("attempt", 0),
+                    "time": ev.timestamp})
+            else:
+                recovery_events.append({
+                    "event": "FENCED",
+                    "reason": ev.data.get("reason", ""),
+                    "detail": ev.data.get("detail", ""),
+                    "msg_epoch": ev.data.get("msg_epoch", 0),
+                    "am_epoch": ev.data.get("am_epoch", 0),
+                    "time": ev.timestamp})
             continue
         d = dag(ev)
         if t is HistoryEventType.DAG_SUBMITTED and d:
@@ -229,6 +258,7 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
         d.containers = containers
         d.node_events = node_events
         d.admission_events = admission_events
+        d.recovery_events = recovery_events
     return dags
 
 

@@ -1,6 +1,8 @@
 """Recovery-journal fsck: validate a journal's record CRCs, event ordering,
-and commit-ledger pairing, then print the terminal state recovery would
-infer for each DAG.
+commit-ledger pairing, and admission-queue pairing (``DAG_QUEUED`` /
+``DAG_REQUEUED_ON_RECOVERY`` records resolved by a promoting
+``DAG_SUBMITTED``), then print the terminal state recovery would infer
+for each DAG and each still-parked submission.
 
 Point it at one or more journal files, at an app's ``recovery/`` directory
 (all attempts are checked in order), or at a staging dir + app id::
@@ -24,6 +26,7 @@ from typing import Dict, List, Optional, Tuple
 
 from tez_tpu.am.history import HistoryEvent, HistoryEventType
 from tez_tpu.am.recovery import JournalLineError, decode_journal_line
+from tez_tpu.dag.plan import DAGPlan
 
 #: Events whose arrival after a DAG's terminal record is a bug (lifecycle
 #: and ledger records; incidental events like NODE_BLACKLISTED may straggle).
@@ -32,6 +35,13 @@ _LIFECYCLE = frozenset({
     HistoryEventType.DAG_STARTED, HistoryEventType.DAG_COMMIT_STARTED,
     HistoryEventType.DAG_COMMIT_FINISHED, HistoryEventType.DAG_COMMIT_ABORTED,
     HistoryEventType.DAG_FINISHED,
+})
+
+#: Admission-queue records: the ``dag_id`` slot carries the submission id,
+#: not a DAG id — they must never materialize a phantom DAG ledger.
+_ADMISSION = frozenset({
+    HistoryEventType.DAG_QUEUED,
+    HistoryEventType.DAG_REQUEUED_ON_RECOVERY,
 })
 
 
@@ -58,6 +68,28 @@ class DagLedger:
 
 
 @dataclasses.dataclass
+class SubLedger:
+    """Per-submission admission ledger: a ``DAG_QUEUED`` (and any successor
+    ``DAG_REQUEUED_ON_RECOVERY``) record is closed by the ``DAG_SUBMITTED``
+    stamped with the same ``sub_id`` — exactly the pairing discipline the
+    commit ledger gets."""
+    queued: int = 0
+    requeued: int = 0
+    promoted: bool = False
+    dag_name: str = ""
+    decode_error: str = ""
+
+    @property
+    def inferred(self) -> str:
+        """What recovery would conclude for this submission."""
+        if self.promoted:
+            return "PROMOTED"
+        if self.decode_error:
+            return f"LOST (plan undecodable: {self.decode_error})"
+        return "UNRESOLVED (successor AM must replay)"
+
+
+@dataclasses.dataclass
 class FsckReport:
     files: List[str] = dataclasses.field(default_factory=list)
     records: int = 0
@@ -65,14 +97,78 @@ class FsckReport:
     warnings: List[str] = dataclasses.field(default_factory=list)
     torn_tail: bool = False
     dags: Dict[str, DagLedger] = dataclasses.field(default_factory=dict)
+    subs: Dict[str, SubLedger] = dataclasses.field(default_factory=dict)
+    sub_order: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
         return not self.errors
 
 
+def _check_admission(report: FsckReport, ev: HistoryEvent,
+                     where: str) -> bool:
+    """Admission-queue pairing.  Returns True when the event was a queue
+    record (consumed here, never a DAG-ledger record)."""
+    t = ev.event_type
+    if t in _ADMISSION:
+        sub_id = ev.dag_id or ""
+        if not sub_id:
+            report.errors.append(f"{where}: {t.name} without a sub_id")
+            return True
+        led = report.subs.get(sub_id)
+        if led is None:
+            led = report.subs[sub_id] = SubLedger()
+            report.sub_order.append(sub_id)
+        if t is HistoryEventType.DAG_QUEUED:
+            if led.queued:
+                report.errors.append(
+                    f"{where}: duplicate DAG_QUEUED for {sub_id}")
+            if led.requeued:
+                report.errors.append(
+                    f"{where}: DAG_QUEUED for {sub_id} after a "
+                    f"DAG_REQUEUED_ON_RECOVERY (attempt order violated)")
+            led.queued += 1
+        else:
+            if not led.queued:
+                report.errors.append(
+                    f"{where}: DAG_REQUEUED_ON_RECOVERY for {sub_id} that "
+                    f"was never DAG_QUEUED")
+            led.requeued += 1
+        if led.promoted:
+            report.errors.append(
+                f"{where}: {t.name} for {sub_id} after its promotion "
+                f"(DAG_SUBMITTED already resolved it)")
+        led.dag_name = ev.data.get("dag_name", "") or led.dag_name
+        raw = ev.data.get("plan")
+        if raw:
+            try:
+                DAGPlan.deserialize(bytes.fromhex(raw))
+                led.decode_error = ""
+            except Exception as e:  # noqa: BLE001 — flagged, not fatal here
+                led.decode_error = repr(e)
+        else:
+            led.decode_error = "queued record carries no plan"
+        return True
+    if t is HistoryEventType.DAG_SUBMITTED:
+        sub_id = ev.data.get("sub_id")
+        if sub_id:
+            led = report.subs.get(sub_id)
+            if led is None:
+                report.errors.append(
+                    f"{where}: DAG_SUBMITTED resolves sub_id {sub_id} that "
+                    f"was never DAG_QUEUED")
+            elif led.promoted:
+                report.errors.append(
+                    f"{where}: duplicate promotion of {sub_id}")
+            else:
+                led.promoted = True
+    return False
+
+
 def _check_event(report: FsckReport, ev: HistoryEvent, where: str) -> None:
     report.records += 1
+    if _check_admission(report, ev, where):
+        return
     dag_id = ev.dag_id
     if dag_id is None:
         return
@@ -148,6 +244,21 @@ def fsck_files(paths: List[str]) -> FsckReport:
                     report.errors.append(f"{where}: corrupt record: {e}")
                 continue
             _check_event(report, ev, where)
+    # an undecodable plan on a still-parked record is lost work — the
+    # successor AM can never replay it; on a promoted record it is merely
+    # suspicious (the live plan object made it through)
+    for sub_id, led in report.subs.items():
+        if not led.decode_error:
+            continue
+        name = led.dag_name or "<unnamed>"
+        if led.promoted:
+            report.warnings.append(
+                f"queued record {sub_id} ({name}): plan undecodable "
+                f"(promoted anyway): {led.decode_error}")
+        else:
+            report.errors.append(
+                f"unresolved queued submission {sub_id} ({name}): plan "
+                f"undecodable — replay impossible: {led.decode_error}")
     return report
 
 
@@ -184,6 +295,11 @@ def print_report(report: FsckReport, verbose: bool = False) -> None:
         commit = led.commit_state or "none"
         print(f"dag {dag_id}: {led.events} record(s), commit-ledger={commit}"
               f" -> terminal: {led.inferred_terminal}")
+    for sub_id in report.sub_order:
+        sub = report.subs[sub_id]
+        print(f"sub {sub_id} ({sub.dag_name or '<unnamed>'}): "
+              f"queued={sub.queued} requeued={sub.requeued}"
+              f" -> {sub.inferred}")
     print("fsck: " + ("CLEAN" if report.ok else
                       f"{len(report.errors)} error(s)"))
 

@@ -6,7 +6,7 @@ PY ?= python
 OLD ?= BENCH_r05.json
 NEW ?= /tmp/bench_new.json
 
-.PHONY: test lint bench bench-new bench-diff bench-merge bench-store bench-sort bench-exchange chaos chaos-device-ooo chaos-device chaos-merge chaos-store chaos-push chaos-exchange chaos-ha soak docs doctor
+.PHONY: test lint bench bench-new bench-diff bench-merge bench-store bench-sort bench-exchange chaos chaos-device-ooo chaos-device chaos-merge chaos-store chaos-push chaos-exchange chaos-ha chaos-stream soak docs doctor
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -81,6 +81,14 @@ chaos-push:
 # failover leg (store.replica.lost, zero producer re-execution)
 chaos-ha:
 	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --am-kill --trials 3
+
+# streaming crash survival: 3 resident streams on one session AM under
+# seeded mid-window task kills, then an AM crash mid-stream with sealed
+# uncommitted windows + a half-filled open spool on disk; the successor
+# window-exact replays from the commit ledger — committed windows
+# bit-exact vs a fault-free feed, zero duplicate commits, bounded lag
+chaos-stream:
+	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --stream-kill --trials 3
 
 # multi-tenant session soak: one resident session AM under barrier-synced
 # recurring DAGs from 3 tenants, forced am.admit.shed / am.queue.delay

@@ -1,0 +1,494 @@
+"""Streaming execution mode (docs/streaming.md): spool codec, the
+generalized ``(attempt_epoch, window_id)`` fence at every seam, the
+StreamDriver lifecycle (cuts, exactly-once commits, backpressure, drain),
+AM-crash resume with window-exact replay, and the window-commit ledger
+rules journal_fsck enforces."""
+import os
+import time
+
+import pytest
+
+from tez_tpu.am.app_master import DAGAppMaster
+from tez_tpu.am.history import HistoryEvent, HistoryEventType
+from tez_tpu.am.recovery import encode_journal_line
+from tez_tpu.am.streaming import (StreamSpec, StreamSpoolError,
+                                  decode_spool_record, encode_spool_record,
+                                  read_spool)
+from tez_tpu.common import config as C
+from tez_tpu.common import epoch as epoch_registry
+from tez_tpu.common.epoch import EpochFencedError, WindowFencedError
+from tez_tpu.common.ids import DAGId, TaskAttemptId
+from tez_tpu.common.payload import (InputDescriptor, OutputDescriptor,
+                                    ProcessorDescriptor)
+from tez_tpu.dag.dag import DAG, Edge, Vertex
+from tez_tpu.dag.edge_property import (DataMovementType, DataSourceType,
+                                       EdgeProperty, SchedulingType)
+from tez_tpu.tools import journal_fsck
+
+APP = "app_1_stream"
+
+
+def _template(name, parallelism=2):
+    source = Vertex.create("source", ProcessorDescriptor.create(
+        "tez_tpu.library.streaming:StreamWindowSourceProcessor"),
+        parallelism)
+    sink = Vertex.create("sink", ProcessorDescriptor.create(
+        "tez_tpu.library.streaming:StreamWindowSinkProcessor"), 1)
+    conf = {"tez.runtime.key.class": "bytes",
+            "tez.runtime.value.class": "long"}
+    prop = EdgeProperty.create(
+        DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create(
+            "tez_tpu.library.outputs:OrderedPartitionedKVOutput",
+            payload=conf),
+        InputDescriptor.create(
+            "tez_tpu.library.inputs:OrderedGroupedKVInput", payload=conf))
+    dag = DAG.create(name).add_vertex(source).add_vertex(sink)
+    dag.add_edge(Edge.create(source, sink, prop))
+    return dag.create_dag_plan()
+
+
+def _conf(tmp_staging, **extra):
+    base = {"tez.staging-dir": tmp_staging,
+            "tez.am.local.num-containers": 4,
+            "tez.am.session.max-concurrent-dags": 2,
+            "tez.am.session.queue-size": 8}
+    base.update(extra)
+    return C.TezConfiguration(base)
+
+
+def _spec(name, out_root, **conf):
+    return StreamSpec(name=name, plan=_template(f"{name}-template"),
+                      output_dir=os.path.join(out_root, name), conf=conf)
+
+
+def _records(n, keys=3):
+    return [{"k": f"key{i % keys}", "v": i + 1} for i in range(n)]
+
+
+def _expected_windows(records, window_count):
+    wins, cur = [], []
+    for r in records:
+        cur.append(r)
+        if len(cur) >= window_count:
+            wins.append(cur)
+            cur = []
+    if cur:
+        wins.append(cur)
+    return wins
+
+
+def _expected_part(recs):
+    totals = {}
+    for r in recs:
+        if isinstance(r, dict) and "k" in r:
+            totals[r["k"]] = totals.get(r["k"], 0) + r.get("v", 1)
+    return "\n".join(f"{k} {v}" for k, v in sorted(totals.items())) + "\n"
+
+
+def _assert_windows(out_dir, records, window_count):
+    wins = _expected_windows(records, window_count)
+    for i, recs in enumerate(wins, start=1):
+        path = os.path.join(out_dir, f"w{i:06d}.part0")
+        assert os.path.exists(path), f"window {i} never published"
+        with open(path) as fh:
+            assert fh.read() == _expected_part(recs), f"window {i} diverged"
+    extra = [n for n in os.listdir(out_dir)
+             if not n.startswith(".") and
+             int(n[1:7]) > len(wins)]
+    assert not extra, f"unexpected windows published: {extra}"
+
+
+def _wait_commits(am, n, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if len(am.logging_service.of_type(
+                HistoryEventType.WINDOW_COMMIT_FINISHED)) >= n:
+            return
+        time.sleep(0.02)
+    pytest.fail(f"{n} WINDOW_COMMIT_FINISHED never journaled")
+
+
+# ----------------------------------------------------------- spool codec
+
+def test_spool_codec_roundtrip_and_torn_tail(tmp_path):
+    records = _records(5) + ["EOW"]
+    path = str(tmp_path / "w000001.spool")
+    with open(path, "w") as fh:
+        for r in records:
+            fh.write(encode_spool_record(r) + "\n")
+    assert read_spool(path) == records
+    # a torn FINAL line is the crash signature: dropped, not replayed
+    with open(path, "a") as fh:
+        fh.write(encode_spool_record({"k": "torn"})[:-4])
+    assert read_spool(path) == records
+    # the same damage mid-file is at-rest corruption: loud failure
+    lines = [encode_spool_record(r) for r in records]
+    lines[1] = lines[1][:8] + lines[1][8:].replace("key", "KEY")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    with pytest.raises(StreamSpoolError):
+        read_spool(path)
+    with pytest.raises(StreamSpoolError):
+        decode_spool_record("nonsense")
+    assert read_spool(str(tmp_path / "missing.spool")) == []
+
+
+# ------------------------------------------------- the generalized fence
+
+def test_window_registry_semantics():
+    assert not epoch_registry.is_stale_window(APP, "s", 0)   # batch
+    assert not epoch_registry.is_stale_window(APP, "", 5)    # no stream
+    assert not epoch_registry.is_stale_window(APP, "s", 3)   # never reg'd
+    assert epoch_registry.register_window(APP, "s", 3) == 3
+    assert epoch_registry.is_stale_window(APP, "s", 2)
+    assert not epoch_registry.is_stale_window(APP, "s", 3)
+    assert not epoch_registry.is_stale_window(APP, "s", 4)
+    # a replayed older window cannot roll the fence back
+    assert epoch_registry.register_window(APP, "s", 2) == 3
+    assert epoch_registry.current_window(APP, "s") == 3
+    # the window coordinate is scoped per (app, stream)
+    assert not epoch_registry.is_stale_window(APP, "other", 1)
+    assert issubclass(WindowFencedError, EpochFencedError)
+
+
+def test_umbilical_fences_stale_window(tmp_staging):
+    """Heartbeat + commit arbitration: a straggler stamped with a sealed
+    window is told to die / refused the commit, with the same typed
+    ATTEMPT_FENCED journal record the epoch fence leaves."""
+    from tez_tpu.am.task_comm import HeartbeatRequest
+    am = DAGAppMaster(APP + "hb", _conf(tmp_staging), attempt=1)
+    am.start()
+    try:
+        epoch_registry.register_window(APP + "hb", "s", 2)
+        zombie = TaskAttemptId(DAGId(APP + "hb", 1).vertex(0).task(0), 0)
+        resp = am.task_comm.heartbeat(HeartbeatRequest(
+            attempt_id=zombie, events=[], epoch=1, window_id=1, stream="s"))
+        assert resp.should_die
+        assert not am.task_comm.can_commit(zombie, epoch=1, window_id=1,
+                                           stream="s")
+        fenced = am.logging_service.of_type(HistoryEventType.ATTEMPT_FENCED)
+        assert fenced and fenced[0].data.get("reason") == "stale_window"
+        # batch stamp (window 0) sails through the window fence
+        resp = am.task_comm.heartbeat(HeartbeatRequest(
+            attempt_id=zombie, events=[], epoch=1))
+        assert not resp.should_die
+    finally:
+        am.stop()
+
+
+def test_shuffle_and_store_seams_fence_stale_window():
+    from tez_tpu.shuffle.service import ShuffleService
+    from tez_tpu.store.buffer_store import ShuffleBufferStore
+    epoch_registry.register_window(APP, "s", 5)
+    svc = ShuffleService()
+    with pytest.raises(WindowFencedError):
+        svc.register("p", 0, run=None, app_id=APP, window_id=4, stream="s")
+    with pytest.raises(WindowFencedError):
+        svc.push_publish("p", 0, run=None, app_id=APP, window_id=4,
+                         stream="s")
+    with pytest.raises(WindowFencedError):
+        svc.fetch_partition("p", 0, 0, app_id=APP, window_id=4, stream="s")
+    store = ShuffleBufferStore()
+    with pytest.raises(WindowFencedError):
+        store.publish("p", 0, run=None, app_id=APP, window_id=4, stream="s")
+    with pytest.raises(WindowFencedError):
+        store.republish_lineage("lin", "p2", app_id=APP, window_id=4,
+                                stream="s")
+    # batch (window 0) is never window-fenced at any of these seams
+    svc.register("batchp", 0, run=object(), app_id=APP, window_id=0,
+                 stream="s")
+
+
+# --------------------------------------------------- driver lifecycle e2e
+
+def test_stream_driver_end_to_end(tmp_staging, tmp_path):
+    records = _records(7)           # count=3: w1, w2 full + w3 cut by drain
+    am = DAGAppMaster(APP + "e2e", _conf(tmp_staging), attempt=1)
+    am.start()
+    try:
+        driver = am.open_stream(_spec(
+            "clicks", str(tmp_path), **{
+                "tez.runtime.stream.window.count": 3}))
+        assert driver.ingest(records) == 3      # open window after 2 cuts
+        final = driver.drain(timeout=60)
+        assert final["committed"] == [1, 2, 3]
+        assert final["retired"] and final["lag"] == 0
+        assert final["aborted"] == [] and final["replayed"] == []
+        _assert_windows(str(tmp_path / "clicks"), records, 3)
+        # the ledger bracket is paired per window, in order
+        started = am.logging_service.of_type(
+            HistoryEventType.WINDOW_COMMIT_STARTED)
+        finished = am.logging_service.of_type(
+            HistoryEventType.WINDOW_COMMIT_FINISHED)
+        assert [e.data["window_id"] for e in started] == [1, 2, 3]
+        assert [e.data["window_id"] for e in finished] == [1, 2, 3]
+        assert all(not e.data["replayed"] for e in finished)
+        with pytest.raises(Exception):          # retired: ingest refused
+            driver.ingest([{"k": "late"}])
+    finally:
+        am.stop()
+    # the journal these runs leave satisfies fsck's window rules
+    files = journal_fsck.discover_journals(
+        os.path.join(tmp_staging, APP + "e2e", "recovery"))
+    report = journal_fsck.fsck_files(files)
+    assert report.ok, report.errors
+    assert report.streams["clicks"].inferred.startswith("RETIRED")
+    assert report.streams["clicks"].committed == [1, 2, 3]
+
+
+def test_punctuation_cuts_windows_early(tmp_staging, tmp_path):
+    records = [{"k": "a", "v": 1}, {"k": "b", "v": 2}, "EOW",
+               {"k": "a", "v": 10}, "EOW"]
+    am = DAGAppMaster(APP + "punc", _conf(tmp_staging), attempt=1)
+    am.start()
+    try:
+        driver = am.open_stream(_spec(
+            "punct", str(tmp_path), **{
+                "tez.runtime.stream.window.count": 100,
+                "tez.runtime.stream.window.punctuation": "EOW"}))
+        driver.ingest(records)
+        final = driver.drain(timeout=60)
+        assert final["committed"] == [1, 2]
+    finally:
+        am.stop()
+    out = str(tmp_path / "punct")
+    with open(os.path.join(out, "w000001.part0")) as fh:
+        assert fh.read() == "a 1\nb 2\n"
+    with open(os.path.join(out, "w000002.part0")) as fh:
+        assert fh.read() == "a 10\n"
+
+
+def test_backpressure_paces_source_and_journals_lagging(tmp_staging,
+                                                        tmp_path):
+    """max-lag=1 with 1-record windows: every second ingest must block
+    until the previous window commits — bounded lag, nothing dropped, ONE
+    typed WINDOW_LAGGING event per lag episode."""
+    records = _records(4)
+    am = DAGAppMaster(APP + "lag", _conf(tmp_staging), attempt=1)
+    am.start()
+    try:
+        driver = am.open_stream(_spec(
+            "paced", str(tmp_path), **{
+                "tez.runtime.stream.window.count": 1,
+                "tez.runtime.stream.max-lag": 1}))
+        driver.ingest(records)
+        final = driver.drain(timeout=120)
+        assert final["committed"] == [1, 2, 3, 4]    # nothing dropped
+        assert final["lag_episodes"] >= 1
+        lagging = am.logging_service.of_type(HistoryEventType.WINDOW_LAGGING)
+        assert len(lagging) == final["lag_episodes"]
+        assert lagging[0].data["max_lag"] == 1
+        assert lagging[0].data["stream"] == "paced"
+    finally:
+        am.stop()
+    _assert_windows(str(tmp_path / "paced"), records, 1)
+
+
+def test_publish_window_is_idempotent(tmp_staging, tmp_path):
+    """The commit bracket's renames roll forward: a tmp whose final name
+    already exists is dropped, never double-published — replaying an open
+    bracket after a crash cannot corrupt a committed window."""
+    class _NoAM:
+        app_id = APP + "pub"
+
+        def __init__(self, conf):
+            self.conf = conf
+
+    am = _NoAM(_conf(tmp_staging))
+    from tez_tpu.am.streaming import StreamDriver
+    driver = StreamDriver(am, _spec("pub", str(tmp_path)))   # never started
+    out = str(tmp_path / "pub")
+    with open(os.path.join(out, ".w000001.part0.tmp"), "w") as fh:
+        fh.write("a 1\n")
+    assert driver._publish_window(1) == 1
+    with open(os.path.join(out, "w000001.part0")) as fh:
+        assert fh.read() == "a 1\n"
+    # a zombie re-creates the tmp with different bytes: the committed
+    # final must win, the tmp must be swallowed
+    with open(os.path.join(out, ".w000001.part0.tmp"), "w") as fh:
+        fh.write("ZOMBIE\n")
+    driver._publish_window(1)
+    with open(os.path.join(out, "w000001.part0")) as fh:
+        assert fh.read() == "a 1\n"
+    assert not os.path.exists(os.path.join(out, ".w000001.part0.tmp"))
+
+
+# ------------------------------------------------------ AM crash resume
+
+def test_am_crash_mid_stream_resumes_window_exact(tmp_staging, tmp_path):
+    """Crash with committed + sealed-uncommitted + open windows on disk:
+    the successor must keep committed windows sealed, window-exact replay
+    the uncommitted sealed one, and resume the open spool with its
+    ingested records intact — exactly one WINDOW_COMMIT_FINISHED per
+    window across both incarnations."""
+    records = _records(9)
+    conf = _conf(tmp_staging)
+    app_id = APP + "crash"
+    am1 = DAGAppMaster(app_id, conf, attempt=1)
+    am1.start()
+    am1.open_stream(_spec("surv", str(tmp_path), **{
+        "tez.runtime.stream.window.count": 3}))
+    am1.streams["surv"].ingest(records[:7])    # w1+w2 sealed, 1 open rec
+    _wait_commits(am1, 1)
+    am1.crash()
+    epoch_registry.reset()
+
+    am2 = DAGAppMaster(app_id, conf, attempt=2)
+    am2.start()
+    try:
+        am2.recover_and_resume()
+        assert "surv" in am2.streams, "stream not resumed from the ledger"
+        driver = am2.streams["surv"]
+        driver.ingest(records[7:])             # open window fills to 3
+        final = driver.drain(timeout=60)
+        assert final["committed"] == [1, 2, 3]
+        # whatever was uncommitted-but-sealed at the crash replayed
+        assert set(final["replayed"]) <= {1, 2}
+    finally:
+        am2.stop()
+    _assert_windows(str(tmp_path / "surv"), records, 3)
+
+    rec_dir = os.path.join(tmp_staging, app_id, "recovery")
+    files = journal_fsck.discover_journals(rec_dir)
+    assert len(files) == 2
+    report = journal_fsck.fsck_files(files)
+    assert report.ok, report.errors
+    assert report.streams["surv"].inferred.startswith("RETIRED")
+    # exactly-once across incarnations: no window committed twice
+    commits = {}
+    for f in files:
+        from tez_tpu.am.recovery import RecoveryParser, decode_journal_line
+        with open(f, errors="replace") as fh:
+            for line in fh:
+                if not line.strip():
+                    continue
+                try:
+                    ev = decode_journal_line(line.strip())
+                except Exception:
+                    continue
+                if ev.event_type is HistoryEventType.WINDOW_COMMIT_FINISHED:
+                    w = ev.data["window_id"]
+                    commits[w] = commits.get(w, 0) + 1
+    assert commits == {1: 1, 2: 1, 3: 1}
+
+
+# --------------------------------------------------- fsck window rules
+
+def _sev(event_type, stream="s", w=None, **extra):
+    data = {"stream": stream}
+    if w is not None:
+        data["window_id"] = w
+    data.update(extra)
+    return HistoryEvent(event_type, data=data)
+
+
+def _write(path, events):
+    os.makedirs(os.path.dirname(str(path)), exist_ok=True)
+    with open(str(path), "w") as fh:
+        for ev in events:
+            fh.write(encode_journal_line(ev) + "\n")
+    return str(path)
+
+
+OPENED = HistoryEventType.STREAM_OPENED
+RETIRED = HistoryEventType.STREAM_RETIRED
+W_START = HistoryEventType.WINDOW_COMMIT_STARTED
+W_FIN = HistoryEventType.WINDOW_COMMIT_FINISHED
+W_ABORT = HistoryEventType.WINDOW_COMMIT_ABORTED
+
+
+def test_fsck_window_ledger_clean_and_violations(tmp_path):
+    # clean: open, two paired brackets in order, retire
+    p = _write(tmp_path / "ok.jsonl", [
+        _sev(OPENED), _sev(W_START, w=1), _sev(W_FIN, w=1),
+        _sev(W_START, w=2), _sev(W_FIN, w=2), _sev(RETIRED)])
+    report = journal_fsck.fsck_files([p])
+    assert report.ok, report.errors
+    assert report.streams["s"].inferred.startswith("RETIRED")
+
+    # duplicate FINISHED: the exactly-once violation
+    p = _write(tmp_path / "dup.jsonl", [
+        _sev(OPENED), _sev(W_START, w=1), _sev(W_FIN, w=1),
+        _sev(W_FIN, w=1)])
+    assert not journal_fsck.fsck_files([p]).ok
+
+    # committed window ids must be strictly increasing
+    p = _write(tmp_path / "order.jsonl", [
+        _sev(OPENED), _sev(W_START, w=2), _sev(W_FIN, w=2),
+        _sev(W_START, w=1), _sev(W_FIN, w=1)])
+    assert not journal_fsck.fsck_files([p]).ok
+
+    # FINISHED without a matching open STARTED
+    p = _write(tmp_path / "orphan.jsonl", [_sev(OPENED), _sev(W_FIN, w=1)])
+    assert not journal_fsck.fsck_files([p]).ok
+
+    # nothing may follow STREAM_RETIRED
+    p = _write(tmp_path / "late.jsonl", [
+        _sev(OPENED), _sev(RETIRED), _sev(W_START, w=1)])
+    assert not journal_fsck.fsck_files([p]).ok
+
+    # events on a never-opened stream
+    p = _write(tmp_path / "noopen.jsonl", [_sev(W_START, w=1)])
+    assert not journal_fsck.fsck_files([p]).ok
+
+
+def test_fsck_window_crash_rollforward_is_warning_not_error(tmp_path):
+    """Attempt 1 dies inside w2's bracket; attempt 2 re-opens the SAME
+    window's bracket (roll-forward) and finishes it — a warning-level
+    crash signature, never an exactly-once error."""
+    rec = tmp_path / "recovery"
+    _write(rec / "1" / "journal.jsonl", [
+        _sev(OPENED), _sev(W_START, w=1), _sev(W_FIN, w=1),
+        _sev(W_START, w=2)])
+    _write(rec / "2" / "journal.jsonl", [
+        _sev(W_START, w=2), _sev(W_FIN, w=2), _sev(RETIRED)])
+    files = journal_fsck.discover_journals(str(rec))
+    report = journal_fsck.fsck_files(files)
+    assert report.ok, report.errors
+    assert any("re-opened" in w for w in report.warnings)
+    assert report.streams["s"].committed == [1, 2]
+    # but a DIFFERENT window barging into an open bracket is an error
+    p = _write(tmp_path / "barge.jsonl", [
+        _sev(OPENED), _sev(W_START, w=1), _sev(W_START, w=2)])
+    assert not journal_fsck.fsck_files([p]).ok
+    # and a live stream's trailing open bracket is the recovery case
+    p = _write(tmp_path / "open.jsonl", [_sev(OPENED), _sev(W_START, w=1)])
+    report = journal_fsck.fsck_files([p])
+    assert report.ok
+    assert "IN-COMMIT" in report.streams["s"].inferred
+
+
+# -------------------------------------------- observability integration
+
+def test_stream_events_reach_parser_and_tools(tmp_staging, tmp_path):
+    records = _records(4)
+    am = DAGAppMaster(APP + "obs", _conf(tmp_staging), attempt=1)
+    am.start()
+    try:
+        driver = am.open_stream(_spec(
+            "obs", str(tmp_path), **{
+                "tez.runtime.stream.window.count": 2}))
+        driver.ingest(records)
+        driver.drain(timeout=60)
+    finally:
+        am.stop()
+    from tez_tpu.tools.counter_diff import stream_summary
+    from tez_tpu.tools.doctor import diagnose_streams
+    from tez_tpu.tools.history_parser import parse_jsonl_files
+    journal = os.path.join(tmp_staging, APP + "obs", "recovery", "1",
+                           "journal.jsonl")
+    dags = parse_jsonl_files([journal])
+    assert dags, "window DAGs missing from the history parse"
+    evs = next(iter(dags.values())).stream_events
+    kinds = [e["event"] for e in evs]
+    assert kinds.count("COMMIT_FINISHED") == 2
+    assert kinds[0] == "OPENED" and kinds[-1] == "RETIRED"
+    summary = stream_summary(dags)
+    assert summary["committed"] == 2 and summary["replayed"] == 0
+    assert summary["p95_ms"] >= summary["p50_ms"] > 0
+    rows = diagnose_streams(dags)
+    assert rows and rows[0]["stream"] == "obs"
+    assert rows[0]["committed"] == 2 and rows[0]["retired"]
+    assert rows[0]["slowest"]["dominant_plane"] != ""

@@ -140,6 +140,9 @@ class DAGAppMaster:
         self.slo_watchdog = _slo.from_conf(conf, journal=self.history)
         from tez_tpu.am.admission import AdmissionController
         self.admission = AdmissionController(self)
+        #: resident stream drivers keyed by stream name (streaming mode,
+        #: docs/streaming.md); populated by open_stream and by recovery
+        self.streams: Dict[str, Any] = {}
         self._register_handlers()
         self._started = False
 
@@ -215,6 +218,8 @@ class DAGAppMaster:
             self.web_ui.stop()
         self.thread_dumper.stop()
         self.heartbeat_monitor.stop()
+        for driver in list(self.streams.values()):
+            driver.crash()   # un-drained streams resume on the successor
         self.admission.stop()
         for dag in list(self.live_dags.values()):
             speculator = getattr(dag, "speculator", None)
@@ -252,6 +257,8 @@ class DAGAppMaster:
         # abandon — not resolve — the admission queue: parked submitters
         # get AMCrashedError and must re-attach; their DAG_QUEUED records
         # stay unresolved in the journal, which is the replay contract
+        for driver in list(self.streams.values()):
+            driver.crash()   # window loop dies mid-bracket; ledger decides
         self.admission.crash()
         for dag in list(self.live_dags.values()):
             speculator = getattr(dag, "speculator", None)
@@ -557,6 +564,44 @@ class DAGAppMaster:
         self.dispatch(DAGEvent(DAGEventType.DAG_START, dag_id))
         return dag_id
 
+    # -- streaming mode (docs/streaming.md) ----------------------------------
+    def open_stream(self, spec: Any) -> Any:
+        """Open a resident windowed stream: journal the rebuildable spec
+        (STREAM_OPENED, fsync'd — the successor incarnation's resume
+        contract), start the driver, hand the ingest surface back."""
+        assert self._started, "AM not started"
+        from tez_tpu.am.streaming import StreamDriver
+        if spec.name in self.streams:
+            raise ValueError(f"stream {spec.name!r} already open")
+        self.history(HistoryEvent(
+            HistoryEventType.STREAM_OPENED, data=spec.journal_data()))
+        driver = StreamDriver(self, spec).start()
+        self.streams[spec.name] = driver
+        return driver
+
+    def _resume_streams(self, parser: Any) -> None:
+        """Resume every non-retired journaled stream (recovery): sealed
+        windows are served from the ledger, the first uncommitted window
+        re-runs from its surviving spool (StreamDriver._resume_from)."""
+        from tez_tpu.am.streaming import StreamDriver
+        for stream_id, rec in parser.stream_records().items():
+            if stream_id in self.streams:
+                continue
+            driver = StreamDriver.resume(self, rec)
+            if driver is not None:
+                self.streams[stream_id] = driver
+                log.info("stream %s: resumed after AM restart", stream_id)
+
+    @staticmethod
+    def _is_window_plan(plan: Optional[DAGPlan]) -> bool:
+        """True for a per-window DAG cloned by a StreamDriver — its
+        replay belongs to the stream's ledger, never the generic DAG
+        recovery path (re-running window N outside the driver would race
+        the resumed stream and break exactly-once)."""
+        if plan is None:
+            return False
+        return bool((plan.dag_conf or {}).get("tez.runtime.stream.id"))
+
     def wait_for_dag(self, dag_id: DAGId,
                      timeout: Optional[float] = None) -> DAGState:
         with self._dag_done:
@@ -632,10 +677,25 @@ class DAGAppMaster:
                             data.dag_id, data.plan.name)
                     self._dag_done.notify_all()
                 continue
+            if self._is_window_plan(data.plan):
+                # a stream's in-flight window DAG: keep the id sequence
+                # monotonic but leave the re-run to the resumed driver —
+                # the window-commit ledger, not DAG recovery, decides
+                # whether window N runs again (docs/streaming.md)
+                try:
+                    seq = int(data.dag_id.rsplit("_", 1)[1])
+                    self._dag_seq = max(self._dag_seq, seq)
+                except (ValueError, IndexError):
+                    pass
+                log.info("dag %s: window DAG of stream %s — deferring to "
+                         "stream resume", data.dag_id,
+                         data.plan.dag_conf.get("tez.runtime.stream.id"))
+                continue
             recovered = self._recover_one(data)
             if recovered is not None:
                 last = recovered
         self._replay_admission_queue(parser)
+        self._resume_streams(parser)
         return last
 
     def _recover_one(self, data: Any) -> Optional[DAGId]:
@@ -721,6 +781,12 @@ class DAGAppMaster:
                           rec["decode_error"])
                 continue
             plan = DAGPlan.deserialize(bytes.fromhex(rec["plan"]))
+            if self._is_window_plan(plan):
+                # the resumed StreamDriver resubmits its own windows;
+                # requeueing here would double-run the window
+                log.info("queued submission %s (%s): window DAG, deferring "
+                         "to stream resume", rec["sub_id"], plan.name)
+                continue
             self.admission.requeue(plan, rec.get("tenant") or "",
                                    rec["sub_id"])
 

@@ -72,6 +72,21 @@ class HistoryEventType(enum.Enum):
     # (tenant, kind, observed, target ride in data) so chaos/soak can
     # assert on breaches straight from the journal
     TENANT_SLO_BREACH = enum.auto()
+    # streaming mode (am/streaming.py, docs/streaming.md): STREAM_OPENED
+    # journals the resident stream's full spec (so a successor AM can
+    # rebuild the window driver after a crash); STREAM_RETIRED seals it —
+    # no window commit may ever follow.  WINDOW_COMMIT_STARTED/FINISHED/
+    # ABORTED are the per-window exactly-once ledger, the windowed analog
+    # of the DAG_COMMIT_* records (stream + window_id ride in data);
+    # WINDOW_LAGGING types one backpressure episode (ingest blocked at
+    # tez.runtime.stream.max-lag).  All summary events: each must be on
+    # disk before the stream advances past it.
+    STREAM_OPENED = enum.auto()
+    STREAM_RETIRED = enum.auto()
+    WINDOW_COMMIT_STARTED = enum.auto()
+    WINDOW_COMMIT_FINISHED = enum.auto()
+    WINDOW_COMMIT_ABORTED = enum.auto()
+    WINDOW_LAGGING = enum.auto()
 
 
 #: Events whose loss recovery cannot tolerate — flushed synchronously.
@@ -92,6 +107,12 @@ SUMMARY_EVENT_TYPES = frozenset({
     HistoryEventType.DAG_REQUEUED_ON_RECOVERY,
     HistoryEventType.ATTEMPT_FENCED,
     HistoryEventType.TENANT_SLO_BREACH,
+    HistoryEventType.STREAM_OPENED,
+    HistoryEventType.STREAM_RETIRED,
+    HistoryEventType.WINDOW_COMMIT_STARTED,
+    HistoryEventType.WINDOW_COMMIT_FINISHED,
+    HistoryEventType.WINDOW_COMMIT_ABORTED,
+    HistoryEventType.WINDOW_LAGGING,
 })
 
 

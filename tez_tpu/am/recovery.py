@@ -13,6 +13,7 @@ import dataclasses
 import json
 import logging
 import os
+import threading
 import time
 import zlib
 from typing import Any, Dict, List, Optional, Set
@@ -31,6 +32,14 @@ COMMIT_LEDGER_TYPES = frozenset({
     HistoryEventType.DAG_COMMIT_STARTED,
     HistoryEventType.DAG_COMMIT_FINISHED,
     HistoryEventType.DAG_COMMIT_ABORTED,
+    # streaming window ledger (am/streaming.py): the per-window analog of
+    # the DAG commit WAL — STARTED fsync'd before the committer touches
+    # the output dir, FINISHED/ABORTED before the stream advances, so the
+    # commit.ledger.fsync fault point crashes streams mid-commit exactly
+    # like batch DAGs
+    HistoryEventType.WINDOW_COMMIT_STARTED,
+    HistoryEventType.WINDOW_COMMIT_FINISHED,
+    HistoryEventType.WINDOW_COMMIT_ABORTED,
 })
 
 
@@ -88,6 +97,10 @@ class RecoveryService:
         self.flush_interval = float(
             ctx.conf.get(C.DAG_RECOVERY_FLUSH_INTERVAL_SECS) or 0)
         self._last_flush = 0.0
+        # appenders are no longer single-threaded: the dispatcher,
+        # admission threads AND every resident stream driver journal
+        # through here — unserialized writes interleave records mid-line
+        self._write_lock = threading.Lock()
 
     def start(self) -> None:
         os.makedirs(self.dir, exist_ok=True)
@@ -98,37 +111,51 @@ class RecoveryService:
         if self._fh is None:
             return
         faults.fire("am.recovery.append", detail=event.event_type.name)
-        self._fh.write(encode_journal_line(event) + "\n")
-        if event.is_summary:
-            if event.event_type in COMMIT_LEDGER_TYPES:
-                # fail mode here IS the mid-commit AM crash: the ledger
-                # record may or may not have reached disk, and recovery
-                # must cope with either
-                faults.fire("commit.ledger.fsync",
-                            detail=event.event_type.name)
+        if event.is_summary and event.event_type in COMMIT_LEDGER_TYPES:
+            # fail mode here IS the mid-commit AM crash: the ledger
+            # record may or may not have reached disk, and recovery
+            # must cope with either
+            faults.fire("commit.ledger.fsync",
+                        detail=event.event_type.name)
+        fd = None
+        with self._write_lock:
+            if self._fh is None:  # lost a race with stop()
+                return
+            self._fh.write(encode_journal_line(event) + "\n")
+            if event.is_summary:
+                # flush under the lock (it drains the shared buffer in
+                # write order); the fsync happens OUTSIDE it so bulk task
+                # events never queue behind a disk barrier — the kernel
+                # syncs everything flushed so far, which includes ours
+                self._fh.flush()
+                fd = self._fh.fileno()
+                self._last_flush = time.time()
+            elif self.flush_interval > 0:
+                now = time.time()
+                if now - self._last_flush >= self.flush_interval:
+                    self._fh.flush()
+                    self._last_flush = now
+        if fd is not None:
             faults.fire("am.recovery.fsync", detail=event.event_type.name)
             from tez_tpu.common import metrics, tracing
             t0 = time.perf_counter()
-            self._fh.flush()
-            os.fsync(self._fh.fileno())
+            try:
+                os.fsync(fd)
+            except OSError:      # raced stop(); the flush already landed
+                pass
             fsync_ms = (time.perf_counter() - t0) * 1000.0
             metrics.observe("commit.ledger.fsync", fsync_ms)
             if event.event_type in COMMIT_LEDGER_TYPES:
                 tracing.event("commit.ledger.fsync",
                               record=event.event_type.name,
                               dag_id=event.dag_id, ms=round(fsync_ms, 3))
-            self._last_flush = time.time()
-        elif self.flush_interval > 0:
-            now = time.time()
-            if now - self._last_flush >= self.flush_interval:
-                self._fh.flush()
-                self._last_flush = now
 
     def stop(self) -> None:
-        if self._fh is not None:
-            self._fh.flush()
-            self._fh.close()
-            self._fh = None
+        with self._write_lock:
+            if self._fh is not None:
+                self._fh.flush()
+                self._fh.close()
+                self._fh = None
 
 
 @dataclasses.dataclass
@@ -282,7 +309,9 @@ class RecoveryParser:
         events: List[HistoryEvent] = []
         files = self.journal_files()
         for fi, path in enumerate(files):
-            with open(path) as fh:
+            # lenient decode: a crash can tear the tail mid-byte, and the
+            # CRC frame (not the codec) is what rejects mangled records
+            with open(path, errors="replace") as fh:
                 lines = [ln.strip() for ln in fh]
             while lines and not lines[-1]:
                 lines.pop()
@@ -374,6 +403,52 @@ class RecoveryParser:
             else:
                 rec["decode_error"] = "queued record carries no plan"
             out.append(rec)
+        return out
+
+    def stream_records(self) -> Dict[str, Dict[str, Any]]:
+        """Streaming recovery state, per stream id (docs/streaming.md).
+
+        Replays the window-commit ledger: a window with a
+        ``WINDOW_COMMIT_FINISHED`` record is sealed forever (a successor
+        AM serves it from disk, never re-runs it); the first window
+        after ``last_committed`` is where the resumed stream picks up.
+        Each value::
+
+            {"spec": <STREAM_OPENED data>, "retired": bool,
+             "committed": set[int], "aborted": set[int],
+             "open_started": set[int],   # STARTED with no FINISHED/ABORTED
+             "last_committed": int}
+        """
+        out: Dict[str, Dict[str, Any]] = {}
+
+        def rec(stream: str) -> Dict[str, Any]:
+            if stream not in out:
+                out[stream] = {"spec": None, "retired": False,
+                               "committed": set(), "aborted": set(),
+                               "open_started": set(), "last_committed": 0}
+            return out[stream]
+
+        for ev in self.read_events():
+            t = ev.event_type
+            if t is HistoryEventType.STREAM_OPENED:
+                r = rec(ev.data.get("stream", ""))
+                r["spec"] = dict(ev.data)
+            elif t is HistoryEventType.STREAM_RETIRED:
+                rec(ev.data.get("stream", ""))["retired"] = True
+            elif t in (HistoryEventType.WINDOW_COMMIT_STARTED,
+                       HistoryEventType.WINDOW_COMMIT_FINISHED,
+                       HistoryEventType.WINDOW_COMMIT_ABORTED):
+                r = rec(ev.data.get("stream", ""))
+                w = int(ev.data.get("window_id", 0))
+                if t is HistoryEventType.WINDOW_COMMIT_STARTED:
+                    r["open_started"].add(w)
+                elif t is HistoryEventType.WINDOW_COMMIT_FINISHED:
+                    r["open_started"].discard(w)
+                    r["committed"].add(w)
+                    r["last_committed"] = max(r["last_committed"], w)
+                else:
+                    r["open_started"].discard(w)
+                    r["aborted"].add(w)
         return out
 
     def _parse_dag(self, dag_id: str, plan: Optional[DAGPlan],

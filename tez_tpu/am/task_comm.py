@@ -37,6 +37,10 @@ class HeartbeatRequest:
     progress: float = 0.0
     #: AM epoch stamped into the runner's TaskSpec (0 = unstamped/legacy)
     epoch: int = 0
+    #: Streaming window coordinate of the generalized fence (0 = batch)
+    window_id: int = 0
+    #: Stream identity the window belongs to ("" = not streaming)
+    stream: str = ""
 
 
 @dataclasses.dataclass
@@ -82,11 +86,28 @@ class TaskCommunicatorManager:
         if self.epoch > 0:
             epoch_registry.register(getattr(ctx, "app_id", ""), self.epoch)
 
-    def _fenced(self, msg_epoch: int, detail: str) -> bool:
-        """True when the caller (or this AM itself) is from a stale epoch."""
+    def _fenced(self, msg_epoch: int, detail: str,
+                window_id: int = 0, stream: str = "") -> bool:
+        """True when the caller (or this AM itself) is from a stale epoch,
+        or — streaming mode — from a *known-older window* of a live stream
+        (the ``(attempt_epoch, window_id)`` fence generalization)."""
         if not self._fencing or self.epoch <= 0:
             return False
         app_id = getattr(self.ctx, "app_id", "")
+        if epoch_registry.is_stale_window(app_id, stream, window_id):
+            faults.fire("fence.stale_window", detail=detail)
+            tracing.event("fence.stale_window", seam="umbilical",
+                          reason="stale_window", window_id=window_id,
+                          stream=stream,
+                          current=epoch_registry.current_window(
+                              app_id, stream),
+                          detail=detail)
+            log.warning("fenced stale-window message (%s window %d < %d): %s",
+                        stream, window_id,
+                        epoch_registry.current_window(app_id, stream), detail)
+            self._record_fence("stale_window", msg_epoch, detail,
+                               window_id=window_id, stream=stream)
+            return True
         if 0 < msg_epoch < self.epoch:
             faults.fire("fence.stale_epoch", detail=detail)
             tracing.event("fence.stale_epoch", seam="umbilical",
@@ -108,7 +129,8 @@ class TaskCommunicatorManager:
             return True
         return False
 
-    def _record_fence(self, reason: str, msg_epoch: int, detail: str) -> None:
+    def _record_fence(self, reason: str, msg_epoch: int, detail: str,
+                      window_id: int = 0, stream: str = "") -> None:
         """Make every fencing rejection forensically visible: a flight MARK
         (acceptance surface for chaos --am-kill) plus an ATTEMPT_FENCED
         journal record (counter_diff's zombie-fenced tally).  Rare by
@@ -123,10 +145,12 @@ class TaskCommunicatorManager:
             return
         try:
             from tez_tpu.am.history import HistoryEvent, HistoryEventType
-            history(HistoryEvent(
-                HistoryEventType.ATTEMPT_FENCED,
-                data={"reason": reason, "msg_epoch": msg_epoch,
-                      "am_epoch": self.epoch, "detail": detail}))
+            data = {"reason": reason, "msg_epoch": msg_epoch,
+                    "am_epoch": self.epoch, "detail": detail}
+            if stream:
+                data["stream"] = stream
+                data["window_id"] = window_id
+            history(HistoryEvent(HistoryEventType.ATTEMPT_FENCED, data=data))
         except Exception:  # noqa: BLE001 — forensics never block fencing
             log.exception("ATTEMPT_FENCED journaling failed")
 
@@ -157,7 +181,9 @@ class TaskCommunicatorManager:
         # surfaces as an umbilical fault on the runner side
         faults.fire("am.heartbeat", detail=str(request.attempt_id))
         if self._fenced(getattr(request, "epoch", 0),
-                        f"heartbeat {request.attempt_id}"):
+                        f"heartbeat {request.attempt_id}",
+                        window_id=getattr(request, "window_id", 0),
+                        stream=getattr(request, "stream", "")):
             # a zombie runner must stop, not keep feeding a dead (or wrong)
             # incarnation's state machines
             return HeartbeatResponse(events=[], should_die=True)
@@ -175,10 +201,13 @@ class TaskCommunicatorManager:
         events = self._pull_events(request.attempt_id, session)
         return HeartbeatResponse(events=events, should_die=session.killed)
 
-    def can_commit(self, attempt_id: TaskAttemptId, epoch: int = 0) -> bool:
+    def can_commit(self, attempt_id: TaskAttemptId, epoch: int = 0,
+                   window_id: int = 0, stream: str = "") -> bool:
         # commit arbitration is the last line of exactly-once defense: a
-        # zombie attempt (or this comm itself, once superseded) never wins
-        if self._fenced(epoch, f"can_commit {attempt_id}"):
+        # zombie attempt (or this comm itself, once superseded) never wins,
+        # and neither does a straggler from a sealed streaming window
+        if self._fenced(epoch, f"can_commit {attempt_id}",
+                        window_id=window_id, stream=stream):
             return False
         vertex = self._vertex_for(attempt_id)
         if vertex is None:
@@ -190,8 +219,10 @@ class TaskCommunicatorManager:
             return task.can_commit(attempt_id)
 
     def task_done(self, attempt_id: TaskAttemptId, events: List[TezEvent],
-                  counters: Optional[TezCounters], epoch: int = 0) -> None:
-        if self._fenced(epoch, f"task_done {attempt_id}"):
+                  counters: Optional[TezCounters], epoch: int = 0,
+                  window_id: int = 0, stream: str = "") -> None:
+        if self._fenced(epoch, f"task_done {attempt_id}",
+                        window_id=window_id, stream=stream):
             return
         if events:
             self._route_events(attempt_id, events)

@@ -207,12 +207,16 @@ class RemoteUmbilical(FramedClient):
     def heartbeat(self, request: Any) -> Any:
         return self._call("heartbeat", request)
 
-    def can_commit(self, attempt_id: Any, epoch: int = 0) -> bool:
-        return self._call("can_commit", attempt_id, epoch=epoch)
+    def can_commit(self, attempt_id: Any, epoch: int = 0,
+                   window_id: int = 0, stream: str = "") -> bool:
+        return self._call("can_commit", attempt_id, epoch=epoch,
+                          window_id=window_id, stream=stream)
 
     def task_done(self, attempt_id: Any, events: Any, counters: Any,
-                  epoch: int = 0) -> None:
-        self._call("task_done", attempt_id, events, counters, epoch=epoch)
+                  epoch: int = 0, window_id: int = 0,
+                  stream: str = "") -> None:
+        self._call("task_done", attempt_id, events, counters, epoch=epoch,
+                   window_id=window_id, stream=stream)
 
     def task_failed(self, attempt_id: Any, diagnostics: str,
                     fatal: bool = False, counters: Any = None) -> None:

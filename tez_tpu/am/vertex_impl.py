@@ -830,6 +830,8 @@ class VertexImpl:
             lineage=getattr(self.dag, "lineage_hashes", {}).get(self.name,
                                                                 ""),
             tenant=getattr(self.dag, "tenant", ""),
+            window_id=int(self.conf.get(C.STREAM_WINDOW_ID) or 0),
+            stream=str(self.conf.get(C.STREAM_ID) or ""),
         )
 
     def status_dict(self) -> Dict[str, Any]:

@@ -303,6 +303,13 @@ AM_SLO_MIN_COUNT = _key(
     "Minimum observations (completed DAGs / queue waits / admission "
     "verdicts) before an SLO target is evaluated, so a single outlier "
     "cannot latch a breach")
+AM_SLO_WINDOW_P95_MS = _key(
+    "tez.am.slo.window.p95-ms", 0.0, Scope.AM,
+    "Streaming SLO target on p95 per-window commit latency in ms (cut -> "
+    "WINDOW_COMMIT_FINISHED), evaluated live from the stream.window.latency "
+    "histogram; a breach latches a TENANT_SLO_BREACH history event under "
+    "the stream's tenant and surfaces on GET /slo "
+    "(0 = watchdog off; docs/streaming.md)")
 METRICS_ENABLED = _key(
     "tez.metrics.enabled", True, Scope.AM,
     "Serve GET /metrics (Prometheus text: counters, latency histograms, "
@@ -852,6 +859,55 @@ STORE_RESULT_CACHE_ADMIT = _key(
     "committed lineage-tagged output, 'second-use' seals only lineage "
     "keys already observed once this session (scan-resistant), 'never' "
     "disables sealing (lineage reuse off for quota purposes)")
+STREAM_ID = _key(
+    "tez.runtime.stream.id", "", Scope.DAG,
+    "streaming mode: stream identity stamped onto every per-window DAG "
+    "plan (and every TaskSpec) by the window driver; the key of the "
+    "(attempt_epoch, window_id) fence registry and the marker recovery "
+    "uses to hand window DAGs back to the driver instead of resubmitting "
+    "them.  '' = batch DAG (docs/streaming.md)")
+STREAM_WINDOW_ID = _key(
+    "tez.runtime.stream.window-id", 0, Scope.DAG,
+    "streaming mode: the numbered window a per-window DAG computes, "
+    "stamped by the window driver; rides every TaskSpec/heartbeat/"
+    "shuffle-register/push/store-publish as the second fence coordinate. "
+    "0 = batch (never fenced; pre-streaming semantics)")
+STREAM_WINDOW_COUNT = _key(
+    "tez.runtime.stream.window.count", 100, Scope.AM,
+    "count-based window cut: the source seals the open window after this "
+    "many ingested records (punctuation, if configured, can cut earlier)")
+STREAM_WINDOW_PUNCTUATION = _key(
+    "tez.runtime.stream.window.punctuation", "", Scope.AM,
+    "punctuation-based window cut: ingesting a record whose key equals "
+    "this token seals the open window (the punctuation record itself is "
+    "not part of any window).  '' = count-based cuts only")
+STREAM_MAX_LAG = _key(
+    "tez.runtime.stream.max-lag", 4, Scope.AM,
+    "backpressure bound on windows cut but not yet committed: ingest() "
+    "blocks (source pacing) once the lag reaches this many windows, "
+    "journaling one typed WINDOW_LAGGING event per lag episode and "
+    "observing stream.window.lag — bounded lag, never OOM or silent "
+    "drop (docs/streaming.md)")
+STREAM_INGEST_POLL_MS = _key(
+    "tez.runtime.stream.ingest.poll-ms", 10.0, Scope.AM,
+    "poll interval of a backpressured ingest() while it waits for the "
+    "window lag to drop back under tez.runtime.stream.max-lag")
+STREAM_WINDOW_TIMEOUT_SECS = _key(
+    "tez.runtime.stream.window.timeout-secs", 120.0, Scope.AM,
+    "per-window DAG completion deadline; a window that neither succeeds "
+    "nor fails inside it aborts the window (WINDOW_COMMIT_ABORTED) and "
+    "fails the stream rather than stalling ingest forever")
+STREAM_INPUT = _key(
+    "tez.runtime.stream.input", "", Scope.DAG,
+    "spool file of the sealed window a per-window DAG reads (CRC-framed "
+    "record journal under <staging>/stream/<stream>/); stamped by the "
+    "window driver, read by StreamWindowSourceProcessor")
+STREAM_OUTPUT_DIR = _key(
+    "tez.runtime.stream.output-dir", "", Scope.DAG,
+    "directory per-window results land in: the sink writes "
+    ".w<N>.<part>.tmp files and the driver's exactly-once committer "
+    "renames them to w<N>.part<i> between WINDOW_COMMIT_STARTED and "
+    "WINDOW_COMMIT_FINISHED ledger records")
 
 
 def runtime_conf_subset(conf: Mapping) -> "TezConfiguration":

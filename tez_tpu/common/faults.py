@@ -99,6 +99,19 @@ KNOWN_POINTS: Dict[str, str] = {
     "fence.stale_epoch":
         "observability point fired wherever a stale-epoch actor is rejected "
         "(task_comm, shuffle service/server, committer publish fence)",
+    "fence.stale_window":
+        "observability point fired wherever a stale-WINDOW actor is "
+        "rejected — the streaming generalization of fence.stale_epoch "
+        "(umbilical, shuffle register/push/fetch, store publish)",
+    "stream.window.commit":
+        "am/streaming.py exactly-once window committer, fired between the "
+        "WINDOW_COMMIT_STARTED and WINDOW_COMMIT_FINISHED ledger records "
+        "(detail = <stream>@w<window>); fail mode crashes the stream "
+        "mid-commit — the chaos --stream-kill lever",
+    "stream.ingest":
+        "am/streaming.py StreamDriver.ingest (detail = <stream> record "
+        "count); delay mode paces the source, fail mode drops the ingest "
+        "call with a typed error",
     "device.dispatch.delay":
         "ops/async_stage.py readback completion (detail = span=<id>); delay "
         "mode holds one span's completion while later spans drain past it — "

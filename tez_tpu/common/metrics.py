@@ -154,7 +154,12 @@ WELL_KNOWN_HISTOGRAMS = ("shuffle.fetch.rtt", "spill.write", "shuffle.merge",
                          # flight recorder (obs/flight.py): one snapshot
                          # serialize + atomic write when a dump trigger
                          # (DAG failure, breaker-open, watchdog, shed) fires
-                         "obs.flight.dump")
+                         "obs.flight.dump",
+                         # streaming mode (am/streaming.py): per-window
+                         # cut->commit latency, and the window lag the
+                         # backpressure gate observed while pacing the
+                         # source (unit: windows, not ms)
+                         "stream.window.latency", "stream.window.lag")
 
 
 class MetricsRegistry:

@@ -158,7 +158,10 @@ class ShuffleFetchTable:
         republished) is served zero-copy instead of over TCP."""
         try:
             batch = self.service.fetch_partition(
-                path, spill, partition, counters=self.context.counters)
+                path, spill, partition, counters=self.context.counters,
+                app_id=getattr(self.context, "app_id", ""),
+                window_id=getattr(self.context, "window_id", 0),
+                stream=getattr(self.context, "stream", ""))
         except ShuffleDataNotFound:
             return None
         with self._deliver_lock:
@@ -182,7 +185,10 @@ class ShuffleFetchTable:
                           spill=payload.spill_id, partition=partition):
             faults.fire("shuffle.fetch.read", detail=payload.path_component)
             batch = self.service.fetch_partition(
-                payload.path_component, payload.spill_id, partition)
+                payload.path_component, payload.spill_id, partition,
+                app_id=getattr(self.context, "app_id", ""),
+                window_id=getattr(self.context, "window_id", 0),
+                stream=getattr(self.context, "stream", ""))
         metrics.observe("shuffle.fetch.rtt",
                         (_time.perf_counter() - t0) * 1000.0,
                         counters=self.context.counters)

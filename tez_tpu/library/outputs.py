@@ -212,7 +212,9 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                 app_id=getattr(ctx, "app_id", ""),
                 tenant=getattr(ctx, "tenant", ""),
                 replicas=int(_conf_get(
-                    ctx, "tez.runtime.shuffle.push.replicas", 1)))
+                    ctx, "tez.runtime.shuffle.push.replicas", 1)),
+                window_id=getattr(ctx, "window_id", 0),
+                stream=getattr(ctx, "stream", ""))
         store = self.service.buffer_store()
         if self._lineage and store is not None:
             # a non-pipelined output seals exactly one run (spill -1);
@@ -237,7 +239,9 @@ class OrderedPartitionedKVOutput(LogicalOutput):
         store.republish_lineage(self._lineage, path,
                                 epoch=getattr(ctx, "am_epoch", 0),
                                 app_id=getattr(ctx, "app_id", ""),
-                                counters=ctx.counters)
+                                counters=ctx.counters,
+                                window_id=getattr(ctx, "window_id", 0),
+                                stream=getattr(ctx, "stream", ""))
         run = store.get(path, -1)
         ctx.counters.increment(TaskCounter.OUTPUT_BYTES_PHYSICAL, run.nbytes)
         ctx.counters.find_counter("ShuffleStore",
@@ -322,7 +326,10 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                               lineage=self._lineage,
                               tenant=getattr(self.context, "tenant", ""),
                               counters=self.context.counters,
-                              use_store=not push)
+                              use_store=not push,
+                              window_id=getattr(self.context,
+                                                "window_id", 0),
+                              stream=getattr(self.context, "stream", ""))
         # last=False; close() sends the final marker
         self.context.send_events(self._events_for_run(run, spill_id, False))
         self._spills_sent += 1
@@ -356,7 +363,10 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                                   _empty_run(self.num_physical_outputs),
                                   epoch=getattr(self.context, "am_epoch", 0),
                                   app_id=getattr(self.context, "app_id", ""),
-                                  counters=self.context.counters)
+                                  counters=self.context.counters,
+                                  window_id=getattr(self.context,
+                                                    "window_id", 0),
+                                  stream=getattr(self.context, "stream", ""))
             return [CompositeDataMovementEvent(0, self.num_physical_outputs,
                                                payload)]
         assert final_run is not None
@@ -366,7 +376,10 @@ class OrderedPartitionedKVOutput(LogicalOutput):
                               app_id=getattr(self.context, "app_id", ""),
                               lineage=self._lineage,
                               tenant=getattr(self.context, "tenant", ""),
-                              counters=self.context.counters)
+                              counters=self.context.counters,
+                              window_id=getattr(self.context,
+                                                "window_id", 0),
+                              stream=getattr(self.context, "stream", ""))
         self.context.counters.increment(
             TaskCounter.OUTPUT_BYTES_PHYSICAL, final_run.nbytes)
         return self._events_for_run(final_run, -1, True)

@@ -11,7 +11,11 @@ Targets (``tez.am.slo.*``, all disabled at 0):
   the queue is one FIFO, so queue wait is a property of the session, not
   a tenant — reported under tenant ``*``);
 - ``shed-rate`` — shed / (accepted + shed) per tenant, from the
-  admission controller's live tenant stats.
+  admission controller's live tenant stats;
+- ``window.p95-ms`` — p95 window cut→commit latency of streaming mode,
+  read from the ``stream.window.latency`` histogram the StreamDriver
+  feeds on every ``WINDOW_COMMIT_FINISHED`` (session-wide like queue
+  wait: reported under tenant ``*``).
 
 Evaluation is *edge-triggered and latched*: a (tenant, kind) pair
 breaches once when it crosses its target and clears once when it drops
@@ -40,6 +44,7 @@ _HISTORY_LIMIT = 64
 KIND_SUBMIT = "submit_p95_ms"
 KIND_QUEUE_WAIT = "queue_wait_p95_ms"
 KIND_SHED_RATE = "shed_rate"
+KIND_WINDOW = "window_p95_ms"
 
 
 class SloWatchdog:
@@ -51,6 +56,7 @@ class SloWatchdog:
         self.queue_wait_p95_ms = float(
             conf.get(C.AM_SLO_QUEUE_WAIT_P95_MS) or 0.0)
         self.shed_rate = float(conf.get(C.AM_SLO_SHED_RATE) or 0.0)
+        self.window_p95_ms = float(conf.get(C.AM_SLO_WINDOW_P95_MS) or 0.0)
         self.min_count = max(1, int(conf.get(C.AM_SLO_MIN_COUNT) or 1))
         self._journal = journal
         self._lock = threading.Lock()
@@ -63,12 +69,13 @@ class SloWatchdog:
 
     def enabled(self) -> bool:
         return (self.submit_p95_ms > 0 or self.queue_wait_p95_ms > 0
-                or self.shed_rate > 0)
+                or self.shed_rate > 0 or self.window_p95_ms > 0)
 
     def targets(self) -> Dict[str, float]:
         return {KIND_SUBMIT: self.submit_p95_ms,
                 KIND_QUEUE_WAIT: self.queue_wait_p95_ms,
-                KIND_SHED_RATE: self.shed_rate}
+                KIND_SHED_RATE: self.shed_rate,
+                KIND_WINDOW: self.window_p95_ms}
 
     # -- evaluation --------------------------------------------------------
     def _checks(self, tenant_stats: Dict[str, Dict[str, int]]
@@ -94,6 +101,11 @@ class SloWatchdog:
             if h is not None and h.count >= self.min_count:
                 out.append(("*", KIND_QUEUE_WAIT, h.quantile(0.95),
                             self.queue_wait_p95_ms))
+        if self.window_p95_ms > 0:
+            h = hists.get("stream.window.latency")
+            if h is not None and h.count >= self.min_count:
+                out.append(("*", KIND_WINDOW, h.quantile(0.95),
+                            self.window_p95_ms))
         return out
 
     def evaluate(self, tenant_stats: Dict[str, Dict[str, int]]

@@ -79,6 +79,16 @@ class _BaseContext:
         return getattr(self._runner.spec, "tenant", "")
 
     @property
+    def window_id(self) -> int:
+        """Streaming window this attempt computes (0 = batch/unstamped)."""
+        return getattr(self._runner.spec, "window_id", 0)
+
+    @property
+    def stream(self) -> str:
+        """Stream identity for the window fence ("" = not streaming)."""
+        return getattr(self._runner.spec, "stream", "")
+
+    @property
     def counters(self) -> TezCounters:
         return self._runner.counters
 
@@ -126,9 +136,12 @@ class _BaseContext:
         leaf outputs can gate publishing (reference: canCommit flows through
         the processor, but output commit also honors it).  The spec's AM
         epoch rides along so a zombie attempt from a pre-crash incarnation
-        is fenced at the arbitration seam."""
+        is fenced at the arbitration seam; in streaming mode the window id
+        rides along too so a straggler from a sealed window is fenced the
+        same way."""
         return self._runner.umbilical.can_commit(
-            self._runner.spec.attempt_id, epoch=self.am_epoch)
+            self._runner.spec.attempt_id, epoch=self.am_epoch,
+            window_id=self.window_id, stream=self.stream)
 
     @property
     def work_dirs(self) -> List[str]:
@@ -178,4 +191,5 @@ class TezProcessorContext(_BaseContext, ProcessorContext):
 
     def can_commit(self) -> bool:
         return self._runner.umbilical.can_commit(
-            self._runner.spec.attempt_id, epoch=self.am_epoch)
+            self._runner.spec.attempt_id, epoch=self.am_epoch,
+            window_id=self.window_id, stream=self.stream)

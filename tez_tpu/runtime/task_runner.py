@@ -145,9 +145,11 @@ class TaskRunner:
         self.counters.find_counter(TaskCounter.WALL_CLOCK_MILLISECONDS)\
             .set_value(int((time.time() - start) * 1000))
         if state == "SUCCEEDED":
-            self.umbilical.task_done(self.spec.attempt_id,
-                                     self._drain_events(), self.counters,
-                                     epoch=getattr(self.spec, "am_epoch", 0))
+            self.umbilical.task_done(
+                self.spec.attempt_id, self._drain_events(), self.counters,
+                epoch=getattr(self.spec, "am_epoch", 0),
+                window_id=getattr(self.spec, "window_id", 0),
+                stream=getattr(self.spec, "stream", ""))
         elif state == "KILLED":
             self.umbilical.task_killed(self.spec.attempt_id,
                                        "killed during execution")
@@ -310,7 +312,9 @@ class TaskRunner:
         from tez_tpu.am.task_comm import HeartbeatRequest
         req = HeartbeatRequest(self.spec.attempt_id, self._drain_events(),
                                counters=None, progress=self.progress,
-                               epoch=getattr(self.spec, "am_epoch", 0))
+                               epoch=getattr(self.spec, "am_epoch", 0),
+                               window_id=getattr(self.spec, "window_id", 0),
+                               stream=getattr(self.spec, "stream", ""))
         t0 = time.perf_counter()
         resp = self.umbilical.heartbeat(req)
         metrics.observe("am.heartbeat.rtt",

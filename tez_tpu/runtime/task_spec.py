@@ -67,6 +67,14 @@ class TaskSpec:
     #: the task scheduler's deficit round-robin and the buffer store's
     #: byte quotas key on it ("" = the anonymous default tenant).
     tenant: str = ""
+    #: Streaming-mode window coordinate: the numbered window this attempt
+    #: computes.  Paired with ``am_epoch`` it forms the generalized
+    #: ``(attempt_epoch, window_id)`` fence — a straggler from a sealed
+    #: window is rejected at every seam a pre-crash zombie would be
+    #: (0 = batch/unstamped: never fenced, pre-streaming semantics).
+    window_id: int = 0
+    #: Stream identity for the window fence registry ("" = not streaming).
+    stream: str = ""
 
     @property
     def task_index(self) -> int:

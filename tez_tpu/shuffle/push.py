@@ -279,7 +279,7 @@ class SpillPusher:
                  tenant: str = "",
                  secrets: Optional[JobTokenSecretManager] = None,
                  backoff_base: float = 0.05, rng: Any = None,
-                 replicas: int = 1):
+                 replicas: int = 1, window_id: int = 0, stream: str = ""):
         self.service = service
         self.retries = max(1, int(retries))
         self.inflight_limit = int(inflight_limit_bytes)
@@ -290,6 +290,9 @@ class SpillPusher:
         self.epoch = epoch
         self.app_id = app_id
         self.tenant = tenant
+        #: generalized fence's second coordinate (0/"" = batch, unfenced)
+        self.window_id = int(window_id)
+        self.stream = stream
         self.secrets = secrets
         self.backoff_base = backoff_base
         self._rng = rng
@@ -366,7 +369,8 @@ class SpillPusher:
                     self.service.push_publish(
                         path, spill_id, run, epoch=self.epoch,
                         app_id=self.app_id, tenant=self.tenant,
-                        counters=self.counters, replicas=self.replicas)
+                        counters=self.counters, replicas=self.replicas,
+                        window_id=self.window_id, stream=self.stream)
                 else:
                     if self.secrets is None:
                         raise PermissionError(

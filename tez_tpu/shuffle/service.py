@@ -20,9 +20,28 @@ import numpy as np
 
 from tez_tpu.common import epoch as epoch_registry
 from tez_tpu.common import faults
-from tez_tpu.common.epoch import EpochFencedError
+from tez_tpu.common.epoch import EpochFencedError, WindowFencedError
 from tez_tpu.ops.runformat import KVBatch, Run, RUN_HEADER_NBYTES
 from tez_tpu.shuffle.push import PushRejected, push_key, replica_key
+
+
+def _window_fence(seam: str, app_id: str, window_id: int, stream: str,
+                  src: str) -> None:
+    """The window coordinate of the generalized (epoch, window) fence at a
+    shuffle seam: a straggler from a sealed streaming window is rejected
+    exactly like a stale-epoch zombie (batch traffic — window 0 / no
+    stream — is never fenced)."""
+    if not epoch_registry.is_stale_window(app_id, stream, window_id):
+        return
+    faults.fire("fence.stale_window", detail=f"{seam} {src}")
+    from tez_tpu.common import tracing
+    tracing.event("fence.stale_window", seam=seam, reason="stale_window",
+                  window_id=window_id, stream=stream,
+                  current=epoch_registry.current_window(app_id, stream),
+                  src=src)
+    raise WindowFencedError(
+        f"{seam} from stale window {window_id} of stream {stream} "
+        f"(current {epoch_registry.current_window(app_id, stream)}): {src}")
 
 
 def _maybe_corrupt(path_component: str, spill_id: int,
@@ -104,12 +123,17 @@ class ShuffleService:
                  epoch: int = 0, app_id: str = "",
                  lineage: str = "", tenant: str = "",
                  counters: Any = None,
-                 use_store: bool = True) -> None:
+                 use_store: bool = True, window_id: int = 0,
+                 stream: str = "") -> None:
         """Producers stamped with an AM epoch are fenced: a zombie task from
         a pre-restart incarnation must not (re-)register outputs the live
         AM's re-runs now own.  Unstamped registrations (epoch 0, e.g. direct
         test callers) are never fenced.  Pre-crash data already registered
-        stays fetchable — recovery's short-circuited consumers read it."""
+        stays fetchable — recovery's short-circuited consumers read it.
+        In streaming mode the window coordinate is fenced the same way: a
+        straggler from a sealed window cannot register into the open one."""
+        _window_fence("shuffle.register", app_id, window_id, stream,
+                      f"{path_component}/{spill_id}")
         if epoch > 0 and epoch_registry.is_stale(app_id, epoch):
             faults.fire("fence.stale_epoch",
                         detail=f"shuffle.register {path_component}")
@@ -158,7 +182,8 @@ class ShuffleService:
     def push_publish(self, path_component: str, spill_id: int, run: Any,
                      partition: Optional[int] = None, epoch: int = 0,
                      app_id: str = "", tenant: str = "",
-                     counters: Any = None, replicas: int = 1) -> None:
+                     counters: Any = None, replicas: int = 1,
+                     window_id: int = 0, stream: str = "") -> None:
         """Eager-push landing zone (docs/push_shuffle.md).
 
         Admission-checked publish into the buffer store.  ``partition``
@@ -172,7 +197,10 @@ class ShuffleService:
         admission grant.  Raises PushRejected (admission said no —
         caller retries then falls back to pull) or EpochFencedError (a
         re-attempted mapper's stale push, rejected exactly like a stale
-        register)."""
+        register; a stale-WINDOW push raises the WindowFencedError
+        subclass)."""
+        _window_fence("shuffle.push", app_id, window_id, stream,
+                      f"{path_component}/{spill_id}")
         if epoch > 0 and epoch_registry.is_stale(app_id, epoch):
             faults.fire("fence.stale_epoch",
                         detail=f"shuffle.push {path_component}")
@@ -294,7 +322,14 @@ class ShuffleService:
         return batch
 
     def fetch_partition(self, path_component: str, spill_id: int,
-                        partition: int, counters: Any = None) -> KVBatch:
+                        partition: int, counters: Any = None,
+                        app_id: str = "", window_id: int = 0,
+                        stream: str = "") -> KVBatch:
+        # consumer-side window fence: a reducer attempt from a sealed
+        # streaming window must not keep pulling data the open window's
+        # re-run now owns (stamped fetches only; batch is unfenced)
+        _window_fence("shuffle.fetch", app_id, window_id, stream,
+                      f"{path_component}/{spill_id}")
         # store.replica.lost seam (consumer side): fail mode declares the
         # PRIMARY copies gone — store entries and the producer's local
         # registration both — forcing the coded-replica failover path, the

@@ -40,7 +40,7 @@ import time
 
 from tez_tpu.common import epoch as epoch_registry
 from tez_tpu.common import metrics
-from tez_tpu.common.epoch import EpochFencedError
+from tez_tpu.common.epoch import EpochFencedError, WindowFencedError
 from tez_tpu.obs import flight as _flight
 from tez_tpu.ops.runformat import FileRun, KVBatch, Run, save_run_partitioned
 
@@ -220,7 +220,8 @@ class ShuffleBufferStore:
     def publish(self, path_component: str, spill_id: int, run: Any,
                 epoch: int = 0, app_id: str = "", lineage: str = "",
                 tenant: str = "", counters: Any = None,
-                replica: bool = False) -> None:
+                replica: bool = False, window_id: int = 0,
+                stream: str = "") -> None:
         """Insert a run under (path_component, spill_id).
 
         Epoch-fenced like ShuffleService.register: a stamped publish from
@@ -230,11 +231,23 @@ class ShuffleBufferStore:
         over-quota lands on host instead; host/disk over-quota raise
         :class:`StoreQuotaExceeded` — the producer keeps its own copy).
         ``replica=True`` marks a coded buddy copy of an already-published
-        run (accounted under store.replica.bytes; docs/recovery.md)."""
+        run (accounted under store.replica.bytes; docs/recovery.md).
+        A stamped publish from a *sealed streaming window* is fenced the
+        same way (WindowFencedError) — window N's stragglers can never
+        contaminate window N+1's store state."""
         if epoch > 0 and epoch_registry.is_stale(app_id, epoch):
             raise EpochFencedError(
                 f"store publish from stale epoch {epoch} "
                 f"(current {epoch_registry.current(app_id)}): "
+                f"{path_component}/{spill_id}")
+        if epoch_registry.is_stale_window(app_id, stream, window_id):
+            from tez_tpu.common import faults as _faults
+            _faults.fire("fence.stale_window",
+                         detail=f"store.publish {path_component}")
+            raise WindowFencedError(
+                f"store publish from stale window {window_id} of stream "
+                f"{stream} (current "
+                f"{epoch_registry.current_window(app_id, stream)}): "
                 f"{path_component}/{spill_id}")
         tenant = str(tenant or "")
         if isinstance(run, FileRun):
@@ -678,7 +691,8 @@ class ShuffleBufferStore:
 
     def republish_lineage(self, lineage: str, new_path: str,
                           epoch: int = 0, app_id: str = "",
-                          counters: Any = None) -> List[int]:
+                          counters: Any = None, window_id: int = 0,
+                          stream: str = "") -> List[int]:
         """Serve a lineage hit: alias the sealed runs under ``new_path``
         (zero copy — same entries, one more ref each) so the recurring
         DAG's consumers fetch them exactly like fresh output.  Returns the
@@ -686,6 +700,10 @@ class ShuffleBufferStore:
         if epoch > 0 and epoch_registry.is_stale(app_id, epoch):
             raise EpochFencedError(
                 f"lineage republish from stale epoch {epoch}: {lineage}")
+        if epoch_registry.is_stale_window(app_id, stream, window_id):
+            raise WindowFencedError(
+                f"lineage republish from stale window {window_id} of "
+                f"stream {stream}: {lineage}")
         path = LINEAGE_PREFIX + lineage
         with self._lock:
             hits = [((p, s), e) for (p, s), e in self._entries.items()

@@ -17,6 +17,13 @@ external sink must re-run so the sink sees its side effects.
 Per-task lineage keys are ``<vertex_hash>/<task_index>/<dest_vertex>`` —
 the task index pins the partition range, the destination vertex pins
 which edge output the segment feeds.
+
+Streaming mode: a per-window DAG plan carries ``tez.runtime.stream.id``
+and ``tez.runtime.stream.window-id`` in its DAG conf, and both are folded
+into every vertex hash — the lineage key is effectively
+``(lineage, window_id)``, so window N's sealed runs can never be served
+as a cache hit to window M, while a window-exact REPLAY of window N
+(same stream, same window id, same spool) hits and skips recomputation.
 """
 from __future__ import annotations
 
@@ -47,6 +54,16 @@ def vertex_lineage_hashes(plan: Any) -> Dict[str, str]:
     computed topologically so a hash transitively covers the whole
     upstream subgraph (input signature)."""
     hashes: Dict[str, str] = {}
+    # window-scoped lineage: the stream/window coordinates (DAG-level conf)
+    # salt every vertex hash so sealed entries are keyed (lineage, window)
+    dag_conf = dict(getattr(plan, "dag_conf", None) or {})
+    stream = str(dag_conf.get("tez.runtime.stream.id", "") or "")
+    window_salt = b""
+    if stream:
+        window_salt = (
+            f"|stream:{stream}"
+            f"/w{dag_conf.get('tez.runtime.stream.window-id', 0)}"
+        ).encode()
     pending = {v.name: v for v in plan.vertices}
     edges_in: Dict[str, list] = {v.name: [] for v in plan.vertices}
     for e in plan.edges:
@@ -70,6 +87,7 @@ def vertex_lineage_hashes(plan: Any) -> Dict[str, str]:
                 h.update(b"|leaf:" + _descriptor_bytes(
                     getattr(lo, "descriptor", lo)))
             h.update(b"|conf:" + _conf_bytes(v.conf))
+            h.update(window_salt)
             for e in sorted(ins, key=lambda e: e.id):
                 p = e.edge_property
                 h.update(b"|edge:" + str(p.data_movement_type).encode())

@@ -1025,6 +1025,258 @@ def run_am_kill(seed: int, workdir: str,
         reset_store()
 
 
+# ----------------------------------------------------------- stream kill
+
+def _build_stream_template(name: str, parallelism: int = 2,
+                           fault_spec: str = "",
+                           fault_seed: int = 0) -> "DAG":
+    """Window DAG template: StreamWindowSourceProcessor striping the
+    sealed spool into a scatter-gather edge, StreamWindowSinkProcessor
+    grouping it into a window-tagged tmp part file.  The driver clones it
+    per window; a fault spec set here rides every window's dag_conf, so
+    each window arms its own seeded fault scope."""
+    from tez_tpu.library.streaming import (StreamWindowSinkProcessor,
+                                           StreamWindowSourceProcessor)
+    source = Vertex.create("source", ProcessorDescriptor.create(
+        StreamWindowSourceProcessor), parallelism)
+    sink = Vertex.create("sink", ProcessorDescriptor.create(
+        StreamWindowSinkProcessor), 1)
+    conf = {"tez.runtime.key.class": "bytes",
+            "tez.runtime.value.class": "long"}
+    prop = EdgeProperty.create(
+        DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create(
+            "tez_tpu.library.outputs:OrderedPartitionedKVOutput",
+            payload=conf),
+        InputDescriptor.create(
+            "tez_tpu.library.inputs:OrderedGroupedKVInput", payload=conf))
+    dag = DAG.create(name).add_vertex(source).add_vertex(sink)
+    dag.add_edge(Edge.create(source, sink, prop))
+    if fault_spec:
+        dag.set_conf("tez.test.fault.spec", fault_spec)
+        dag.set_conf("tez.test.fault.seed", fault_seed)
+    return dag
+
+
+def _stream_records(seed: int, tenant: int, n: int) -> List[Dict[str, Any]]:
+    """Deterministic per-tenant record feed: same (seed, tenant) -> same
+    records, so the storm leg and the fault-free baseline ingest
+    byte-identical windows."""
+    rng = random.Random(seed * 1000 + tenant)
+    return [{"k": f"t{tenant}key{i % 7}", "v": rng.randint(1, 100)}
+            for i in range(n)]
+
+
+def _stream_outputs(out_dir: str) -> Dict[str, bytes]:
+    """Committed (final-named) window part files only — hidden .tmp files
+    are pre-commit scratch and may legitimately differ after a crash."""
+    out: Dict[str, bytes] = {}
+    if not os.path.isdir(out_dir):
+        return out
+    for name in sorted(os.listdir(out_dir)):
+        p = os.path.join(out_dir, name)
+        if not name.startswith(".") and os.path.isfile(p):
+            with open(p, "rb") as fh:
+                out[name] = fh.read()
+    return out
+
+
+def run_stream_kill(seed: int, workdir: str, timeout: float = 120.0,
+                    tenants: int = 3) -> Tuple[bool, str]:
+    """Streaming chaos scenario (``make chaos-stream``). Returns (ok,
+    detail).
+
+    One session AM holds ``tenants`` resident streams.  Each stream's
+    window template carries a seeded ``task.run`` fail fault, so task
+    attempts die mid-window and are retried inside their window; after
+    every stream has at least one ``WINDOW_COMMIT_FINISHED`` the AM is
+    crashed mid-stream (``crash()`` — nothing graceful journaled) with
+    sealed-but-uncommitted windows and a half-filled open spool on disk.
+    A successor incarnation resumes every stream from the commit ledger,
+    window-exact replays the uncommitted sealed windows, keeps the open
+    spool's ingested records, takes the rest of the feed, and drains.
+
+    Asserted: every committed window is bit-exact vs a fault-free
+    baseline of the same feed (same cuts, same totals), the threaded
+    recovery journals fsck clean with exactly ONE WINDOW_COMMIT_FINISHED
+    per (stream, window) across both incarnations, and post-recovery lag
+    stays inside ``tez.runtime.stream.max-lag``."""
+    from tez_tpu.am.app_master import DAGAppMaster
+    from tez_tpu.am.history import HistoryEventType
+    from tez_tpu.am.recovery import decode_journal_line
+    from tez_tpu.am.streaming import StreamSpec
+    from tez_tpu.common import config as C
+    from tez_tpu.common import epoch as epoch_registry
+    from tez_tpu.store import reset_store
+    from tez_tpu.tools import journal_fsck
+
+    window_count = 6
+    max_lag = 4
+    phase1, total = 18, 27      # crash lands between w3's cut and drain
+    stream_names = [f"s{t}" for t in range(tenants)]
+    feeds = {t: _stream_records(seed, t, total) for t in range(tenants)}
+
+    def session_conf(staging: str) -> "C.TezConfiguration":
+        return C.TezConfiguration({
+            "tez.staging-dir": staging,
+            "tez.am.local.num-containers": 4,
+            # one slot per stream so windows of different streams overlap
+            "tez.am.session.max-concurrent-dags": tenants,
+            "tez.am.session.queue-size": 32,
+            "tez.runtime.stream.window.count": window_count,
+            "tez.runtime.stream.max-lag": max_lag,
+        })
+
+    def make_spec(t: int, out_root: str, fault: bool) -> "StreamSpec":
+        name = stream_names[t]
+        dag = _build_stream_template(
+            f"{name}-template",
+            fault_spec="task.run:fail:n=1,exc=runtime" if fault else "",
+            fault_seed=seed * 10 + t)
+        return StreamSpec(name=name, plan=dag.create_dag_plan(),
+                          output_dir=os.path.join(out_root, name))
+
+    # ---- fault-free baseline: same feeds, no faults, no crash ----------
+    reset_store()
+    base_root = os.path.join(workdir, f"skbase{seed}")
+    base_out = os.path.join(base_root, "out")
+    am = DAGAppMaster(f"app_1_skb{seed}",
+                      session_conf(os.path.join(base_root, "staging")),
+                      attempt=1)
+    am.start()
+    try:
+        drivers = {t: am.open_stream(make_spec(t, base_out, fault=False))
+                   for t in range(tenants)}
+        for t, driver in drivers.items():
+            driver.ingest(feeds[t])
+        for driver in drivers.values():
+            driver.drain(timeout=timeout)
+    finally:
+        am.stop()
+        faults.clear_all()
+        epoch_registry.reset()
+        reset_store()
+    baselines = {t: _stream_outputs(os.path.join(base_out, stream_names[t]))
+                 for t in range(tenants)}
+    for t, files in baselines.items():
+        if not files:
+            return False, f"stream {stream_names[t]}: baseline " \
+                          f"committed no windows"
+
+    # ---- storm leg: seeded attempt kills + one AM crash mid-stream -----
+    storm_root = os.path.join(workdir, f"skill{seed}")
+    storm_out = os.path.join(storm_root, "out")
+    staging = os.path.join(storm_root, "staging")
+    conf = session_conf(staging)
+    app_id = f"app_1_skill{seed}"
+    am1 = DAGAppMaster(app_id, conf, attempt=1)
+    am1.start()
+    crashed = False
+    try:
+        drivers = {t: am1.open_stream(make_spec(t, storm_out, fault=True))
+                   for t in range(tenants)}
+        for t, driver in drivers.items():
+            driver.ingest(feeds[t][:phase1])
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            done = {ev.data.get("stream") for ev in
+                    am1.logging_service.of_type(
+                        HistoryEventType.WINDOW_COMMIT_FINISHED)}
+            if done >= set(stream_names):
+                break
+            time.sleep(0.02)
+        else:
+            return False, "not every stream committed a window pre-crash"
+        am1.crash()
+        crashed = True
+    finally:
+        if not crashed:
+            am1.stop()
+        faults.clear_all()
+        epoch_registry.reset()
+
+    am2 = DAGAppMaster(app_id, conf, attempt=2)
+    am2.start()
+    ok = False
+    try:
+        am2.recover_and_resume()
+        if set(am2.streams) != set(stream_names):
+            return False, (f"successor resumed streams "
+                           f"{sorted(am2.streams)}, expected "
+                           f"{stream_names}; "
+                           f"{_fsck_summary(staging, app_id)}")
+        replayed = 0
+        for t in range(tenants):
+            driver = am2.streams[stream_names[t]]
+            replayed += len(driver.status()["replayed"])
+            driver.ingest(feeds[t][phase1:])
+            lag = driver.status()["lag"]
+            if lag > max_lag:
+                return False, (f"stream {stream_names[t]}: post-recovery "
+                               f"lag {lag} over the {max_lag} bound")
+        lag_episodes = 0
+        for t in range(tenants):
+            final = am2.streams[stream_names[t]].drain(timeout=timeout)
+            lag_episodes += final["lag_episodes"]
+            if final["lag"] != 0 or not final["retired"]:
+                return False, (f"stream {stream_names[t]}: drained to "
+                               f"{final}")
+        ok = True
+    finally:
+        am2.stop()
+        faults.clear_all()
+        epoch_registry.reset()
+        reset_store()
+    if not ok:
+        return False, "unreachable"
+
+    # ---- bit-exact committed windows vs the fault-free baseline --------
+    windows = 0
+    for t in range(tenants):
+        got = _stream_outputs(os.path.join(storm_out, stream_names[t]))
+        if got != baselines[t]:
+            return False, (f"stream {stream_names[t]}: committed windows "
+                           f"diverged from baseline ({sorted(got)} vs "
+                           f"{sorted(baselines[t])}); "
+                           f"{_fsck_summary(staging, app_id)}")
+        windows += len(got)
+
+    # ---- exactly-once: fsck + a direct duplicate-commit count ----------
+    files = journal_fsck.discover_journals(
+        os.path.join(staging, app_id, "recovery"))
+    report = journal_fsck.fsck_files(files)
+    if not report.ok:
+        return False, f"journal fsck found errors: {report.errors[:3]}"
+    commits: Dict[Tuple[str, int], int] = {}
+    for path in files:
+        with open(path, errors="replace") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = decode_journal_line(line)
+                except Exception:  # noqa: BLE001 — torn tail at the crash
+                    continue
+                if ev.event_type.name == "WINDOW_COMMIT_FINISHED":
+                    key = (str(ev.data.get("stream")),
+                           int(ev.data.get("window_id", 0)))
+                    commits[key] = commits.get(key, 0) + 1
+    dupes = {k: n for k, n in commits.items() if n != 1}
+    if dupes:
+        return False, (f"duplicate WINDOW_COMMIT_FINISHED across "
+                       f"incarnations: {dupes}")
+    if len(commits) != windows:
+        return False, (f"{len(commits)} committed windows journaled vs "
+                       f"{windows} published")
+    return True, (f"{windows} window(s) bit-exact over {tenants} streams "
+                  f"after mid-window attempt kills + mid-stream AM crash; "
+                  f"{replayed} window-exact replay(s), 0 duplicate "
+                  f"commits, {lag_episodes} lag episode(s), lag bounded "
+                  f"by {max_lag}")
+
+
 def run_device_ooo(seed: int, spans: int = 4,
                    records: int = 1500) -> Tuple[bool, str]:
     """Out-of-order device-completion scenario: the async double-buffered
@@ -1580,6 +1832,17 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                          "bit-exact; plus the coded push-replica leg "
                          "(store.replica.lost forces a buddy failover "
                          "with zero producer re-execution)")
+    ap.add_argument("--stream-kill", action="store_true",
+                    help="run the streaming crash-survival scenario: "
+                         "--tenants resident streams on one session AM "
+                         "under seeded mid-window task kills, then an AM "
+                         "crash mid-stream with uncommitted sealed "
+                         "windows and a half-filled open spool; the "
+                         "successor window-exact replays from the commit "
+                         "ledger and every committed window must be "
+                         "bit-exact vs a fault-free feed, with zero "
+                         "duplicate commits and bounded post-recovery "
+                         "lag")
     ap.add_argument("--exchange-skew", action="store_true",
                     help="run the skewed-key mesh-exchange scenario: a hot "
                          "partition over the round budget plus one chip "
@@ -1683,6 +1946,24 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                     failures += 1
                     print(f"REPRO: python -m tez_tpu.tools.chaos "
                           f"--am-kill --seed {seed}")
+        finally:
+            if cleanup:
+                shutil.rmtree(workdir, ignore_errors=True)
+        return 1 if failures else 0
+    if args.stream_kill:
+        failures = 0
+        try:
+            for seed in range(args.seed, args.seed + args.trials):
+                ok, detail = run_stream_kill(seed, workdir,
+                                             timeout=args.timeout,
+                                             tenants=args.tenants)
+                print(("ok   " if ok else "FAIL ") +
+                      f"stream-kill seed={seed}: {detail}")
+                _flight_dump_scenario("stream-kill", seed, ok)
+                if not ok:
+                    failures += 1
+                    print(f"REPRO: python -m tez_tpu.tools.chaos "
+                          f"--stream-kill --seed {seed}")
         finally:
             if cleanup:
                 shutil.rmtree(workdir, ignore_errors=True)

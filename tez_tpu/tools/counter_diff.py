@@ -12,7 +12,7 @@ rather than an opaque bucket-count delta.
 from __future__ import annotations
 
 import sys
-from typing import Dict, List, Tuple
+from typing import Any, Dict, List, Tuple
 
 from tez_tpu.common.counters import (MESH_EXCHANGE_EFFICIENCY_COUNTERS,
                                      MESH_EXCHANGE_GROUP,
@@ -99,6 +99,15 @@ EXCHANGE_HISTS = ("mesh.exchange.round",)
 #: any growth is flagged; replica BYTES are workload-shaped (replicas=2
 #: pays them on purpose, like coded duplicate exchange — never flagged).
 RECOVERY_REPLICA_COUNTERS = ("store.replica.bytes", "store.replica.failover")
+
+
+#: Streaming mode (am/streaming.py).  Committed windows are workload-
+#: shaped (more input = more windows — never flagged); replays, aborts,
+#: and lag episodes are pressure: a fault-free keeping-up stream has none,
+#: so any growth is flagged.  Per-window latency rides the common
+#: LatencyHistogram plumbing plus an exact p50/p95 recomputed from the
+#: window-commit ledger timestamps.
+STREAM_HISTS = ("stream.window.latency", "stream.window.lag")
 
 
 #: Observability plane (obs/flight.py, am/admission.py).  Queue wait is
@@ -192,6 +201,59 @@ def diff_recovery(dags_a: Dict, dags_b: Dict,
         va, vb = int(ga.get(name, 0)), int(gb.get(name, 0))
         out.append((name, va, vb,
                     name == "store.replica.failover" and vb > va))
+    return out
+
+
+def stream_summary(dags: Dict) -> Dict[str, Any]:
+    """Session streaming roll-up off the window-commit ledger stream:
+    ``{"committed", "replayed", "aborted", "lag_episodes", "p50_ms",
+    "p95_ms"}``.  Per-window latency is exact — COMMIT_FINISHED timestamp
+    minus the window DAG's submit time — so it works on histories whose
+    metrics plane was off."""
+    events: List[Dict] = []
+    for d in dags.values():
+        events = getattr(d, "stream_events", None) or events
+    committed = [e for e in events if e["event"] == "COMMIT_FINISHED"]
+    lat: List[float] = []
+    for e in committed:
+        d = dags.get(e.get("dag_id", ""))
+        if d is not None and d.submit_time and e["time"] > d.submit_time:
+            lat.append((e["time"] - d.submit_time) * 1000.0)
+    lat.sort()
+    return {
+        "committed": len(committed),
+        "replayed": sum(1 for e in committed if e.get("replayed")),
+        "aborted": sum(1 for e in events if e["event"] == "COMMIT_ABORTED"),
+        "lag_episodes": sum(1 for e in events if e["event"] == "LAGGING"),
+        "p50_ms": lat[len(lat) // 2] if lat else 0.0,
+        "p95_ms": lat[int(len(lat) * 0.95)] if lat else 0.0,
+    }
+
+
+def diff_stream(dags_a: Dict, dags_b: Dict
+                ) -> List[Tuple[str, float, float, bool]]:
+    """[(name, a, b, regressed)] for the streaming section: committed
+    windows and exact p50/p95 are reported unflagged (workload-shaped);
+    replay, abort, and lag-episode growth is flagged — a keeping-up
+    fault-free stream has zero of each."""
+    sa, sb = stream_summary(dags_a), stream_summary(dags_b)
+    if not (sa["committed"] or sb["committed"] or sa["aborted"]
+            or sb["aborted"]):
+        return []
+    out: List[Tuple[str, float, float, bool]] = [
+        ("stream.windows.committed", sa["committed"], sb["committed"],
+         False),
+        ("stream.windows.replayed", sa["replayed"], sb["replayed"],
+         sb["replayed"] > sa["replayed"]),
+        ("stream.windows.aborted", sa["aborted"], sb["aborted"],
+         sb["aborted"] > sa["aborted"]),
+        ("stream.lag.episodes", sa["lag_episodes"], sb["lag_episodes"],
+         sb["lag_episodes"] > sa["lag_episodes"]),
+        ("stream.window.p50_ms", round(sa["p50_ms"], 1),
+         round(sb["p50_ms"], 1), False),
+        ("stream.window.p95_ms", round(sa["p95_ms"], 1),
+         round(sb["p95_ms"], 1), False),
+    ]
     return out
 
 
@@ -433,6 +495,24 @@ def main() -> int:
             print(f"{tenant:24} {_fmt_tenant(sa):>40} "
                   f"{_fmt_tenant(sb):>40}{flag}")
             regressions += int(regressed)
+    stream = diff_stream(sessions[0], sessions[1])
+    if stream:
+        print(f"\n{'streaming (windows/replays/lag)':60} "
+              f"{'A':>14} {'B':>14}")
+        for name, va, vb, regressed in stream:
+            flag = "  << REGRESSION" if regressed else ""
+            print(f"{name:60} {va:14g} {vb:14g}{flag}")
+            regressions += int(regressed)
+        stream_h = diff_device_stages(a.counters, b.counters,
+                                      names=STREAM_HISTS)
+        if stream_h:
+            print(f"\n{'stream window (wall ms)':32} "
+                  f"{'A':>14} {'B':>14} {'delta':>12}")
+            for name, ms_a, ms_b, regressed in stream_h:
+                flag = "  << REGRESSION" if regressed else ""
+                print(f"{name:32} {ms_a:14.1f} {ms_b:14.1f} "
+                      f"{ms_b - ms_a:+12.1f}{flag}")
+                regressions += int(regressed)
     recovery = diff_recovery(sessions[0], sessions[1],
                              a.counters, b.counters)
     if recovery:
@@ -457,7 +537,8 @@ def main() -> int:
         print(f"{regressions} regression(s) (latency p95 >= "
               f"{REGRESSION_RATIO}x baseline, containment event growth, "
               f"store eviction/demotion churn growth, exchange "
-              f"round/split growth, tenant shed/failure growth, or "
+              f"round/split growth, tenant shed/failure growth, "
+              f"stream replay/abort/lag growth, or "
               f"recovery requeue/fence/failover growth)")
     return 0
 

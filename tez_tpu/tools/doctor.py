@@ -122,7 +122,7 @@ def load_slo_breaches(journal_files: List[str]) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = []
     for path in journal_files:
         try:
-            with open(path) as fh:
+            with open(path, errors="replace") as fh:
                 lines = fh.readlines()
         except OSError:
             continue
@@ -149,7 +149,7 @@ def load_am_restarts(journal_files: List[str]) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = []
     for path in journal_files:
         try:
-            with open(path) as fh:
+            with open(path, errors="replace") as fh:
                 lines = fh.readlines()
         except OSError:
             continue
@@ -295,6 +295,94 @@ def straggler_attempts(dag: Any, top: int = 3,
                 })
     rows.sort(key=lambda r: (-r["slowdown"], -r["duration_s"]))
     return rows[:top]
+
+
+# --------------------------------------------------------------------------
+# Streaming triage
+# --------------------------------------------------------------------------
+
+def diagnose_streams(dags: Dict[str, Any],
+                     snaps: Optional[List[Any]] = None
+                     ) -> List[Dict[str, Any]]:
+    """Per-stream triage rows from the session-scoped ``stream_events``
+    the history parser attaches to every DagInfo: window commit /
+    replay / abort / lag counts, cut→commit latency p50/p95, and — for
+    the slowest committed window — a dominant-plane attribution computed
+    by running the blame sweep over that window-DAG's own submit→commit
+    wall.  That last bit is the streaming pager question: *this window
+    blew its SLO — which plane ate it?*"""
+    events: List[Dict[str, Any]] = []
+    for d in dags.values():
+        events = getattr(d, "stream_events", []) or []
+        break                       # session-scoped: same list on every DAG
+    if not events:
+        return []
+    flight_iv = intervals_from_flight(snaps or [])
+    by_stream: Dict[str, List[Dict[str, Any]]] = {}
+    for ev in events:
+        by_stream.setdefault(str(ev.get("stream") or "?"), []).append(ev)
+    rows: List[Dict[str, Any]] = []
+    for name, evs in sorted(by_stream.items()):
+        committed = [e for e in evs if e["event"] == "COMMIT_FINISHED"]
+        row: Dict[str, Any] = {
+            "stream": name,
+            "committed": len(committed),
+            "replayed": sum(1 for e in committed if e.get("replayed")),
+            "aborted": sum(1 for e in evs
+                           if e["event"] == "COMMIT_ABORTED"),
+            "lag_episodes": sum(1 for e in evs
+                                if e["event"] == "LAGGING"),
+            "retired": any(e["event"] == "RETIRED" for e in evs),
+        }
+        # exact window latency: COMMIT_FINISHED wall time minus the
+        # window DAG's own submit time (both on the journal clock)
+        timed: List[Tuple[float, Any, Any, float, float]] = []
+        for ev in committed:
+            d = dags.get(str(ev.get("dag_id") or ""))
+            t1 = float(ev.get("time") or 0.0)
+            t0 = float(getattr(d, "submit_time", 0.0) or 0.0)
+            if d is not None and t1 > t0 > 0:
+                timed.append((t1 - t0, ev.get("window_id"), d, t0, t1))
+        timed.sort(key=lambda r: r[0])
+        if timed:
+            n = len(timed)
+            row["p50_ms"] = round(timed[int(0.5 * (n - 1))][0] * 1000, 1)
+            row["p95_ms"] = round(timed[int(0.95 * (n - 1))][0] * 1000, 1)
+            wall, w, d, t0, t1 = timed[-1]
+            segments = blame_sweep(
+                t0, t1, intervals_from_history(d) + flight_iv)
+            plane_s = {p: 0.0 for p in PLANES}
+            for s, e, p in segments:
+                plane_s[p] += e - s
+            dom, sec = max(
+                ((p, s) for p, s in plane_s.items() if p != "control"),
+                key=lambda ps: ps[1], default=("control", 0.0))
+            if sec <= 0:
+                dom, sec = "control", plane_s["control"]
+            row["slowest"] = {
+                "window_id": w, "wall_s": round(wall, 4),
+                "dominant_plane": dom,
+                "plane_pct": round(100.0 * sec / max(wall, 1e-9), 2),
+            }
+        rows.append(row)
+    return rows
+
+
+def render_streams(rows: List[Dict[str, Any]]) -> str:
+    L: List[str] = ["", "streaming:"]
+    for r in rows:
+        state = "retired" if r["retired"] else "live"
+        lat = (f"  p50/p95 {r['p50_ms']:.0f}/{r['p95_ms']:.0f} ms"
+               if "p50_ms" in r else "")
+        L.append(f"  {r['stream']} ({state}): {r['committed']} committed, "
+                 f"{r['replayed']} replayed, {r['aborted']} aborted, "
+                 f"{r['lag_episodes']} lag episode(s){lat}")
+        slow = r.get("slowest")
+        if slow:
+            L.append(f"    slowest window w{slow['window_id']}: "
+                     f"{slow['wall_s']:.3f} s — {slow['dominant_plane']} "
+                     f"dominates ({slow['plane_pct']}% of the window)")
+    return "\n".join(L)
 
 
 # --------------------------------------------------------------------------
@@ -502,6 +590,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     rep = diagnose(dag, snaps, breaches,
                    fleet=vertex_fleet_medians(dags),
                    am_restarts=restarts)
+    streams = diagnose_streams(dags, snaps)
+    if streams:
+        rep["streams"] = streams
     if args.perfetto:
         from tez_tpu.tools import trace_export
         events = trace_export.history_to_events(dag)
@@ -511,7 +602,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             {"traceEvents": events, "displayTimeUnit": "ms"},
             args.perfetto)
         rep["perfetto"] = args.perfetto
-    print(json.dumps(rep, indent=1) if args.json else render_text(rep))
+    if args.json:
+        print(json.dumps(rep, indent=1))
+    else:
+        print(render_text(rep))
+        if streams:
+            print(render_streams(streams))
     return 0 if "error" not in rep else 1
 
 

@@ -102,6 +102,11 @@ class DagInfo:
     # "detail", "msg_epoch", "am_epoch", "time"} — session-scoped,
     # attached to every dag
     recovery_events: List[Dict] = dataclasses.field(default_factory=list)
+    # session streaming stream (window-commit ledger + lag episodes) in
+    # event order: {"event": OPENED|RETIRED|COMMIT_STARTED|COMMIT_FINISHED
+    # |COMMIT_ABORTED|LAGGING, "stream", "window_id", "dag_id", "time",
+    # ...extras} — session-scoped, attached to every dag
+    stream_events: List[Dict] = dataclasses.field(default_factory=list)
 
     @property
     def duration(self) -> float:
@@ -125,6 +130,15 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
     node_events: List[Dict] = []
     admission_events: List[Dict] = []
     recovery_events: List[Dict] = []
+    stream_events: List[Dict] = []
+    _streaming = {
+        HistoryEventType.STREAM_OPENED: "OPENED",
+        HistoryEventType.STREAM_RETIRED: "RETIRED",
+        HistoryEventType.WINDOW_COMMIT_STARTED: "COMMIT_STARTED",
+        HistoryEventType.WINDOW_COMMIT_FINISHED: "COMMIT_FINISHED",
+        HistoryEventType.WINDOW_COMMIT_ABORTED: "COMMIT_ABORTED",
+        HistoryEventType.WINDOW_LAGGING: "LAGGING",
+    }
 
     def dag(ev: HistoryEvent) -> Optional[DagInfo]:
         if ev.dag_id is None:
@@ -166,6 +180,20 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
                     "msg_epoch": ev.data.get("msg_epoch", 0),
                     "am_epoch": ev.data.get("am_epoch", 0),
                     "time": ev.timestamp})
+            continue
+        if t in _streaming:
+            # session-scoped streaming ledger: a WINDOW_COMMIT_*'s dag_id
+            # names the window DAG (whose own lifecycle events build its
+            # DagInfo); the stream-level record stays out of the per-DAG
+            # model like admission/recovery records do
+            stream_events.append({
+                "event": _streaming[t],
+                "stream": ev.data.get("stream", ""),
+                "window_id": ev.data.get("window_id", 0),
+                "dag_id": ev.dag_id or "",
+                "replayed": bool(ev.data.get("replayed")),
+                "lag": ev.data.get("lag", 0),
+                "time": ev.timestamp})
             continue
         d = dag(ev)
         if t is HistoryEventType.DAG_SUBMITTED and d:
@@ -259,6 +287,7 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
         d.node_events = node_events
         d.admission_events = admission_events
         d.recovery_events = recovery_events
+        d.stream_events = stream_events
     return dags
 
 
@@ -278,7 +307,9 @@ def parse_jsonl_files(paths: List[str]) -> Dict[str, DagInfo]:
                 print(f"warning: no such history file: {path}",
                       file=sys.stderr)
                 continue
-            with open(path) as fh:
+            # lenient decode: a crashed writer can tear the tail
+            # mid-byte; the CRC frame rejects the mangled record
+            with open(path, errors="replace") as fh:
                 for line in fh:
                     line = line.strip()
                     if line:

@@ -1,8 +1,11 @@
 """Recovery-journal fsck: validate a journal's record CRCs, event ordering,
-commit-ledger pairing, and admission-queue pairing (``DAG_QUEUED`` /
+commit-ledger pairing, admission-queue pairing (``DAG_QUEUED`` /
 ``DAG_REQUEUED_ON_RECOVERY`` records resolved by a promoting
-``DAG_SUBMITTED``), then print the terminal state recovery would infer
-for each DAG and each still-parked submission.
+``DAG_SUBMITTED``), and the streaming window-commit ledger
+(``WINDOW_COMMIT_STARTED`` brackets closed by FINISHED/ABORTED, window
+ids strictly increasing per stream, nothing after ``STREAM_RETIRED``),
+then print the terminal state recovery would infer for each DAG, each
+still-parked submission, and each stream.
 
 Point it at one or more journal files, at an app's ``recovery/`` directory
 (all attempts are checked in order), or at a staging dir + app id::
@@ -42,6 +45,18 @@ _LIFECYCLE = frozenset({
 _ADMISSION = frozenset({
     HistoryEventType.DAG_QUEUED,
     HistoryEventType.DAG_REQUEUED_ON_RECOVERY,
+})
+
+#: Streaming records: keyed by ``data["stream"]``, checked against the
+#: per-stream window ledger (a window DAG's own records still flow into
+#: its DagLedger like any DAG's — these are the STREAM-level brackets).
+_STREAMING = frozenset({
+    HistoryEventType.STREAM_OPENED,
+    HistoryEventType.STREAM_RETIRED,
+    HistoryEventType.WINDOW_COMMIT_STARTED,
+    HistoryEventType.WINDOW_COMMIT_FINISHED,
+    HistoryEventType.WINDOW_COMMIT_ABORTED,
+    HistoryEventType.WINDOW_LAGGING,
 })
 
 
@@ -90,6 +105,31 @@ class SubLedger:
 
 
 @dataclasses.dataclass
+class StreamLedger:
+    """Per-stream window-commit ledger: every ``WINDOW_COMMIT_STARTED``
+    bracket must close (FINISHED or ABORTED), a window is FINISHED at
+    most once (exactly-once), committed ids are strictly increasing
+    (windows run sequentially), and nothing follows ``STREAM_RETIRED``."""
+    opened: bool = False
+    retired: bool = False
+    open_window: Optional[int] = None       # STARTED with no close yet
+    committed: List[int] = dataclasses.field(default_factory=list)
+    aborted: List[int] = dataclasses.field(default_factory=list)
+    lag_events: int = 0
+
+    @property
+    def inferred(self) -> str:
+        """What a resuming StreamDriver would conclude."""
+        if self.retired:
+            return f"RETIRED ({len(self.committed)} window(s) committed)"
+        if self.open_window is not None:
+            return (f"IN-COMMIT w{self.open_window} (successor rolls the "
+                    f"idempotent bracket forward)")
+        nxt = (self.committed[-1] + 1) if self.committed else 1
+        return f"LIVE (resume from window {nxt})"
+
+
+@dataclasses.dataclass
 class FsckReport:
     files: List[str] = dataclasses.field(default_factory=list)
     records: int = 0
@@ -99,6 +139,7 @@ class FsckReport:
     dags: Dict[str, DagLedger] = dataclasses.field(default_factory=dict)
     subs: Dict[str, SubLedger] = dataclasses.field(default_factory=dict)
     sub_order: List[str] = dataclasses.field(default_factory=list)
+    streams: Dict[str, StreamLedger] = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -165,9 +206,100 @@ def _check_admission(report: FsckReport, ev: HistoryEvent,
     return False
 
 
+def _check_streaming(report: FsckReport, ev: HistoryEvent,
+                     where: str) -> bool:
+    """Window-commit ledger pairing.  Returns True when the event was a
+    stream-level record (consumed here; a window DAG's OWN lifecycle
+    records still flow to its DagLedger)."""
+    t = ev.event_type
+    if t not in _STREAMING:
+        return False
+    stream = ev.data.get("stream", "")
+    if not stream:
+        report.errors.append(f"{where}: {t.name} without a stream id")
+        return True
+    led = report.streams.setdefault(stream, StreamLedger())
+    if led.retired and t is not HistoryEventType.STREAM_OPENED:
+        report.errors.append(
+            f"{where}: {t.name} for stream {stream} after STREAM_RETIRED")
+        return True
+    if t is HistoryEventType.STREAM_OPENED:
+        if led.opened:
+            report.errors.append(
+                f"{where}: duplicate STREAM_OPENED for {stream}")
+        led.opened = True
+        return True
+    if not led.opened:
+        report.errors.append(
+            f"{where}: {t.name} for stream {stream} that was never "
+            f"STREAM_OPENED")
+    if t is HistoryEventType.STREAM_RETIRED:
+        if led.open_window is not None:
+            report.errors.append(
+                f"{where}: STREAM_RETIRED for {stream} with commit bracket "
+                f"w{led.open_window} still open")
+        led.retired = True
+    elif t is HistoryEventType.WINDOW_LAGGING:
+        led.lag_events += 1
+    else:
+        w = int(ev.data.get("window_id", 0))
+        if w <= 0:
+            report.errors.append(
+                f"{where}: {t.name} for stream {stream} without a window id")
+            return True
+        if t is HistoryEventType.WINDOW_COMMIT_STARTED:
+            if led.open_window == w:
+                # the crash-mid-commit replay: the successor re-opens the
+                # SAME window's bracket and rolls it forward (idempotent)
+                report.warnings.append(
+                    f"{where}: commit bracket w{w} of {stream} re-opened "
+                    f"(roll-forward after AM crash)")
+            elif led.open_window is not None:
+                report.errors.append(
+                    f"{where}: WINDOW_COMMIT_STARTED w{w} for {stream} with "
+                    f"bracket w{led.open_window} still open")
+            if w in led.committed:
+                report.errors.append(
+                    f"{where}: WINDOW_COMMIT_STARTED w{w} for {stream} "
+                    f"after that window already committed (exactly-once "
+                    f"violated)")
+            elif w in led.aborted:
+                report.warnings.append(
+                    f"{where}: window w{w} of {stream} re-runs after an "
+                    f"abort")
+            led.open_window = w
+        elif t is HistoryEventType.WINDOW_COMMIT_FINISHED:
+            if led.open_window != w:
+                report.errors.append(
+                    f"{where}: WINDOW_COMMIT_FINISHED w{w} for {stream} "
+                    f"without its open STARTED (bracket was "
+                    f"{'w%d' % led.open_window if led.open_window else 'closed'})")
+            if w in led.committed:
+                report.errors.append(
+                    f"{where}: duplicate WINDOW_COMMIT_FINISHED w{w} for "
+                    f"{stream} (exactly-once violated)")
+            elif led.committed and w <= led.committed[-1]:
+                report.errors.append(
+                    f"{where}: {stream} committed w{w} after "
+                    f"w{led.committed[-1]} — window ids must be strictly "
+                    f"increasing")
+            led.committed.append(w)
+            led.open_window = None
+        else:   # WINDOW_COMMIT_ABORTED
+            if led.open_window is not None and led.open_window != w:
+                report.errors.append(
+                    f"{where}: WINDOW_COMMIT_ABORTED w{w} for {stream} "
+                    f"while bracket w{led.open_window} is open")
+            led.aborted.append(w)
+            led.open_window = None
+    return True
+
+
 def _check_event(report: FsckReport, ev: HistoryEvent, where: str) -> None:
     report.records += 1
     if _check_admission(report, ev, where):
+        return
+    if _check_streaming(report, ev, where):
         return
     dag_id = ev.dag_id
     if dag_id is None:
@@ -224,7 +356,9 @@ def fsck_files(paths: List[str]) -> FsckReport:
     ledger threads across AM incarnations)."""
     report = FsckReport(files=list(paths))
     for fi, path in enumerate(paths):
-        with open(path) as fh:
+        # a crash can tear the tail mid-byte, not just mid-line: decode
+        # leniently and let the CRC check reject the mangled record
+        with open(path, errors="replace") as fh:
             lines = [ln.strip() for ln in fh]
         while lines and not lines[-1]:
             lines.pop()
@@ -236,7 +370,10 @@ def fsck_files(paths: List[str]) -> FsckReport:
             try:
                 ev = decode_journal_line(line)
             except JournalLineError as e:
-                if fi == len(paths) - 1 and li == len(lines) - 1:
+                # the tail of ANY attempt file is where that incarnation
+                # died — a torn final record there is the expected crash
+                # signature, not at-rest corruption
+                if li == len(lines) - 1:
                     report.torn_tail = True
                     report.warnings.append(
                         f"{where}: torn trailing record (tolerated): {e}")
@@ -259,6 +396,14 @@ def fsck_files(paths: List[str]) -> FsckReport:
             report.errors.append(
                 f"unresolved queued submission {sub_id} ({name}): plan "
                 f"undecodable — replay impossible: {led.decode_error}")
+    # a trailing open window bracket is what an AM crash mid-commit
+    # leaves; recovery rolls it forward (idempotent renames), so it is a
+    # warning — but only on a LIVE stream, never a retired one
+    for stream, sled in report.streams.items():
+        if sled.open_window is not None and not sled.retired:
+            report.warnings.append(
+                f"stream {stream}: commit bracket w{sled.open_window} "
+                f"still open (AM died mid-commit; successor rolls forward)")
     return report
 
 
@@ -300,6 +445,10 @@ def print_report(report: FsckReport, verbose: bool = False) -> None:
         print(f"sub {sub_id} ({sub.dag_name or '<unnamed>'}): "
               f"queued={sub.queued} requeued={sub.requeued}"
               f" -> {sub.inferred}")
+    for stream, sled in sorted(report.streams.items()):
+        print(f"stream {stream}: {len(sled.committed)} committed, "
+              f"{len(sled.aborted)} aborted, {sled.lag_events} lag "
+              f"episode(s) -> {sled.inferred}")
     print("fsck: " + ("CLEAN" if report.ok else
                       f"{len(report.errors)} error(s)"))
 

@@ -6,7 +6,7 @@ PY ?= python
 OLD ?= BENCH_r05.json
 NEW ?= /tmp/bench_new.json
 
-.PHONY: test lint bench bench-new bench-diff bench-merge bench-store bench-sort bench-exchange chaos chaos-device-ooo chaos-device chaos-merge chaos-store chaos-push chaos-exchange chaos-ha chaos-stream soak docs doctor
+.PHONY: test lint bench bench-new bench-diff bench-merge bench-store bench-sort bench-exchange chaos chaos-device-ooo chaos-device chaos-merge chaos-store chaos-push chaos-exchange chaos-ha chaos-stream chaos-slo-burn soak docs doctor top metrics-smoke
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -90,6 +90,13 @@ chaos-ha:
 chaos-stream:
 	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --stream-kill --trials 3
 
+# burn-before-breach SLO alerting: one resident stream ramping toward a
+# window-p95 target; the telemetry sampler's multi-window burn evaluation
+# must journal SLO_BURN_ALERT strictly before TENANT_SLO_BREACH, fsck's
+# SLO ledger and the doctor's alert->breach join must both agree
+chaos-slo-burn:
+	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --slo-burn --trials 3
+
 # multi-tenant session soak: one resident session AM under barrier-synced
 # recurring DAGs from 3 tenants, forced am.admit.shed / am.queue.delay
 # faults plus seeded task faults — every accepted DAG bit-exact, shed
@@ -103,6 +110,18 @@ soak:
 # straggler, output bit-exact vs the fault-free padded baseline
 chaos-exchange:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 $(PY) -m tez_tpu.tools.chaos --exchange-skew --trials 3
+
+# live terminal view of one AM's GET /doctor/live (docs/telemetry.md);
+# the AM must run with tez.am.web.enabled=true (make soak does)
+URL ?= http://127.0.0.1:8080
+top:
+	$(PY) -m tez_tpu.tools.top $(URL)
+
+# tier-1 scrape smoke: boot an AM with the web UI on, then validate
+# /metrics via the strict golden parser, /metrics.json structurally,
+# and /doctor/live through graft top's renderer
+metrics-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_metrics_smoke.py -q
 
 docs:
 	$(PY) -m tez_tpu.tools.gen_config_docs > docs/configuration.md

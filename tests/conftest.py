@@ -71,6 +71,20 @@ def _disarm_trace_plane():
 
 
 @pytest.fixture(autouse=True)
+def _reset_timeseries_plane():
+    """The live time-series registry is process-global like the metrics
+    registry; sampled rings and registered collectors leaked by one
+    test's AM must not feed the next test's windows."""
+    yield
+    from tez_tpu.obs import timeseries
+    reg = timeseries.registry()
+    reg.reset()
+    for name in reg.collectors():
+        reg.unregister_collector(name)
+    reg.capacity = timeseries.DEFAULT_CAPACITY
+
+
+@pytest.fixture(autouse=True)
 def _reset_device_breaker():
     """The device circuit breaker is a sticky process singleton; a test
     that tripped it (injected device faults) must not leave the device

@@ -1,0 +1,175 @@
+"""Label-aware exposition: name->label splitting, byte-for-byte golden
+text rendering, drill-down filters, the structured JSON surface, and the
+strict parser's invariant checks.
+
+The golden fixture (tests/golden/metrics_exposition.txt) pins the wire
+format: rendering is deterministic by construction (sorted families,
+sorted label sets, no timestamps), so any diff against the golden file
+is a real format change and must be reviewed as one.
+"""
+import os
+
+import pytest
+
+from tez_tpu.common.metrics import Histogram
+from tez_tpu.obs.exposition import (parse_exposition, render_json,
+                                    render_text, split_labels)
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "metrics_exposition.txt")
+
+
+def _fixture():
+    """A small, fully deterministic exposition covering every label
+    family: the stream aggregate, a per-stream series, a per-tenant
+    series, a lane gauge, a plain gauge, and a plain counter group."""
+    agg = Histogram("stream.window.latency")
+    for v in (100.0, 300.0):
+        agg.observe(v)
+    s1 = Histogram("stream.s1.window.latency")
+    s1.observe(100.0)
+    ten = Histogram("tenant.acme.dag.latency")
+    ten.observe(5000.0)
+    hists = {h.name: h for h in (agg, s1, ten)}
+    gauges = {"slo.burn.active": 1.0,
+              "mesh.lane.0.occupancy": 0.5,
+              "tenant.acme.store.bytes": 4096.0}
+    counters = {"TaskCounter": {"SPILLED_RECORDS": 3, "INPUT_RECORDS": 12},
+                "LatencyHistogram.x": {"COUNT": 9}}  # skipped: hist-backed
+    return hists, gauges, counters
+
+
+def test_split_labels():
+    assert split_labels("stream.window.latency") == \
+        ("stream.window.latency", {})          # the session aggregate
+    assert split_labels("stream.s1.window.latency") == \
+        ("stream.window.latency", {"stream": "s1"})
+    assert split_labels("tenant.acme.dag.latency") == \
+        ("tenant.dag.latency", {"tenant": "acme"})
+    assert split_labels("mesh.lane.3.occupancy") == \
+        ("mesh.lane.occupancy", {"lane": "3"})
+    assert split_labels("am.admit.queue_wait") == \
+        ("am.admit.queue_wait", {})
+
+
+def test_render_text_matches_golden():
+    hists, gauges, counters = _fixture()
+    text = render_text(hists, gauges, counters)
+    with open(GOLDEN, encoding="utf-8") as fh:
+        golden = fh.read()
+    assert text == golden, (
+        "exposition text drifted from tests/golden/metrics_exposition.txt"
+        " — if the format change is intentional, regenerate the golden"
+        " file from render_text() and review the diff")
+
+
+def test_golden_passes_the_strict_parser():
+    with open(GOLDEN, encoding="utf-8") as fh:
+        fams = parse_exposition(fh.read())
+    hist = fams["tez_latency_stream_window_latency_ms"]
+    assert hist["type"] == "histogram"
+    # aggregate (no labels) and the s1 drill-down share one family
+    label_sets = {tuple(sorted(lb.items()))
+                  for _, lb, _ in hist["samples"]}
+    assert ("stream", "s1") in {
+        kv for ls in label_sets for kv in ls}
+    counts = [(n, lb, v) for n, lb, v in hist["samples"]
+              if n.endswith("_count")]
+    assert ({}, 2.0) in [(lb, v) for _, lb, v in counts]
+    assert ({"stream": "s1"}, 1.0) in [(lb, v) for _, lb, v in counts]
+    gauge = fams["tez_tenant_store_bytes"]
+    assert gauge["samples"] == [
+        ("tez_tenant_store_bytes", {"tenant": "acme"}, 4096.0)]
+    counter = fams["tez_counter"]
+    assert ("tez_counter", {"group": "TaskCounter",
+                            "name": "SPILLED_RECORDS"}, 3.0) \
+        in counter["samples"]
+    # the LatencyHistogram.* counter group is rendered as a histogram
+    # family above, never duplicated as tez_counter rows
+    assert not any(lb.get("group", "").startswith("LatencyHistogram")
+                   for _, lb, _ in counter["samples"])
+
+
+def test_drilldown_filters():
+    hists, gauges, counters = _fixture()
+    t = render_text(hists, gauges, counters, tenant="acme")
+    fams = parse_exposition(t)
+    assert set(fams) == {"tez_latency_tenant_dag_latency_ms",
+                         "tez_tenant_store_bytes"}
+    s = render_text(hists, gauges, counters, stream="s1")
+    fams = parse_exposition(s)
+    assert set(fams) == {"tez_latency_stream_window_latency_ms"}
+    assert all(lb.get("stream") == "s1"
+               for _, lb, _ in fams[
+                   "tez_latency_stream_window_latency_ms"]["samples"])
+    # filtering drops the unlabeled counter block entirely
+    assert "tez_counter" not in parse_exposition(t)
+
+
+def test_label_escaping_round_trips():
+    weird = 'we"ird\\ten\nant'
+    h = Histogram(f"tenant.{weird}.dag.latency")
+    h.observe(10.0)
+    text = render_text({h.name: h}, {})
+    fams = parse_exposition(text)
+    labels = [lb for _, lb, _ in
+              fams["tez_latency_tenant_dag_latency_ms"]["samples"]]
+    assert all(lb["tenant"] == weird for lb in labels)
+
+
+def test_render_json_rows_windows_accounting():
+    hists, gauges, _ = _fixture()
+    windows = {"stream.s1.window.latency": {"count": 1, "p95": 128.0}}
+    acct = {"series": 6, "evicted": 0, "scrape_errors": 0}
+    out = render_json(hists, gauges, windows=windows, accounting=acct,
+                      window_s=10.0)
+    assert out["window_s"] == 10.0
+    assert out["accounting"] == acct
+    by_series = {r["series"]: r for r in out["histograms"]}
+    row = by_series["stream.s1.window.latency"]
+    assert row["name"] == "stream.window.latency"
+    assert row["labels"] == {"stream": "s1"}
+    assert row["count"] == 1
+    assert row["window"] == windows["stream.s1.window.latency"]
+    assert 64.0 < row["p95"] <= 128.0
+    assert by_series["stream.window.latency"]["labels"] == {}
+    # tenant drill-down filters JSON rows the same way as text
+    only = render_json(hists, gauges, tenant="acme")
+    assert {r["series"] for r in only["histograms"]} == \
+        {"tenant.acme.dag.latency"}
+    assert {r["series"] for r in only["gauges"]} == \
+        {"tenant.acme.store.bytes"}
+
+
+def test_parser_rejects_untyped_samples():
+    with pytest.raises(ValueError, match="no TYPE"):
+        parse_exposition("tez_mystery 1\n")
+
+
+def test_parser_rejects_non_cumulative_buckets():
+    bad = ("# TYPE tez_latency_x_ms histogram\n"
+           'tez_latency_x_ms_bucket{le="1"} 5\n'
+           'tez_latency_x_ms_bucket{le="2"} 3\n'
+           'tez_latency_x_ms_bucket{le="+Inf"} 5\n'
+           "tez_latency_x_ms_sum 9\n"
+           "tez_latency_x_ms_count 5\n")
+    with pytest.raises(ValueError, match="not cumulative"):
+        parse_exposition(bad)
+
+
+def test_parser_rejects_count_bucket_mismatch():
+    bad = ("# TYPE tez_latency_x_ms histogram\n"
+           'tez_latency_x_ms_bucket{le="+Inf"} 5\n'
+           "tez_latency_x_ms_sum 9\n"
+           "tez_latency_x_ms_count 4\n")
+    with pytest.raises(ValueError, match="_count"):
+        parse_exposition(bad)
+
+
+def test_parser_rejects_missing_inf_bucket():
+    bad = ("# TYPE tez_latency_x_ms histogram\n"
+           'tez_latency_x_ms_bucket{le="1"} 5\n'
+           "tez_latency_x_ms_sum 9\n"
+           "tez_latency_x_ms_count 5\n")
+    with pytest.raises(ValueError, match=r"\+Inf"):
+        parse_exposition(bad)

@@ -1,0 +1,205 @@
+"""Time-series registry: ring eviction accounting, deterministic
+windowed aggregation, histogram snapshot/merge determinism, collector
+write-through and failure accounting, per-plane busy attribution.
+
+Every test drives :class:`TimeSeriesRegistry` with explicit ``now_ns``
+values, so the expected windows are exact — no sleeps, no wall clock.
+"""
+import pytest
+
+from tez_tpu.common import metrics
+from tez_tpu.obs import timeseries
+from tez_tpu.obs.timeseries import Series, TimeSeriesRegistry
+
+S = 1_000_000_000  # ns
+
+
+def _hist(name, values):
+    h = metrics.registry().histogram(name)
+    for v in values:
+        h.observe(v)
+    return h
+
+
+def test_ring_eviction_is_counted_never_silent():
+    reg = TimeSeriesRegistry(capacity=4)
+    for i in range(10):
+        metrics.set_gauge("tsr.g", float(i))
+        reg.sample(now_ns=i * S)
+    s = reg._series["tsr.g"]
+    assert len(s.points) == 4
+    assert s.evicted == 6
+    # the newest samples survive; the oldest were the ones evicted
+    assert [p[1] for p in s.points] == [6.0, 7.0, 8.0, 9.0]
+    acct = reg.accounting()
+    assert acct["evicted"] >= 6
+    assert acct["samples"] == 10
+    assert acct["series"] >= 1
+
+
+def test_series_capacity_floor_is_two():
+    s = Series("x", "gauge", 0)
+    assert s.capacity == 2
+
+
+def test_hist_window_delta_is_exact_and_repeatable():
+    reg = TimeSeriesRegistry()
+    h = _hist("tsw.lat", [])
+    reg.sample(now_ns=0)                   # zero baseline
+    h.observe(100.0)
+    h.observe(200.0)
+    reg.sample(now_ns=1 * S)
+    h.observe(300.0)
+    reg.sample(now_ns=2 * S)
+
+    # wide window: delta against the zero baseline == everything
+    wide = reg.window("tsw.lat", 10.0, now_ns=2 * S)
+    assert wide["count"] == 3
+    assert wide["sum_ms"] == 600.0
+    assert wide["rate_per_s"] == 1.5      # 3 obs over exactly 2 s
+
+    # narrow window: base is the newest sample at/before now-1s, so
+    # only the 300 ms observation is inside
+    narrow = reg.window("tsw.lat", 1.0, now_ns=2 * S)
+    assert narrow["count"] == 1
+    assert narrow["sum_ms"] == 300.0
+    assert 256.0 < narrow["p95"] <= 512.0
+
+    # pure function of ring contents: identical on every call
+    assert reg.window("tsw.lat", 1.0, now_ns=2 * S) == narrow
+    assert reg.window("tsw.lat", 10.0, now_ns=2 * S) == wide
+
+
+def test_hist_window_quantiles_match_bucket_math():
+    reg = TimeSeriesRegistry()
+    values = [10.0, 20.0, 40.0, 80.0, 700.0]
+    h = _hist("tsq.lat", [])
+    reg.sample(now_ns=0)
+    for v in values:
+        h.observe(v)
+    reg.sample(now_ns=1 * S)
+    w = reg.window("tsq.lat", 5.0, now_ns=1 * S)
+    counts = [0] * metrics.NUM_BUCKETS
+    for v in values:
+        counts[metrics.bucket_index(v)] += 1
+    for q, key in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+        assert w[key] == round(metrics.quantile_from_buckets(counts, q), 4)
+
+
+def test_hist_window_clamps_negative_deltas():
+    # a registry reset between samples makes cumulative counts go DOWN;
+    # the windowed delta must clamp at zero, not report garbage
+    pts = [(0, (5, 0), 5, 500.0), (1 * S, (1, 0), 1, 80.0)]
+    w = timeseries._hist_window(pts, 10 * S, 1 * S)
+    assert w["count"] == 0
+    assert w["sum_ms"] == 0.0
+    assert w["p95"] == 0.0
+
+
+def test_hist_window_covered_reports_truncation():
+    reg = TimeSeriesRegistry()
+    _hist("tsc.lat", [50.0])
+    reg.sample(now_ns=10 * S)
+    reg.sample(now_ns=11 * S)
+    # asked for 60 s but the ring only spans 1 s
+    w = reg.window("tsc.lat", 60.0, now_ns=11 * S)
+    assert w["covered_s"] == 1.0
+
+
+def test_gauge_window_stats_and_strict_start():
+    reg = TimeSeriesRegistry()
+    for i, v in enumerate([1.0, 3.0, 5.0, 7.0]):
+        metrics.set_gauge("tsg.depth", v)
+        reg.sample(now_ns=i * S)
+    w = reg.window("tsg.depth", 2.0, now_ns=3 * S)
+    # start = 1 s, and the cut is strict: only t=2s and t=3s qualify
+    assert w == {"n": 2, "last": 7.0, "min": 5.0, "max": 7.0,
+                 "mean": 6.0, "kind": "gauge"}
+    # empty window falls back to the last known value with n=0
+    stale = reg.window("tsg.depth", 1.0, now_ns=30 * S)
+    assert stale["n"] == 0 and stale["last"] == 7.0
+
+
+def test_window_of_unknown_series_is_none():
+    reg = TimeSeriesRegistry()
+    assert reg.window("never.sampled", 5.0, now_ns=0) is None
+
+
+def test_hist_snapshot_and_merge_are_order_independent():
+    values = [3.0, 17.0, 90.0, 2048.0, 70000.0, 90.0]
+    a = metrics.Histogram("a")
+    b = metrics.Histogram("b")
+    for v in values:
+        a.observe(v)
+    for v in reversed(values):
+        b.observe(v)
+    sa, sb = a.snapshot(), b.snapshot()
+    assert sa.counts == sb.counts
+    assert sa.count == sb.count == len(values)
+    assert sa.sum_ms == pytest.approx(sb.sum_ms)
+    # merging two snapshots == observing the union, in any order
+    merged = [x + y for x, y in zip(sa.counts, sb.counts)]
+    union = metrics.Histogram("u")
+    for v in values * 2:
+        union.observe(v)
+    assert merged == union.counts
+    for q in (0.5, 0.95, 0.99):
+        assert metrics.quantile_from_buckets(merged, q) == \
+            union.quantile(q)
+
+
+def test_sampled_rings_reproduce_identically():
+    # two registries fed the same snapshots at the same timestamps agree
+    # on every windowed aggregate — the determinism the golden surfaces
+    # (burn alerts, /metrics.json windows) are built on
+    r1, r2 = TimeSeriesRegistry(), TimeSeriesRegistry()
+    h = _hist("tsd.lat", [])
+    for t, obs in ((0, []), (1, [40.0]), (2, [600.0, 70.0]), (3, [9.0])):
+        for v in obs:
+            h.observe(v)
+        r1.sample(now_ns=t * S)
+        r2.sample(now_ns=t * S)
+    for win in (1.0, 2.0, 10.0):
+        assert r1.window("tsd.lat", win, now_ns=3 * S) == \
+            r2.window("tsd.lat", win, now_ns=3 * S)
+
+
+def test_collector_write_through_and_error_accounting():
+    reg = TimeSeriesRegistry()
+    reg.register_collector("lanes", lambda: {"mesh.lane.0.occupancy": 0.75})
+    reg.register_collector("sick", lambda: 1 / 0)
+    reg.sample(now_ns=1 * S)
+    # collector gauges ride the rings AND write through to the
+    # point-in-time gauge surface GET /metrics renders
+    assert reg.window("mesh.lane.0.occupancy", 5.0, 1 * S)["last"] == 0.75
+    assert metrics.registry().gauges()["mesh.lane.0.occupancy"] == 0.75
+    assert reg.accounting()["collector_errors"] == 1
+    reg.unregister_collector("sick")
+    reg.sample(now_ns=2 * S)
+    assert reg.accounting()["collector_errors"] == 1
+
+
+def test_plane_busy_attribution_uses_shared_mapping():
+    reg = TimeSeriesRegistry()
+    reg.sample(now_ns=0)                  # zero baseline for well-knowns
+    _hist("store.publish", [100.0])
+    _hist("mesh.exchange.round", [40.0, 60.0])
+    _hist("obs.flight.dump", [999.0])     # mapped to None: never blamed
+    reg.sample(now_ns=1 * S)
+    busy = reg.plane_busy_ms(10.0, now_ns=1 * S)
+    assert busy["store"] == 100.0
+    assert busy["exchange"] == 100.0
+    assert set(busy) == set(timeseries.PLANES)
+    assert sum(busy.values()) == 200.0
+
+
+def test_reset_drops_data_keeps_collectors():
+    reg = TimeSeriesRegistry()
+    reg.register_collector("keep", lambda: {"k.g": 1.0})
+    reg.sample(now_ns=0)
+    reg.note_scrape_error()
+    reg.reset()
+    acct = reg.accounting()
+    assert acct["series"] == 0 and acct["samples"] == 0
+    assert acct["scrape_errors"] == 0
+    assert reg.collectors() == ["keep"]

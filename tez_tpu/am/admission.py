@@ -35,11 +35,10 @@ import dataclasses
 import itertools
 import logging
 import threading
-import time
 from typing import Any, Dict, List, Optional
 
 from tez_tpu.client.errors import DAGRejectedError
-from tez_tpu.common import config as C
+from tez_tpu.common import clock, config as C
 from tez_tpu.common import faults, metrics
 from tez_tpu.obs import flight as _flight
 
@@ -132,7 +131,7 @@ class AdmissionController:
                 sub = _QueuedSubmission(
                     sub_id=f"{self._am.app_id}-sub{next(self._sub_seq)}",
                     plan=plan, tenant=tenant, recovery_data=recovery_data,
-                    enqueued_at=time.monotonic())
+                    enqueued_at=clock.mono_s())
                 ts.accepted += 1
                 ts.queued += 1
                 self._queue.append(sub)
@@ -278,7 +277,7 @@ class AdmissionController:
                 self._draining = None
                 self._publish_gauges_locked()
             metrics.observe("am.admit.queue_wait",
-                            (time.monotonic() - sub.enqueued_at) * 1000.0)
+                            (clock.mono_s() - sub.enqueued_at) * 1000.0)
             self._slo_tick()
             sub.done.set()
 
@@ -293,7 +292,7 @@ class AdmissionController:
         tenant = str(tenant or "")
         sub = _QueuedSubmission(
             sub_id=sub_id, plan=plan, tenant=tenant, recovery_data=None,
-            enqueued_at=time.monotonic())
+            enqueued_at=clock.mono_s())
         with self._lock:
             # future fresh submissions must never collide with a replayed
             # sub_id: advance the sequence past the replayed number

@@ -11,7 +11,6 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
 import concurrent.futures
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -26,7 +25,7 @@ from tez_tpu.am.launcher import RunnerPool
 from tez_tpu.am.task_comm import TaskCommunicatorManager
 from tez_tpu.am.task_scheduler import (TaskSchedulerManager,
                                        create_task_scheduler)
-from tez_tpu.common import config as C
+from tez_tpu.common import clock, config as C
 from tez_tpu.common.counters import TezCounters
 from tez_tpu.common.dispatcher import Dispatcher
 from tez_tpu.common.ids import DAGId, TaskAttemptId
@@ -140,6 +139,10 @@ class DAGAppMaster:
         self.slo_watchdog = _slo.from_conf(conf, journal=self.history)
         from tez_tpu.am.admission import AdmissionController
         self.admission = AdmissionController(self)
+        from tez_tpu.am.telemetry import TelemetrySampler
+        #: the live telemetry plane: periodic ring sampler + burn-rate SLO
+        #: evaluation + the GET /doctor/live surface (docs/telemetry.md)
+        self.telemetry = TelemetrySampler(self)
         #: resident stream drivers keyed by stream name (streaming mode,
         #: docs/streaming.md); populated by open_stream and by recovery
         self.streams: Dict[str, Any] = {}
@@ -208,12 +211,17 @@ class DAGAppMaster:
             self.umbilical_server.start()
         if self.web_ui is not None:
             self.web_ui.start()
+        self.telemetry.start()
         self._started = True
         self.history(HistoryEvent(HistoryEventType.AM_STARTED,
                                   data={"app_id": self.app_id,
                                         "attempt": self.attempt}))
 
     def stop(self) -> None:
+        # first: the TELEMETRY_SNAPSHOT summary event needs the history
+        # plane still up, and the final accounting should see the session
+        # as the scrapers last did
+        self.telemetry.stop()
         if self.web_ui is not None:
             self.web_ui.stop()
         self.thread_dumper.stop()
@@ -250,6 +258,7 @@ class DAGAppMaster:
             faults.fire("am.crash", detail=f"attempt={self.attempt}")
         except BaseException:  # noqa: BLE001 — a fail rule still crashes us
             pass
+        self.telemetry.crash()   # no TELEMETRY_SNAPSHOT: SIGKILL analog
         if self.web_ui is not None:
             self.web_ui.stop()
         self.thread_dumper.stop()
@@ -467,8 +476,8 @@ class DAGAppMaster:
         """Release the DAG's admission slot (promotes the queue head) and
         record its per-tenant completion latency.  Outside _dag_done — the
         admission lock never nests inside it."""
-        elapsed_s = (time.monotonic()
-                     - getattr(dag, "submit_monotonic", time.monotonic()))
+        elapsed_s = (clock.mono_s()
+                     - getattr(dag, "submit_monotonic", clock.mono_s()))
         self.admission.on_dag_finished(
             getattr(dag, "tenant", ""), final.name, elapsed_s * 1000.0)
 
@@ -502,7 +511,7 @@ class DAGAppMaster:
             data=submit_data))
         dag = DAGImpl(dag_id, plan, self, recovery_data=recovery_data)
         dag.tenant = tenant
-        dag.submit_monotonic = time.monotonic()
+        dag.submit_monotonic = clock.mono_s()
         with self._dag_done:
             self.live_dags[str(dag_id)] = dag
             self.dag_ids_by_name[plan.name] = str(dag_id)

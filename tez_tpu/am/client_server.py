@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Any, Optional
 
+from tez_tpu.common import clock
 from tez_tpu.am.umbilical_server import (_recv_msg, _send_msg,
                                           authenticate_stream)
 from tez_tpu.common.security import JobTokenSecretManager
@@ -40,7 +41,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 method, args, kwargs = _recv_msg(self.rfile)
                 # any authenticated request is a client liveness signal
                 # (reference: TezClient.sendAMHeartbeat / client keepalive)
-                server.last_client_contact = time.time()
+                server.last_client_contact = clock.wall_s()
                 if method not in _METHODS:
                     _send_msg(self.wfile, (False, f"no method {method}"))
                     continue
@@ -81,7 +82,7 @@ class DAGClientServer:
         self._tcp.daemon_threads = True
         self._tcp.am = am                # type: ignore[attr-defined]
         self._tcp.secrets = secrets      # type: ignore[attr-defined]
-        self._tcp.last_client_contact = time.time()  # type: ignore
+        self._tcp.last_client_contact = clock.wall_s()  # type: ignore
         self.shutdown_event = threading.Event()
         self._tcp.shutdown_event = self.shutdown_event  # type: ignore
         self._thread = threading.Thread(target=self._tcp.serve_forever,
@@ -108,7 +109,7 @@ class DAGClientServer:
         def _watch() -> None:
             while not self.shutdown_event.wait(
                     min(5.0, max(0.2, timeout_secs / 3))):
-                if time.time() - self.last_client_contact > timeout_secs:
+                if clock.wall_s() - self.last_client_contact > timeout_secs:
                     log.warning("no client contact for %.0fs: shutting "
                                 "session down", timeout_secs)
                     try:

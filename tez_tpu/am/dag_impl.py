@@ -9,9 +9,9 @@ from __future__ import annotations
 
 import enum
 import logging
-import time
 from typing import Any, Dict, List, Optional, Sequence, TYPE_CHECKING
 
+from tez_tpu.common import clock
 from tez_tpu.am.edge import EdgeImpl
 from tez_tpu.am.events import (DAGEvent, DAGEventType, VertexEvent,
                                VertexEventType)
@@ -141,7 +141,7 @@ class DAGImpl:
         return ()
 
     def _on_start(self, event: DAGEvent) -> None:
-        self.start_time = time.time()
+        self.start_time = clock.wall_s()
         self.ctx.history(HistoryEvent(
             HistoryEventType.DAG_STARTED, dag_id=str(self.dag_id),
             data={"dag_name": self.name}))
@@ -188,7 +188,7 @@ class DAGImpl:
     def _finish(self) -> DAGState:
         if self.succeeded_vertices == len(self.vertices):
             return self._start_commit()
-        self.finish_time = time.time()
+        self.finish_time = clock.wall_s()
         final = DAGState.FAILED if self.failed_vertices else DAGState.KILLED
         self._finish_history(final)
         return final
@@ -197,7 +197,7 @@ class DAGImpl:
     def _start_commit(self) -> DAGState:
         committers = self._collect_committers()
         if not committers:
-            self.finish_time = time.time()
+            self.finish_time = clock.wall_s()
             self._finish_history(DAGState.SUCCEEDED)
             return DAGState.SUCCEEDED
         # ledger record 1/2: COMMIT_STARTED is fsync'd (summary event,
@@ -252,7 +252,7 @@ class DAGImpl:
         return out
 
     def _on_commit_completed(self, event: DAGEvent) -> DAGState:
-        self.finish_time = time.time()
+        self.finish_time = clock.wall_s()
         if getattr(event, "fenced", False):
             # A superseded incarnation owns nothing anymore: it must not
             # journal to the ledger (the live AM writes it), must not abort
@@ -309,7 +309,7 @@ class DAGImpl:
             self._kill_requested = True
             return DAGState.COMMITTING
         if not self._any_live_vertices():
-            self.finish_time = time.time()
+            self.finish_time = clock.wall_s()
             self._finish_history(DAGState.KILLED)
             return DAGState.KILLED
         self._terminate_vertices("DAG kill requested")
@@ -321,7 +321,7 @@ class DAGImpl:
         self.diagnostics.append(
             f"internal error: {getattr(event, 'diagnostics', '')}")
         self._terminate_vertices("internal error")
-        self.finish_time = time.time()
+        self.finish_time = clock.wall_s()
         self._finish_history(DAGState.ERROR)
         return DAGState.ERROR
 

@@ -8,11 +8,10 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Any
 
 from tez_tpu.am.events import TaskAttemptEvent, TaskAttemptEventType
-from tez_tpu.common import config as C
+from tez_tpu.common import clock, config as C
 from tez_tpu.common import faults
 
 log = logging.getLogger(__name__)
@@ -64,7 +63,7 @@ class HeartbeatMonitor:
         backlog = self.ctx.task_scheduler.backlog()
         if backlog > 0:
             self.ctx.ensure_runners(backlog)
-        now = time.time()
+        now = clock.wall_s()
         if self.timeout_ms > 0:
             cutoff = self.timeout_ms / 1000.0
             for attempt_id, last in \

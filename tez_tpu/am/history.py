@@ -16,6 +16,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from tez_tpu.common import clock
 from tez_tpu.common.payload import resolve_class
 
 
@@ -69,9 +70,22 @@ class HistoryEventType(enum.Enum):
     NODE_BLACKLISTED = enum.auto()
     NODE_FORCED_ACTIVE = enum.auto()
     # SLO watchdog (obs/slo.py): one event per latched breach episode
-    # (tenant, kind, observed, target ride in data) so chaos/soak can
-    # assert on breaches straight from the journal
+    # (tenant, kind, stream, observed, target ride in data) so chaos/soak
+    # can assert on breaches straight from the journal
     TENANT_SLO_BREACH = enum.auto()
+    # burn-rate alerting (obs/slo.py evaluate_burn, docs/telemetry.md):
+    # one event per latched burn episode — a fast-window p95 crossed
+    # threshold x target while the cumulative histogram was still under
+    # target, i.e. the series is TRENDING toward breach.  Carries the
+    # same tenant/kind/stream labels as TENANT_SLO_BREACH so doctor can
+    # join alert -> breach per stream.  Summary event: the chaos leg
+    # asserts journal ordering (alert strictly before breach), so the
+    # alert must be on disk when it fires, not when the buffer drains.
+    SLO_BURN_ALERT = enum.auto()
+    # live telemetry plane (am/telemetry.py): the sampler's ring/overflow
+    # accounting journaled once at AM stop, so counter_diff can flag
+    # eviction/scrape-error growth across runs without scraping a live AM
+    TELEMETRY_SNAPSHOT = enum.auto()
     # streaming mode (am/streaming.py, docs/streaming.md): STREAM_OPENED
     # journals the resident stream's full spec (so a successor AM can
     # rebuild the window driver after a crash); STREAM_RETIRED seals it —
@@ -107,6 +121,8 @@ SUMMARY_EVENT_TYPES = frozenset({
     HistoryEventType.DAG_REQUEUED_ON_RECOVERY,
     HistoryEventType.ATTEMPT_FENCED,
     HistoryEventType.TENANT_SLO_BREACH,
+    HistoryEventType.SLO_BURN_ALERT,
+    HistoryEventType.TELEMETRY_SNAPSHOT,
     HistoryEventType.STREAM_OPENED,
     HistoryEventType.STREAM_RETIRED,
     HistoryEventType.WINDOW_COMMIT_STARTED,
@@ -130,7 +146,7 @@ class HistoryEvent:
 
     def __post_init__(self) -> None:
         if not self.timestamp:
-            self.timestamp = time.time()
+            self.timestamp = clock.wall_s()
 
     @property
     def is_summary(self) -> bool:
@@ -191,7 +207,7 @@ class JsonlHistoryLoggingService(HistoryLoggingService):
         self.log_dir = log_dir or "/tmp/tez-tpu-history"
         self.app_id = app_id or \
             ((conf.get("tez.app.id") if conf else "") or
-             f"app_{int(time.time())}")
+             f"app_{int(clock.wall_s())}")
         self._fh = None
         self._date: Optional[str] = None
         self._lock = threading.Lock()

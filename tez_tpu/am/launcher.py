@@ -12,12 +12,11 @@ from __future__ import annotations
 import itertools
 import logging
 import threading
-import time
 from typing import Any, Dict, Optional
 
 from tez_tpu.am.history import HistoryEvent, HistoryEventType
 from tez_tpu.api.runtime import ObjectRegistry
-from tez_tpu.common import faults
+from tez_tpu.common import clock, faults
 from tez_tpu.common.counters import DAGCounter
 from tez_tpu.common.ids import ContainerId
 
@@ -127,9 +126,9 @@ class RunnerPool:
     def shutdown(self, wait: bool = True) -> None:
         self._stopped = True
         if wait:
-            deadline = time.time() + 10
+            deadline = clock.wall_s() + 10
             for t in list(self._runners.values()):
-                t.join(timeout=max(0.1, deadline - time.time()))
+                t.join(timeout=max(0.1, deadline - clock.wall_s()))
 
 
 class SubprocessRunnerPool:

@@ -19,7 +19,7 @@ import zlib
 from typing import Any, Dict, List, Optional, Set
 
 from tez_tpu.am.history import HistoryEvent, HistoryEventType
-from tez_tpu.common import config as C
+from tez_tpu.common import clock, config as C
 from tez_tpu.common import faults
 from tez_tpu.dag.plan import DAGPlan
 
@@ -105,7 +105,7 @@ class RecoveryService:
     def start(self) -> None:
         os.makedirs(self.dir, exist_ok=True)
         self._fh = open(os.path.join(self.dir, "journal.jsonl"), "a")
-        self._last_flush = time.time()
+        self._last_flush = clock.wall_s()
 
     def handle(self, event: HistoryEvent) -> None:
         if self._fh is None:
@@ -129,9 +129,9 @@ class RecoveryService:
                 # syncs everything flushed so far, which includes ours
                 self._fh.flush()
                 fd = self._fh.fileno()
-                self._last_flush = time.time()
+                self._last_flush = clock.wall_s()
             elif self.flush_interval > 0:
-                now = time.time()
+                now = clock.wall_s()
                 if now - self._last_flush >= self.flush_interval:
                     self._fh.flush()
                     self._last_flush = now

@@ -15,13 +15,12 @@ from __future__ import annotations
 import logging
 import math
 import threading
-import time
 from typing import Any, Dict, Set
 
 from tez_tpu.am.estimators import TaskRuntimeEstimator, create_estimator
 from tez_tpu.am.events import TaskEvent, TaskEventType
 from tez_tpu.am.task_impl import TaskAttemptState, TaskState
-from tez_tpu.common import config as C
+from tez_tpu.common import clock, config as C
 
 log = logging.getLogger(__name__)
 
@@ -109,7 +108,7 @@ class Speculator:
         if self.dag.state in TERMINAL_DAG_STATES:
             self._stop.set()
             return 0
-        now = time.time()
+        now = clock.wall_s()
         budget = self._speculation_budget()
         speculated = 0
         for vertex in self.dag.vertices.values():

@@ -59,7 +59,6 @@ import logging
 import os
 import queue
 import threading
-import time
 import zlib
 from typing import Any, Dict, List, Optional, Set
 
@@ -67,7 +66,7 @@ from tez_tpu.am.dag_impl import DAGState
 from tez_tpu.am.history import HistoryEvent, HistoryEventType
 from tez_tpu.common import config as C
 from tez_tpu.common import epoch as epoch_registry
-from tez_tpu.common import faults, metrics
+from tez_tpu.common import clock, faults, metrics
 from tez_tpu.dag.plan import DAGPlan
 
 log = logging.getLogger(__name__)
@@ -184,6 +183,15 @@ class StreamDriver:
 
     def __init__(self, am: Any, spec: StreamSpec,
                  resume: Optional[Dict[str, Any]] = None):
+        # the stream name becomes a metric-name segment
+        # (``stream.<name>.window.latency`` et al., split into a
+        # stream= label at exposition) — so "window" is reserved for the
+        # session-wide aggregate family, and a dot would make the label
+        # split ambiguous
+        if not spec.name or spec.name == "window" or "." in spec.name:
+            raise StreamError(
+                f"invalid stream name {spec.name!r}: must be non-empty, "
+                f"not the reserved name 'window', and contain no '.'")
         self.am = am
         self.spec = spec
         conf = am.conf.merged(spec.conf)
@@ -283,7 +291,7 @@ class StreamDriver:
                   os.path.join(self.dir, spool_name(w)))
         with self._lock:
             self._cut = w
-            self._cut_monotonic[w] = time.monotonic()
+            self._cut_monotonic[w] = clock.mono_s()
             self._open_id = w + 1
             self._open_count = 0
         self._queue.put(w)
@@ -377,14 +385,14 @@ class StreamDriver:
         self._commit_window(w, str(dag_id), replay=replay)
 
     def _wait(self, dag_id: Any) -> Any:
-        deadline = time.monotonic() + self.window_timeout
+        deadline = clock.mono_s() + self.window_timeout
         while True:
             try:
                 return self.am.wait_for_dag(dag_id, timeout=0.5)
             except TimeoutError:
                 if self._dead:
                     raise StreamError("AM crashed mid-window") from None
-                if time.monotonic() >= deadline:
+                if clock.mono_s() >= deadline:
                     raise
 
     def _commit_window(self, w: int, dag_id: str, replay: bool = False) -> None:
@@ -409,8 +417,13 @@ class StreamDriver:
             cut_at = self._cut_monotonic.pop(w, None)
             self._lock.notify_all()
         if cut_at is not None:
-            ms = (time.monotonic() - cut_at) * 1000.0
+            ms = (clock.mono_s() - cut_at) * 1000.0
             metrics.observe("stream.window.latency", ms)
+            # per-stream twin of the aggregate: the series the SLO
+            # watchdog checks (and burn-evaluates) per stream, split into
+            # a stream= label at exposition — why "window" is a reserved
+            # stream name (__init__ guard)
+            metrics.observe(f"stream.{self.spec.name}.window.latency", ms)
         metrics.set_gauge(f"stream.{self.spec.name}.committed", float(w))
         self._tick_slo()
 
@@ -462,7 +475,7 @@ class StreamDriver:
         self._check_alive()
         if self._open_count > 0:
             self._cut_window()
-        deadline = time.monotonic() + timeout
+        deadline = clock.mono_s() + timeout
         with self._lock:
             while len(self._committed) + len(self._aborted) < self._cut:
                 if self._error is not None:
@@ -471,7 +484,7 @@ class StreamDriver:
                 if self._dead:
                     raise StreamError("AM crashed during drain")
                 if not self._lock.wait(timeout=0.2) and \
-                        time.monotonic() >= deadline:
+                        clock.mono_s() >= deadline:
                     raise TimeoutError(
                         f"stream {self.spec.name}: {self._cut - len(self._committed) - len(self._aborted)} "
                         f"window(s) still uncommitted after {timeout}s")
@@ -528,7 +541,7 @@ class StreamDriver:
             if w in self._committed or w in self._aborted:
                 continue
             self._replayed.add(w)
-            self._cut_monotonic[w] = time.monotonic()
+            self._cut_monotonic[w] = clock.mono_s()
             self._queue.put(w)
         if self._replayed:
             log.info("stream %s: resuming — %d committed, replaying "

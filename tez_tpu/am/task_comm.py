@@ -14,13 +14,12 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
-import time
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 from tez_tpu.api.events import TezAPIEvent, TezEvent
 from tez_tpu.am.events import (TaskAttemptEvent, TaskAttemptEventType,
                                VertexEvent, VertexEventType)
-from tez_tpu.common import epoch as epoch_registry
+from tez_tpu.common import clock, epoch as epoch_registry
 from tez_tpu.common import faults, tracing
 from tez_tpu.common.counters import TezCounters
 from tez_tpu.common.ids import ContainerId, TaskAttemptId
@@ -56,13 +55,13 @@ class _AttemptSession:
     def __init__(self) -> None:
         self.edge_seqs: Dict[str, int] = {}
         self.killed = False
-        self.last_heartbeat = time.time()
+        self.last_heartbeat = clock.wall_s()
         self.custom_events: List[TezAPIEvent] = []
         # progress-stuck detection (TaskHeartbeatHandler progress check):
         # an attempt that heartbeats but whose progress never moves and
         # which generates no events is hung, not alive
         self.last_progress = -1.0
-        self.last_activity = time.time()
+        self.last_activity = clock.wall_s()
 
 
 class TaskCommunicatorManager:
@@ -188,7 +187,7 @@ class TaskCommunicatorManager:
             # incarnation's state machines
             return HeartbeatResponse(events=[], should_die=True)
         session = self._session(request.attempt_id)
-        session.last_heartbeat = time.time()
+        session.last_heartbeat = clock.wall_s()
         if request.events or request.progress != session.last_progress:
             session.last_progress = request.progress
             session.last_activity = session.last_heartbeat

@@ -11,9 +11,9 @@ from __future__ import annotations
 
 import enum
 import logging
-import time
 from typing import Any, Dict, List, Optional, TYPE_CHECKING
 
+from tez_tpu.common import clock
 from tez_tpu.am.events import (SchedulerEvent, SchedulerEventType, TaskEvent,
                                TaskAttemptEvent, TaskAttemptEventType,
                                TaskEventType, VertexEvent, VertexEventType)
@@ -68,7 +68,7 @@ class TaskAttemptImpl:
         self.progress: float = 0.0
         self.launch_time: float = 0.0
         self.finish_time: float = 0.0
-        self.creation_time: float = time.time()
+        self.creation_time: float = clock.wall_s()
         self.is_speculative = False
         self.is_rescheduled = False   # re-run after output loss
         self.output_failure_reports: Dict[int, int] = {}  # consumer task -> count
@@ -114,7 +114,7 @@ class TaskAttemptImpl:
     def _on_started(self, event: TaskAttemptEvent) -> None:
         self.container_id = getattr(event, "container_id", None)
         self.node_id = getattr(event, "node_id", "")
-        self.launch_time = time.time()
+        self.launch_time = clock.wall_s()
         self.ctx.history(HistoryEvent(
             HistoryEventType.TASK_ATTEMPT_STARTED,
             dag_id=str(self.attempt_id.dag_id),
@@ -135,7 +135,7 @@ class TaskAttemptImpl:
             self.counters = counters
 
     def _on_done(self, event: TaskAttemptEvent) -> None:
-        self.finish_time = time.time()
+        self.finish_time = clock.wall_s()
         counters = getattr(event, "counters", None)
         if counters is not None:
             self.counters = counters
@@ -147,7 +147,7 @@ class TaskAttemptImpl:
         self._notify_scheduler_ended()
 
     def _on_failed(self, event: TaskAttemptEvent) -> None:
-        self.finish_time = time.time()
+        self.finish_time = clock.wall_s()
         diag = getattr(event, "diagnostics", "")
         if diag:
             self.diagnostics.append(diag)
@@ -160,7 +160,7 @@ class TaskAttemptImpl:
         self._notify_scheduler_ended(failed=True)
 
     def _on_killed(self, event: TaskAttemptEvent) -> None:
-        self.finish_time = time.time()
+        self.finish_time = clock.wall_s()
         diag = getattr(event, "diagnostics", "")
         if diag:
             self.diagnostics.append(diag)
@@ -178,7 +178,7 @@ class TaskAttemptImpl:
         the task re-runs (reference: SURVEY.md §3.5 fetch-failure path)."""
         consumer = getattr(event, "consumer_task_index", -1)
         if not self.output_failure_reports:
-            self.first_output_failure_time = time.time()
+            self.first_output_failure_time = clock.wall_s()
         self.output_failure_reports[consumer] = \
             self.output_failure_reports.get(consumer, 0) + 1
         max_failures = self.vertex.conf.get("tez.am.max.allowed.output.failures", 10)
@@ -193,7 +193,7 @@ class TaskAttemptImpl:
         max_window = float(self.vertex.conf.get(
             "tez.am.max.allowed.time-sec.for-read-error", 300))
         window_expired = \
-            time.time() - self.first_output_failure_time > max_window
+            clock.wall_s() - self.first_output_failure_time > max_window
         local_fetch = getattr(event, "is_local_fetch", False)
         disk_error = getattr(event, "is_disk_error_at_source", False)
         total = sum(self.output_failure_reports.values())
@@ -327,7 +327,7 @@ class TaskImpl:
         return att
 
     def _on_schedule(self, event: TaskEvent) -> None:
-        self.scheduled_time = time.time()
+        self.scheduled_time = clock.wall_s()
         self.ctx.history(HistoryEvent(
             HistoryEventType.TASK_STARTED,
             dag_id=str(self.task_id.dag_id),
@@ -358,7 +358,7 @@ class TaskImpl:
         self.next_attempt_number = max(self.next_attempt_number, n + 1)
         att = TaskAttemptImpl(self.task_id.attempt(n), self.vertex)
         att.sm.force_state(TaskAttemptState.SUCCEEDED)
-        now = time.time()
+        now = clock.wall_s()
         att.progress = 1.0
         att.launch_time = att.finish_time = now
         counters = rec.get("counters")
@@ -397,7 +397,7 @@ class TaskImpl:
 
     def _on_attempt_succeeded(self, event: TaskEvent) -> None:
         self.successful_attempt = event.attempt_id
-        self.finish_time = time.time()
+        self.finish_time = clock.wall_s()
         # Kill other live attempts (speculation losers).
         for att in self.live_attempts():
             att.handle(TaskAttemptEvent(
@@ -421,7 +421,7 @@ class TaskImpl:
                      self.max_failed_attempts)
             self._spawn_attempt()
             return TaskState.RUNNING
-        self.finish_time = time.time()
+        self.finish_time = clock.wall_s()
         self.ctx.dag_counters.increment(DAGCounter.NUM_FAILED_TASKS)
         self._finish_history("FAILED")
         self.ctx.dispatch(VertexEvent(
@@ -443,7 +443,7 @@ class TaskImpl:
         if self._terminating:
             if not self.live_attempts():
                 self.killed_attempts += 1
-                self.finish_time = time.time()
+                self.finish_time = clock.wall_s()
                 self.ctx.dag_counters.increment(DAGCounter.NUM_KILLED_TASKS)
                 self._finish_history("KILLED")
                 self.ctx.dispatch(VertexEvent(

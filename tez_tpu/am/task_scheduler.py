@@ -17,9 +17,9 @@ import heapq
 import itertools
 import logging
 import threading
-import time
 from typing import Any, Dict, List, Optional, Set, Tuple, TYPE_CHECKING
 
+from tez_tpu.common import clock
 from tez_tpu.am.events import (SchedulerEvent, SchedulerEventType,
                                TaskAttemptEvent, TaskAttemptEventType)
 from tez_tpu.common.ids import ContainerId, TaskAttemptId
@@ -112,7 +112,7 @@ class LocalTaskSchedulerService(TaskSchedulerService):
         with self._lock:
             heapq.heappush(self._heap,
                            (priority, next(self._seq), attempt_id, task_spec))
-            self._queued[attempt_id] = time.time()
+            self._queued[attempt_id] = clock.wall_s()
             self._priorities[attempt_id] = priority
             self._queued_tenant[attempt_id] = tenant
             self._tenant_queued[tenant] = \
@@ -176,7 +176,7 @@ class LocalTaskSchedulerService(TaskSchedulerService):
             # one burst of schedule() calls doesn't serially kill a slot's
             # whole complement — UNLESS the top request has waited past
             # max.wait-time-ms, which forces a round
-            now = time.time()
+            now = clock.wall_s()
             hb_between = int(conf.get(C.AM_PREEMPTION_HEARTBEATS_BETWEEN)) \
                 if conf is not None else 3
             max_wait_ms = int(conf.get(C.AM_PREEMPTION_MAX_WAIT_MS)) \

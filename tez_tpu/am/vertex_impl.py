@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import enum
 import logging
-import time
 from typing import Any, Dict, List, Optional, Sequence, Set, TYPE_CHECKING
 
 from tez_tpu.api.events import (CustomProcessorEvent,
@@ -27,7 +26,7 @@ from tez_tpu.am.events import (TaskAttemptEvent, TaskAttemptEventType,
 from tez_tpu.am.history import HistoryEvent, HistoryEventType
 from tez_tpu.am.task_impl import (TaskAttemptState, TaskImpl, TaskState,
                                   TERMINAL_TASK_STATES)
-from tez_tpu.common import config as C
+from tez_tpu.common import clock, config as C
 from tez_tpu.common.counters import TezCounters
 from tez_tpu.common.ids import TaskAttemptId, VertexId
 from tez_tpu.common.statemachine import StateMachineFactory
@@ -142,7 +141,7 @@ class VertexImpl:
 
     # ------------------------------------------------------- initialization
     def _on_init(self, event: VertexEvent) -> VertexState:
-        self.init_time = time.time()
+        self.init_time = clock.wall_s()
         for spec in self.plan.root_inputs:
             if spec.initializer_descriptor is not None:
                 self.pending_initializers.add(spec.name)
@@ -387,7 +386,7 @@ class VertexImpl:
         return self._do_start()
 
     def _do_start(self) -> VertexState:
-        self.start_time = time.time()
+        self.start_time = clock.wall_s()
         self.ctx.history(HistoryEvent(
             HistoryEventType.VERTEX_STARTED,
             dag_id=str(self.vertex_id.dag_id), vertex_id=str(self.vertex_id),
@@ -594,7 +593,7 @@ class VertexImpl:
     _aborted = False
 
     def _finish_succeeded(self) -> VertexState:
-        self.finish_time = time.time()
+        self.finish_time = clock.wall_s()
         self.counters = TezCounters()  # fresh roll-up (vertex may rerun)
         for t in self.tasks.values():
             att = t.successful_attempt_impl()
@@ -641,7 +640,7 @@ class VertexImpl:
         return VertexState.FAILED
 
     def _abort(self, final: str, terminate_tasks: bool = False) -> None:
-        self.finish_time = time.time()
+        self.finish_time = clock.wall_s()
         # per-vertex commit mode: this vertex's outputs never committed —
         # abort them (committed vertices stay committed; reference does not
         # roll back per-vertex commits on later DAG failure).  The commit

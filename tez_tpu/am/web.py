@@ -23,7 +23,13 @@ Endpoints:
   GET /trace            Chrome/Perfetto trace_event JSON (span buffer, or
                         history-derived when tracing was disarmed)
   GET /metrics          Prometheus text: counters, latency histograms,
-                        running-task/queued-fetch/epoch gauges
+                        running-task/queued-fetch/epoch gauges; dynamic
+                        series split into tenant=/stream=/lane= labels
+                        (?tenant=X / ?stream=Y drill down)
+  GET /metrics.json     structured JSON exposition + windowed aggregates
+                        from the live time-series rings (?window=SECONDS)
+  GET /doctor/live      continuous doctor: incremental per-plane blame,
+                        tenants, streams, queue depth, lane occupancy
 """
 from __future__ import annotations
 
@@ -31,9 +37,10 @@ import http.server
 import json
 import logging
 import threading
-import time
 import urllib.parse
 from typing import Any, Dict, List, Optional
+
+from tez_tpu.common import clock
 
 log = logging.getLogger(__name__)
 
@@ -332,14 +339,41 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self._send(200, json.dumps(self._trace(am)).encode())
         elif path == "/slo":
             self._send(200, json.dumps(self._slo(am)).encode())
-        elif path == "/metrics":
+        elif path in ("/metrics", "/metrics.json"):
             from tez_tpu.common import config as C
             conf = getattr(am, "conf", None)
             if conf is not None and not bool(conf.get(C.METRICS_ENABLED)):
                 self._send(404, b'{"error": "tez.metrics.enabled is off"}')
+                return
+            tenant = (query.get("tenant") or [None])[0]
+            stream = (query.get("stream") or [None])[0]
+            try:
+                if path == "/metrics":
+                    body, ctype = (self._metrics(am, tenant, stream)
+                                   .encode(),
+                                   "text/plain; version=0.0.4; "
+                                   "charset=utf-8")
+                else:
+                    window = float((query.get("window") or ["0"])[0] or 0)
+                    body, ctype = (json.dumps(self._metrics_json(
+                        am, tenant, stream, window)).encode(),
+                        "application/json")
+            except Exception:
+                # scrape-error accounting lives in the plane that broke,
+                # so counter_diff can flag scrape health across runs
+                from tez_tpu.obs import timeseries
+                timeseries.registry().note_scrape_error()
+                raise
+            self._send(200, body, ctype)
+        elif path == "/doctor/live":
+            sampler = getattr(am, "telemetry", None)
+            if sampler is None:
+                self._send(404, b'{"error": "no telemetry sampler"}')
             else:
-                self._send(200, self._metrics(am).encode(),
-                           "text/plain; version=0.0.4; charset=utf-8")
+                window = float((query.get("window") or ["0"])[0] or 0)
+                self._send(200, json.dumps(
+                    sampler.live_status(window or None),
+                    default=str).encode())
         else:
             self._send(404, b'{"error": "not found"}')
 
@@ -401,7 +435,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     def _attempt_dict(a: Any) -> Dict[str, Any]:
         """The one serialization of an attempt row (shared by the task
         table and the drill-down)."""
-        end = a.finish_time or time.time()
+        end = a.finish_time or clock.wall_s()
         return {
             "id": str(a.attempt_id), "state": a.state.name,
             "node": a.node_id or str(a.container_id or ""),
@@ -474,9 +508,12 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         return {"traceEvents": [], "displayTimeUnit": "ms"}
 
     @staticmethod
-    def _metrics(am: Any) -> str:
+    def _metrics(am: Any, tenant: Optional[str] = None,
+                 stream: Optional[str] = None) -> str:
         """Prometheus text scrape: process-global latency histograms +
-        running-task/queued-fetch/epoch gauges + DAG counters."""
+        running-task/queued-fetch/epoch gauges + DAG counters, dynamic
+        series names split into tenant=/stream=/lane= labels
+        (obs/exposition.py); ?tenant= / ?stream= drill down."""
         from tez_tpu.common import metrics
         # every live DAG contributes (concurrent session AM); an idle AM
         # falls back to the most recently retired DAG so post-completion
@@ -512,8 +549,28 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         gauges["running_tasks"] = float(running)
         gauges["am_epoch"] = float(getattr(am, "attempt", 0) or 0)
         gauges.setdefault("shuffle.queued_fetches", 0.0)
-        return metrics.render_prometheus(
-            metrics.registry().histograms(), gauges, counters_dict)
+        from tez_tpu.obs import exposition
+        return exposition.render_text(
+            metrics.registry().histograms(), gauges, counters_dict,
+            tenant=tenant, stream=stream)
+
+    @staticmethod
+    def _metrics_json(am: Any, tenant: Optional[str],
+                      stream: Optional[str],
+                      window_s: float) -> Dict[str, Any]:
+        """GET /metrics.json: the same families as the text format plus
+        windowed rate/p50/p95/p99 from the live rings and the telemetry
+        plane's overflow accounting."""
+        from tez_tpu.common import metrics
+        from tez_tpu.obs import exposition, timeseries
+        sampler = getattr(am, "telemetry", None)
+        if not window_s:
+            window_s = sampler.window_s if sampler is not None else 10.0
+        reg = timeseries.registry()
+        return exposition.render_json(
+            metrics.registry().histograms(), metrics.registry().gauges(),
+            windows=reg.windows(window_s), accounting=reg.accounting(),
+            window_s=window_s, tenant=tenant, stream=stream)
 
     @staticmethod
     def _attempt(am: Any, attempt_id: str) -> Dict[str, Any]:

@@ -23,6 +23,10 @@ the correctness tooling that scales with that codebase:
   ``tools/counter_diff.py`` sections vs ``docs/observability.md``.
 - :mod:`jax_hazards` — ``jax.jit`` recompile churn, implicit host
   syncs in pipeline hot paths, non-daemon threads, bare ``.acquire()``.
+- :mod:`rawtime` — raw ``time.time()`` / ``time.monotonic()`` in
+  ``am/`` and ``obs/``: every stamp in the control and observability
+  planes must come off the shared injectable clock
+  (``common/clock.py``) or flight/journal/time-series timelines fork.
 
 CLI: ``python -m tez_tpu.tools.graftlint`` (or ``make lint``); see
 docs/static_analysis.md.
@@ -33,8 +37,8 @@ from tez_tpu.analysis.core import (Checker, Context, Finding,  # noqa: F401
 
 
 def all_checkers():
-    """The five shipped checkers, in report order."""
+    """The six shipped checkers, in report order."""
     from tez_tpu.analysis import (faultpoints, jax_hazards, knobs,
-                                  lockorder, metric_names)
+                                  lockorder, metric_names, rawtime)
     return [lockorder.CHECKER, knobs.CHECKER, faultpoints.CHECKER,
-            metric_names.CHECKER, jax_hazards.CHECKER]
+            metric_names.CHECKER, jax_hazards.CHECKER, rawtime.CHECKER]

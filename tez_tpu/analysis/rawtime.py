@@ -1,0 +1,116 @@
+"""Raw-clock hygiene for the control and observability planes.
+
+Every timestamp the AM journals, exposes, or correlates must come off
+the shared injectable clock (``common/clock.py``): ``clock.wall_s()``
+for wall time, ``clock.mono_s()`` / ``clock.mono_ns()`` for monotonic
+time.  A raw ``time.time()`` or ``time.monotonic()`` inside ``am/`` or
+``obs/`` silently forks the timebase — flight records, time-series
+samples, and journal events stop being mutually orderable, and the
+deterministic chaos/replay harnesses (which drive the clock) cannot
+reach the call site.  This checker bans the raw calls in those two
+packages so the drift is a lint error, not an archaeology project.
+
+Codes:
+
+- ``raw-clock-call`` — ``time.time()`` / ``time.monotonic()`` /
+  ``time.monotonic_ns()`` called in ``tez_tpu/am/`` or ``tez_tpu/obs/``
+  outside ``common/clock.py`` itself.
+- ``raw-clock-import`` — ``from time import time|monotonic|...`` in
+  scope (aliasing hides the call sites from the call check).
+
+``time.sleep`` / ``time.perf_counter`` / formatting helpers are fine:
+they measure or wait, they don't *stamp*.  Triaged exceptions carry the
+usual ``# graftlint: disable=raw-clock-call`` pragma.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tez_tpu.analysis.core import Checker, Context, Finding
+
+#: packages (repo-relative prefixes) where raw clocks are banned
+_SCOPE_PREFIXES = ("tez_tpu/am/", "tez_tpu/obs/")
+
+#: banned time-module attributes: these STAMP a moment
+_BANNED = frozenset({"time", "monotonic", "monotonic_ns"})
+
+_REMEDY = ("use the shared injectable clock instead: clock.wall_s() / "
+           "clock.mono_s() / clock.mono_ns() from tez_tpu.common.clock")
+
+
+def _in_scope(rel: str) -> bool:
+    return rel.startswith(_SCOPE_PREFIXES)
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rel: str) -> None:
+        self.rel = rel
+        self.stack: List[str] = []
+        self.findings: List[Finding] = []
+        self._seen: dict = {}
+
+    def _qual(self) -> str:
+        return ".".join(self.stack) or "<module>"
+
+    def _add(self, code: str, line: int, what: str, msg: str) -> None:
+        # identity stays line-free: disambiguate repeats within one scope
+        base = f"{self._qual()}:{what}"
+        n = self._seen.get((code, base), 0)
+        self._seen[(code, base)] = n + 1
+        symbol = base if n == 0 else f"{base}#{n}"
+        self.findings.append(Finding(
+            "rawtime", code, self.rel, line, symbol, msg))
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _BANNED and \
+                isinstance(f.value, ast.Name) and f.value.id == "time":
+            self._add("raw-clock-call", node.lineno, f"time.{f.attr}",
+                      f"raw time.{f.attr}() in the {self.rel.split('/')[1]}"
+                      f" plane; {_REMEDY}")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "time" and node.level == 0:
+            for alias in node.names:
+                if alias.name in _BANNED:
+                    self._add(
+                        "raw-clock-import", node.lineno,
+                        f"from-time-import-{alias.name}",
+                        f"'from time import {alias.name}' aliases a raw "
+                        f"clock past the call check; {_REMEDY}")
+        self.generic_visit(node)
+
+
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in ctx.files:
+        if sf.tree is None or not _in_scope(sf.rel):
+            continue
+        v = _Visitor(sf.rel)
+        v.visit(sf.tree)
+        findings.extend(v.findings)
+    return findings
+
+
+CHECKER = Checker(
+    "rawtime",
+    "raw time.time()/time.monotonic() in am/ and obs/ vs the shared "
+    "injectable clock (common/clock.py)",
+    run)

@@ -31,6 +31,23 @@ def mono_ns() -> int:
     return time.monotonic_ns()
 
 
+def mono_s() -> float:
+    """Monotonic seconds on the shared axis (``mono_ns() / 1e9``).
+
+    The injectable replacement for raw ``time.monotonic()`` in ``am/``
+    and ``obs/`` — graftlint's rawtime checker bans the raw call there so
+    every duration and series timestamp provably shares this module's
+    anchor (wall/mono drift between independently-sampled clocks was
+    hand-caught in the PR-12 review; now it is structural)."""
+    return time.monotonic_ns() / 1e9
+
+
+def wall_s() -> float:
+    """Epoch seconds — the injectable replacement for raw ``time.time()``
+    in ``am/`` and ``obs/`` (see :func:`mono_s`)."""
+    return time.time()
+
+
 def anchor() -> Tuple[float, int]:
     """The process ``(wall_s, mono_ns)`` anchor pair.  Flight dumps embed
     it so an offline reader can project event times onto the wall axis of

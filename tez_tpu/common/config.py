@@ -242,6 +242,17 @@ TEST_FAULT_SEED = _key(
     "Seed for the fault plane's deterministic schedule; the same "
     "(spec, seed) pair replays the identical fault storm "
     "(python -m tez_tpu.tools.chaos --seed N prints repro seeds)")
+TEST_RAMP_BASE_MS = _key(
+    "tez.test.ramp.base-ms", 0.0, Scope.DAG,
+    "Base sink latency in ms for the SLO-burn chaos leg's ramp "
+    "processor (test/chaos only): each window sleeps base + step x "
+    "window_id before committing, so windowed p95 climbs a "
+    "deterministic ramp toward the SLO target.  See make "
+    "chaos-slo-burn and docs/telemetry.md")
+TEST_RAMP_STEP_MS = _key(
+    "tez.test.ramp.step-ms", 0.0, Scope.DAG,
+    "Per-window latency increment in ms for the SLO-burn chaos leg's "
+    "ramp processor (test/chaos only); see tez.test.ramp.base-ms")
 DEBUG_LOCKORDER = _key(
     "tez.debug.lockorder", False, Scope.DAG,
     "Arm the runtime lock-order witness for this DAG (test/chaos only): "
@@ -316,6 +327,53 @@ METRICS_ENABLED = _key(
     "running-task/queued-fetch/epoch gauges) on the AM web UI.  Histogram "
     "recording itself is always on — it is a few bucket increments per "
     "IO-sized operation")
+AM_METRICS_SAMPLE_PERIOD_MS = _key(
+    "tez.am.metrics.sample-period-ms", 250.0, Scope.AM,
+    "Tick period of the live telemetry sampler (am/telemetry.py): every "
+    "tick snapshots all histograms, gauges and registered collectors "
+    "into the bounded time-series rings that feed GET /metrics.json "
+    "windows, burn-rate SLO alerts, GET /doctor/live and graft top.  "
+    "The plane is always-on like the flight recorder (one snapshot per "
+    "tick off the hot path, inside the 3% armed-overhead budget); "
+    "0 disables the sampler thread entirely (docs/telemetry.md)")
+AM_METRICS_RING_SAMPLES = _key(
+    "tez.am.metrics.ring.samples", 512, Scope.AM,
+    "Ring capacity per time series, in samples: ~2 minutes of history at "
+    "the default 250 ms period.  The ring evicts oldest-first once full "
+    "and counts every eviction (the telemetry accounting surfaced at "
+    "GET /metrics.json and flagged by counter_diff on growth)")
+AM_METRICS_WINDOW_S = _key(
+    "tez.am.metrics.window-s", 10.0, Scope.AM,
+    "Default aggregation window for the live surfaces: GET /metrics.json "
+    "windowed rate/p50/p95/p99, the continuous doctor's incremental "
+    "blame sweep (GET /doctor/live) and graft top all summarize the "
+    "last this-many seconds unless the request overrides it")
+AM_SLO_BURN_THRESHOLD = _key(
+    "tez.am.slo.burn.threshold", 0.85, Scope.AM,
+    "Error-budget burn alerting threshold as a fraction of each "
+    "tez.am.slo.* target: when a fast-window p95 (or shed rate) crosses "
+    "threshold x target the watchdog latches a typed SLO_BURN_ALERT "
+    "history event plus a flight MARK — *before* the cumulative "
+    "histogram breaches the full target, so a stream trending toward "
+    "its SLO pages while there is still budget left.  0 disables burn "
+    "evaluation (breach-or-not only, the pre-PR-18 behavior)")
+AM_SLO_BURN_FAST_S = _key(
+    "tez.am.slo.burn.fast-window-s", 5.0, Scope.AM,
+    "Fast burn window in seconds: the trigger window.  A burn alert "
+    "latches when this window's p95 crosses threshold x target "
+    "(windowed aggregates come from the telemetry sampler's rings, so "
+    "the sampler period bounds burn-alert latency)")
+AM_SLO_BURN_SLOW_S = _key(
+    "tez.am.slo.burn.slow-window-s", 60.0, Scope.AM,
+    "Slow burn window in seconds: the clear/hysteresis window.  A "
+    "latched burn alert clears only when the slow window's p95 drops "
+    "back under threshold x target, so an oscillating stream pages once "
+    "per episode instead of once per blip (multi-window burn-rate "
+    "evaluation, SRE-workbook style)")
+AM_SLO_BURN_MIN_COUNT = _key(
+    "tez.am.slo.burn.min-count", 2, Scope.AM,
+    "Minimum observations inside the fast window before burn evaluation "
+    "runs for a series, so a single slow outlier cannot page")
 AM_COMMIT_ALL_OUTPUTS_ON_SUCCESS = _key(
     "tez.am.commit-all-outputs-on-dag-success", True, Scope.DAG,
     "Reference: commit at DAG success vs per-vertex commit (DAGImpl commit modes)")

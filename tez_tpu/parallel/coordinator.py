@@ -167,6 +167,10 @@ class MeshExchangeCoordinator:
         self.partition_splits = 0
         self.coded_buddy_wins = 0
         self.last_engine: Optional[str] = None
+        #: cumulative rows landed per device lane (coded duplicates
+        #: included — they occupy the lane), feeding the
+        #: ``mesh.lane.<i>.*`` occupancy gauges via telemetry_collector
+        self.lane_rows: Dict[int, int] = {}
         # consecutive over-budget streak per recurring edge (keyed by the
         # edge id MINUS the per-run dag prefix, so history survives re-runs)
         self._skew_history: Dict[str, int] = {}
@@ -610,6 +614,7 @@ class MeshExchangeCoordinator:
 
         row_words = num_lanes + 1 + value_words   # lanes + klen + vwords
         sent_rows = dup_rows = buddy_wins = rounds_run = 0
+        lane_counts = np.zeros(D, dtype=np.int64)
         per_round_results: List[List[KVBatch]] = []
         for r, (quota, cap) in enumerate(plan):
             lo = r * per_round
@@ -634,6 +639,7 @@ class MeshExchangeCoordinator:
                     [dests_all, (dests_all + 1) % D])
                 dup_rows += n_round
             qc = np.bincount(dests_all, minlength=D)
+            lane_counts += qc
             if coded:
                 # duplication doubled the quotas; re-derive the balanced
                 # cap from the combined histogram (coded always uses
@@ -733,6 +739,9 @@ class MeshExchangeCoordinator:
             self.coded_buddy_wins += buddy_wins
             if rounds_run > 1:
                 self.multi_round_exchanges += 1
+            for d in range(D):
+                self.lane_rows[d] = \
+                    self.lane_rows.get(d, 0) + int(lane_counts[d])
         if st.counters is not None:
             g = st.counters.group(MESH_EXCHANGE_GROUP)
             g.find_counter("exchange.rows.sent").increment(sent_rows)
@@ -818,3 +827,24 @@ def reset_coordinator() -> None:
     global _coordinator
     with _coordinator_lock:
         _coordinator = None
+
+
+def telemetry_collector() -> Dict[str, float]:
+    """Live-telemetry hook (obs/timeseries registry): per-device-lane
+    exchange occupancy gauges — each lane's cumulative landed rows and
+    its share of all landed rows, so ``graft top`` shows a skewed mesh as
+    one hot lane instead of an averaged-away total.  Never *creates* the
+    coordinator: an AM that ran no exchange reports nothing."""
+    with _coordinator_lock:
+        coord = _coordinator
+    if coord is None:
+        return {}
+    with coord.lock:
+        lane_rows = dict(coord.lane_rows)
+    total = float(sum(lane_rows.values()))
+    out: Dict[str, float] = {}
+    for d, rows in lane_rows.items():
+        out[f"mesh.lane.{d}.rows"] = float(rows)
+        out[f"mesh.lane.{d}.occupancy"] = \
+            round(rows / total, 6) if total else 0.0
+    return out

@@ -439,3 +439,12 @@ def local_shuffle_service() -> ShuffleService:
     """The per-process service (one per host; shared by AM and runners in
     local mode, exactly like the NM-singleton ShuffleHandler)."""
     return _local
+
+
+def telemetry_collector() -> Dict[str, float]:
+    """Live-telemetry hook (obs/timeseries registry): registered-run
+    inventory as gauges on every sampler tick — the transport plane's
+    resident footprint, next to the store collector's tier bytes."""
+    n, nbytes = _local.stats()
+    return {"shuffle.registered_runs": float(n),
+            "shuffle.registered_bytes": float(nbytes)}

@@ -769,3 +769,23 @@ class ShuffleBufferStore:
         if self._own_dir:
             import shutil
             shutil.rmtree(self.disk_dir, ignore_errors=True)
+
+
+def telemetry_collector() -> Dict[str, float]:
+    """Live-telemetry hook (obs/timeseries registry): tier/tenant resident
+    bytes as gauges on every sampler tick.  ``_publish_gauges`` only runs
+    on mutation, so a quiescent store's gauges would otherwise go stale in
+    the ring — the collector re-reads them under the store lock.  Returns
+    ``{}`` when no store is installed (batch mode)."""
+    from tez_tpu.store import local_buffer_store
+    store = local_buffer_store()
+    if store is None:
+        return {}
+    s = store.stats()
+    out: Dict[str, float] = {
+        f"store.{tier}.bytes": float(b) for tier, b in s["bytes"].items()}
+    out["store.entries"] = float(s["entries"])
+    for tenant, tb in s["tenant_bytes"].items():
+        out[f"store.tenant.{tenant or 'default'}.bytes"] = \
+            float(sum(tb.values()))
+    return out

@@ -478,6 +478,10 @@ def run_tenant_storm(seed: int, workdir: str, timeout: float = 120.0,
         # recorder) the flight dump — the doctor acceptance path
         "tez.am.slo.shed-rate": 0.01,
         "tez.am.slo.min-count": 2,
+        # live ops plane on: make soak is the documented target for
+        # `graft top` / GET /doctor/live (docs/telemetry.md), so the
+        # storm session serves them on an ephemeral port
+        "tez.am.web.enabled": True,
     }
     # admission faults are process-wide: they fire in the AM's submit path
     # and queue consumer, before any DAG-scoped rules exist.  fail:n=2
@@ -493,6 +497,11 @@ def run_tenant_storm(seed: int, workdir: str, timeout: float = 120.0,
 
     client = TezClient.create(f"tenantstorm{seed}", session_conf,
                               session=True).start()
+    web = getattr(client.framework_client.am, "web_ui", None)
+    if web is not None:
+        # the soak is the documented live target for the ops plane:
+        # point `make top URL=...` (or a Prometheus scraper) here
+        print(f"live ops plane: python -m tez_tpu.tools.top {web.url}")
 
     def submitter(t: int) -> None:
         tenant = tenant_names[t]
@@ -1277,6 +1286,210 @@ def run_stream_kill(seed: int, workdir: str, timeout: float = 120.0,
                   f"by {max_lag}")
 
 
+class RampSinkProcessor(SimpleProcessor):
+    """Slow-burn sink for the SLO-burn leg: sleeps ``base + step ×
+    window_id`` ms before grouping, so each successive window's
+    cut→commit latency climbs a deterministic ramp — exactly the shape
+    burn-rate alerting exists for (degrading, not yet breached)."""
+
+    def run(self, inputs: Dict[str, Any], outputs: Dict[str, Any]) -> None:
+        from tez_tpu.library.streaming import StreamWindowSinkProcessor
+        conf = self.context.conf
+        base = float(conf.get("tez.test.ramp.base-ms", 0) or 0)
+        step = float(conf.get("tez.test.ramp.step-ms", 0) or 0)
+        time.sleep((base + step * self.context.window_id) / 1000.0)
+        StreamWindowSinkProcessor.run(self, inputs, outputs)
+
+
+def _build_ramp_template(name: str, base_ms: float, step_ms: float) -> "DAG":
+    """Window template whose sink latency ramps with the window id."""
+    from tez_tpu.library.streaming import StreamWindowSourceProcessor
+    source = Vertex.create("source", ProcessorDescriptor.create(
+        StreamWindowSourceProcessor), 2)
+    sink = Vertex.create("sink", ProcessorDescriptor.create(
+        RampSinkProcessor), 1)
+    conf = {"tez.runtime.key.class": "bytes",
+            "tez.runtime.value.class": "long"}
+    prop = EdgeProperty.create(
+        DataMovementType.SCATTER_GATHER, DataSourceType.PERSISTED,
+        SchedulingType.SEQUENTIAL,
+        OutputDescriptor.create(
+            "tez_tpu.library.outputs:OrderedPartitionedKVOutput",
+            payload=conf),
+        InputDescriptor.create(
+            "tez_tpu.library.inputs:OrderedGroupedKVInput", payload=conf))
+    dag = DAG.create(name).add_vertex(source).add_vertex(sink)
+    dag.add_edge(Edge.create(source, sink, prop))
+    dag.set_conf("tez.test.ramp.base-ms", base_ms)
+    dag.set_conf("tez.test.ramp.step-ms", step_ms)
+    return dag
+
+
+def run_slo_burn(seed: int, workdir: str, timeout: float = 120.0
+                 ) -> Tuple[bool, str]:
+    """Burn-before-breach scenario (``make chaos-slo-burn``).  Returns
+    (ok, detail).
+
+    One resident stream whose sink latency ramps ~100 ms per window
+    (seed-jittered) against a 900 ms window-p95 SLO with burn alerting
+    at 50% of target.  The telemetry sampler snapshots the latency
+    series into windowed rings; fast-window p95 crosses ``threshold ×
+    target`` several windows before the cumulative p95 crosses the
+    target itself, so the journal must show the typed ``SLO_BURN_ALERT``
+    strictly before the ``TENANT_SLO_BREACH`` for the same
+    (kind, stream) — the alert pages while there is still error budget.
+
+    Asserted: burn alert present and strictly earlier than the breach
+    for stream "ramp"; journal fscks clean (exercising the SLO ledger's
+    label checks); doctor's alert→breach join reports a positive lead
+    time; the graceful stop journals a ``TELEMETRY_SNAPSHOT`` with zero
+    scrape/collector errors."""
+    from tez_tpu.am.app_master import DAGAppMaster
+    from tez_tpu.am.history import HistoryEventType
+    from tez_tpu.am.recovery import decode_journal_line
+    from tez_tpu.am.streaming import StreamSpec
+    from tez_tpu.common import config as C
+    from tez_tpu.common import epoch as epoch_registry
+    from tez_tpu.common import metrics
+    from tez_tpu.obs import timeseries
+    from tez_tpu.store import reset_store
+    from tez_tpu.tools import doctor, journal_fsck
+
+    rng = random.Random(seed)
+    per_window = 3
+    windows = 6
+    base_ms = 60.0 + rng.uniform(0.0, 20.0)
+    step_ms = 100.0 + rng.uniform(0.0, 10.0)
+    records = [{"k": f"key{i % 5}", "v": rng.randint(1, 100)}
+               for i in range(per_window * windows)]
+
+    root = os.path.join(workdir, f"sloburn{seed}")
+    staging = os.path.join(root, "staging")
+    out_dir = os.path.join(root, "out")
+    conf = C.TezConfiguration({
+        "tez.staging-dir": staging,
+        "tez.am.local.num-containers": 3,
+        "tez.runtime.stream.window.count": per_window,
+        # the ramp crosses 50% of target (burn) several windows before
+        # the cumulative p95 crosses the target (breach)
+        "tez.am.slo.window.p95-ms": 900.0,
+        "tez.am.slo.min-count": 3,
+        "tez.am.slo.burn.threshold": 0.5,
+        "tez.am.slo.burn.fast-window-s": 30.0,
+        "tez.am.slo.burn.slow-window-s": 120.0,
+        "tez.am.slo.burn.min-count": 2,
+        "tez.am.metrics.sample-period-ms": 25.0,
+    })
+
+    metrics.registry().reset()
+    timeseries.reset()
+    reset_store()
+    app_id = f"app_1_sloburn{seed}"
+    am = DAGAppMaster(app_id, conf, attempt=1)
+    am.start()
+    try:
+        spec = StreamSpec(
+            name="ramp",
+            plan=_build_ramp_template("ramp-template", base_ms,
+                                      step_ms).create_dag_plan(),
+            output_dir=out_dir)
+        driver = am.open_stream(spec)
+        # pre-register the window-latency histograms and take one
+        # baseline ring sample while both are still all-zero: the
+        # windowed delta is computed against the oldest ring point, so
+        # without this the first window's latency would be invisible to
+        # burn evaluation and the alert could only fire after the
+        # cumulative breach — the opposite of what this leg asserts
+        metrics.registry().histogram("stream.window.latency")
+        metrics.registry().histogram(f"stream.{spec.name}.window.latency")
+        am.telemetry.tick()
+        deadline = time.time() + timeout
+        for w in range(windows):
+            driver.ingest(records[w * per_window:(w + 1) * per_window])
+            while time.time() < deadline:
+                done = am.logging_service.of_type(
+                    HistoryEventType.WINDOW_COMMIT_FINISHED)
+                if len(done) > w:
+                    break
+                time.sleep(0.02)
+            else:
+                return False, f"window {w + 1} never committed"
+            # a deterministic sampler tick between commits: the burn
+            # evaluator always sees window N's latency before window
+            # N+1 can push the cumulative histogram over the target
+            am.telemetry.tick()
+        final = driver.drain(timeout=timeout)
+        if not final["retired"] or len(final["committed"]) != windows:
+            return False, f"stream drained to {final}"
+    finally:
+        am.stop()
+        epoch_registry.reset()
+        reset_store()
+
+    # ---- journal ordering: the page precedes the breach ----------------
+    files = journal_fsck.discover_journals(
+        os.path.join(staging, app_id, "recovery"))
+    burn_t: List[float] = []
+    breach_t: List[float] = []
+    snapshots: List[Dict[str, Any]] = []
+    for path in files:
+        with open(path, errors="replace") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = decode_journal_line(line)
+                except Exception:  # noqa: BLE001 — torn tail
+                    continue
+                name = ev.event_type.name
+                if name == "SLO_BURN_ALERT" \
+                        and ev.data.get("stream") == "ramp":
+                    burn_t.append(ev.timestamp)
+                elif name == "TENANT_SLO_BREACH" \
+                        and ev.data.get("stream") == "ramp":
+                    breach_t.append(ev.timestamp)
+                elif name == "TELEMETRY_SNAPSHOT":
+                    snapshots.append(dict(ev.data))
+    if not burn_t:
+        return False, "no SLO_BURN_ALERT journaled for stream ramp"
+    if not breach_t:
+        return False, "ramp never breached (no TENANT_SLO_BREACH)"
+    lead = min(breach_t) - min(burn_t)
+    if lead <= 0:
+        return False, (f"burn alert did NOT precede the breach "
+                       f"(lead {lead:+.3f}s)")
+
+    # ---- fsck understands the SLO records ------------------------------
+    report = journal_fsck.fsck_files(files)
+    if not report.ok:
+        return False, f"journal fsck found errors: {report.errors[:3]}"
+    key = ("*", "window_p95_ms", "ramp")
+    led = report.slo.get(key)
+    if not led or not led["burn_alerts"] or not led["breaches"]:
+        return False, f"fsck SLO ledger missing {key}: {dict(report.slo)}"
+
+    # ---- doctor joins the alert to its breach --------------------------
+    joined = doctor.join_burn_alerts(doctor.load_slo_burn_alerts(files),
+                                     doctor.load_slo_breaches(files))
+    ramp = [a for a in joined if a.get("stream") == "ramp"]
+    if not ramp or not any(a["breached"] and (a["lead_s"] or 0) > 0
+                           for a in ramp):
+        return False, f"doctor burn→breach join failed: {ramp}"
+
+    # ---- graceful stop accounted for the plane -------------------------
+    if not snapshots:
+        return False, "graceful stop journaled no TELEMETRY_SNAPSHOT"
+    acct = snapshots[-1]
+    if acct.get("scrape_errors") or acct.get("collector_errors"):
+        return False, f"telemetry plane unhealthy at stop: {acct}"
+
+    return True, (f"{windows} windows ramped {base_ms:.0f}"
+                  f"+{step_ms:.0f}ms/w; burn alert paged {lead:.3f}s "
+                  f"before the breach, fsck clean, "
+                  f"{acct.get('series', 0)} series at stop")
+
+
 def run_device_ooo(seed: int, spans: int = 4,
                    records: int = 1500) -> Tuple[bool, str]:
     """Out-of-order device-completion scenario: the async double-buffered
@@ -1843,6 +2056,17 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                          "bit-exact vs a fault-free feed, with zero "
                          "duplicate commits and bounded post-recovery "
                          "lag")
+    ap.add_argument("--slo-burn", action="store_true",
+                    help="run the burn-before-breach SLO scenario: one "
+                         "resident stream whose per-window latency ramps "
+                         "toward a window-p95 SLO target; the telemetry "
+                         "sampler's multi-window burn evaluation must "
+                         "journal SLO_BURN_ALERT strictly before the "
+                         "TENANT_SLO_BREACH lands, journal_fsck must "
+                         "account both under the same (tenant, kind, "
+                         "stream) key, and the doctor must join the alert "
+                         "to the breach that followed it with a positive "
+                         "lead time")
     ap.add_argument("--exchange-skew", action="store_true",
                     help="run the skewed-key mesh-exchange scenario: a hot "
                          "partition over the round budget plus one chip "
@@ -1964,6 +2188,23 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                     failures += 1
                     print(f"REPRO: python -m tez_tpu.tools.chaos "
                           f"--stream-kill --seed {seed}")
+        finally:
+            if cleanup:
+                shutil.rmtree(workdir, ignore_errors=True)
+        return 1 if failures else 0
+    if args.slo_burn:
+        failures = 0
+        try:
+            for seed in range(args.seed, args.seed + args.trials):
+                ok, detail = run_slo_burn(seed, workdir,
+                                          timeout=args.timeout)
+                print(("ok   " if ok else "FAIL ") +
+                      f"slo-burn seed={seed}: {detail}")
+                _flight_dump_scenario("slo-burn", seed, ok)
+                if not ok:
+                    failures += 1
+                    print(f"REPRO: python -m tez_tpu.tools.chaos "
+                          f"--slo-burn --seed {seed}")
         finally:
             if cleanup:
                 shutil.rmtree(workdir, ignore_errors=True)

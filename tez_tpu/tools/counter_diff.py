@@ -8,6 +8,11 @@ groups (written by tez_tpu.common.metrics when the tracing/metrics plane is
 on) are decoded back into bucket distributions and compared on p50/p95/max,
 so a latency regression shows up as "shuffle.fetch.rtt p95 12ms -> 48ms"
 rather than an opaque bucket-count delta.
+
+The telemetry section diffs the stop-time ``TELEMETRY_SNAPSHOT`` journal
+events: ring-eviction / collector-failure / scrape-error growth is
+flagged (an adequately-sized always-on plane has zero of each), series
+cardinality and burn-alert counts are reported unflagged.
 """
 from __future__ import annotations
 
@@ -228,6 +233,52 @@ def stream_summary(dags: Dict) -> Dict[str, Any]:
         "p50_ms": lat[len(lat) // 2] if lat else 0.0,
         "p95_ms": lat[int(len(lat) * 0.95)] if lat else 0.0,
     }
+
+
+def telemetry_summary(dags: Dict) -> Dict[str, int]:
+    """Session telemetry roll-up off the journaled stop-time
+    ``TELEMETRY_SNAPSHOT`` (last one wins — each AM incarnation journals
+    its own) plus the burn-alert count: ``{"series", "evicted",
+    "collector_errors", "scrape_errors", "burn_alerts"}``."""
+    events: List[Dict] = []
+    for d in dags.values():
+        events = getattr(d, "telemetry_events", None) or events
+    snap: Dict = {}
+    for e in events:
+        if e["event"] == "SNAPSHOT":
+            snap = e
+    return {
+        "series": int(snap.get("series", 0)),
+        "evicted": int(snap.get("evicted", 0)),
+        "collector_errors": int(snap.get("collector_errors", 0)),
+        "scrape_errors": int(snap.get("scrape_errors", 0)),
+        "burn_alerts": sum(1 for e in events if e["event"] == "BURN"),
+    }
+
+
+def diff_telemetry(dags_a: Dict, dags_b: Dict
+                   ) -> List[Tuple[str, int, int, bool]]:
+    """[(name, a, b, regressed)] for the telemetry-plane section: ring
+    evictions, collector failures, and scrape errors are flagged on any
+    growth (a correctly-sized always-on plane has zero of each); series
+    cardinality and burn-alert count are reported unflagged (workload-
+    shaped — a chaos leg SHOULD page)."""
+    sa, sb = telemetry_summary(dags_a), telemetry_summary(dags_b)
+    if not any(sa.values()) and not any(sb.values()):
+        return []
+    return [
+        ("telemetry.series", sa["series"], sb["series"], False),
+        ("telemetry.ring.evicted", sa["evicted"], sb["evicted"],
+         sb["evicted"] > sa["evicted"]),
+        ("telemetry.collector.errors", sa["collector_errors"],
+         sb["collector_errors"],
+         sb["collector_errors"] > sa["collector_errors"]),
+        ("telemetry.scrape.errors", sa["scrape_errors"],
+         sb["scrape_errors"],
+         sb["scrape_errors"] > sa["scrape_errors"]),
+        ("telemetry.slo.burn_alerts", sa["burn_alerts"],
+         sb["burn_alerts"], False),
+    ]
 
 
 def diff_stream(dags_a: Dict, dags_b: Dict
@@ -530,6 +581,14 @@ def main() -> int:
             flag = "  << REGRESSION" if regressed else ""
             print(f"{name:60} {va:14d} {vb:14d}{flag}")
             regressions += int(regressed)
+    telemetry = diff_telemetry(sessions[0], sessions[1])
+    if telemetry:
+        print(f"\n{'telemetry plane (rings/collectors/scrapes)':60} "
+              f"{'A':>14} {'B':>14}")
+        for name, va, vb, regressed in telemetry:
+            flag = "  << REGRESSION" if regressed else ""
+            print(f"{name:60} {va:14d} {vb:14d}{flag}")
+            regressions += int(regressed)
     print(f"\nA: {a.dag_id} ({a.state}, {a.duration:.2f}s)  "
           f"B: {b.dag_id} ({b.state}, {b.duration:.2f}s)  "
           f"wall delta {b.duration - a.duration:+.2f}s")
@@ -538,8 +597,9 @@ def main() -> int:
               f"{REGRESSION_RATIO}x baseline, containment event growth, "
               f"store eviction/demotion churn growth, exchange "
               f"round/split growth, tenant shed/failure growth, "
-              f"stream replay/abort/lag growth, or "
-              f"recovery requeue/fence/failover growth)")
+              f"stream replay/abort/lag growth, "
+              f"recovery requeue/fence/failover growth, or telemetry "
+              f"ring-eviction/collector/scrape-error growth)")
     return 0
 
 

@@ -27,7 +27,15 @@ Report sections:
 - **stragglers** — top-3 attempts by slowdown vs their vertex median
   (an injected ``device.dispatch.delay`` surfaces here by name);
 - **slo breaches** — TENANT_SLO_BREACH journal events joined with the
-  flight ring's ``slo.breach.*`` records.
+  flight ring's ``slo.breach.*`` records;
+- **slo burn alerts** — SLO_BURN_ALERT pre-breach pages joined to the
+  breach (if any) that followed them per (tenant, kind, stream), with
+  page-to-breach lead time.
+
+The same blame sweep also runs *live*: ``GET /doctor/live`` on the AM
+web UI (am/telemetry.py) applies the plane mapping to the in-memory
+time-series rings instead of post-hoc artifacts, and ``graft top``
+(tools/top.py, ``make top``) renders it as a refreshing terminal view.
 
 CLI (also ``make doctor``):
   python -m tez_tpu.tools.doctor WORKDIR [--dag ID] [--json]
@@ -46,40 +54,22 @@ import os
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
-# planes in blame-priority order; "control" is the uncovered residual.
-# "recovery" outranks everything: an AM-incarnation bump inside the
-# blamed window means the session itself died and replayed — no amount
-# of store or compute activity explains that wall clock better.
-PLANES = ("recovery", "admission", "exchange", "device", "store",
-          "transport", "compute", "control")
-
-#: histogram-name prefix -> plane (first match wins; None = not blamed,
-#: e.g. the flight recorder's own dump timer)
-PREFIX_PLANE: Tuple[Tuple[str, Optional[str]], ...] = (
-    ("am.admit.queue_wait", "admission"),
-    ("am.heartbeat", None),
-    ("obs.", None),
-    ("mesh.", "exchange"),
-    ("device.", "device"),
-    ("store.", "store"),
-    ("spill.", "store"),
-    ("commit.", "store"),
-    ("shuffle.merge", "compute"),
-    ("shuffle.", "transport"),
-)
+# Planes in blame-priority order and the histogram-prefix -> plane
+# mapping now live in obs/timeseries.py, shared with the LIVE sweep
+# (am/telemetry.py live_status) so the two can never drift; re-exported
+# here because this module is the mapping's historical home and other
+# tools import it from here.  "recovery" outranks everything: an
+# AM-incarnation bump inside the blamed window means the session itself
+# died and replayed — no amount of store or compute activity explains
+# that wall clock better.
+from tez_tpu.obs.timeseries import (PLANES, PREFIX_PLANE,  # noqa: F401
+                                    plane_for_name)
 
 #: span cat -> plane, for flight SPAN edges (cat rides in the scope slot)
 SPAN_CAT_PLANE = {"fetch": "transport", "shuffle": "transport",
                   "task": "compute", "attempt": "compute",
                   "vertex": "compute", "commit": "store",
                   "admission": "admission"}
-
-
-def plane_for_name(name: str) -> Optional[str]:
-    for prefix, plane in PREFIX_PLANE:
-        if name.startswith(prefix):
-            return plane
-    return None
 
 
 # --------------------------------------------------------------------------
@@ -136,6 +126,61 @@ def load_slo_breaches(journal_files: List[str]) -> List[Dict[str, Any]]:
                 continue
             if ev.event_type.name == "TENANT_SLO_BREACH":
                 out.append(dict(ev.data, time=ev.timestamp))
+    return out
+
+
+def load_slo_burn_alerts(journal_files: List[str]) -> List[Dict[str, Any]]:
+    """SLO_BURN_ALERT events off the journal lines — the watchdog's
+    pre-breach pages (obs/slo.py evaluate_burn).  Same tenant/kind/stream
+    labels as TENANT_SLO_BREACH, so :func:`join_burn_alerts` can match
+    each page to the breach (if any) that followed it per stream."""
+    from tez_tpu.am.recovery import decode_journal_line
+    out: List[Dict[str, Any]] = []
+    for path in journal_files:
+        try:
+            with open(path, errors="replace") as fh:
+                lines = fh.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = decode_journal_line(line)
+            except Exception:  # noqa: BLE001 — torn tail lines etc.
+                continue
+            if ev.event_type.name == "SLO_BURN_ALERT":
+                out.append(dict(ev.data, time=ev.timestamp))
+    return out
+
+
+def join_burn_alerts(alerts: List[Dict[str, Any]],
+                     breaches: List[Dict[str, Any]]
+                     ) -> List[Dict[str, Any]]:
+    """Annotate each burn alert with whether a matching breach followed.
+
+    Alerts and breaches join on the (tenant, kind, stream) label triple.
+    An alert that a later breach confirms gains ``breached=True`` and
+    ``lead_s`` (page-to-breach lead time — how early the burn evaluator
+    fired); an alert with no subsequent matching breach keeps
+    ``breached=False`` (the page was early enough that the condition
+    cleared, which is the whole point)."""
+    out: List[Dict[str, Any]] = []
+    for a in alerts:
+        key = (a.get("tenant"), a.get("kind"), a.get("stream") or "")
+        joined = dict(a, breached=False, lead_s=None)
+        for b in breaches:
+            if (b.get("tenant"), b.get("kind"),
+                    b.get("stream") or "") != key:
+                continue
+            bt = b.get("time") or 0.0
+            at = a.get("time") or 0.0
+            if bt >= at:
+                joined["breached"] = True
+                joined["lead_s"] = round(bt - at, 3)
+                break
+        out.append(joined)
     return out
 
 
@@ -392,7 +437,8 @@ def render_streams(rows: List[Dict[str, Any]]) -> str:
 def diagnose(dag: Any, snaps: List[Any],
              slo_breaches: List[Dict[str, Any]],
              fleet: Optional[Dict[str, float]] = None,
-             am_restarts: Optional[List[Dict[str, Any]]] = None
+             am_restarts: Optional[List[Dict[str, Any]]] = None,
+             burn_alerts: Optional[List[Dict[str, Any]]] = None
              ) -> Dict[str, Any]:
     t0 = dag.submit_time or dag.start_time
     t1 = dag.finish_time
@@ -436,6 +482,17 @@ def diagnose(dag: Any, snaps: List[Any],
                     f"not a data-plane stall")
     if slo_breaches:
         verdict += f"; {len(slo_breaches)} SLO breach(es) on record"
+    joined_alerts = join_burn_alerts(burn_alerts or [], slo_breaches)
+    if joined_alerts:
+        paged = [a for a in joined_alerts if a["breached"]]
+        if paged:
+            lead = min(a["lead_s"] for a in paged
+                       if a["lead_s"] is not None)
+            verdict += (f"; burn alert paged {lead:.1f}s before the "
+                        f"first matching breach")
+        else:
+            verdict += (f"; {len(joined_alerts)} burn alert(s) cleared "
+                        f"without breaching")
     return {
         "dag_id": dag.dag_id, "name": dag.name, "tenant": dag.tenant,
         "state": dag.state, "wall_s": round(wall, 4),
@@ -452,6 +509,7 @@ def diagnose(dag: Any, snaps: List[Any],
                       for s, e, p in segments],
         "stragglers": stragglers,
         "slo_breaches": slo_breaches,
+        "slo_burn_alerts": joined_alerts,
         "am_restarts": in_window,
         "verdict": verdict,
         "sources": {
@@ -510,11 +568,24 @@ def render_text(rep: Dict[str, Any]) -> str:
             L.append(f"  attempt {r['attempt']}: "
                      f"+{r['time'] - rep['window'][0]:.3f}s into the "
                      f"window, replay took {r['end'] - r['time']:.3f} s")
+    if rep.get("slo_burn_alerts"):
+        L.append("")
+        L.append("slo burn alerts (pre-breach pages):")
+        for a in rep["slo_burn_alerts"]:
+            where = (f"stream={a['stream']}" if a.get("stream")
+                     else f"tenant={a.get('tenant', '?')}")
+            fate = (f"breached {a['lead_s']:.1f}s later"
+                    if a["breached"] else "cleared without breaching")
+            L.append(f"  {where} {a.get('kind', '?')} observed="
+                     f"{a.get('observed', '?')} target="
+                     f"{a.get('target', '?')} — {fate}")
     if rep["slo_breaches"]:
         L.append("")
         L.append("slo breaches:")
         for b in rep["slo_breaches"]:
-            L.append(f"  tenant={b.get('tenant', '?')} "
+            stream = (f" stream={b['stream']}"
+                      if b.get("stream") else "")
+            L.append(f"  tenant={b.get('tenant', '?')}{stream} "
                      f"{b.get('kind', '?')} observed="
                      f"{b.get('observed', '?')} target="
                      f"{b.get('target', '?')}")
@@ -585,11 +656,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     dag = dags[dag_id]
     snaps = load_flight_dumps(dump_files)
     breaches = load_slo_breaches(journals)
+    burn_alerts = load_slo_burn_alerts(journals)
     restarts = load_am_restarts(journals)
 
     rep = diagnose(dag, snaps, breaches,
                    fleet=vertex_fleet_medians(dags),
-                   am_restarts=restarts)
+                   am_restarts=restarts,
+                   burn_alerts=burn_alerts)
     streams = diagnose_streams(dags, snaps)
     if streams:
         rep["streams"] = streams

@@ -107,6 +107,12 @@ class DagInfo:
     # |COMMIT_ABORTED|LAGGING, "stream", "window_id", "dag_id", "time",
     # ...extras} — session-scoped, attached to every dag
     stream_events: List[Dict] = dataclasses.field(default_factory=list)
+    # session telemetry stream: SLO_BURN_ALERT pages {"event": "BURN",
+    # "tenant", "kind", "stream", "observed", "target", "time"} and the
+    # stop-time TELEMETRY_SNAPSHOT accounting {"event": "SNAPSHOT",
+    # "series", "evicted", "collector_errors", "scrape_errors", "ticks",
+    # "time"} — session-scoped, attached to every dag
+    telemetry_events: List[Dict] = dataclasses.field(default_factory=list)
 
     @property
     def duration(self) -> float:
@@ -131,6 +137,7 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
     admission_events: List[Dict] = []
     recovery_events: List[Dict] = []
     stream_events: List[Dict] = []
+    telemetry_events: List[Dict] = []
     _streaming = {
         HistoryEventType.STREAM_OPENED: "OPENED",
         HistoryEventType.STREAM_RETIRED: "RETIRED",
@@ -193,6 +200,26 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
                 "dag_id": ev.dag_id or "",
                 "replayed": bool(ev.data.get("replayed")),
                 "lag": ev.data.get("lag", 0),
+                "time": ev.timestamp})
+            continue
+        if t is HistoryEventType.SLO_BURN_ALERT:
+            telemetry_events.append({
+                "event": "BURN",
+                "tenant": ev.data.get("tenant", ""),
+                "kind": ev.data.get("kind", ""),
+                "stream": ev.data.get("stream", ""),
+                "observed": ev.data.get("observed", 0.0),
+                "target": ev.data.get("target", 0.0),
+                "time": ev.timestamp})
+            continue
+        if t is HistoryEventType.TELEMETRY_SNAPSHOT:
+            telemetry_events.append({
+                "event": "SNAPSHOT",
+                "series": ev.data.get("series", 0),
+                "evicted": ev.data.get("evicted", 0),
+                "collector_errors": ev.data.get("collector_errors", 0),
+                "scrape_errors": ev.data.get("scrape_errors", 0),
+                "ticks": ev.data.get("ticks", 0),
                 "time": ev.timestamp})
             continue
         d = dag(ev)
@@ -288,6 +315,7 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
         d.admission_events = admission_events
         d.recovery_events = recovery_events
         d.stream_events = stream_events
+        d.telemetry_events = telemetry_events
     return dags
 
 

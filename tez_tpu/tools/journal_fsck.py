@@ -4,8 +4,11 @@ commit-ledger pairing, admission-queue pairing (``DAG_QUEUED`` /
 ``DAG_SUBMITTED``), and the streaming window-commit ledger
 (``WINDOW_COMMIT_STARTED`` brackets closed by FINISHED/ABORTED, window
 ids strictly increasing per stream, nothing after ``STREAM_RETIRED``),
-then print the terminal state recovery would infer for each DAG, each
-still-parked submission, and each stream.
+and the SLO records (``SLO_BURN_ALERT`` / ``TENANT_SLO_BREACH`` must
+carry the tenant/kind labels doctor joins on; ``TELEMETRY_SNAPSHOT``
+accounting must be non-negative), then print the terminal state recovery
+would infer for each DAG, each still-parked submission, and each stream,
+plus the per-(tenant, kind, stream) SLO tally.
 
 Point it at one or more journal files, at an app's ``recovery/`` directory
 (all attempts are checked in order), or at a staging dir + app id::
@@ -57,6 +60,15 @@ _STREAMING = frozenset({
     HistoryEventType.WINDOW_COMMIT_FINISHED,
     HistoryEventType.WINDOW_COMMIT_ABORTED,
     HistoryEventType.WINDOW_LAGGING,
+})
+
+#: SLO / telemetry records: session-scoped (``dag_id`` is None), keyed by
+#: their (tenant, kind, stream) label triple.  The labels are load-bearing:
+#: doctor joins a burn alert to the breach that followed it per stream, so
+#: a record missing them is a structural error, not cosmetics.
+_SLO = frozenset({
+    HistoryEventType.TENANT_SLO_BREACH,
+    HistoryEventType.SLO_BURN_ALERT,
 })
 
 
@@ -140,6 +152,10 @@ class FsckReport:
     subs: Dict[str, SubLedger] = dataclasses.field(default_factory=dict)
     sub_order: List[str] = dataclasses.field(default_factory=list)
     streams: Dict[str, StreamLedger] = dataclasses.field(default_factory=dict)
+    #: (tenant, kind, stream) -> {"burn_alerts": n, "breaches": n}
+    slo: Dict[Tuple[str, str, str], Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
+    telemetry_snapshots: int = 0
 
     @property
     def ok(self) -> bool:
@@ -295,11 +311,43 @@ def _check_streaming(report: FsckReport, ev: HistoryEvent,
     return True
 
 
+def _check_slo(report: FsckReport, ev: HistoryEvent, where: str) -> bool:
+    """SLO / telemetry accounting.  Returns True when the event was a
+    session-scoped SLO or telemetry record (consumed here)."""
+    t = ev.event_type
+    if t is HistoryEventType.TELEMETRY_SNAPSHOT:
+        report.telemetry_snapshots += 1
+        for k in ("evicted", "collector_errors", "scrape_errors"):
+            v = ev.data.get(k)
+            if v is not None and int(v) < 0:
+                report.errors.append(
+                    f"{where}: TELEMETRY_SNAPSHOT with negative {k}={v}")
+        return True
+    if t not in _SLO:
+        return False
+    tenant = ev.data.get("tenant", "")
+    kind = ev.data.get("kind", "")
+    if not tenant or not kind:
+        report.errors.append(
+            f"{where}: {t.name} without tenant/kind labels "
+            f"(doctor cannot join it per stream)")
+        return True
+    key = (tenant, kind, ev.data.get("stream") or "")
+    led = report.slo.setdefault(key, {"burn_alerts": 0, "breaches": 0})
+    if t is HistoryEventType.SLO_BURN_ALERT:
+        led["burn_alerts"] += 1
+    else:
+        led["breaches"] += 1
+    return True
+
+
 def _check_event(report: FsckReport, ev: HistoryEvent, where: str) -> None:
     report.records += 1
     if _check_admission(report, ev, where):
         return
     if _check_streaming(report, ev, where):
+        return
+    if _check_slo(report, ev, where):
         return
     dag_id = ev.dag_id
     if dag_id is None:
@@ -449,6 +497,13 @@ def print_report(report: FsckReport, verbose: bool = False) -> None:
         print(f"stream {stream}: {len(sled.committed)} committed, "
               f"{len(sled.aborted)} aborted, {sled.lag_events} lag "
               f"episode(s) -> {sled.inferred}")
+    for (tenant, kind, stream), led in sorted(report.slo.items()):
+        where = f" stream={stream}" if stream else ""
+        print(f"slo tenant={tenant}{where} {kind}: "
+              f"{led['burn_alerts']} burn alert(s), "
+              f"{led['breaches']} breach(es)")
+    if report.telemetry_snapshots:
+        print(f"telemetry: {report.telemetry_snapshots} snapshot(s)")
     print("fsck: " + ("CLEAN" if report.ok else
                       f"{len(report.errors)} error(s)"))
 

@@ -6,7 +6,7 @@ PY ?= python
 OLD ?= BENCH_r05.json
 NEW ?= /tmp/bench_new.json
 
-.PHONY: test lint bench bench-new bench-diff bench-merge bench-store bench-sort bench-exchange chaos chaos-device-ooo chaos-device chaos-merge chaos-store chaos-push chaos-exchange chaos-ha chaos-stream chaos-slo-burn soak docs doctor top metrics-smoke
+.PHONY: test lint bench bench-new bench-diff bench-merge bench-store bench-sort bench-exchange bench-query chaos chaos-query-storm chaos-device-ooo chaos-device chaos-merge chaos-store chaos-push chaos-exchange chaos-ha chaos-stream chaos-slo-burn soak docs doctor top metrics-smoke
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
@@ -48,6 +48,15 @@ bench-sort:
 # enforces the skew-aware leg's min_vs_baseline >= 1.3 floor
 bench-exchange:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 TEZ_BENCH_EXCHANGE_ONLY=1 $(PY) bench.py
+
+# query plane (docs/query.md): broadcast-vs-repartition legs on the
+# uniform + zipf corpora, then the adaptive-replan headline — run 1
+# repartitions by estimate, run 2 is replanned to broadcast from the
+# observed stats and must beat run 1 (bench-diff enforces the
+# min_vs_baseline >= 1.0 floor); the QUERY_REPLANNED event is asserted
+# in the JSONL journal and in doctor's rendering
+bench-query:
+	JAX_PLATFORMS=cpu TEZ_BENCH_QUERY_ONLY=1 $(PY) bench.py
 
 chaos:
 	$(PY) -m tez_tpu.tools.chaos --trials 3
@@ -104,6 +113,13 @@ chaos-slo-burn:
 # zero epoch fences, per-tenant p95 bounded
 soak:
 	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --tenant-storm --trials 3
+
+# query kill storm: the whole deterministic corpus suite twice per trial
+# (seed parity picks uniform vs zipf) under seeded task/fetch kills with
+# the result cache on — every run bit-exact vs the numpy oracle, kills
+# confirmed in the journal, round 2 must serve lineage cache hits
+chaos-query-storm:
+	JAX_PLATFORMS=cpu $(PY) -m tez_tpu.tools.chaos --query-storm --trials 3
 
 # skewed hot-key exchange with one delayed chip (mesh.exchange.delay):
 # the splitter must hold the round count down and coded r2 must mask the

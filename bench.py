@@ -717,6 +717,15 @@ def main() -> int:
         for rec in bench_exchange(cpu_fallback):
             print(json.dumps(rec), flush=True)
         return 0
+    if os.environ.get("TEZ_BENCH_QUERY_ONLY") == "1":
+        # make bench-query: broadcast-vs-repartition info lines on the
+        # uniform and zipf corpora + the adaptive-replan headline whose
+        # min_vs_baseline floor bench-diff enforces (run 2, replanned
+        # from observed stats, must beat the naive run 1)
+        from tez_tpu.tools.query_bench import bench_query
+        for rec in bench_query(cpu_fallback):
+            print(json.dumps(rec), flush=True)
+        return 0
     if os.environ.get("TEZ_BENCH_MERGE_ONLY") == "1":
         # make bench-merge: just the reduce-side merge-path info line
         num_records = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000

@@ -86,12 +86,10 @@ def test_disk_to_disk_cascade(tmp_path):
                              block_records=128)
     for slot, b in enumerate(batches):
         mm.commit(slot, b)
-    import time
-    # generous: the background merger competes with the whole suite's
-    # threads under -x runs (observed flaking at 20s under full load)
-    deadline = time.time() + 60
-    while mm._disk_to_disk == 0 and time.time() < deadline:
-        time.sleep(0.05)
+    # CV wait, not a sleep-poll: quiesce() returns once the cascade has
+    # drained (6 runs -> 1 is several disk-to-disk folds), however long
+    # the background merger is starved under full-suite load
+    assert mm.quiesce(timeout=120), "background merger never quiesced"
     assert mm._disk_to_disk >= 1
     assert counters.find_counter(TaskCounter.NUM_DISK_TO_DISK_MERGES)\
         .value >= 1

@@ -16,7 +16,9 @@ from tez_tpu.tools import top
 
 
 def _get(url):
-    with urllib.request.urlopen(url, timeout=10) as resp:
+    # generous: the AM web thread competes with the whole suite's
+    # threads under full-suite load
+    with urllib.request.urlopen(url, timeout=60) as resp:
         return resp.read().decode("utf-8")
 
 
@@ -32,7 +34,8 @@ def test_metrics_smoke(tmp_path):
             "v", ProcessorDescriptor.create(
                 "tez_tpu.library.processors:SleepProcessor",
                 payload={"sleep_ms": 1}), 2))
-        c.submit_dag(dag).wait_for_completion(timeout=30)
+        st = c.submit_dag(dag).wait_for_completion(timeout=180)
+        assert st.state.name == "SUCCEEDED"
         am = c.framework_client.am
         url = am.web_ui.url
 
@@ -44,7 +47,14 @@ def test_metrics_smoke(tmp_path):
         assert "tez_counter" in fams
 
         # -- GET /metrics.json: rows, windows, accounting -----------------
+        # the 25ms sampler thread can be starved under full-suite load on
+        # a small box: wait (bounded) for its first tick, then assert
+        import time
+        deadline = time.time() + 60
         body = json.loads(_get(url + "metrics.json?window=30"))
+        while body["accounting"]["samples"] < 1 and time.time() < deadline:
+            time.sleep(0.05)
+            body = json.loads(_get(url + "metrics.json?window=30"))
         assert body["window_s"] == 30.0
         assert body["histograms"] and body["gauges"]
         series = {r["series"] for r in body["histograms"]}

@@ -1,0 +1,328 @@
+"""Relational query engine tests (tez_tpu/query/, docs/query.md).
+
+Four layers, cheapest first:
+
+- logical-plan unit tests: fingerprint stability, schema propagation;
+- planner unit tests: content-addressed vertex names, operator tags,
+  strategy decision records (estimate / forced / pinned / required);
+- PlanFeedback unit tests: observed-build strategy flips, skew-driven
+  reducer bumps, plane blame from histogram deltas;
+- end-to-end: every tools/query_corpus.py query bit-exact vs its numpy
+  oracle under the auto planner, under BOTH forced join strategies, with
+  sealed-lineage reuse on, under a seeded kill storm, and through the
+  skewed-corpus replan path (run 1 repartition by estimate, run 2
+  broadcast by observation, QUERY_REPLANNED journaled).
+"""
+import os
+from types import SimpleNamespace
+
+import pytest
+
+from tez_tpu.am.history import HistoryEventType
+from tez_tpu.query import PlanFeedback, QuerySession, Table, plan_query
+from tez_tpu.query.feedback import blame_from_histograms
+from tez_tpu.tools.query_corpus import CORPUS_QUERIES, generate
+
+CONF_BASE = {"tez.am.local.num-containers": 4}
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    return generate(str(tmp_path_factory.mktemp("qcorpus")),
+                    scale=0.25, skew=0.0, seed=3)
+
+
+@pytest.fixture(scope="module")
+def zipf_corpus(tmp_path_factory):
+    return generate(str(tmp_path_factory.mktemp("qcorpus_zipf")),
+                    scale=0.25, skew=1.2, seed=5)
+
+
+def _session(tmp_path, name, extra=None):
+    conf = dict(CONF_BASE)
+    conf["tez.staging-dir"] = str(tmp_path / name / "staging")
+    conf.update(extra or {})
+    return QuerySession(name, conf)
+
+
+def _events(session, event_type):
+    am = session._am
+    return [ev for ev in am.logging_service.events
+            if ev.event_type is event_type]
+
+
+# ------------------------------------------------------------- logical plan
+
+def _tiny_scan(tmp_path, name="t", rows=("a|1", "b|2")):
+    p = tmp_path / f"{name}.tbl"
+    p.write_text("\n".join(rows) + "\n")
+    return Table.scan(name, [str(p)], ["k", "v"])
+
+
+def test_fingerprints_stable_and_structural(tmp_path):
+    t1 = _tiny_scan(tmp_path).filter("v", "ge", "1", numeric=True)
+    t2 = _tiny_scan(tmp_path).filter("v", "ge", "1", numeric=True)
+    assert t1.plan.fingerprint == t2.plan.fingerprint
+    t3 = _tiny_scan(tmp_path).filter("v", "ge", "2", numeric=True)
+    assert t1.plan.fingerprint != t3.plan.fingerprint
+
+
+def test_schema_propagation(tmp_path):
+    left = _tiny_scan(tmp_path, "l")
+    right = Table.scan("r", [str(tmp_path / "r.tbl")], ["k", "w"])
+    inner = left.join(right, "k")
+    assert inner.plan.schema == ("k", "v", "w")
+    assert left.join(right, "k", how="semi").plan.schema == ("k", "v")
+    assert left.join(right, "k",
+                     how="semi_distinct").plan.schema == ("k",)
+    agg = inner.aggregate(["k"], [("n", "count", "k"),
+                                  ("s", "sum", "v")])
+    assert agg.plan.schema == ("k", "n", "s")
+    win = inner.window("k", "v", func="row_number", out_col="rk")
+    assert win.plan.schema == ("k", "v", "w", "rk")
+    assert inner.limit(5, ["k"]).plan.schema == ("k", "v", "w")
+
+
+# ----------------------------------------------------------------- planner
+
+def test_planner_content_addressed_and_tagged(tmp_path, corpus):
+    q = next(c for c in CORPUS_QUERIES if c.name == "nation_revenue")
+    conf = {"tez.staging-dir": str(tmp_path / "staging")}
+    p1 = plan_query(q.build(corpus), conf, str(tmp_path / "o1"))
+    p2 = plan_query(q.build(corpus), conf, str(tmp_path / "o2"))
+    # identical subplans lower to identical vertex names: that identity
+    # IS the sealed-lineage cache key (docs/query.md, docs/store.md)
+    assert set(p1.operators) == set(p2.operators)
+    assert p1.operators == p2.operators
+    for vname, tag in p1.operators.items():
+        assert vname.startswith("q_")
+        assert "@" in tag            # "<op chain>@<fingerprint>"
+    strategies = [d for d in p1.decisions if d["kind"] == "join_strategy"]
+    assert strategies and strategies[0]["basis"] == "estimate"
+
+
+def test_planner_strategy_bases(tmp_path):
+    left = _tiny_scan(tmp_path, "l")
+    right = Table.scan("r", [str(tmp_path / "l.tbl")], ["k", "w"])
+    conf = {"tez.query.scan.splits": 1}
+
+    def strategy_decision(table, extra=None):
+        p = plan_query(table.plan, {**conf, **(extra or {})},
+                       str(tmp_path / "out"))
+        return next(d for d in p.decisions
+                    if d["kind"] == "join_strategy")
+
+    d = strategy_decision(left.join(right, "k"),
+                          {"tez.query.join.strategy": "repartition"})
+    assert (d["choice"], d["basis"]) == ("repartition", "forced")
+    d = strategy_decision(left.hash_join(right, "k"))
+    assert (d["choice"], d["basis"]) == ("broadcast", "pinned")
+    d = strategy_decision(left.sort_merge_join(right, "k"))
+    assert (d["choice"], d["basis"]) == ("repartition", "pinned")
+    d = strategy_decision(left.join(right, "k", how="semi_distinct"),
+                          {"tez.query.join.strategy": "broadcast"})
+    # distinct-on-key needs the key-partitioned exchange: required
+    # outranks even the forced knob
+    assert (d["choice"], d["basis"]) == ("repartition", "required")
+
+
+# ---------------------------------------------------------------- feedback
+
+def _feedback(**over):
+    conf = {"tez.query.replan.enabled": True,
+            "tez.query.replan.skew-factor": 4.0,
+            "tez.query.replan.max-reducers": 8}
+    conf.update(over)
+    return PlanFeedback(conf)
+
+
+def _strategy_run(fb, fp, strategy, build_bytes, blamed="exchange"):
+    fb.record_run(
+        [{"node": fp, "operator": "join", "kind": "join_strategy",
+          "choice": strategy, "basis": "estimate", "detail": ""}],
+        {(fp, "build"): {"bytes": build_bytes, "partitions": [build_bytes]}},
+        blamed, 1.0)
+
+
+def test_feedback_strategy_flips():
+    fb = _feedback()
+    assert fb.advise_strategy("fp", 1.0) is None   # nothing observed yet
+    _strategy_run(fb, "fp", "repartition", 1024)   # 1KB observed build
+    strat, detail, extras = fb.advise_strategy("fp", 1.0)
+    assert strat == "broadcast" and extras["from"] == "repartition"
+    # outgrown broadcast flips back
+    _strategy_run(fb, "fp2", "broadcast", 8 << 20)
+    strat, _, extras = fb.advise_strategy("fp2", 1.0)
+    assert strat == "repartition" and extras["to"] == "repartition"
+    # observed-good strategy is pinned (no flip-flop on estimates)
+    _strategy_run(fb, "fp3", "broadcast", 1024)
+    strat, _, extras = fb.advise_strategy("fp3", 1.0)
+    assert strat == "broadcast" and extras["from"] == extras["to"]
+
+
+def test_feedback_reducer_bump_on_skew():
+    fb = _feedback()
+    fb.record_run(
+        [{"node": "fp", "operator": "agg", "kind": "parallelism",
+          "choice": 2, "basis": "default", "detail": ""}],
+        {("fp", "group"): {"bytes": 1100, "partitions": [1000, 100]}},
+        "exchange", 1.0)
+    n, _, extras = fb.advise_reducers("fp", 2)
+    assert n == 4 and extras == {"from": 2, "to": 4, "role": "group",
+                                 "peak_bytes": 1000, "rest_bytes": 100.0}
+    # the bump is sticky once the skew is fixed, and capped at max
+    fb.record_run(
+        [{"node": "fp", "operator": "agg", "kind": "parallelism",
+          "choice": 4, "basis": "replan", "detail": ""}],
+        {("fp", "group"): {"bytes": 1200,
+                           "partitions": [300, 300, 300, 300]}},
+        "exchange", 1.0)
+    n, _, _ = fb.advise_reducers("fp", 2)
+    assert n == 4
+    fb.max_reducers = 4
+    fb.record_run([], {("fp", "group"): {"bytes": 1100,
+                                         "partitions": [1000, 50, 25, 25]}},
+                  "exchange", 1.0)
+    assert fb.advise_reducers("fp", 2)[0] == 4
+
+
+def test_feedback_disabled_gives_no_opinion():
+    fb = _feedback(**{"tez.query.replan.enabled": False})
+    _strategy_run(fb, "fp", "repartition", 1024)
+    assert fb.advise_strategy("fp", 1.0) is None
+    assert fb.advise_reducers("fp", 2) is None
+
+
+def test_blame_from_histograms():
+    h = lambda ms: SimpleNamespace(sum_ms=ms)  # noqa: E731
+    before = {"shuffle.fetch.wait_ms": h(10.0)}
+    after = {"shuffle.fetch.wait_ms": h(510.0),
+             "device.dispatch_ms": h(20.0),
+             "unrelated.metric_ms": h(9999.0)}
+    plane, busy = blame_from_histograms(before, after)
+    assert plane == "transport" and busy == 500.0
+    assert blame_from_histograms(after, after) == ("", 0.0)
+
+
+# -------------------------------------------------------------- end to end
+
+def test_corpus_bit_exact_auto(tmp_path, corpus):
+    with _session(tmp_path, "auto") as s:
+        for q in CORPUS_QUERIES:
+            r = s.run(q.build(corpus), str(tmp_path / f"out_{q.name}"),
+                      query_name=q.name, sink=q.sink)
+            assert r.state == "SUCCEEDED"
+            assert r.read_output() == q.oracle(corpus), q.name
+        submitted = _events(s, HistoryEventType.QUERY_SUBMITTED)
+    assert len(submitted) == len(CORPUS_QUERIES)
+    by_name = {ev.data["query"]: ev.data for ev in submitted}
+    assert set(by_name) == {q.name for q in CORPUS_QUERIES}
+    for data in by_name.values():
+        assert data["operators"] and data["wall_s"] > 0
+
+
+def test_corpus_bit_exact_both_strategies_forced(tmp_path, corpus,
+                                                 zipf_corpus):
+    """Physical join strategy must never change results — every join
+    query bit-exact under both forced strategies, on the uniform AND the
+    Zipf-skewed corpus."""
+    joiny = [q for q in CORPUS_QUERIES
+             if any(n.op == "join" for n in q.build(corpus).plan.walk())]
+    assert len(joiny) >= 4
+    for label, c in (("uni", corpus), ("zipf", zipf_corpus)):
+        for strategy in ("broadcast", "repartition"):
+            with _session(tmp_path, f"forced_{label}_{strategy}",
+                          {"tez.query.join.strategy": strategy}) as s:
+                for q in joiny:
+                    r = s.run(q.build(c),
+                              str(tmp_path /
+                                  f"o_{label}_{strategy}_{q.name}"),
+                              query_name=q.name, sink=q.sink)
+                    assert r.state == "SUCCEEDED"
+                    assert r.read_output() == q.oracle(c), \
+                        (label, strategy, q.name)
+
+
+def test_session_lineage_reuse(tmp_path, corpus):
+    """Identical rerun in one session is served from the sealed-lineage
+    store (PR-7) through the governed result cache (PR-11), bit-exact."""
+    q = next(c for c in CORPUS_QUERIES if c.name == "nation_revenue")
+    with _session(tmp_path, "reuse",
+                  {"tez.runtime.store.enabled": True,
+                   "tez.query.replan.enabled": False}) as s:
+        r1 = s.run(q.build(corpus), str(tmp_path / "reuse1"),
+                   query_name=q.name, sink=q.sink)
+        r2 = s.run(q.build(corpus), str(tmp_path / "reuse2"),
+                   query_name=q.name, sink=q.sink)
+        submitted = _events(s, HistoryEventType.QUERY_SUBMITTED)
+    want = q.oracle(corpus)
+    assert r1.read_output() == want and r2.read_output() == want
+    assert r1.cache_hits == 0 and r2.cache_hits > 0
+    assert submitted[-1].data["cache_hits"] == r2.cache_hits
+
+
+def test_replan_flips_exchange_bound_join(tmp_path, zipf_corpus):
+    """The adaptive loop on the seeded skewed corpus: run 1 repartitions
+    by (file-size) estimate; the observed post-filter build side fits the
+    broadcast threshold, so run 2 is replanned to broadcast — journaled
+    as a typed QUERY_REPLANNED summary event BEFORE the DAG submits —
+    and stays bit-exact."""
+    c = zipf_corpus
+
+    def build():
+        # selective filter on the build side: the estimator can't see
+        # through it (estimated_bytes is file size), the observation can
+        small = c.scan("orders").filter("o_total", "ge", "95000",
+                                        numeric=True)
+        return (c.scan("lineitem")
+                .join(small, "l_orderkey", "o_orderkey")
+                .aggregate(["l_flag"], [("n", "count", "l_flag"),
+                                        ("rev", "sum", "l_price")]))
+
+    conf = {"tez.query.broadcast.max-mb": 0.004}
+    with _session(tmp_path, "replan", conf) as s:
+        r1 = s.run(build(), str(tmp_path / "rp1"), query_name="rp")
+        r2 = s.run(build(), str(tmp_path / "rp2"), query_name="rp")
+    d1 = next(d for d in r1.decisions if d["kind"] == "join_strategy")
+    d2 = next(d for d in r2.decisions if d["kind"] == "join_strategy")
+    assert (d1["choice"], d1["basis"]) == ("repartition", "estimate")
+    assert (d2["choice"], d2["basis"]) == ("broadcast", "replan")
+    assert r1.replans == [] and len(r2.replans) >= 1
+    flip = next(p for p in r2.replans if p["kind"] == "join_strategy")
+    assert (flip["from"], flip["to"]) == ("repartition", "broadcast")
+    assert r1.read_output() == r2.read_output() != []
+
+
+def test_query_kill_storm_inline(tmp_path, corpus):
+    """Tier-1 sliver of chaos --query-storm: two corpus queries under
+    seeded recoverable task kills with the result cache on — retries may
+    cost time, never rows."""
+    queries = [q for q in CORPUS_QUERIES
+               if q.name in ("nation_revenue", "supply_chain")]
+    with _session(tmp_path, "storm",
+                  {"tez.runtime.store.enabled": True,
+                   "tez.am.task.max.failed.attempts": 4}) as s:
+        for i, q in enumerate(queries):
+            r = s.run(q.build(corpus), str(tmp_path / f"storm_{q.name}"),
+                      query_name=q.name, sink=q.sink,
+                      dag_conf={"tez.test.fault.spec":
+                                "task.run:fail:n=2,exc=runtime",
+                                "tez.test.fault.seed": i,
+                                "tez.dag.tenant": f"tenant{i % 2}"})
+            assert r.state == "SUCCEEDED"
+            assert r.read_output() == q.oracle(corpus), q.name
+        finished = _events(s, HistoryEventType.TASK_ATTEMPT_FINISHED)
+        killed = sum(1 for ev in finished
+                     if (ev.data or {}).get("state") == "FAILED")
+    assert killed >= 2
+
+
+@pytest.mark.slow
+def test_query_storm_chaos_harness(tmp_path):
+    """The full chaos leg: both corpus flavors (seed parity picks
+    uniform vs Zipf), the whole suite twice per seed, kills + cache."""
+    from tez_tpu.tools import chaos
+    for seed in (0, 1):
+        ok, detail = chaos.run_query_storm(seed, str(tmp_path),
+                                           timeout=120.0)
+        assert ok, detail

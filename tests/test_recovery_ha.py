@@ -89,7 +89,11 @@ def test_crash_replays_parked_admission_queue(tmp_staging):
     am1.start()
     am1.submit_dag(_plan("qa", sleep_ms=20_000))   # holds the only slot
     errors, crashed = [], []
+    # serialize the parks: two racing submitters can journal their
+    # DAG_QUEUED records in the opposite order of sub-id assignment,
+    # and this test asserts on arrival ORDER
     t_b = _park(am1, _plan("qb"), errors, crashed)
+    _wait_journaled(am1, 1)
     t_c = _park(am1, _plan("qc"), errors, crashed)
     _wait_journaled(am1, 2)
     am1.crash()

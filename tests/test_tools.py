@@ -377,7 +377,10 @@ def test_task_jax_profile_trace(tmp_path):
 
         dag = DAG.create("profdag").add_vertex(Vertex.create(
             "v", ProcessorDescriptor.create(ComputeProcessor), 1))
-        st = c.submit_dag(dag).wait_for_completion(timeout=60)
+        # generous: the XLA profiler's first start in a loaded process
+        # can pay tens of seconds of one-time setup (observed flaking
+        # at 60s under full-suite load)
+        st = c.submit_dag(dag).wait_for_completion(timeout=300)
         assert st.state.name == "SUCCEEDED"
     finally:
         c.stop()

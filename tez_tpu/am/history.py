@@ -101,6 +101,17 @@ class HistoryEventType(enum.Enum):
     WINDOW_COMMIT_FINISHED = enum.auto()
     WINDOW_COMMIT_ABORTED = enum.auto()
     WINDOW_LAGGING = enum.auto()
+    # relational query layer (tez_tpu/query, docs/query.md):
+    # QUERY_SUBMITTED records one planned query per run — the logical
+    # fingerprint, per-join physical strategy, the vertex -> operator
+    # attribution map, and the store's lineage cache-hit delta — so
+    # counter_diff can count plans/cache hits per session journal.
+    # QUERY_REPLANNED types one PlanFeedback decision (strategy flip or
+    # reducer bump) with the doctor plane it blamed; summary event so
+    # the decision is on disk before the replanned DAG submits — the
+    # doctor must be able to blame the planner itself after a crash.
+    QUERY_SUBMITTED = enum.auto()
+    QUERY_REPLANNED = enum.auto()
 
 
 #: Events whose loss recovery cannot tolerate — flushed synchronously.
@@ -129,6 +140,8 @@ SUMMARY_EVENT_TYPES = frozenset({
     HistoryEventType.WINDOW_COMMIT_FINISHED,
     HistoryEventType.WINDOW_COMMIT_ABORTED,
     HistoryEventType.WINDOW_LAGGING,
+    HistoryEventType.QUERY_SUBMITTED,
+    HistoryEventType.QUERY_REPLANNED,
 })
 
 

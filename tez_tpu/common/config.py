@@ -967,6 +967,58 @@ STREAM_OUTPUT_DIR = _key(
     "renames them to w<N>.part<i> between WINDOW_COMMIT_STARTED and "
     "WINDOW_COMMIT_FINISHED ledger records")
 
+# -- relational query layer (tez_tpu/query, docs/query.md) ------------------
+
+QUERY_BROADCAST_MAX_MB = _key(
+    "tez.query.broadcast.max-mb", 32.0, Scope.DAG,
+    "planner join-strategy threshold: when the estimated (or, on a "
+    "replanned run, observed) build-side size fits under this many MB "
+    "the join lowers to a broadcast hash join (one-to-all "
+    "UnorderedKVEdge); otherwise to a repartition sort-merge join "
+    "(two scatter-gather ordered edges)")
+QUERY_JOIN_STRATEGY = _key(
+    "tez.query.join.strategy", "auto", Scope.DAG,
+    "force the join lowering: 'auto' = pick by stats vs "
+    "tez.query.broadcast.max-mb, 'broadcast' / 'repartition' = always "
+    "that physical strategy (test/bench override; also what a "
+    "PlanFeedback replan pins per node)")
+QUERY_REDUCERS = _key(
+    "tez.query.reducers", 2, Scope.DAG,
+    "downstream parallelism of every query exchange (repartition "
+    "join, aggregate, window); a skew replan may raise it per node up "
+    "to tez.query.replan.max-reducers")
+QUERY_SCAN_SPLITS = _key(
+    "tez.query.scan.splits", 2, Scope.DAG,
+    "desired text splits (and so task parallelism) of each scan stage")
+QUERY_STATS_DIR = _key(
+    "tez.query.stats.dir", "", Scope.DAG,
+    "side-channel directory where query processors drop per-task "
+    "qstats JSON (records/bytes emitted per exchange partition); the "
+    "QuerySession aggregates them into the per-node partition-size "
+    "histograms PlanFeedback replans from.  '' = stats collection off")
+QUERY_OPERATOR_TAG = _key(
+    "tez.query.operator", "", Scope.VERTEX,
+    "planner-set vertex tag naming the logical plan operator this "
+    "vertex executes (e.g. 'hash_join(o_custkey)@a1b2c3d4e5f6'); rides "
+    "vertex conf so history events, flight dumps, and the lineage "
+    "fingerprint all attribute back to the operator")
+QUERY_REPLAN_ENABLED = _key(
+    "tez.query.replan.enabled", True, Scope.CLIENT,
+    "adaptive re-optimization: after each query run the session feeds "
+    "the doctor's per-plane blame and the observed qstats histograms "
+    "into PlanFeedback; the next run of the same logical node may flip "
+    "join strategy or raise reducer parallelism, journaling one typed "
+    "QUERY_REPLANNED summary event per decision")
+QUERY_REPLAN_SKEW_FACTOR = _key(
+    "tez.query.replan.skew-factor", 4.0, Scope.CLIENT,
+    "replan trigger: an exchange whose largest observed partition "
+    "exceeds this multiple of the mean size of the other partitions is "
+    "skewed — the next plan doubles that node's reducer count (up to "
+    "tez.query.replan.max-reducers)")
+QUERY_REPLAN_MAX_REDUCERS = _key(
+    "tez.query.replan.max-reducers", 8, Scope.CLIENT,
+    "ceiling a skew replan may raise a query exchange's parallelism to")
+
 
 def runtime_conf_subset(conf: Mapping) -> "TezConfiguration":
     """Filter the runtime keys into an edge payload (reference: edge config

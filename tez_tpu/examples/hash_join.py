@@ -4,90 +4,49 @@ Reference parity: tez-examples/.../HashJoinExample.java:74 (benchmark
 workload 5, BASELINE.md): the small side ships over a BROADCAST edge with
 UnorderedKVOutput; each streaming (fact) task builds a hash set/table and
 joins its split of the big side.
+
+This example is a thin shim over the relational query layer
+(tez_tpu/query/, docs/query.md): the whole workload is one logical plan —
+``stream SEMI JOIN hash_side`` with the join strategy pinned to broadcast
+— lowered by the planner onto exactly the DAG shape the hand-built
+original used (a 1-task build vertex over a broadcast UnorderedKVEdge
+into a fused scan+hash_join probe vertex with a FileOutput sink).  The
+output is bit-exact with the pre-query-layer example: one
+``(word, "1")`` record per stream occurrence whose word appears in the
+hash side.
 """
 from __future__ import annotations
 
 import sys
-from typing import Dict
 
-from tez_tpu.api.runtime import LogicalInput, LogicalOutput
+from tez_tpu.query import Table, plan_query
 from tez_tpu.client.tez_client import TezClient
-from tez_tpu.common.payload import (InputDescriptor,
-                                    InputInitializerDescriptor,
-                                    OutputCommitterDescriptor,
-                                    OutputDescriptor, ProcessorDescriptor)
-from tez_tpu.dag.dag import (DAG, DataSinkDescriptor, DataSourceDescriptor,
-                             Edge, Vertex)
-from tez_tpu.library.conf import UnorderedKVEdgeConfig
-from tez_tpu.library.processors import SimpleProcessor
 
 
-class ForwardProcessor(SimpleProcessor):
-    """Reads the (small) hash side and forwards keys downstream."""
-
-    def run(self, inputs: Dict[str, LogicalInput],
-            outputs: Dict[str, LogicalOutput]) -> None:
-        reader = inputs["input"].get_reader()
-        writer = outputs["joiner"].get_writer()
-        for _offset, line in reader:
-            key = line.strip()
-            if key:
-                writer.write(key, b"")
-
-
-class HashJoinProcessor(SimpleProcessor):
-    """Builds the broadcast hash set, streams the big side, emits matches
-    (reference: HashJoinExample.HashJoinProcessor)."""
-
-    def run(self, inputs: Dict[str, LogicalInput],
-            outputs: Dict[str, LogicalOutput]) -> None:
-        hash_side = inputs["hashside"].get_reader()
-        keys = {k for k, _ in hash_side}
-        stream = inputs["input"].get_reader()
-        writer = outputs["output"].get_writer()
-        for _offset, line in stream:
-            word = line.strip()
-            if word in keys:
-                writer.write(word, "1")
+def build_plan(stream_paths, hash_paths) -> Table:
+    stream = Table.scan("stream", list(stream_paths), ["word"],
+                        mode="lines")
+    hash_side = Table.scan("hashside", list(hash_paths), ["word"],
+                           mode="lines")
+    # semi join: keep every stream occurrence whose word is in the hash
+    # side; hash_join pins the broadcast strategy the example is about
+    return stream.hash_join(hash_side, "word", how="semi")
 
 
 def build_dag(stream_paths, hash_paths, output_path: str,
-              num_joiners: int = 2) -> DAG:
-    hash_side = Vertex.create("hashside", ProcessorDescriptor.create(
-        ForwardProcessor), 1)
-    hash_side.add_data_source("input", DataSourceDescriptor.create(
-        InputDescriptor.create("tez_tpu.io.text:TextInput"),
-        InputInitializerDescriptor.create(
-            "tez_tpu.io.text:TextSplitGenerator",
-            payload={"paths": list(hash_paths), "desired_splits": 1})))
-    joiner = Vertex.create("joiner", ProcessorDescriptor.create(
-        HashJoinProcessor), num_joiners)
-    joiner.add_data_source("input", DataSourceDescriptor.create(
-        InputDescriptor.create("tez_tpu.io.text:TextInput"),
-        InputInitializerDescriptor.create(
-            "tez_tpu.io.text:TextSplitGenerator",
-            payload={"paths": list(stream_paths),
-                     "desired_splits": num_joiners})))
-    joiner.add_data_sink("output", DataSinkDescriptor.create(
-        OutputDescriptor.create("tez_tpu.io.file_output:FileOutput",
-                                payload={"path": output_path,
-                                         "key_serde": "text",
-                                         "value_serde": "text"}),
-        OutputCommitterDescriptor.create(
-            "tez_tpu.io.file_output:FileOutputCommitter",
-            payload={"path": output_path})))
-    edge = UnorderedKVEdgeConfig.new_builder("bytes", "bytes").build()
-    dag = DAG.create("HashJoin").add_vertex(hash_side).add_vertex(joiner)
-    # rename edge output key: hash_side -> joiner under input name "hashside"
-    dag.add_edge(Edge.create(hash_side, joiner,
-                             edge.create_default_broadcast_edge_property()))
-    return dag
+              num_joiners: int = 2, conf=None):
+    merged = {"tez.query.scan.splits": num_joiners, **(conf or {})}
+    planned = plan_query(build_plan(stream_paths, hash_paths), merged,
+                         output_path, dag_name="HashJoin",
+                         sink={"key_col": "word", "literal": "1"})
+    return planned.dag
 
 
 def run(stream_paths, hash_paths, output_path: str, conf=None, **kw) -> str:
     with TezClient.create("HashJoin", conf or {}) as client:
-        status = client.submit_dag(build_dag(
-            stream_paths, hash_paths, output_path, **kw)).wait_for_completion()
+        dag = build_dag(stream_paths, hash_paths, output_path,
+                        conf=conf, **kw)
+        status = client.submit_dag(dag).wait_for_completion()
         return status.state.name
 
 

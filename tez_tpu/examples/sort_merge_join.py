@@ -3,105 +3,48 @@
 Reference parity: tez-examples/.../SortMergeJoinExample.java:72 (benchmark
 workload 3, BASELINE.md): both sides shuffle sorted on the join key to the
 same partition space; the joiner walks the two grouped iterators in lockstep.
+
+This example is a thin shim over the relational query layer
+(tez_tpu/query/, docs/query.md): the whole workload is one logical plan —
+``left SEMI-DISTINCT JOIN right`` on the tokenized word — whose
+semi_distinct join REQUIRES the repartition strategy, so the planner
+lowers it onto exactly the DAG shape the hand-built original used (both
+scan sides terminating into key-partitioned OrderedPartitionedKVEdges
+feeding a lockstep sort-merge joiner).  The output is bit-exact with the
+pre-query-layer example: one ``(word, "1")`` record per distinct word
+present on both sides.
 """
 from __future__ import annotations
 
 import sys
-from typing import Dict
 
-from tez_tpu.api.runtime import LogicalInput, LogicalOutput
+from tez_tpu.query import Table, plan_query
 from tez_tpu.client.tez_client import TezClient
-from tez_tpu.common.payload import (InputDescriptor,
-                                    InputInitializerDescriptor,
-                                    OutputCommitterDescriptor,
-                                    OutputDescriptor, ProcessorDescriptor)
-from tez_tpu.dag.dag import (DAG, DataSinkDescriptor, DataSourceDescriptor,
-                             Edge, Vertex)
-from tez_tpu.library.conf import OrderedPartitionedKVEdgeConfig
-from tez_tpu.library.processors import SimpleProcessor
 
 
-class PrepareProcessor(SimpleProcessor):
-    """Tokenize a side into (key, "") sorted output."""
-
-    def run(self, inputs: Dict[str, LogicalInput],
-            outputs: Dict[str, LogicalOutput]) -> None:
-        reader = inputs["input"].get_reader()
-        writer = outputs["joiner"].get_writer()
-        for _offset, line in reader:
-            for word in line.split():
-                writer.write(word, b"")
-
-
-class SortMergeJoinProcessor(SimpleProcessor):
-    """Lockstep merge of two key-sorted grouped inputs (inner join)."""
-
-    def run(self, inputs: Dict[str, LogicalInput],
-            outputs: Dict[str, LogicalOutput]) -> None:
-        left = iter(inputs["left"].get_reader())
-        right = iter(inputs["right"].get_reader())
-        writer = outputs["output"].get_writer()
-
-        def nxt(it):
-            try:
-                k, vs = next(it)
-                return k, vs
-            except StopIteration:
-                return None, None
-
-        lk, lv = nxt(left)
-        rk, rv = nxt(right)
-        while lk is not None and rk is not None:
-            if lk == rk:
-                writer.write(lk, "1")
-                lk, lv = nxt(left)
-                rk, rv = nxt(right)
-            elif lk < rk:
-                lk, lv = nxt(left)
-            else:
-                rk, rv = nxt(right)
-
-
-def _side(name: str, paths, parallelism: int) -> Vertex:
-    v = Vertex.create(name, ProcessorDescriptor.create(PrepareProcessor),
-                      parallelism)
-    v.add_data_source("input", DataSourceDescriptor.create(
-        InputDescriptor.create("tez_tpu.io.text:TextInput"),
-        InputInitializerDescriptor.create(
-            "tez_tpu.io.text:TextSplitGenerator",
-            payload={"paths": list(paths), "desired_splits": parallelism})))
-    return v
+def build_plan(left_paths, right_paths) -> Table:
+    left = Table.scan("left", list(left_paths), ["word"], mode="words")
+    right = Table.scan("right", list(right_paths), ["word"], mode="words")
+    # semi_distinct: one row per distinct key on both sides — the
+    # lockstep emit-once-per-matching-key the original joiner performed
+    return left.join(right, "word", how="semi_distinct")
 
 
 def build_dag(left_paths, right_paths, output_path: str,
-              num_joiners: int = 2, side_parallelism: int = 2) -> DAG:
-    left = _side("left", left_paths, side_parallelism)
-    right = _side("right", right_paths, side_parallelism)
-    joiner = Vertex.create("joiner", ProcessorDescriptor.create(
-        SortMergeJoinProcessor), num_joiners)
-    joiner.add_data_sink("output", DataSinkDescriptor.create(
-        OutputDescriptor.create("tez_tpu.io.file_output:FileOutput",
-                                payload={"path": output_path,
-                                         "key_serde": "text",
-                                         "value_serde": "text"}),
-        OutputCommitterDescriptor.create(
-            "tez_tpu.io.file_output:FileOutputCommitter",
-            payload={"path": output_path})))
-    edge = OrderedPartitionedKVEdgeConfig.new_builder("bytes", "bytes")
-    dag = DAG.create("SortMergeJoin")
-    for v in (left, right, joiner):
-        dag.add_vertex(v)
-    dag.add_edge(Edge.create(left, joiner,
-                             edge.build().create_default_edge_property()))
-    dag.add_edge(Edge.create(right, joiner,
-                             edge.build().create_default_edge_property()))
-    return dag
+              num_joiners: int = 2, side_parallelism: int = 2, conf=None):
+    merged = {"tez.query.reducers": num_joiners,
+              "tez.query.scan.splits": side_parallelism, **(conf or {})}
+    planned = plan_query(build_plan(left_paths, right_paths), merged,
+                         output_path, dag_name="SortMergeJoin",
+                         sink={"key_col": "word", "literal": "1"})
+    return planned.dag
 
 
 def run(left_paths, right_paths, output_path: str, conf=None, **kw) -> str:
     with TezClient.create("SortMergeJoin", conf or {}) as client:
-        status = client.submit_dag(build_dag(
-            left_paths, right_paths, output_path, **kw)).wait_for_completion()
+        dag = build_dag(left_paths, right_paths, output_path,
+                        conf=conf, **kw)
+        status = client.submit_dag(dag).wait_for_completion()
         return status.state.name
 
 

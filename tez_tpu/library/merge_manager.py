@@ -302,6 +302,26 @@ class ShuffleMergeManager:
         if self._poisoned is not None:
             raise RuntimeError(f"shuffle merge state lost: {self._poisoned}")
 
+    def quiesce(self, timeout: Optional[float] = None) -> bool:
+        """Block until the background merger has nothing runnable and
+        nothing in flight (or the manager broke/closed).  Every state
+        transition toward idle already notifies the manager Condition,
+        so this is a real CV wait, not a poll — tests and drain paths
+        that previously slept on private counters use this instead.
+        Returns False only on timeout."""
+        def _idle() -> bool:
+            if self._closed or self._error is not None or \
+                    self._poisoned is not None:
+                return True
+            if self._merging or self._disk_claim is not None:
+                return False
+            # sync disk cascades claim in place (the run list keeps the
+            # merging prefix until the replace), so "due" covers them
+            return not self._mem_merge_due() and \
+                not self._disk_merge_due_locked()
+        with self.lock:
+            return bool(self.lock.wait_for(_idle, timeout))
+
     # ------------------------------------------------------- background merge
     def _wake_threshold(self) -> float:
         """Fraction of the budget at which a commit wakes the merger: the

@@ -1,0 +1,16 @@
+"""Relational query layer: logical plans compiled onto the DAG substrate.
+
+Tez exists to be compiled onto by higher engines (Hive/Pig — SURVEY
+§"What Tez is"); this package is that engine in miniature.  A
+dataframe-ish builder (:mod:`tez_tpu.query.logical`) produces logical
+plans; the planner (:mod:`tez_tpu.query.planner`) lowers them to DAGs
+over the existing library edges, choosing the physical join strategy
+from partition stats; :mod:`tez_tpu.query.session` runs them through a
+resident TezClient session with lineage/result-cache reuse and feeds
+observed run telemetry back into :mod:`tez_tpu.query.feedback` for
+adaptive re-optimization (docs/query.md).
+"""
+from tez_tpu.query.logical import Table  # noqa: F401
+from tez_tpu.query.planner import plan_query  # noqa: F401
+from tez_tpu.query.feedback import PlanFeedback  # noqa: F401
+from tez_tpu.query.session import QuerySession  # noqa: F401

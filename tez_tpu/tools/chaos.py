@@ -1490,6 +1490,107 @@ def run_slo_burn(seed: int, workdir: str, timeout: float = 120.0
                   f"{acct.get('series', 0)} series at stop")
 
 
+# ------------------------------------------------------------ query storm
+
+#: Recoverable per-query faults for the query storm: every kill stays
+#: inside the retry envelope (max.failed.attempts=4), so a wrong or
+#: missing row is always a query-engine bug, never storm overreach.
+QUERY_STORM_MENU = (
+    "task.run:fail:n=1,exc=runtime",
+    "task.run:fail:n=2,exc=runtime",
+    "shuffle.fetch.read:fail:n=1,exc=io",
+)
+
+
+def run_query_storm(seed: int, workdir: str,
+                    timeout: float = 120.0) -> Tuple[bool, str]:
+    """Corpus queries under seeded task kills with the result cache on.
+    Returns (ok, detail).
+
+    One resident QuerySession (store enabled, so the PR-7 sealed-lineage
+    store serves the PR-11 governed result cache) runs the whole
+    tools/query_corpus.py suite twice — even seeds on the uniform
+    corpus, odd on the Zipf-skewed one — with every DAG carrying a
+    seeded recoverable task/fetch kill and an alternating tenant tag.
+    Replanning is pinned off so both rounds lower to byte-identical
+    vertices: round 2 must be served partly from sealed lineage.  The
+    contract under all of that:
+
+    - every query completes and its output is bit-exact vs the numpy
+      oracle (the kill storm may cost retries, never rows);
+    - the storm actually killed something: at least one FAILED task
+      attempt in the session AM's journal;
+    - round 2 hit the sealed-lineage result cache at least once —
+      cached reruns must be exactly as correct as computed ones.
+    """
+    from tez_tpu.am.history import HistoryEventType
+    from tez_tpu.query import QuerySession
+    from tez_tpu.store import reset_store
+    from tez_tpu.tools.query_corpus import CORPUS_QUERIES, generate
+
+    reset_store()
+    faults.clear_all()
+    storm_dir = os.path.join(workdir, f"querystorm{seed}")
+    skew = 0.0 if seed % 2 == 0 else 1.1
+    corpus = generate(os.path.join(storm_dir, "data"), scale=0.3,
+                      skew=skew, seed=seed)
+    session_conf = {
+        "tez.staging-dir": os.path.join(storm_dir, "staging"),
+        "tez.am.local.num-containers": 4,
+        "tez.am.task.max.failed.attempts": 4,
+        "tez.runtime.store.enabled": True,
+        # stable plans across rounds: the replan path has its own test
+        # (tests/test_query.py); here round 2 must re-lower byte-
+        # identically so sealed lineage can serve it
+        "tez.query.replan.enabled": False,
+    }
+    tenants = ("tenant0", "tenant1")
+    cache_hits = 0
+    try:
+        with QuerySession(f"querystorm{seed}", session_conf) as session:
+            for rnd in (0, 1):
+                for i, q in enumerate(CORPUS_QUERIES):
+                    spec = QUERY_STORM_MENU[(seed + i)
+                                            % len(QUERY_STORM_MENU)]
+                    out = os.path.join(storm_dir,
+                                       f"out_r{rnd}_{q.name}")
+                    res = session.run(
+                        q.build(corpus), out, query_name=q.name,
+                        sink=q.sink, timeout=timeout,
+                        dag_conf={"tez.test.fault.spec": spec,
+                                  "tez.test.fault.seed": seed + i,
+                                  "tez.dag.tenant": tenants[i % 2]})
+                    if res.state != "SUCCEEDED":
+                        return False, (f"round {rnd} {q.name} "
+                                       f"state={res.state} under {spec}")
+                    got, want = res.read_output(), q.oracle(corpus)
+                    if got != want:
+                        return False, (f"round {rnd} {q.name} diverged "
+                                       f"under {spec}: {len(got)} rows "
+                                       f"vs oracle {len(want)}")
+                    if rnd == 1:
+                        cache_hits += res.cache_hits
+            am = session._am
+            events = list(getattr(getattr(am, "logging_service", None),
+                                  "events", []) or [])
+    finally:
+        faults.clear_all()
+    killed = sum(
+        1 for ev in events
+        if ev.event_type is HistoryEventType.TASK_ATTEMPT_FINISHED and
+        (ev.data or {}).get("state") == "FAILED")
+    if killed == 0:
+        return False, "storm never killed a task attempt"
+    if cache_hits == 0:
+        return False, ("round 2 never hit the sealed-lineage result "
+                       "cache — content-addressed reuse is broken")
+    queries = len(CORPUS_QUERIES)
+    return True, (f"{2 * queries} query runs bit-exact on the "
+                  f"{'zipf' if skew else 'uniform'} corpus; "
+                  f"{killed} attempt(s) killed, round 2 served "
+                  f"{cache_hits} lineage hit(s) from the result cache")
+
+
 def run_device_ooo(seed: int, spans: int = 4,
                    records: int = 1500) -> Tuple[bool, str]:
     """Out-of-order device-completion scenario: the async double-buffered
@@ -2035,6 +2136,15 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--p95-bound", type=float, default=30.0,
                     help="per-tenant p95 completion-latency bound in "
                          "seconds for --tenant-storm (default 30)")
+    ap.add_argument("--query-storm", action="store_true",
+                    help="run the query-engine kill scenario: the whole "
+                         "tools/query_corpus.py suite twice through one "
+                         "resident QuerySession (result cache on) with "
+                         "every DAG carrying a seeded recoverable "
+                         "task/fetch kill and a tenant tag — all outputs "
+                         "bit-exact vs the numpy oracle, at least one "
+                         "attempt actually killed, and round 2 served "
+                         "partly from the sealed-lineage result cache")
     ap.add_argument("--am-kill", action="store_true",
                     help="run the AM crash-survival scenario: SIGKILL the "
                          "session AM with one DAG mid-run and two parked "
@@ -2153,6 +2263,23 @@ def _dispatch(argv: Optional[List[str]] = None) -> int:
                     failures += 1
                     print(f"REPRO: python -m tez_tpu.tools.chaos "
                           f"--tenant-storm --seed {seed}")
+        finally:
+            if cleanup:
+                shutil.rmtree(workdir, ignore_errors=True)
+        return 1 if failures else 0
+    if args.query_storm:
+        failures = 0
+        try:
+            for seed in range(args.seed, args.seed + args.trials):
+                ok, detail = run_query_storm(seed, workdir,
+                                             timeout=args.timeout)
+                print(("ok   " if ok else "FAIL ") +
+                      f"query-storm seed={seed}: {detail}")
+                _flight_dump_scenario("query-storm", seed, ok)
+                if not ok:
+                    failures += 1
+                    print(f"REPRO: python -m tez_tpu.tools.chaos "
+                          f"--query-storm --seed {seed}")
         finally:
             if cleanup:
                 shutil.rmtree(workdir, ignore_errors=True)

@@ -256,6 +256,43 @@ def telemetry_summary(dags: Dict) -> Dict[str, int]:
     }
 
 
+def query_summary(dags: Dict) -> Dict[str, int]:
+    """Session query-plane roll-up off the planner's journal stream
+    (tez_tpu/query/session.py): ``{"plans", "cache_hits", "replans"}``.
+    ``plans`` counts QUERY_SUBMITTED records, ``cache_hits`` sums their
+    sealed-lineage result-cache deltas, ``replans`` counts the typed
+    QUERY_REPLANNED decisions."""
+    events: List[Dict] = []
+    for d in dags.values():
+        events = getattr(d, "query_events", None) or events
+    submitted = [e for e in events if e["event"] == "SUBMITTED"]
+    return {
+        "plans": len(submitted),
+        "cache_hits": sum(int(e.get("cache_hits", 0)) for e in submitted),
+        "replans": sum(1 for e in events if e["event"] == "REPLANNED"),
+    }
+
+
+def diff_query(dags_a: Dict, dags_b: Dict
+               ) -> List[Tuple[str, int, int, bool]]:
+    """[(name, a, b, regressed)] for the query-plane section: plan count
+    is workload-shaped and cache hits are efficiency (more is better) —
+    both unflagged; replan growth IS flagged: a replan means the static
+    planner mis-sized an exchange badly enough to pay a whole observe-
+    and-rerun cycle, so more of them against the same workload means the
+    estimator (or the feedback loop's stability) regressed."""
+    sa, sb = query_summary(dags_a), query_summary(dags_b)
+    if not (sa["plans"] or sb["plans"]):
+        return []
+    return [
+        ("query.plans", sa["plans"], sb["plans"], False),
+        ("query.result_cache.hits", sa["cache_hits"], sb["cache_hits"],
+         False),
+        ("query.replans", sa["replans"], sb["replans"],
+         sb["replans"] > sa["replans"]),
+    ]
+
+
 def diff_telemetry(dags_a: Dict, dags_b: Dict
                    ) -> List[Tuple[str, int, int, bool]]:
     """[(name, a, b, regressed)] for the telemetry-plane section: ring
@@ -589,6 +626,14 @@ def main() -> int:
             flag = "  << REGRESSION" if regressed else ""
             print(f"{name:60} {va:14d} {vb:14d}{flag}")
             regressions += int(regressed)
+    query = diff_query(sessions[0], sessions[1])
+    if query:
+        print(f"\n{'query plane (plans/cache hits/replans)':60} "
+              f"{'A':>14} {'B':>14}")
+        for name, va, vb, regressed in query:
+            flag = "  << REGRESSION" if regressed else ""
+            print(f"{name:60} {va:14d} {vb:14d}{flag}")
+            regressions += int(regressed)
     print(f"\nA: {a.dag_id} ({a.state}, {a.duration:.2f}s)  "
           f"B: {b.dag_id} ({b.state}, {b.duration:.2f}s)  "
           f"wall delta {b.duration - a.duration:+.2f}s")
@@ -598,8 +643,9 @@ def main() -> int:
               f"store eviction/demotion churn growth, exchange "
               f"round/split growth, tenant shed/failure growth, "
               f"stream replay/abort/lag growth, "
-              f"recovery requeue/fence/failover growth, or telemetry "
-              f"ring-eviction/collector/scrape-error growth)")
+              f"recovery requeue/fence/failover growth, telemetry "
+              f"ring-eviction/collector/scrape-error growth, or query "
+              f"replan growth)")
     return 0
 
 

@@ -480,6 +480,21 @@ def diagnose(dag: Any, snaps: List[Any],
         verdict += (f"; AM restarted inside the window (attempt "
                     f"{in_window[-1]['attempt']}) — recovery replay, "
                     f"not a data-plane stall")
+    # query plane (tez_tpu/query/): SUBMITTED entries whose dag_id names
+    # THIS dag, plus the REPLANNED decisions for those queries (replans
+    # are journaled just before the re-optimized run is submitted)
+    q_events = getattr(dag, "query_events", None) or []
+    q_submitted = [e for e in q_events
+                   if e.get("event") == "SUBMITTED"
+                   and e.get("dag_id") == dag.dag_id]
+    q_names = {e["query"] for e in q_submitted}
+    q_replans = [e for e in q_events
+                 if e.get("event") == "REPLANNED"
+                 and e.get("query") in q_names]
+    if q_replans:
+        r = q_replans[-1]
+        verdict += (f"; query '{r['query']}' was re-optimized before this "
+                    f"run ({r['kind']}: {r['from']} -> {r['to']})")
     if slo_breaches:
         verdict += f"; {len(slo_breaches)} SLO breach(es) on record"
     joined_alerts = join_burn_alerts(burn_alerts or [], slo_breaches)
@@ -511,6 +526,7 @@ def diagnose(dag: Any, snaps: List[Any],
         "slo_breaches": slo_breaches,
         "slo_burn_alerts": joined_alerts,
         "am_restarts": in_window,
+        "query": {"submitted": q_submitted, "replans": q_replans},
         "verdict": verdict,
         "sources": {
             "flight_dumps": len(snaps),
@@ -579,6 +595,24 @@ def render_text(rep: Dict[str, Any]) -> str:
             L.append(f"  {where} {a.get('kind', '?')} observed="
                      f"{a.get('observed', '?')} target="
                      f"{a.get('target', '?')} — {fate}")
+    q = rep.get("query") or {}
+    if q.get("submitted") or q.get("replans"):
+        L.append("")
+        L.append("query plane (logical plans behind this dag):")
+        for e in q.get("submitted", []):
+            strat = ", ".join(f"{fp[:8]}={s}"
+                              for fp, s in sorted(
+                                  (e.get("strategies") or {}).items()))
+            L.append(f"  plan '{e['query']}' fp={e['fingerprint'][:12]} "
+                     f"wall={e['wall_s']:.3f}s cache_hits="
+                     f"{e['cache_hits']} replans={e['replans']}"
+                     + (f" blamed={e['blamed']}" if e.get("blamed")
+                        else "")
+                     + (f"  [{strat}]" if strat else ""))
+        for r in q.get("replans", []):
+            L.append(f"  REPLANNED '{r['query']}' {r['operator']} "
+                     f"({r['kind']}): {r['from']} -> {r['to']} — "
+                     f"{r['detail']}")
     if rep["slo_breaches"]:
         L.append("")
         L.append("slo breaches:")

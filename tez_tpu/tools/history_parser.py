@@ -113,6 +113,13 @@ class DagInfo:
     # "series", "evicted", "collector_errors", "scrape_errors", "ticks",
     # "time"} — session-scoped, attached to every dag
     telemetry_events: List[Dict] = dataclasses.field(default_factory=list)
+    # session query-plan stream (tez_tpu/query/, docs/query.md):
+    # SUBMITTED entries {"event", "query", "fingerprint", "dag_id",
+    # "strategies", "cache_hits", "replans", "blamed", "wall_s", "time"}
+    # and REPLANNED entries {"event", "query", "node", "operator",
+    # "kind", "from", "to", "detail", "time"} — session-scoped, attached
+    # to every dag
+    query_events: List[Dict] = dataclasses.field(default_factory=list)
 
     @property
     def duration(self) -> float:
@@ -138,6 +145,7 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
     recovery_events: List[Dict] = []
     stream_events: List[Dict] = []
     telemetry_events: List[Dict] = []
+    query_events: List[Dict] = []
     _streaming = {
         HistoryEventType.STREAM_OPENED: "OPENED",
         HistoryEventType.STREAM_RETIRED: "RETIRED",
@@ -210,6 +218,33 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
                 "stream": ev.data.get("stream", ""),
                 "observed": ev.data.get("observed", 0.0),
                 "target": ev.data.get("target", 0.0),
+                "time": ev.timestamp})
+            continue
+        if t is HistoryEventType.QUERY_SUBMITTED:
+            # session-scoped planner record; the dag_id names the lowered
+            # DAG (whose own lifecycle events build its DagInfo)
+            query_events.append({
+                "event": "SUBMITTED",
+                "query": ev.data.get("query", ""),
+                "fingerprint": ev.data.get("fingerprint", ""),
+                "dag_id": ev.dag_id or "",
+                "strategies": ev.data.get("strategies", {}),
+                "cache_hits": ev.data.get("cache_hits", 0),
+                "replans": ev.data.get("replans", 0),
+                "blamed": ev.data.get("blamed", ""),
+                "wall_s": ev.data.get("wall_s", 0.0),
+                "time": ev.timestamp})
+            continue
+        if t is HistoryEventType.QUERY_REPLANNED:
+            query_events.append({
+                "event": "REPLANNED",
+                "query": ev.data.get("query", ""),
+                "node": ev.data.get("node", ""),
+                "operator": ev.data.get("operator", ""),
+                "kind": ev.data.get("kind", ""),
+                "from": ev.data.get("from", ""),
+                "to": ev.data.get("to", ""),
+                "detail": ev.data.get("detail", ""),
                 "time": ev.timestamp})
             continue
         if t is HistoryEventType.TELEMETRY_SNAPSHOT:
@@ -316,6 +351,7 @@ def parse_history_events(events: List[HistoryEvent]) -> Dict[str, DagInfo]:
         d.recovery_events = recovery_events
         d.stream_events = stream_events
         d.telemetry_events = telemetry_events
+        d.query_events = query_events
     return dags
 
 
